@@ -1,0 +1,44 @@
+"""hmsc_tpu — a TPU-native framework for Hierarchical Modelling of Species
+Communities (Bayesian joint species distribution models).
+
+A ground-up JAX/XLA re-architecture of the capability set of the HMSC R
+package (reference surveyed in SURVEY.md): blocked Gibbs sampling of latent
+Gaussian JSDMs with traits, phylogeny, adaptive latent factors, spatial random
+levels (Full GP / GPP / NNGP), mixed observation models, variable selection
+and reduced-rank regression — with chains vmapped over a device mesh and all
+hot updates as batched, jit-compiled array programs.
+"""
+
+from .model import Hmsc, XSelect, set_priors
+from .random_level import HmscRandomLevel, set_priors_random_level
+from .precompute import (compute_data_parameters, compute_initial_parameters,
+                         construct_knots)
+from .mcmc.sampler import sample_mcmc
+from .post import (Posterior, pool_mcmc_chains, compute_associations,
+                   convert_to_coda_object, effective_size, gelman_rhat,
+                   align_posterior)
+
+# reference-style camelCase aliases
+sampleMcmc = sample_mcmc
+setPriors = set_priors
+computeDataParameters = compute_data_parameters
+computeInitialParameters = compute_initial_parameters
+constructKnots = construct_knots
+poolMcmcChains = pool_mcmc_chains
+computeAssociations = compute_associations
+convertToCodaObject = convert_to_coda_object
+alignPosterior = align_posterior
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Hmsc", "HmscRandomLevel", "XSelect", "set_priors",
+    "set_priors_random_level", "compute_data_parameters",
+    "compute_initial_parameters", "construct_knots", "sample_mcmc",
+    "Posterior", "pool_mcmc_chains", "compute_associations",
+    "convert_to_coda_object", "effective_size", "gelman_rhat",
+    "align_posterior",
+    "sampleMcmc", "setPriors", "computeDataParameters",
+    "computeInitialParameters", "constructKnots", "poolMcmcChains",
+    "computeAssociations", "convertToCodaObject", "alignPosterior",
+]

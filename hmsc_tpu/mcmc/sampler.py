@@ -1,0 +1,117 @@
+"""Posterior sampling driver (reference ``R/sampleMcmc.R:68-380``).
+
+TPU execution model (SURVEY.md §2.3 "Parallelism"):
+
+- one jitted sweep per model config, ``lax.scan`` over iterations with
+  strided sample recording (transient / thin handled inside the scan);
+- independent chains are a leading batch axis via ``vmap``;
+- multi-device: the chain axis (and optionally the species axis) is laid out
+  over a ``jax.sharding.Mesh`` — XLA inserts the (trivial, gather-only)
+  collectives; there is no inter-chain communication during sampling.
+
+The reference's SOCK-cluster process fan-out collapses into this one
+compiled program.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..model import Hmsc
+from ..precompute import compute_data_parameters
+from .structs import (DEFAULT_NF_CAP, build_model_data, build_spec, build_state)
+from .sweep import make_sweep, record_sample
+from . import updaters as U
+
+__all__ = ["sample_mcmc"]
+
+
+def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
+                n_chains: int = 1, seed: int | None = None, init_par=None,
+                adapt_nf=None, updater: dict | None = None,
+                nf_cap: int = DEFAULT_NF_CAP, dtype=jnp.float32,
+                data_par=None, from_prior: bool = False,
+                align_post: bool = True, mesh=None, chain_axis: str = "chains",
+                return_state: bool = False):
+    """Run the blocked Gibbs sampler; returns a :class:`~hmsc_tpu.post.Posterior`.
+
+    Arguments mirror the reference's ``sampleMcmc`` (samples/transient/thin/
+    nChains/initPar/adaptNf/updater/dataParList/fromPrior/alignPost); the
+    process-parallel ``nParallel`` is replaced by device parallelism via
+    ``mesh``.
+    """
+    from ..post.posterior import Posterior
+
+    if adapt_nf is None:
+        adapt_nf = tuple(transient for _ in range(hM.nr))
+    else:
+        adapt_nf = tuple(int(a) for a in np.broadcast_to(adapt_nf, (hM.nr,)))
+    if any(a > transient for a in adapt_nf):
+        raise ValueError("transient parameter should be no less than any element of adaptNf parameter")
+
+    spec = build_spec(hM, nf_cap)
+    if data_par is None:
+        data_par = compute_data_parameters(hM)
+    data = build_model_data(hM, data_par, spec, dtype=dtype)
+
+    rng = np.random.default_rng(seed)
+    chain_seeds = rng.integers(0, 2**31 - 1, size=n_chains)
+
+    if from_prior:
+        from .prior import sample_prior_chains
+        post = sample_prior_chains(hM, spec, data_par, samples, n_chains, rng)
+        return Posterior(hM, spec, post, samples=samples, transient=transient,
+                         thin=thin)
+
+    states = [build_state(hM, spec, int(s), init_par, dtype=dtype)
+              for s in chain_seeds]
+    state0 = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.asarray(chain_seeds))
+
+    sweep = make_sweep(spec, updater, adapt_nf)
+
+    def run_chain(state, key):
+        key, k0 = jax.random.split(key)
+        state = U.update_z(spec, data, state, k0)   # reference inits Z via one updateZ pass
+
+        def one_iter(carry, _):
+            state, key = carry
+            key, sub = jax.random.split(key)
+            state = sweep(data, state, sub)
+            return (state, key), None
+
+        carry = (state, key)
+        if transient > 0:
+            carry, _ = jax.lax.scan(one_iter, carry, None, length=transient)
+
+        def sample_step(carry, _):
+            carry, _ = jax.lax.scan(one_iter, carry, None, length=thin)
+            rec = record_sample(spec, data, carry[0])
+            return carry, rec
+
+        carry, recs = jax.lax.scan(sample_step, carry, None, length=samples)
+        return recs, carry[0]
+
+    fn = jax.vmap(run_chain)
+    if mesh is not None:
+        # shard the chain batch axis over the mesh; everything else replicates
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sh = NamedSharding(mesh, P(chain_axis))
+        state0 = jax.tree.map(lambda x: jax.device_put(x, sh), state0)
+        keys = jax.device_put(keys, sh)
+    fn = jax.jit(fn)
+
+    recs, final_state = fn(state0, keys)
+    recs = jax.tree.map(np.asarray, recs)        # (chains, samples, ...)
+
+    post = Posterior(hM, spec, recs, samples=samples, transient=transient,
+                     thin=thin)
+    if align_post and spec.nr > 0:
+        from ..post.align import align_posterior
+        for _ in range(5):
+            align_posterior(post)
+    if return_state:
+        return post, final_state
+    return post

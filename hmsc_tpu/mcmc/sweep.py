@@ -1,0 +1,150 @@
+"""One Gibbs sweep in the reference's fixed update order
+(``R/sampleMcmc.R:219-306``), assembled at trace time from static flags.
+
+The sweep is a pure function ``(data, state, key) -> state`` suitable for
+``lax.scan`` and ``vmap`` over chains.  Updaters can be disabled via the
+``updater`` toggle dict exactly like the reference (``updater$Eta=FALSE`` ->
+``updater={"Eta": False}``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import updaters as U
+from .spatial import update_alpha, update_eta_spatial
+from .structs import GibbsState, ModelData, ModelSpec
+
+__all__ = ["make_sweep", "record_sample"]
+
+
+def make_sweep(spec: ModelSpec, updater: dict | None = None,
+               adapt_nf: tuple | None = None):
+    updater = updater or {}
+    on = lambda name: updater.get(name, True) is not False
+    adapt_nf = adapt_nf or tuple(0 for _ in range(spec.nr))
+
+    def sweep(data: ModelData, state: GibbsState, key) -> GibbsState:
+        state = state.replace(it=state.it + 1)
+        ks = jax.random.split(key, 8)
+
+        if on("BetaLambda"):
+            state = U.update_beta_lambda(spec, data, state, ks[0])
+        if on("GammaV"):
+            state = U.update_gamma_v(spec, data, state, ks[1])
+        if spec.has_phylo and on("Rho"):
+            state = U.update_rho(spec, data, state, ks[2])
+        if on("LambdaPriors"):
+            state = U.update_lambda_priors(spec, data, state, ks[3])
+
+        if on("Eta") and spec.nr > 0:
+            LFix = U.linear_fixed(spec, data, state.Beta)
+            LRan = [U.level_loading(data.levels[r], state.levels[r])
+                    for r in range(spec.nr)]
+            for r in range(spec.nr):
+                S = state.Z - LFix
+                for q in range(spec.nr):
+                    if q != r:
+                        S = S - LRan[q]
+                kr = jax.random.fold_in(ks[4], r)
+                if spec.levels[r].spatial is None:
+                    lv = U.update_eta_nonspatial(spec, data, state, r, kr, S)
+                else:
+                    lv = update_eta_spatial(spec, data, state, r, kr, S)
+                levels = list(state.levels)
+                levels[r] = lv
+                state = state.replace(levels=tuple(levels))
+                LRan[r] = U.level_loading(data.levels[r], state.levels[r])
+
+        if on("Alpha"):
+            for r in range(spec.nr):
+                if spec.levels[r].spatial is not None:
+                    lv = update_alpha(spec, data, state, r,
+                                      jax.random.fold_in(ks[5], r))
+                    levels = list(state.levels)
+                    levels[r] = lv
+                    state = state.replace(levels=tuple(levels))
+
+        if on("InvSigma"):
+            state = U.update_inv_sigma(spec, data, state, ks[6])
+        if on("Z"):
+            state = U.update_z(spec, data, state, ks[7])
+
+        # factor-count adaptation during burn-in (iter <= adaptNf[r])
+        for r in range(spec.nr):
+            if adapt_nf[r] > 0 and on("Nf"):
+                kr = jax.random.fold_in(ks[5], 1000 + r)
+                lv_new = U.update_nf(spec, data, state, r, kr)
+                gate = (state.it <= adapt_nf[r])
+                lv_old = state.levels[r]
+                lv = jax.tree.map(
+                    lambda a, b: jnp.where(gate, a, b), lv_new, lv_old)
+                levels = list(state.levels)
+                levels[r] = lv
+                state = state.replace(levels=tuple(levels))
+        return state
+
+    return sweep
+
+
+# ---------------------------------------------------------------------------
+# combineParameters at record time (reference R/combineParameters.R:1-58)
+# ---------------------------------------------------------------------------
+
+def record_sample(spec: ModelSpec, data: ModelData, state: GibbsState) -> dict:
+    """Back-transform the current state to the original X/Tr scale and return
+    the posterior-sample pytree (the postList schema, SURVEY.md §2.2)."""
+    Beta = state.Beta
+    Gamma = state.Gamma
+    iV = state.iV
+
+    # traits: Gamma columns back to raw-trait scale
+    tm, ts = data.tr_scale_par[0], data.tr_scale_par[1]
+    Gamma = Gamma / ts[None, :]
+    if data.tr_intercept_ind is not None:
+        corr = (tm[None, :] * Gamma).sum(axis=1) - tm[data.tr_intercept_ind] * Gamma[:, data.tr_intercept_ind]
+        Gamma = Gamma.at[:, data.tr_intercept_ind].add(-corr)
+
+    # covariates: Beta/Gamma rows and iV rows+cols
+    xm = data.x_scale_par[0], data.x_scale_par[1]
+    xmean, xs = xm
+    ncn = spec.nc_nrrr
+    scale_rows = jnp.concatenate(
+        [xs, jnp.ones(spec.nc - ncn, dtype=xs.dtype)]) if spec.nc > ncn else xs
+    mean_rows = jnp.concatenate(
+        [xmean, jnp.zeros(spec.nc - ncn, dtype=xmean.dtype)]) if spec.nc > ncn else xmean
+    if spec.nc_rrr > 0 and data.xrrr_scale_par is not None:
+        pass  # XRRR back-transform handled with the wRRR extras (P7)
+    Beta = Beta / scale_rows[:, None]
+    Gamma = Gamma / scale_rows[:, None]
+    if data.x_intercept_ind is not None:
+        ii = data.x_intercept_ind
+        corrB = (mean_rows[:, None] * Beta).sum(axis=0) - mean_rows[ii] * Beta[ii]
+        corrG = (mean_rows[:, None] * Gamma).sum(axis=0) - mean_rows[ii] * Gamma[ii]
+        Beta = Beta.at[ii].add(-corrB)
+        Gamma = Gamma.at[ii].add(-corrG)
+    iV_t = iV * scale_rows[:, None] * scale_rows[None, :]
+    V = jnp.linalg.inv(iV_t)
+
+    rec = {
+        "Beta": Beta,
+        "Gamma": Gamma,
+        "V": V,
+        "sigma": 1.0 / state.iSigma,
+        "rho": (data.rhopw[state.rho_idx, 0] if spec.has_phylo
+                else jnp.zeros((), dtype=Beta.dtype)),
+    }
+    for r in range(spec.nr):
+        lv = state.levels[r]
+        rec[f"Eta_{r}"] = lv.Eta
+        rec[f"Lambda_{r}"] = U.lambda_effective(lv)
+        rec[f"Psi_{r}"] = lv.Psi
+        rec[f"Delta_{r}"] = lv.Delta
+        rec[f"Alpha_{r}"] = lv.alpha_idx
+        rec[f"nfMask_{r}"] = lv.nf_mask
+    if spec.nc_rrr > 0:
+        rec["wRRR"] = state.wRRR
+        rec["PsiRRR"] = state.PsiRRR
+        rec["DeltaRRR"] = state.DeltaRRR
+    return rec

@@ -1,0 +1,41 @@
+"""Dense linear-algebra helpers for the Gibbs engine.
+
+All solvers are batched-friendly (leading batch axes via vmap) and keep
+everything on the MXU: cholesky + triangular solves, no explicit inverses
+(the reference's ``chol2inv``/``backsolve`` pattern, e.g.
+``R/updateBetaLambda.R:100-103``, maps to ``cho_solve``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.scipy.linalg import cho_solve, solve_triangular
+
+__all__ = ["chol_spd", "solve_from_chol", "sample_mvn_prec"]
+
+# Relative jitter added to diagonals before cholesky; f32 MCMC insurance
+# (design choice documented in SURVEY.md §7 point 6).
+_JITTER = 1e-6
+
+
+def chol_spd(A: jnp.ndarray, jitter: float = _JITTER) -> jnp.ndarray:
+    """Cholesky of a symmetric PD matrix with relative diagonal jitter."""
+    n = A.shape[-1]
+    scale = jnp.mean(jnp.diagonal(A, axis1=-2, axis2=-1), axis=-1)
+    eye = jnp.eye(n, dtype=A.dtype)
+    A = A + (jitter * scale)[..., None, None] * eye
+    return jnp.linalg.cholesky(A)
+
+
+def solve_from_chol(L: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Solve A x = b given L = chol(A) (lower)."""
+    return cho_solve((L, True), b)
+
+
+def sample_mvn_prec(L: jnp.ndarray, rhs: jnp.ndarray, eps: jnp.ndarray) -> jnp.ndarray:
+    """Draw from N(P^{-1} rhs, P^{-1}) given L = chol(P) and eps ~ N(0, I).
+
+    mean = P^{-1} rhs; noise = L^{-T} eps  (cov L^{-T} L^{-1} = P^{-1}).
+    """
+    mean = cho_solve((L, True), rhs)
+    noise = solve_triangular(jnp.swapaxes(L, -1, -2), eps, lower=False)
+    return mean + noise

@@ -1,0 +1,31 @@
+"""Post-hoc factor sign alignment across chains (reference
+``R/alignPosterior.R:18-100``, called 5x after sampling).
+
+Latent factors are identified only up to sign: for each level and factor, every
+sample's (Lambda, Eta) pair is sign-flipped to correlate positively with the
+cross-chain posterior-mean Lambda.  Host-side numpy over the stacked arrays.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["align_posterior"]
+
+
+def align_posterior(post) -> None:
+    for r in range(post.spec.nr):
+        lam = post.arrays[f"Lambda_{r}"]          # (c, s, nf, ns[, ncr])
+        eta = post.arrays[f"Eta_{r}"]             # (c, s, np, nf)
+        lam2 = lam[..., 0] if lam.ndim == 5 else lam
+        mean_lam = lam2.mean(axis=(0, 1))         # (nf, ns)
+        # per-sample correlation sign against the cross-chain mean
+        num = np.einsum("csfj,fj->csf", lam2, mean_lam)
+        sign = np.where(num < 0, -1.0, 1.0)       # (c, s, nf)
+        if lam.ndim == 5:
+            lam *= sign[..., None, None]
+        else:
+            lam *= sign[..., None]
+        eta *= sign[:, :, None, :]
+        post.arrays[f"Lambda_{r}"] = lam
+        post.arrays[f"Eta_{r}"] = eta

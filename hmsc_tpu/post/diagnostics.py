@@ -1,0 +1,125 @@
+"""Convergence diagnostics: effective sample size and split-R-hat, plus the
+coda-style named export (reference delegates to the ``coda`` package via
+``R/convertToCodaObject.r``; we compute ESS/PSRF in-house with the standard
+Geyer initial-monotone-sequence and Gelman-Rubin split-chain estimators)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["effective_size", "gelman_rhat", "convert_to_coda_object"]
+
+
+def _autocov_fft(x: np.ndarray) -> np.ndarray:
+    """Autocovariance per chain along axis 1 via FFT; x (chains, n, ...)."""
+    n = x.shape[1]
+    xc = x - x.mean(axis=1, keepdims=True)
+    nfft = int(2 ** np.ceil(np.log2(2 * n)))
+    f = np.fft.rfft(xc, n=nfft, axis=1)
+    acov = np.fft.irfft(f * np.conj(f), n=nfft, axis=1)[:, :n]
+    return acov / n
+
+
+def effective_size(x: np.ndarray) -> np.ndarray:
+    """ESS over (chains, samples, ...) via Geyer's initial monotone sequence.
+
+    Returns an array of the trailing shape.
+    """
+    x = np.asarray(x, dtype=float)
+    if x.ndim == 1:
+        x = x[None, :]
+    m, n = x.shape[:2]
+    acov = _autocov_fft(x)                       # (m, n, ...)
+    # combine chains (rank-normalised would be arviz-style; plain mean here)
+    var_w = acov[:, 0].mean(axis=0)
+    rho = acov.mean(axis=0) / np.where(var_w == 0, 1.0, var_w)
+    # Geyer: sum consecutive pairs while positive & monotone
+    trail = rho.shape[1:]
+    rho2 = rho.reshape(n, -1)
+    ess = np.empty(rho2.shape[1])
+    for j in range(rho2.shape[1]):
+        t = 1
+        s = 0.0
+        prev = np.inf
+        while t + 1 < n:
+            pair = rho2[t, j] + rho2[t + 1, j]
+            if pair < 0:
+                break
+            pair = min(pair, prev)
+            s += pair
+            prev = pair
+            t += 2
+        ess[j] = m * n / (1.0 + 2.0 * s)
+    return ess.reshape(trail) if trail else float(ess[0])
+
+
+def gelman_rhat(x: np.ndarray) -> np.ndarray:
+    """Split-chain potential scale reduction factor (PSRF)."""
+    x = np.asarray(x, dtype=float)
+    m, n = x.shape[:2]
+    half = n // 2
+    splits = np.concatenate([x[:, :half], x[:, half:2 * half]], axis=0)
+    mm, nn = splits.shape[:2]
+    mean_c = splits.mean(axis=1)
+    var_c = splits.var(axis=1, ddof=1)
+    W = var_c.mean(axis=0)
+    B = nn * mean_c.var(axis=0, ddof=1)
+    var_hat = (nn - 1) / nn * W + B / nn
+    with np.errstate(divide="ignore", invalid="ignore"):
+        rhat = np.sqrt(var_hat / W)
+    return np.where(W > 0, rhat, 1.0)
+
+
+def convert_to_coda_object(post, get_parameters=("Beta", "Gamma", "V", "sigma", "rho")):
+    """Named per-parameter chain arrays with reference-style labels
+    (``B[cov (C1), sp (S1)]``; reference convertToCodaObject.r:119-221).
+
+    Returns {param: (array (chains, samples, k), labels)}; factor-padded
+    parameters are exported at the static nf_max (zero-padded), matching the
+    reference's cross-chain zero-padding behaviour.
+    """
+    hM, spec = post.hM, post.spec
+    out = {}
+    for par in get_parameters:
+        if par not in post.arrays:
+            continue
+        a = post.arrays[par]
+        flat = a.reshape(a.shape[:2] + (-1,))
+        labels = _labels_for(par, hM, a.shape[2:])
+        out[par] = (flat, labels)
+    for r in range(spec.nr):
+        for par in ("Eta", "Lambda", "Alpha", "Psi", "Delta"):
+            key = f"{par}_{r}"
+            a = post.arrays[key]
+            if par == "Alpha":
+                # export as grid values like the reference (:204)
+                vals = hM.ranLevels[r].alphapw[:, 0] if spec.levels[r].spatial else None
+                if vals is not None:
+                    a = np.asarray(vals)[a]
+            flat = a.reshape(a.shape[:2] + (-1,))
+            out[key] = (flat, [f"{par}{r+1}[{i+1}]" for i in range(flat.shape[2])])
+        lam = post.arrays[f"Lambda_{r}"]
+        lam = lam[..., 0] if lam.ndim == 5 else lam
+        om = np.einsum("csfj,csfk->csjk", lam, lam)
+        out[f"Omega_{r}"] = (
+            om.reshape(om.shape[:2] + (-1,)),
+            [f"Omega{r+1}[{hM.sp_names[j]}, {hM.sp_names[k]}]"
+             for j in range(spec.ns) for k in range(spec.ns)])
+    return out
+
+
+def _labels_for(par, hM, shape):
+    if par == "Beta":
+        return [f"B[{c} (C{ci+1}), {s} (S{si+1})]"
+                for ci, c in enumerate(hM.cov_names) for si, s in enumerate(hM.sp_names)]
+    if par == "Gamma":
+        return [f"G[{c} (C{ci+1}), {t} (T{ti+1})]"
+                for ci, c in enumerate(hM.cov_names) for ti, t in enumerate(hM.tr_names)]
+    if par == "V":
+        return [f"V[{a}, {b}]" for a in hM.cov_names for b in hM.cov_names]
+    if par == "sigma":
+        return [f"Sig[{s}]" for s in hM.sp_names]
+    if par == "rho":
+        return ["Rho"]
+    n = int(np.prod(shape)) if shape else 1
+    return [f"{par}[{i+1}]" for i in range(n)]

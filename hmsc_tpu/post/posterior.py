@@ -1,0 +1,128 @@
+"""Posterior container: the recorded sample arrays and the reference's
+postList access patterns (reference ``R/poolMcmcChains.R``,
+``R/getPostEstimate.R``).
+
+Samples live as stacked numpy arrays with leading (chains, samples) axes —
+the TPU-native layout: every summary is one vectorised reduction instead of
+the reference's per-sample R list traversals.  ``post_list()`` materialises
+the reference's list-of-dicts schema for capability parity.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Posterior", "pool_mcmc_chains"]
+
+
+class Posterior:
+    """Recorded posterior for a fitted model.
+
+    ``arrays`` maps parameter name -> (chains, samples, ...) numpy array.
+    Per-level parameters use the ``_{r}`` suffix (Eta_0, Lambda_0, ...);
+    ``nfMask_{r}`` records the active-factor mask per sample (the ragged
+    nf bookkeeping the reference handles by list-shapes).
+    """
+
+    def __init__(self, hM, spec, arrays: dict, samples: int, transient: int,
+                 thin: int):
+        self.hM = hM
+        self.spec = spec
+        self.arrays = {k: np.asarray(v) for k, v in arrays.items()}
+        self.samples = samples
+        self.transient = transient
+        self.thin = thin
+        self.n_chains = next(iter(self.arrays.values())).shape[0] if self.arrays else 0
+
+    # ------------------------------------------------------------------
+    def __getitem__(self, name: str) -> np.ndarray:
+        return self.arrays[name]
+
+    def pooled(self, name: str) -> np.ndarray:
+        """(chains*samples, ...) flattened view (poolMcmcChains)."""
+        a = self.arrays[name]
+        return a.reshape((-1,) + a.shape[2:])
+
+    def post_list(self) -> list[list[dict]]:
+        """The reference's postList[[chain]][[sample]] schema: a dict per
+        recorded draw with the 13 elements of combineParameters
+        (reference combineParameters.R:57)."""
+        out = []
+        nr = self.spec.nr
+        for c in range(self.n_chains):
+            chain = []
+            for s in range(self.arrays["Beta"].shape[1]):
+                d = {
+                    "Beta": self.arrays["Beta"][c, s],
+                    "wRRR": self.arrays["wRRR"][c, s] if "wRRR" in self.arrays else None,
+                    "Gamma": self.arrays["Gamma"][c, s],
+                    "V": self.arrays["V"][c, s],
+                    "rho": float(self.arrays["rho"][c, s]),
+                    "sigma": self.arrays["sigma"][c, s],
+                    "Eta": [self._trim(c, s, r, "Eta") for r in range(nr)],
+                    "Lambda": [self._trim(c, s, r, "Lambda") for r in range(nr)],
+                    "Alpha": [self._trim(c, s, r, "Alpha") for r in range(nr)],
+                    "Psi": [self._trim(c, s, r, "Psi") for r in range(nr)],
+                    "Delta": [self._trim(c, s, r, "Delta") for r in range(nr)],
+                    "PsiRRR": self.arrays["PsiRRR"][c, s] if "PsiRRR" in self.arrays else None,
+                    "DeltaRRR": self.arrays["DeltaRRR"][c, s] if "DeltaRRR" in self.arrays else None,
+                }
+                chain.append(d)
+            out.append(chain)
+        return out
+
+    def _trim(self, c, s, r, what):
+        """Cut a factor-padded array down to its active factors (the
+        reference's ragged nf shapes)."""
+        mask = self.arrays[f"nfMask_{r}"][c, s] > 0
+        a = self.arrays[f"{what}_{r}"][c, s]
+        if what == "Eta":
+            return a[:, mask]
+        if what == "Alpha":
+            return a[mask]
+        if what in ("Lambda", "Psi"):
+            out = a[mask]
+            ls = self.spec.levels[r]
+            return out[:, :, 0] if ls.x_dim == 0 else out
+        if what == "Delta":
+            return a[mask]
+        return a
+
+    # ------------------------------------------------------------------
+    def get_post_estimate(self, par: str, r: int = 0, q=()):
+        """Posterior mean / support / quantiles for a parameter
+        (reference ``R/getPostEstimate.R:32-79``).  Derived parameters
+        ``Omega`` (= Lambda' Lambda per level) and ``OmegaCor`` supported."""
+        a = self._param_array(par, r)
+        out = {
+            "mean": a.mean(axis=0),
+            "support": (a > 0).mean(axis=0),
+            "supportNeg": (a < 0).mean(axis=0),
+        }
+        if len(q):
+            out["q"] = np.quantile(a, q, axis=0)
+        return out
+
+    def _param_array(self, par: str, r: int = 0) -> np.ndarray:
+        """Pooled (draws, ...) array for a named or derived parameter."""
+        if par in ("Omega", "OmegaCor"):
+            lam = self.pooled(f"Lambda_{r}")          # (n, nf, ns, ncr)
+            lam = lam[..., 0] if lam.ndim == 4 else lam
+            om = np.einsum("nfj,nfk->njk", lam, lam)
+            if par == "OmegaCor":
+                d = np.sqrt(np.maximum(np.einsum("njj->nj", om), 1e-12))
+                om = om / d[:, :, None] / d[:, None, :]
+            return om
+        if par in ("Eta", "Lambda", "Psi", "Delta", "Alpha"):
+            return self.pooled(f"{par}_{r}")
+        return self.pooled(par)
+
+
+def pool_mcmc_chains(post: Posterior, start: int = 0, thin: int = 1) -> list[dict]:
+    """Flatten postList[chains][samples] -> a flat list of sample dicts
+    (reference ``R/poolMcmcChains.R:19-27``)."""
+    pl = post.post_list()
+    out = []
+    for chain in pl:
+        out.extend(chain[start::thin])
+    return out

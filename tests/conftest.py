@@ -1,0 +1,23 @@
+"""Test configuration: force an 8-virtual-device CPU platform *before* JAX
+initialises, so sharding/multi-chip paths are exercised without TPU hardware."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def td():
+    from hmsc_tpu.data import make_td
+    return make_td(seed=66)
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(1234)

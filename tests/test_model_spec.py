@@ -1,0 +1,174 @@
+"""Constructor validation and scaling semantics (reference
+``tests/testthat/test-setHmsc.R``, ``test-setRL.R``, ``test-setPriors.R``)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from hmsc_tpu import Hmsc, HmscRandomLevel, set_priors
+from hmsc_tpu.utils.formula import design_matrix
+
+
+def _simple_y(ny=20, ns=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((ny, ns)) > 0).astype(float)
+
+
+class TestConstructorValidation:
+    def test_y_must_be_matrix(self):
+        with pytest.raises(ValueError, match="Y argument must be a matrix"):
+            Hmsc(Y=np.zeros(10))
+
+    def test_x_row_mismatch(self):
+        with pytest.raises(ValueError, match="number of rows in X"):
+            Hmsc(Y=_simple_y(), X=np.ones((7, 2)))
+
+    def test_x_na_rejected(self):
+        X = np.ones((20, 2))
+        X[0, 1] = np.nan
+        with pytest.raises(ValueError, match="X must contain no NA"):
+            Hmsc(Y=_simple_y(), X=X)
+
+    def test_xdata_and_x_exclusive(self):
+        with pytest.raises(ValueError, match="only single of XData and X"):
+            Hmsc(Y=_simple_y(), x_data=pd.DataFrame({"a": np.ones(20)}),
+                 X=np.ones((20, 1)))
+
+    def test_tr_row_mismatch(self):
+        with pytest.raises(ValueError, match="rows in Tr"):
+            Hmsc(Y=_simple_y(), X=np.ones((20, 1)), Tr=np.ones((5, 1)))
+
+    def test_tr_na_rejected(self):
+        Tr = np.ones((3, 2))
+        Tr[1, 1] = np.nan
+        with pytest.raises(ValueError, match="Tr parameter must not contain any NA"):
+            Hmsc(Y=_simple_y(), X=np.ones((20, 1)), Tr=Tr)
+
+    def test_c_shape(self):
+        with pytest.raises(ValueError, match="square matrix C"):
+            Hmsc(Y=_simple_y(), X=np.ones((20, 1)), C=np.eye(5))
+
+    def test_ranlevels_without_design(self):
+        rL = HmscRandomLevel(n_units=20)
+        with pytest.raises(ValueError, match="studyDesign is empty"):
+            Hmsc(Y=_simple_y(), X=np.ones((20, 1)), ran_levels={"u": rL})
+
+    def test_study_design_rows(self):
+        rL = HmscRandomLevel(n_units=5)
+        sd = pd.DataFrame({"u": [str(i) for i in range(5)]})
+        with pytest.raises(ValueError, match="rows in studyDesign"):
+            Hmsc(Y=_simple_y(), X=np.ones((20, 1)), study_design=sd,
+                 ran_levels={"u": rL})
+
+    def test_distr_bad_string(self):
+        with pytest.raises(ValueError, match="distributions ill defined"):
+            Hmsc(Y=_simple_y(), X=np.ones((20, 1)), distr="bernoulli")
+
+    def test_xlist_length(self):
+        with pytest.raises(ValueError, match="length of X list"):
+            Hmsc(Y=_simple_y(ns=3), X=[np.ones((20, 2))] * 2)
+
+
+class TestDistrEncoding:
+    def test_strings(self):
+        m = Hmsc(Y=_simple_y(ns=4), X=np.ones((20, 1)),
+                 distr=["normal", "probit", "poisson", "lognormal poisson"])
+        assert m.distr[:, 0].tolist() == [1, 2, 3, 3]
+        assert m.distr[:, 1].tolist() == [1, 0, 0, 1]
+
+    def test_scalar_broadcast(self):
+        m = Hmsc(Y=_simple_y(), X=np.ones((20, 1)), distr="probit")
+        assert (m.distr[:, 0] == 2).all() and (m.distr[:, 1] == 0).all()
+
+
+class TestScaling:
+    def test_x_scaling_with_intercept(self):
+        rng = np.random.default_rng(3)
+        xd = pd.DataFrame({"a": rng.standard_normal(30) * 4 + 2,
+                           "b": (rng.uniform(size=30) > 0.4).astype(float)})
+        m = Hmsc(Y=_simple_y(ny=30), x_data=xd, x_formula="~a+b")
+        # intercept and binary column untouched, continuous standardised
+        assert m.x_scale_par[0, 0] == 0 and m.x_scale_par[1, 0] == 1
+        a_col = m.cov_names.index("a")
+        assert np.isclose(m.XScaled[:, a_col].mean(), 0, atol=1e-12)
+        assert np.isclose(m.XScaled[:, a_col].std(ddof=1), 1, atol=1e-12)
+        b_col = m.cov_names.index("b")
+        assert np.array_equal(m.XScaled[:, b_col], xd["b"].to_numpy())
+
+    def test_yscale_normal_only(self):
+        rng = np.random.default_rng(4)
+        Y = rng.standard_normal((25, 2)) * 3 + 1
+        m = Hmsc(Y=Y, X=np.ones((25, 1)), distr="normal", y_scale=True)
+        assert np.allclose(m.YScaled.mean(axis=0), 0, atol=1e-12)
+        m2 = Hmsc(Y=_simple_y(25, 2), X=np.ones((25, 1)), distr="probit",
+                  y_scale=True)
+        assert np.array_equal(m2.YScaled, m2.Y)
+
+
+class TestPriorDefaults:
+    def test_defaults(self):
+        m = Hmsc(Y=_simple_y(), X=np.column_stack([np.ones(20), np.arange(20.)]))
+        assert m.V0.shape == (2, 2) and m.f0 == 3
+        assert m.mGamma.shape == (2,)
+        assert m.aSigma.shape == (3,) and m.bSigma[0] == 5.0
+
+    def test_rho_requires_phylo(self):
+        m = Hmsc(Y=_simple_y(), X=np.ones((20, 1)))
+        with pytest.raises(ValueError, match="no phylogenic relationship"):
+            set_priors(m, rhopw=np.ones((5, 2)))
+
+    def test_f0_bound(self):
+        m = Hmsc(Y=_simple_y(), X=np.ones((20, 2)))
+        with pytest.raises(ValueError, match="f0 must be greater"):
+            set_priors(m, f0=1)
+
+
+class TestRandomLevel:
+    def test_needs_argument(self):
+        with pytest.raises(ValueError, match="At least one argument"):
+            HmscRandomLevel()
+
+    def test_sdata_distmat_exclusive(self):
+        with pytest.raises(ValueError, match="cannot both"):
+            HmscRandomLevel(s_data=np.ones((5, 2)), dist_mat=np.eye(5))
+
+    def test_alphapw_grid(self):
+        xy = pd.DataFrame(np.random.default_rng(0).uniform(size=(6, 2)),
+                          index=[f"p{i}" for i in range(6)])
+        rL = HmscRandomLevel(s_data=xy)
+        assert rL.alphapw.shape == (101, 2)
+        assert rL.alphapw[0, 0] == 0 and np.isclose(rL.alphapw[0, 1], 0.5)
+
+    def test_units(self):
+        rL = HmscRandomLevel(units=["a", "b", "a", "c"])
+        assert rL.N == 3
+        assert rL.nf_min == 2 and np.isinf(rL.nf_max)
+
+
+class TestFormula:
+    def test_main_effects_and_interaction(self):
+        df = pd.DataFrame({"a": [1.0, 2, 3, 4], "b": [0.5, 1, 1.5, 2]})
+        X, names = design_matrix("~a*b", df)
+        assert names == ["(Intercept)", "a", "b", "a:b"]
+        assert np.allclose(X[:, 3], df.a * df.b)
+
+    def test_categorical_expansion(self):
+        df = pd.DataFrame({"g": pd.Categorical(["x", "y", "z", "y"])})
+        X, names = design_matrix("~g", df)
+        assert names == ["(Intercept)", "gy", "gz"]
+        assert X[:, 1].tolist() == [0, 1, 0, 1]
+
+    def test_no_intercept(self):
+        df = pd.DataFrame({"a": [1.0, 2, 3]})
+        X, names = design_matrix("~a-1", df)
+        assert names == ["a"]
+
+
+def test_td_fixture_builds(td):
+    m = td["m"]
+    assert m.ny == 50 and m.ns == 4 and m.nr == 2
+    assert m.C is not None and m.nt == 3
+    assert (m.distr[:, 0] == 2).all()
+    assert m.np_[0] == 50 and m.np_[1] == 10
+    # spatial level is the second one
+    assert m.ranLevels[1].spatial_method == "Full"
