@@ -25,14 +25,33 @@ def _spatial_prior_eta(hM, lp, r, alpha_idx, np_r, nf, rng):
             L = np.linalg.cholesky(W + 1e-8 * np.eye(np_r))
             eta[:, h] = L @ rng.standard_normal(np_r)
         return eta
-    # NNGP / GPP: draw from the same kernel on the stored coordinates
-    s = lp.s
-    dd = s[:, None, :] - s[None, :, :]
-    dist = np.sqrt((dd**2).sum(-1))
+    if lp.kind == "NNGP":
+        # sequential Vecchia draw from the *approximate* process the posterior
+        # sampler targets (same nn_coef/nn_D factors), not the exact kernel —
+        # keeps prior<->posterior Geweke checks consistent
+        for h in range(nf):
+            g = alpha_idx[h]
+            if alphas[g] == 0:
+                continue  # W = I: keep the standard-normal column
+            coef, D = lp.nn_coef[g], lp.nn_D[g]
+            col = np.zeros(np_r)  # zeros: padded neighbour slots index 0 before it's written
+            eps = rng.standard_normal(np_r)
+            for i in range(np_r):
+                col[i] = coef[i] @ col[lp.nn_idx[i]] + np.sqrt(D[i]) * eps[i]
+            eta[:, h] = col
+        return eta
+    # GPP: covariance of the predictive process = W12 iW22 W21 + diag(dD),
+    # reconstructed from the stored grids so prior == posterior target
     for h in range(nf):
-        a = alphas[alpha_idx[h]]
-        W = np.eye(np_r) if a == 0 else np.exp(-dist / a)
-        L = np.linalg.cholesky(W + 1e-8 * np.eye(np_r))
+        g = alpha_idx[h]
+        if alphas[g] == 0:
+            continue
+        dD = 1.0 / lp.idDg[g]
+        W12 = lp.idDW12g[g] * dD[:, None]
+        W22 = lp.Fg[g] - W12.T @ (lp.idDg[g][:, None] * W12)
+        cov = W12 @ np.linalg.solve(W22 + 1e-8 * np.eye(W22.shape[0]), W12.T)
+        cov += np.diag(dD)
+        L = np.linalg.cholesky(cov + 1e-8 * np.eye(np_r))
         eta[:, h] = L @ rng.standard_normal(np_r)
     return eta
 
@@ -43,7 +62,9 @@ def sample_prior(hM, spec, data_par, rng: np.random.Generator) -> dict:
     from ..model import FIXED_SIGMA2
 
     nc, nt, ns = hM.nc, hM.nt, hM.ns
-    Gamma = rng.multivariate_normal(hM.mGamma, hM.UGamma).reshape(nc, nt)
+    # column-major vec(Gamma), matching update_gamma_v's convention
+    Gamma = rng.multivariate_normal(hM.mGamma, hM.UGamma).reshape(
+        (nc, nt), order="F")
     V = np.atleast_2d(sps.invwishart.rvs(df=hM.f0, scale=hM.V0, random_state=rng))
 
     est = hM.distr[:, 1] == 1

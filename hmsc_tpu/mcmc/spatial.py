@@ -67,8 +67,10 @@ def update_eta_spatial(spec: ModelSpec, data: ModelData, state: GibbsState,
     big = jnp.zeros((nf, npr, nf, npr), dtype=F.dtype)
     fi = jnp.arange(nf)
     big = big.at[fi, :, fi, :].add(iW)
+    # advanced-index axes move to the front: the indexed view is (np, nf, nf),
+    # exactly LiSL's layout
     ui = jnp.arange(npr)
-    big = big.at[:, ui, :, ui].add(jnp.transpose(LiSL, (1, 0, 2)))
+    big = big.at[:, ui, :, ui].add(LiSL)
     big = big.reshape(nf * npr, nf * npr)
     rhs = F.T.reshape(-1)                         # factor-major vec
     L = chol_spd(big)

@@ -320,10 +320,14 @@ def update_gamma_v(spec: ModelSpec, data: ModelData, state: GibbsState,
     E = state.Beta - state.Gamma @ data.Tr.T
     if spec.has_phylo:
         e = data.Qeig[state.rho_idx]
-        Et = E @ data.U
-        A = (Et / e[None, :]) @ Et.T
-        TrQ = data.U @ (data.UTr / e[:, None])            # iQ Tr (ns, nt)
-        TtQT = data.UTr.T @ (data.UTr / e[:, None])
+        se = jnp.sqrt(e)
+        # sqrt-split the 1/e weights so f32 intermediates stay ~1/sqrt(e_min)
+        # and the Gram products are exactly symmetric PSD
+        Et = (E @ data.U) / se[None, :]
+        A = Et @ Et.T
+        UTs = data.UTr / se[:, None]
+        TrQ = data.U @ (UTs / se[:, None])                # iQ Tr (ns, nt)
+        TtQT = UTs.T @ UTs
     else:
         A = E @ E.T
         TrQ = data.Tr

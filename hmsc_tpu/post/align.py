@@ -22,10 +22,11 @@ def align_posterior(post) -> None:
         # per-sample correlation sign against the cross-chain mean
         num = np.einsum("csfj,fj->csf", lam2, mean_lam)
         sign = np.where(num < 0, -1.0, 1.0)       # (c, s, nf)
+        # arrays may be read-only views of JAX buffers; multiply out-of-place
         if lam.ndim == 5:
-            lam *= sign[..., None, None]
+            lam = lam * sign[..., None, None]
         else:
-            lam *= sign[..., None]
-        eta *= sign[:, :, None, :]
+            lam = lam * sign[..., None]
+        eta = eta * sign[:, :, None, :]
         post.arrays[f"Lambda_{r}"] = lam
         post.arrays[f"Eta_{r}"] = eta

@@ -58,9 +58,14 @@ def compute_data_parameters(hM: Hmsc) -> DataParams:
     if hM.C is not None:
         d, U = np.linalg.eigh(hM.C)
         # clip tiny negative eigenvalues from near-singular trees
-        d = np.clip(d, 1e-10, None)
+        d = np.clip(d, 1e-8, None)
         par.U, par.d = U, d
-        par.Qeig = _rho_eigvals(hM.rhopw[:, 0], d)
+        # Floor the Q(rho) eigenvalues at 1e-4: the engine consumes them as
+        # 1/e in f32 quadratic forms, and for near-singular C only the
+        # rho=1 grid endpoint is affected (min eig = (1-rho) + rho*d_min).
+        # The log-dets are recomputed from the floored values so the rho
+        # grid posterior stays internally consistent (SURVEY.md §7.6).
+        par.Qeig = np.maximum(_rho_eigvals(hM.rhopw[:, 0], d), 1e-4)
         par.logdetQ = np.sum(np.log(par.Qeig), axis=1)
 
     par.rL_par = []
@@ -266,7 +271,10 @@ def compute_initial_parameters(hM: Hmsc, nf_max_static, rng: np.random.Generator
     else:
         Gamma = init_par.get("Gamma")
         if Gamma is None:
-            Gamma = rng.multivariate_normal(hM.mGamma, hM.UGamma).reshape(nc, nt)
+            # column-major vec(Gamma) convention, matching update_gamma_v and
+            # the reference (updateGammaV.R:30-32)
+            Gamma = rng.multivariate_normal(hM.mGamma, hM.UGamma).reshape(
+                (nc, nt), order="F")
         V = init_par.get("V")
         if V is None:
             V = sps.invwishart.rvs(df=hM.f0, scale=hM.V0, random_state=rng)

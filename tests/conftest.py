@@ -1,5 +1,12 @@
 """Test configuration: force an 8-virtual-device CPU platform *before* JAX
-initialises, so sharding/multi-chip paths are exercised without TPU hardware."""
+backends initialise, so sharding/multi-chip paths are exercised without TPU
+hardware.
+
+The environment may inject a TPU PJRT plugin via sitecustomize that overrides
+``JAX_PLATFORMS`` at registration time; setting the config value after import
+(but before backend init) wins over both the env var and that override, and
+keeps the test suite off the (single, serialized) TPU tunnel.
+"""
 
 import os
 
@@ -7,6 +14,10 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,70 @@
+"""Shared builders for the engine tests: small models and their
+(spec, data, state) triples."""
+
+import numpy as np
+import pandas as pd
+
+from hmsc_tpu.model import Hmsc
+from hmsc_tpu.random_level import HmscRandomLevel, set_priors_random_level
+from hmsc_tpu.precompute import compute_data_parameters
+from hmsc_tpu.mcmc.structs import build_model_data, build_spec, build_state
+
+
+def small_model(ny=40, ns=6, nc=2, distr="normal", n_units=8, spatial=None,
+                nf=2, seed=0, with_phylo=False, with_traits=False, nt=2,
+                missing=0.0, n_knots=None, x_dim=0, n_neighbours=5):
+    """A compact Hmsc model with one random level, for updater-level tests."""
+    rng = np.random.default_rng(seed)
+    X = np.column_stack([np.ones(ny), rng.standard_normal((ny, nc - 1))])
+    Y = rng.standard_normal((ny, ns)) + X @ rng.standard_normal((nc, ns))
+    if distr == "probit":
+        Y = (Y > 0).astype(float)
+    elif distr == "poisson":
+        Y = rng.poisson(np.exp(np.clip(Y, -5, 3))).astype(float)
+    if missing > 0:
+        Y = np.where(rng.uniform(size=Y.shape) < missing, np.nan, Y)
+
+    units = [f"u{i:02d}" for i in rng.integers(0, n_units, ny)]
+    # ensure every unit appears
+    for i in range(n_units):
+        units[i % ny] = f"u{i:02d}"
+    study = pd.DataFrame({"lvl": units})
+
+    kw = {}
+    if spatial is not None:
+        xy = rng.uniform(size=(n_units, 2))
+        s_df = pd.DataFrame(xy, index=sorted(set(units)), columns=["x", "y"])
+        kw = dict(s_data=s_df, s_method=spatial)
+        if spatial == "GPP":
+            k = n_knots or 4
+            kw["s_knot"] = rng.uniform(size=(k, 2))
+        if spatial == "NNGP":
+            kw["n_neighbours"] = n_neighbours
+        rl = HmscRandomLevel(**kw)
+    elif x_dim > 0:
+        xd = pd.DataFrame(
+            np.column_stack([np.ones(n_units),
+                             rng.standard_normal((n_units, x_dim - 1))]),
+            index=sorted(set(units)))
+        rl = HmscRandomLevel(x_data=xd)
+    else:
+        rl = HmscRandomLevel(units=study["lvl"])
+    set_priors_random_level(rl, nf_max=nf, nf_min=nf)
+
+    hkw = {}
+    if with_phylo:
+        from hmsc_tpu.data.td import random_coalescent_corr
+        hkw["C"] = random_coalescent_corr(ns, rng)
+    if with_traits:
+        hkw["Tr"] = np.column_stack([np.ones(ns), rng.standard_normal((ns, nt - 1))])
+    m = Hmsc(Y=Y, X=X, distr=distr, study_design=study,
+             ran_levels={"lvl": rl}, **hkw)
+    return m
+
+
+def build_all(m, seed=0, nf_cap=4):
+    spec = build_spec(m, nf_cap)
+    dp = compute_data_parameters(m)
+    data = build_model_data(m, dp, spec)
+    state = build_state(m, spec, seed)
+    return spec, data, state, dp
