@@ -34,7 +34,9 @@ def _spatial_prior_eta(hM, lp, r, alpha_idx, np_r, nf, rng):
             if alphas[g] == 0:
                 continue  # W = I: keep the standard-normal column
             coef, D = lp.nn_coef[g], lp.nn_D[g]
-            col = np.zeros(np_r)  # zeros: padded neighbour slots index 0 before it's written
+            # padded neighbour slots are safe because precompute zeroes their
+            # nn_coef entries (precompute.py pad_mask), not because of init order
+            col = np.zeros(np_r)
             eps = rng.standard_normal(np_r)
             for i in range(np_r):
                 col[i] = coef[i] @ col[lp.nn_idx[i]] + np.sqrt(D[i]) * eps[i]

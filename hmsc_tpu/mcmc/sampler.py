@@ -15,6 +15,8 @@ compiled program.
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -26,6 +28,41 @@ from .sweep import make_sweep, record_sample
 from . import updaters as U
 
 __all__ = ["sample_mcmc"]
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin):
+    """One jitted chain-vmapped sampling program per static config.
+
+    Keyed on the hashable (spec, updater toggles, scan lengths) so repeated
+    ``sample_mcmc`` calls with the same shapes reuse the compiled executable
+    (XLA compilation is the dominant cost for small models)."""
+    updater = dict(updater_items) if updater_items else None
+    sweep = make_sweep(spec, updater, adapt_nf)
+
+    def run_chain(data, state, key):
+        key, k0 = jax.random.split(key)
+        state = U.update_z(spec, data, state, k0)   # reference inits Z via one updateZ pass
+
+        def one_iter(carry, _):
+            state, key = carry
+            key, sub = jax.random.split(key)
+            state = sweep(data, state, sub)
+            return (state, key), None
+
+        carry = (state, key)
+        if transient > 0:
+            carry, _ = jax.lax.scan(one_iter, carry, None, length=transient)
+
+        def sample_step(carry, _):
+            carry, _ = jax.lax.scan(one_iter, carry, None, length=thin)
+            rec = record_sample(spec, data, carry[0])
+            return carry, rec
+
+        carry, recs = jax.lax.scan(sample_step, carry, None, length=samples)
+        return recs, carry[0]
+
+    return jax.jit(jax.vmap(run_chain, in_axes=(None, 0, 0)))
 
 
 def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
@@ -70,40 +107,17 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
     state0 = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
     keys = jax.vmap(jax.random.PRNGKey)(jnp.asarray(chain_seeds))
 
-    sweep = make_sweep(spec, updater, adapt_nf)
-
-    def run_chain(state, key):
-        key, k0 = jax.random.split(key)
-        state = U.update_z(spec, data, state, k0)   # reference inits Z via one updateZ pass
-
-        def one_iter(carry, _):
-            state, key = carry
-            key, sub = jax.random.split(key)
-            state = sweep(data, state, sub)
-            return (state, key), None
-
-        carry = (state, key)
-        if transient > 0:
-            carry, _ = jax.lax.scan(one_iter, carry, None, length=transient)
-
-        def sample_step(carry, _):
-            carry, _ = jax.lax.scan(one_iter, carry, None, length=thin)
-            rec = record_sample(spec, data, carry[0])
-            return carry, rec
-
-        carry, recs = jax.lax.scan(sample_step, carry, None, length=samples)
-        return recs, carry[0]
-
-    fn = jax.vmap(run_chain)
+    updater_items = (tuple(sorted(updater.items())) if updater else None)
+    fn = _compiled_runner(spec, updater_items, adapt_nf,
+                          int(samples), int(transient), int(thin))
     if mesh is not None:
         # shard the chain batch axis over the mesh; everything else replicates
         from jax.sharding import NamedSharding, PartitionSpec as P
         sh = NamedSharding(mesh, P(chain_axis))
         state0 = jax.tree.map(lambda x: jax.device_put(x, sh), state0)
         keys = jax.device_put(keys, sh)
-    fn = jax.jit(fn)
 
-    recs, final_state = fn(state0, keys)
+    recs, final_state = fn(data, state0, keys)
     recs = jax.tree.map(np.asarray, recs)        # (chains, samples, ...)
 
     post = Posterior(hM, spec, recs, samples=samples, transient=transient,

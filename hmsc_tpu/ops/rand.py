@@ -25,7 +25,9 @@ def truncated_normal(key, lower, upper, mean=0.0, std=1.0):
     Numerics: inverse-CDF in the *survival* parameterisation whenever the
     interval sits in the right tail, so one-sided probit truncations stay
     accurate far into the tail in f32 (the naive CDF form saturates at ~5
-    sigma).
+    sigma).  Beyond ~9 sigma even the survival probability underflows f32;
+    there the exact asymptotic draw (X | X > t) = t + Exp(1)/t + O(t^-3)
+    (Robert 1995) takes over, so the op is finite at any truncation.
     """
     shape = jnp.broadcast_shapes(jnp.shape(lower), jnp.shape(upper),
                                  jnp.shape(mean), jnp.shape(std))
@@ -46,7 +48,14 @@ def truncated_normal(key, lower, upper, mean=0.0, std=1.0):
     p = pa + u * (pb - pa)
     x_left = ndtri(jnp.clip(p, _TINY, 1.0))
 
-    x = jnp.where(right, x_right, x_left)
+    # far-tail fallback: past ~9 sigma the interval probability underflows
+    # f32 and ndtri saturates; the exponential asymptotic is exact there
+    FAR = 9.0
+    e1 = -jnp.log(u)
+    x_far_r = a + e1 / jnp.maximum(a, 1.0)
+    x_far_l = b - e1 / jnp.maximum(-b, 1.0)
+    x = jnp.where(right, jnp.where(a > FAR, x_far_r, x_right),
+                  jnp.where(b < -FAR, x_far_l, x_left))
     x = jnp.clip(x, a, b)                  # guard the clipped-quantile edges
     return mean + std * x
 

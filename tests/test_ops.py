@@ -32,6 +32,26 @@ def test_truncated_normal_onesided_moments():
     assert abs(y.mean() - refy.mean()) < 0.05
 
 
+def test_truncated_normal_far_tail():
+    """>9-sigma one-sided truncations hit the exponential asymptotic branch:
+    draws must stay finite with mean excess ~1/t past where f32 ndtr
+    underflows (probit cells with extreme linear predictors)."""
+    key = jax.random.PRNGKey(7)
+    n = 100_000
+    for t in (12.0, 40.0):
+        x = truncated_normal(jax.random.fold_in(key, int(t)),
+                             jnp.full(n, t), jnp.full(n, jnp.inf), 0.0, 1.0)
+        assert np.all(np.isfinite(np.asarray(x)))
+        assert np.all(np.asarray(x) >= t)
+        assert abs(float(x.mean()) - (t + 1.0 / t)) < 2e-2 * t
+        # mirrored left tail
+        y = truncated_normal(jax.random.fold_in(key, 100 + int(t)),
+                             jnp.full(n, -jnp.inf), jnp.full(n, -t), 0.0, 1.0)
+        assert np.all(np.isfinite(np.asarray(y)))
+        assert np.all(np.asarray(y) <= -t)
+        assert abs(float(y.mean()) + (t + 1.0 / t)) < 2e-2 * t
+
+
 def test_truncated_normal_two_sided():
     key = jax.random.PRNGKey(3)
     n = 200_000
