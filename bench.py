@@ -99,7 +99,7 @@ def numpy_reference_gibbs(Y, X, n_iter, nf, rng):
         L = np.linalg.cholesky(P)
         rhs = S @ Lambda.T
         m = np.linalg.solve(L.T, np.linalg.solve(L, rhs.T)).T
-        Eta = m + rng.standard_normal((ny, nf)) @ np.linalg.inv(L).T
+        Eta = m + np.linalg.solve(L.T, rng.standard_normal((nf, ny))).T
     return Beta
 
 

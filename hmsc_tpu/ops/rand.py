@@ -49,11 +49,15 @@ def truncated_normal(key, lower, upper, mean=0.0, std=1.0):
     x_left = ndtri(jnp.clip(p, _TINY, 1.0))
 
     # far-tail fallback: past ~9 sigma the interval probability underflows
-    # f32 and ndtri saturates; the exponential asymptotic is exact there
+    # f32 and ndtri saturates; the exponential asymptotic is exact there.
+    # Drawn from the exponential *truncated to [a, b]* so two-sided far
+    # intervals stay continuous (no point mass at the clipped bound).
     FAR = 9.0
-    e1 = -jnp.log(u)
-    x_far_r = a + e1 / jnp.maximum(a, 1.0)
-    x_far_l = b - e1 / jnp.maximum(-b, 1.0)
+    span = jnp.clip(b - a, 0.0, jnp.inf)
+    lam_r = jnp.maximum(a, 1.0)
+    x_far_r = a - jnp.log1p(-u * (1.0 - jnp.exp(-lam_r * span))) / lam_r
+    lam_l = jnp.maximum(-b, 1.0)
+    x_far_l = b + jnp.log1p(-u * (1.0 - jnp.exp(-lam_l * span))) / lam_l
     x = jnp.where(right, jnp.where(a > FAR, x_far_r, x_right),
                   jnp.where(b < -FAR, x_far_l, x_left))
     x = jnp.clip(x, a, b)                  # guard the clipped-quantile edges

@@ -1,0 +1,231 @@
+"""Analytic conditional-moment tests (SURVEY.md §4 tier 3): hold every block
+but one fixed, draw the free block many times, and compare empirical moments
+against the closed-form full conditional computed independently in f64 numpy
+from the reference's formulas (cited per test).  This replaces the
+reference's seed-pinned sums, which pin the RNG stream rather than the math.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hmsc_tpu.mcmc import updaters as U
+from hmsc_tpu.mcmc.spatial import update_alpha
+from hmsc_tpu.model import Hmsc
+
+from util import build_all, small_model
+
+N_DRAWS = 3000
+
+
+def _draws(fn, n=N_DRAWS, seed=0):
+    keys = jax.random.split(jax.random.PRNGKey(seed), n)
+    return jax.vmap(fn)(keys)
+
+
+# ---------------------------------------------------------------------------
+# updateEta non-spatial (reference R/updateEta.R:44-92)
+# ---------------------------------------------------------------------------
+
+def test_eta_nonspatial_moments():
+    m = small_model(distr="normal", ny=60, ns=5, n_units=6, nf=2, seed=11)
+    spec, data, state, _ = build_all(m, seed=2)
+    S = state.Z - U.linear_fixed(spec, data, state.Beta)
+
+    draws = _draws(lambda k: U.update_eta_nonspatial(
+        spec, data, state, 0, k, S).Eta)
+    draws = np.asarray(draws, dtype=float)            # (n, np, nf)
+
+    # analytic conditional: prec_u = I + n_u Lam iSig Lam',
+    # mean_u = prec_u^{-1} Lam iSig sum_{i in u} S_i
+    lam = np.asarray(U.lambda_effective(state.levels[0]), dtype=float)[:, :, 0]
+    isig = np.asarray(state.iSigma, dtype=float)
+    pi = np.asarray(data.levels[0].pi_row)
+    Snp = np.asarray(S, dtype=float)
+    nf = lam.shape[0]
+    shared = (lam * isig[None, :]) @ lam.T
+    for u in range(spec.levels[0].n_units):
+        rows = Snp[pi == u]
+        prec = np.eye(nf) + len(rows) * shared
+        mean = np.linalg.solve(prec, (lam * isig[None, :]) @ rows.sum(0))
+        cov = np.linalg.inv(prec)
+        emp_mean = draws[:, u].mean(0)
+        emp_cov = np.cov(draws[:, u].T)
+        assert np.allclose(emp_mean, mean, atol=4.5 * np.sqrt(np.diag(cov) / N_DRAWS).max())
+        assert np.allclose(emp_cov, cov, atol=0.15 * max(1.0, np.abs(cov).max()))
+
+
+# ---------------------------------------------------------------------------
+# updateBetaLambda without factors = per-species Bayesian regression
+# (reference R/updateBetaLambda.R:76-122 with nf = 0)
+# ---------------------------------------------------------------------------
+
+def test_beta_conditional_moments_no_factors():
+    rng = np.random.default_rng(5)
+    ny, ns, nc = 50, 4, 3
+    X = np.column_stack([np.ones(ny), rng.standard_normal((ny, nc - 1))])
+    Y = X @ rng.standard_normal((nc, ns)) + rng.standard_normal((ny, ns))
+    m = Hmsc(Y=Y, X=X, distr="normal", x_scale=False)
+    spec, data, state, _ = build_all(m, seed=3)
+
+    draws = np.asarray(_draws(lambda k: U.update_beta_lambda(
+        spec, data, state, k).Beta), dtype=float)     # (n, nc, ns)
+
+    iV = np.asarray(state.iV, dtype=float)
+    isig = np.asarray(state.iSigma, dtype=float)
+    Mu = np.asarray(state.Gamma, dtype=float) @ np.asarray(data.Tr, dtype=float).T
+    Xn = np.asarray(data.X, dtype=float)
+    Z = np.asarray(state.Z, dtype=float)
+    for j in range(ns):
+        prec = iV + isig[j] * Xn.T @ Xn
+        mean = np.linalg.solve(prec, iV @ Mu[:, j] + isig[j] * Xn.T @ Z[:, j])
+        cov = np.linalg.inv(prec)
+        se = np.sqrt(np.diag(cov) / N_DRAWS)
+        assert np.allclose(draws[:, :, j].mean(0), mean, atol=4.5 * se.max())
+        emp_cov = np.cov(draws[:, :, j].T)
+        assert np.allclose(emp_cov, cov, atol=0.15 * max(1.0, np.abs(cov).max()))
+
+
+# ---------------------------------------------------------------------------
+# updateRho: exact grid probabilities (reference R/updateRho.R:1-25)
+# ---------------------------------------------------------------------------
+
+def test_rho_grid_frequencies():
+    m = small_model(distr="normal", ns=8, with_phylo=True, with_traits=True,
+                    seed=21)
+    spec, data, state, dp = build_all(m, seed=4)
+
+    draws = np.asarray(_draws(lambda k: U.update_rho(
+        spec, data, state, k).rho_idx, n=6000), dtype=int)
+
+    # exact log-probabilities in f64: E in C's eigenbasis
+    E = (np.asarray(state.Beta, dtype=float)
+         - np.asarray(state.Gamma, dtype=float) @ np.asarray(data.Tr, dtype=float).T)
+    Et = E @ np.asarray(data.U, dtype=float)
+    iV = np.asarray(state.iV, dtype=float)
+    q = np.einsum("cj,cd,dj->j", Et, iV, Et)
+    Qeig = np.asarray(data.Qeig, dtype=float)
+    logdetQ = np.asarray(data.logdetQ, dtype=float)
+    rhopw = np.asarray(data.rhopw, dtype=float)
+    ll = np.log(rhopw[:, 1]) - 0.5 * spec.nc * logdetQ - 0.5 * (q[None, :] / Qeig).sum(1)
+    p = np.exp(ll - ll.max())
+    p /= p.sum()
+
+    freq = np.bincount(draws, minlength=spec.n_rho) / len(draws)
+    # compare where mass is non-negligible
+    big = p > 0.01
+    assert np.allclose(freq[big], p[big], atol=0.03)
+    assert freq[p < 1e-6].sum() < 0.01
+
+
+# ---------------------------------------------------------------------------
+# updateAlpha Full: exact grid probabilities (reference R/updateAlpha.R:3-33)
+# ---------------------------------------------------------------------------
+
+def test_alpha_full_grid_frequencies():
+    m = small_model(distr="normal", spatial="Full", n_units=8, nf=2, seed=31)
+    spec, data, state, _ = build_all(m, seed=5)
+
+    draws = np.asarray(_draws(lambda k: update_alpha(
+        spec, data, state, 0, k).alpha_idx, n=6000), dtype=int)  # (n, nf)
+
+    eta = np.asarray(state.levels[0].Eta, dtype=float)
+    iWg = np.asarray(data.levels[0].iWg, dtype=float)
+    detWg = np.asarray(data.levels[0].detWg, dtype=float)
+    alphapw = np.asarray(data.levels[0].alphapw, dtype=float)
+    for h in range(spec.levels[0].nf_max):
+        v = np.einsum("u,guv,v->g", eta[:, h], iWg, eta[:, h])
+        ll = np.log(alphapw[:, 1]) - 0.5 * detWg - 0.5 * v
+        p = np.exp(ll - ll.max())
+        p /= p.sum()
+        freq = np.bincount(draws[:, h], minlength=spec.levels[0].n_alpha) / len(draws)
+        big = p > 0.01
+        assert np.allclose(freq[big], p[big], atol=0.03)
+
+
+# ---------------------------------------------------------------------------
+# updateInvSigma: conjugate gamma moments (reference R/updateInvSigma.R:3-43)
+# ---------------------------------------------------------------------------
+
+def test_inv_sigma_moments():
+    m = small_model(distr="normal", ny=40, ns=5, seed=41)
+    spec, data, state, _ = build_all(m, seed=6)
+
+    draws = np.asarray(_draws(lambda k: U.update_inv_sigma(
+        spec, data, state, k).iSigma), dtype=float)
+
+    Eps = np.asarray(state.Z, dtype=float) - np.asarray(
+        U.total_loading(spec, data, state), dtype=float)
+    shape = np.asarray(data.aSigma, dtype=float) + 0.5 * spec.ny
+    rate = np.asarray(data.bSigma, dtype=float) + 0.5 * (Eps ** 2).sum(0)
+    mean = shape / rate
+    var = shape / rate ** 2
+    se = np.sqrt(var / N_DRAWS)
+    assert np.allclose(draws.mean(0), mean, atol=4.5 * se.max())
+    assert np.allclose(draws.var(0), var, rtol=0.2)
+
+
+# ---------------------------------------------------------------------------
+# updateLambdaPriors: psi conjugate moments, delta vs f64 numpy mirror
+# (reference R/updateLambdaPriors.R:3-53)
+# ---------------------------------------------------------------------------
+
+def test_lambda_priors_psi_moments():
+    m = small_model(distr="normal", nf=3, seed=51)
+    spec, data, state, _ = build_all(m, seed=7)
+    lv = state.levels[0]
+
+    draws = np.asarray(_draws(lambda k: U.update_lambda_priors(
+        spec, data, state, k).levels[0].Psi), dtype=float)  # (n, nf, ns, 1)
+
+    nu = float(np.asarray(data.levels[0].nu)[0])
+    lam = np.asarray(U.lambda_effective(lv), dtype=float)
+    delta = np.asarray(lv.Delta, dtype=float)
+    tau = np.cumprod(delta, axis=0)
+    a = nu / 2 + 0.5
+    b = nu / 2 + 0.5 * lam ** 2 * tau[:, None, :]
+    mean = a / b
+    se = np.sqrt(a / b ** 2 / N_DRAWS)
+    mask = np.asarray(lv.nf_mask) > 0
+    assert np.allclose(draws.mean(0)[mask], mean[mask], atol=5 * se.max())
+
+
+# ---------------------------------------------------------------------------
+# updateGammaV: Wishart mean for iV and centered Gaussian for Gamma
+# (reference R/updateGammaV.R:4-34)
+# ---------------------------------------------------------------------------
+
+def test_gamma_v_moments():
+    m = small_model(distr="normal", ns=6, with_traits=True, seed=61)
+    spec, data, state, _ = build_all(m, seed=8)
+
+    def draw(k):
+        out = U.update_gamma_v(spec, data, state, k)
+        return out.iV, out.Gamma
+    out = _draws(draw)
+    iV_draws = np.asarray(out[0], dtype=float)
+    G_draws = np.asarray(out[1], dtype=float)
+
+    # E[iV] = (f0 + ns) * (E E' + V0)^{-1}  (no phylo: iQ = I)
+    E = (np.asarray(state.Beta, dtype=float)
+         - np.asarray(state.Gamma, dtype=float) @ np.asarray(data.Tr, dtype=float).T)
+    A = E @ E.T + np.asarray(data.V0, dtype=float)
+    mean_iV = (spec.f0 + spec.ns) * np.linalg.inv(A)
+    assert np.allclose(iV_draws.mean(0), mean_iV, rtol=0.1,
+                       atol=0.05 * np.abs(mean_iV).max())
+
+    # Gamma: E[Gamma] = E_iV[ solve(iUG + kron(Tr'Tr, iV), iUG mG + vec(iV B Tr)) ]
+    # estimated with the same iV draws (law of total expectation)
+    Tr = np.asarray(data.Tr, dtype=float)
+    TtT = Tr.T @ Tr
+    iUG = np.asarray(data.iUGamma, dtype=float)
+    mG = np.asarray(data.mGamma, dtype=float)
+    B = np.asarray(state.Beta, dtype=float)
+    acc = np.zeros((spec.nc, spec.nt))
+    for iV in iV_draws[:500]:
+        prec = iUG + np.kron(TtT, iV)
+        rhs = iUG @ mG + ((iV @ B) @ Tr).T.reshape(-1)
+        acc += np.linalg.solve(prec, rhs).reshape(spec.nt, spec.nc).T
+    mean_G = acc / 500
+    assert np.allclose(G_draws.mean(0), mean_G, atol=0.1 + 0.05 * np.abs(mean_G).max())

@@ -52,6 +52,18 @@ def test_truncated_normal_far_tail():
         assert abs(float(y.mean()) + (t + 1.0 / t)) < 2e-2 * t
 
 
+def test_truncated_normal_far_two_sided():
+    """Two-sided intervals entirely past 9 sigma must stay continuous (the
+    truncated-exponential fallback), with no point mass at the upper bound."""
+    key = jax.random.PRNGKey(9)
+    n = 100_000
+    x = np.asarray(truncated_normal(key, jnp.full(n, 9.2), jnp.full(n, 9.4)))
+    assert np.all((x >= 9.2) & (x <= 9.4))
+    assert (x == 9.4).mean() < 0.01
+    # the conditional density decreases over the interval
+    assert (x < 9.3).mean() > 0.55
+
+
 def test_truncated_normal_two_sided():
     key = jax.random.PRNGKey(3)
     n = 200_000

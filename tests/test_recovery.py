@@ -1,0 +1,175 @@
+"""End-to-end parameter-recovery tests on simulated data (SURVEY.md §4
+tier 5 — the role the reference's vignettes 2-4 play: known beta / rho /
+spatial-alpha recovery), plus factor-count adaptation and the multi-device
+chain fan-out on the virtual 8-device CPU mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from hmsc_tpu.data.td import simulate_jsdm
+from hmsc_tpu.model import Hmsc
+from hmsc_tpu.random_level import HmscRandomLevel, set_priors_random_level
+from hmsc_tpu.mcmc.sampler import sample_mcmc
+from hmsc_tpu.mcmc import updaters as U
+
+from util import build_all, small_model
+
+
+def test_beta_recovery_probit():
+    """Vignette-2-style check: posterior-mean Beta correlates > 0.9 with the
+    generating coefficients on a 200 x 30 probit model."""
+    sim = simulate_jsdm(ny=200, ns=30, nc=3, distr="probit",
+                        rng=np.random.default_rng(3), n_factors=2)
+    study = pd.DataFrame({"unit": [f"u{i}" for i in range(200)]})
+    rl = HmscRandomLevel(units=study["unit"])
+    set_priors_random_level(rl, nf_max=2, nf_min=2)
+    m = Hmsc(Y=sim["Y"], X=sim["X"], distr="probit", study_design=study,
+             ran_levels={"unit": rl}, x_scale=False)
+    post = sample_mcmc(m, samples=150, transient=150, n_chains=2, seed=0)
+    bhat = np.asarray(post["Beta"], dtype=float).reshape(-1, 3, 30).mean(0)
+    corr = np.corrcoef(bhat.ravel(), sim["Beta"].ravel())[0, 1]
+    assert corr > 0.9, corr
+
+
+def test_rho_recovery():
+    """Phylogenetic signal: rho = 0.6 in the generator must be recovered
+    (posterior mean well away from both 0 and 1)."""
+    sim = simulate_jsdm(ny=250, ns=40, nc=3, distr="normal", with_phylo=True,
+                        with_traits=False, rho=0.6, n_factors=0, beta_sd=1.0,
+                        rng=np.random.default_rng(11))
+    m = Hmsc(Y=sim["Y"], X=sim["X"], distr="normal", C=sim["C"], x_scale=False)
+    post = sample_mcmc(m, samples=200, transient=200, n_chains=2, seed=1)
+    rho_mean = float(np.asarray(post["rho"], dtype=float).mean())
+    assert 0.25 < rho_mean < 0.95, rho_mean
+    beta_hat = np.asarray(post["Beta"], dtype=float).reshape(-1, 3, 40).mean(0)
+    corr = np.corrcoef(beta_hat.ravel(), sim["Beta"].ravel())[0, 1]
+    assert corr > 0.9, corr
+
+
+def test_spatial_alpha_recovery():
+    """Spatial GP range: eta drawn from an exponential GP with alpha = 0.35
+    on the unit square; the fitted Full-method level must put its posterior
+    alpha mass well away from zero (vignette-4-style check)."""
+    rng = np.random.default_rng(13)
+    n_units, ny, ns = 60, 240, 12
+    xy = rng.uniform(size=(n_units, 2))
+    d = np.sqrt(((xy[:, None] - xy[None, :]) ** 2).sum(-1))
+    W = np.exp(-d / 0.35)
+    eta = np.linalg.cholesky(W + 1e-8 * np.eye(n_units)) @ rng.standard_normal(n_units)
+    lam = rng.standard_normal(ns) * 1.5
+    unit_of = rng.integers(0, n_units, ny)
+    unit_of[:n_units] = np.arange(n_units)
+    X = np.column_stack([np.ones(ny), rng.standard_normal(ny)])
+    beta = rng.standard_normal((2, ns)) * 0.5
+    Z = X @ beta + eta[unit_of][:, None] * lam[None, :] + rng.standard_normal((ny, ns))
+    Y = Z  # normal observation model
+
+    units = [f"u{i:02d}" for i in unit_of]
+    study = pd.DataFrame({"plot": units})
+    s_df = pd.DataFrame(xy, index=[f"u{i:02d}" for i in range(n_units)],
+                        columns=["x", "y"])
+    rl = HmscRandomLevel(s_data=s_df)
+    set_priors_random_level(rl, nf_max=2, nf_min=2)
+    m = Hmsc(Y=Y, X=X, distr="normal", study_design=study,
+             ran_levels={"plot": rl}, x_scale=False)
+    post = sample_mcmc(m, samples=150, transient=150, n_chains=2, seed=2)
+
+    # leading factor's alpha (grid value) should be non-zero most of the time
+    alphapw = m.ranLevels[0].alphapw
+    idx = np.asarray(post["Alpha_0"], dtype=int).reshape(-1, post["Alpha_0"].shape[-1])
+    lam_norm = np.linalg.norm(
+        np.asarray(post["Lambda_0"], dtype=float), axis=(-2, -1)).reshape(-1, idx.shape[1])
+    lead = lam_norm.argmax(1)
+    a_lead = alphapw[idx[np.arange(len(lead)), lead], 0]
+    assert (a_lead > 0).mean() > 0.8, (a_lead > 0).mean()
+    # and its scale should be in the right decade (truth 0.35, grid to ~bbox diag)
+    assert 0.05 < np.median(a_lead) < 1.2, np.median(a_lead)
+
+
+# ---------------------------------------------------------------------------
+# factor-count adaptation (reference R/updateNf.R:3-71)
+# ---------------------------------------------------------------------------
+
+def _nf_counts(spec, data, state, r, it, n=400):
+    state = state.replace(it=jnp.asarray(it, dtype=jnp.int32))
+    keys = jax.random.split(jax.random.PRNGKey(0), n)
+    masks = jax.vmap(lambda k: U.update_nf(spec, data, state, r, k).nf_mask)(keys)
+    return np.asarray(masks).sum(axis=1)
+
+
+def test_update_nf_add():
+    """With healthy loadings, spare capacity, and it > 20, the adapt move
+    (fires with prob 1/exp(1+5e-4 it)) must append exactly one factor."""
+    m = small_model(distr="normal", nf=2, seed=71)
+    set_priors_random_level(m.ranLevels[0], nf_max=4, nf_min=2)
+    spec, data, state, _ = build_all(m, seed=9, nf_cap=4)
+    lv = state.levels[0]
+    # 2 active of 4 slots, healthy loadings
+    mask = jnp.asarray([1.0, 1.0, 0.0, 0.0])
+    lam = jnp.ones_like(lv.Lambda) * mask[:, None, None]
+    state = state.replace(levels=(lv.replace(nf_mask=mask, Lambda=lam),))
+    counts = _nf_counts(spec, data, state, 0, it=30)
+    frac_added = (counts == 3).mean()
+    assert set(np.unique(counts)) <= {2.0, 3.0}
+    # p(adapt) at it=30 is 1/exp(1.015) ~ 0.36
+    assert 0.2 < frac_added < 0.5, frac_added
+
+
+def test_update_nf_drop():
+    """An all-shrunk factor (every |lambda| < 1e-3) must be dropped when the
+    adapt move fires, down to nf_min."""
+    m = small_model(distr="normal", nf=2, seed=72)
+    set_priors_random_level(m.ranLevels[0], nf_max=4, nf_min=2)
+    spec, data, state, _ = build_all(m, seed=10, nf_cap=4)
+    lv = state.levels[0]
+    mask = jnp.asarray([1.0, 1.0, 1.0, 0.0])
+    lam = jnp.ones_like(lv.Lambda) * mask[:, None, None]
+    lam = lam.at[1].set(1e-5)        # factor 1 fully shrunk
+    state = state.replace(levels=(lv.replace(nf_mask=mask, Lambda=lam),))
+    counts = _nf_counts(spec, data, state, 0, it=30)
+    assert set(np.unique(counts)) <= {2.0, 3.0}
+    frac_dropped = (counts == 2).mean()
+    assert 0.2 < frac_dropped < 0.5, frac_dropped
+    # compaction keeps active factors as a prefix
+    keys = jax.random.split(jax.random.PRNGKey(1), 200)
+    masks = np.asarray(jax.vmap(
+        lambda k: U.update_nf(spec, data, state.replace(
+            it=jnp.asarray(30, dtype=jnp.int32)), 0, k).nf_mask)(keys))
+    for row in masks:
+        on = np.flatnonzero(row)
+        assert np.array_equal(on, np.arange(len(on)))
+
+
+def test_update_nf_respects_nf_min():
+    m = small_model(distr="normal", nf=2, seed=73)
+    set_priors_random_level(m.ranLevels[0], nf_max=4, nf_min=2)
+    spec, data, state, _ = build_all(m, seed=11, nf_cap=4)
+    lv = state.levels[0]
+    mask = jnp.asarray([1.0, 1.0, 0.0, 0.0])
+    lam = jnp.full_like(lv.Lambda, 1e-5) * mask[:, None, None]  # all shrunk
+    state = state.replace(levels=(lv.replace(nf_mask=mask, Lambda=lam),))
+    counts = _nf_counts(spec, data, state, 0, it=30)
+    assert counts.min() >= spec.levels[0].nf_min
+
+
+# ---------------------------------------------------------------------------
+# multi-device chain fan-out (SURVEY.md §5 "communication backend")
+# ---------------------------------------------------------------------------
+
+def test_multidevice_mesh_chains():
+    from jax.sharding import Mesh
+    devs = np.array(jax.devices())
+    assert len(devs) == 8, "conftest must provide 8 virtual devices"
+    mesh = Mesh(devs, ("chains",))
+    m = small_model(distr="probit", ny=40, ns=6, seed=81)
+    post = sample_mcmc(m, samples=20, transient=20, n_chains=8, seed=3,
+                       mesh=mesh)
+    beta = np.asarray(post["Beta"], dtype=float)
+    assert beta.shape[:2] == (8, 20)
+    assert np.isfinite(beta).all()
+    # chains must differ (independent streams)
+    assert np.std(beta.mean(axis=(1, 2, 3))) > 0
