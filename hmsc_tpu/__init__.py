@@ -16,7 +16,10 @@ from .precompute import (compute_data_parameters, compute_initial_parameters,
 from .mcmc.sampler import sample_mcmc
 from .post import (Posterior, pool_mcmc_chains, compute_associations,
                    convert_to_coda_object, effective_size, gelman_rhat,
-                   align_posterior)
+                   align_posterior, evaluate_model_fit, compute_waic,
+                   compute_variance_partitioning)
+from .predict import (predict, predict_latent_factor, compute_predicted_values,
+                      create_partition, construct_gradient, prepare_gradient)
 
 # reference-style camelCase aliases
 sampleMcmc = sample_mcmc
@@ -28,6 +31,14 @@ poolMcmcChains = pool_mcmc_chains
 computeAssociations = compute_associations
 convertToCodaObject = convert_to_coda_object
 alignPosterior = align_posterior
+evaluateModelFit = evaluate_model_fit
+computeWAIC = compute_waic
+computeVariancePartitioning = compute_variance_partitioning
+predictLatentFactor = predict_latent_factor
+computePredictedValues = compute_predicted_values
+createPartition = create_partition
+constructGradient = construct_gradient
+prepareGradient = prepare_gradient
 
 __version__ = "0.1.0"
 
@@ -38,7 +49,13 @@ __all__ = [
     "Posterior", "pool_mcmc_chains", "compute_associations",
     "convert_to_coda_object", "effective_size", "gelman_rhat",
     "align_posterior",
+    "evaluate_model_fit", "compute_waic", "compute_variance_partitioning",
+    "predict", "predict_latent_factor", "compute_predicted_values",
+    "create_partition", "construct_gradient", "prepare_gradient",
     "sampleMcmc", "setPriors", "computeDataParameters",
     "computeInitialParameters", "constructKnots", "poolMcmcChains",
     "computeAssociations", "convertToCodaObject", "alignPosterior",
+    "evaluateModelFit", "computeWAIC", "computeVariancePartitioning",
+    "predictLatentFactor", "computePredictedValues", "createPartition",
+    "constructGradient", "prepareGradient",
 ]

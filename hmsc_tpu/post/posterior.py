@@ -38,6 +38,18 @@ class Posterior:
     def __getitem__(self, name: str) -> np.ndarray:
         return self.arrays[name]
 
+    def subset(self, start: int = 0, thin: int = 1) -> "Posterior":
+        """New Posterior keeping every ``thin``-th recorded sample from
+        ``start`` on, per chain (the reference's poolMcmcChains start/thin
+        window, ``poolMcmcChains.R:19-27``)."""
+        if start == 0 and thin == 1:
+            return self
+        arrays = {k: v[:, start::thin] for k, v in self.arrays.items()}
+        sub = Posterior(self.hM, self.spec, arrays,
+                        samples=arrays["Beta"].shape[1],
+                        transient=self.transient, thin=self.thin * thin)
+        return sub
+
     def pooled(self, name: str) -> np.ndarray:
         """(chains*samples, ...) flattened view (poolMcmcChains)."""
         a = self.arrays[name]

@@ -1,0 +1,253 @@
+"""Prediction-layer tests (L5): predict, predictLatentFactor kriging,
+partitioned CV, gradients (reference behavior per R/predict.R,
+R/predictLatentFactor.R, R/computePredictedValues.R, R/constructGradient.R)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from hmsc_tpu import (Hmsc, HmscRandomLevel, predict, predict_latent_factor,
+                      compute_predicted_values, create_partition,
+                      construct_gradient, prepare_gradient, sample_mcmc)
+from hmsc_tpu.random_level import set_priors_random_level
+
+from util import small_model
+
+
+@pytest.fixture(scope="module")
+def fitted_probit():
+    m = small_model(ny=60, ns=5, nc=2, distr="probit", n_units=10, seed=3)
+    post = sample_mcmc(m, samples=25, transient=25, n_chains=2, seed=1,
+                       nf_cap=2)
+    return m, post
+
+
+# ---------------------------------------------------------------------------
+# predictLatentFactor
+# ---------------------------------------------------------------------------
+
+def _toy_spatial_level(n_units=12, seed=0):
+    rng = np.random.default_rng(seed)
+    xy = rng.uniform(size=(n_units, 2))
+    names = [f"u{i:02d}" for i in range(n_units)]
+    rl = HmscRandomLevel(s_data=pd.DataFrame(xy, index=names))
+    return rl, names, xy
+
+
+def test_latent_factor_old_units_passthrough():
+    rl, names, _ = _toy_spatial_level()
+    rng = np.random.default_rng(0)
+    eta = rng.standard_normal((4, len(names), 2))
+    alpha = np.full((4, 2), 3, dtype=int)
+    out = predict_latent_factor(names[:5], names, eta, alpha, rl, rng=rng)
+    np.testing.assert_allclose(out, eta[:, :5, :])
+
+
+def test_latent_factor_full_kriging_mean_matches_manual():
+    rl, names, xy = _toy_spatial_level()
+    rng = np.random.default_rng(1)
+    n_old = 9
+    old, new = names[:n_old], names[n_old:]
+    rl_old = HmscRandomLevel(
+        s_data=pd.DataFrame(np.vstack([xy[:n_old], xy[n_old:]]),
+                            index=old + new))
+    eta = rng.standard_normal((3, n_old, 2))
+    g = 40                                    # some nonzero grid index
+    alpha = np.full((3, 2), g, dtype=int)
+    out = predict_latent_factor(old + new, old, eta, alpha, rl_old,
+                                predict_mean=True, rng=rng)
+    a = rl_old.alphapw[g, 0]
+    assert a > 0
+    d = lambda A, B: np.sqrt(((A[:, None] - B[None]) ** 2).sum(-1))
+    K11 = np.exp(-d(xy[:n_old], xy[:n_old]) / a) + 1e-8 * np.eye(n_old)
+    K12 = np.exp(-d(xy[:n_old], xy[n_old:]) / a)
+    for i in range(3):
+        for h in range(2):
+            m_ref = K12.T @ np.linalg.solve(K11, eta[i, :, h])
+            np.testing.assert_allclose(out[i, n_old:, h], m_ref, atol=1e-4)
+
+
+def test_latent_factor_sampled_kriging_concentrates_near_neighbours():
+    """A sampled Full-kriging draw at a point very near an observed unit
+    must stay close to that unit's eta (GP continuity)."""
+    rng = np.random.default_rng(2)
+    xy = rng.uniform(size=(10, 2))
+    xy_new = xy[0] + 1e-4                     # essentially on top of unit 0
+    names = [f"u{i}" for i in range(10)] + ["new"]
+    rl = HmscRandomLevel(s_data=pd.DataFrame(np.vstack([xy, xy_new]),
+                                             index=names))
+    eta = rng.standard_normal((200, 10, 1))
+    alpha = np.full((200, 1), 60, dtype=int)  # long range
+    out = predict_latent_factor(names, names[:10], eta, alpha, rl, rng=rng)
+    err = out[:, 10, 0] - eta[:, 0, 0]
+    assert np.abs(err).mean() < 0.05
+
+
+@pytest.mark.parametrize("method,extra", [
+    ("NNGP", dict(n_neighbours=5)),
+    ("GPP", dict(s_knot=np.random.default_rng(5).uniform(size=(5, 2)))),
+])
+def test_latent_factor_sparse_methods_run(method, extra):
+    rng = np.random.default_rng(4)
+    xy = rng.uniform(size=(15, 2))
+    names = [f"u{i:02d}" for i in range(15)]
+    rl = HmscRandomLevel(s_data=pd.DataFrame(xy, index=names),
+                         s_method=method, **extra)
+    eta = rng.standard_normal((6, 10, 3))
+    alpha = rng.integers(0, 100, size=(6, 3))
+    out = predict_latent_factor(names, names[:10], eta, alpha, rl, rng=rng)
+    assert out.shape == (6, 15, 3)
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out[:, :10], eta)
+
+
+def test_latent_factor_nonspatial_new_units():
+    rl = HmscRandomLevel(units=[f"a{i}" for i in range(6)])
+    rng = np.random.default_rng(0)
+    eta = rng.standard_normal((500, 6, 2))
+    out = predict_latent_factor([f"a{i}" for i in range(6)] + ["b1", "b2"],
+                                [f"a{i}" for i in range(6)], eta,
+                                np.zeros((500, 2), int), rl, rng=rng)
+    new = out[:, 6:, :]
+    assert abs(new.mean()) < 0.05 and abs(new.std() - 1) < 0.05
+    out_m = predict_latent_factor(["b1"], [f"a{i}" for i in range(6)], eta,
+                                  np.zeros((500, 2), int), rl,
+                                  predict_mean=True, rng=rng)
+    assert np.all(out_m == 0)
+
+
+# ---------------------------------------------------------------------------
+# predict
+# ---------------------------------------------------------------------------
+
+def test_predict_training_expected(fitted_probit):
+    m, post = fitted_probit
+    pred = predict(post, expected=True, seed=0)
+    n_draws = post.pooled("Beta").shape[0]
+    assert pred.shape == (n_draws, m.ny, m.ns)
+    assert np.all((pred >= 0) & (pred <= 1))
+    # posterior-mean occupancy should separate observed 0s from 1s
+    mp = pred.mean(axis=0)
+    assert mp[m.Y > 0.5].mean() > mp[m.Y < 0.5].mean()
+
+
+def test_predict_sampled_draws_are_binary(fitted_probit):
+    m, post = fitted_probit
+    pred = predict(post, expected=False, seed=0)
+    assert set(np.unique(pred)) <= {0.0, 1.0}
+
+
+def test_predict_new_design_and_units(fitted_probit):
+    m, post = fitted_probit
+    ny_new = 7
+    rng = np.random.default_rng(0)
+    X_new = np.column_stack([np.ones(ny_new), rng.standard_normal(ny_new)])
+    sd_new = pd.DataFrame({"lvl": [f"new{i}" for i in range(ny_new)]})
+    pred = predict(post, X=X_new, study_design=sd_new, expected=True, seed=0)
+    assert pred.shape[1:] == (ny_new, m.ns)
+    assert np.isfinite(pred).all()
+
+
+def test_predict_conditional_runs_and_tracks_yc(fitted_probit):
+    """Conditioning on Yc for some species must shift the latent factors:
+    predictions for the *other* species change relative to unconditional."""
+    m, post = fitted_probit
+    Yc = np.full((m.ny, m.ns), np.nan)
+    Yc[:, :2] = m.Y[:, :2]
+    p_unc = predict(post, expected=True, seed=0)
+    # even the default single refinement step must condition on Yc (the
+    # initial Z update against Yc precedes the first Eta update)
+    for steps in (1, 5):
+        p_con = predict(post, Yc=Yc, mcmc_step=steps, expected=True, seed=0)
+        assert p_con.shape == p_unc.shape
+        assert np.isfinite(p_con).all()
+        assert not np.allclose(p_con[:, :, 2:], p_unc[:, :, 2:])
+
+
+# ---------------------------------------------------------------------------
+# partition / CV
+# ---------------------------------------------------------------------------
+
+def test_create_partition_shapes(fitted_probit):
+    m, _ = fitted_probit
+    part = create_partition(m, nfolds=3, rng=np.random.default_rng(0))
+    assert part.shape == (m.ny,)
+    assert set(part) == {1, 2, 3}
+    part2 = create_partition(m, nfolds=3, column="lvl",
+                             rng=np.random.default_rng(0))
+    # all rows of a unit share a fold
+    for u in set(m.df_pi[0]):
+        rows = np.asarray(m.df_pi[0]) == u
+        assert len(set(part2[rows])) == 1
+
+
+def test_compute_predicted_values_cv(fitted_probit):
+    m, post = fitted_probit
+    part = create_partition(m, nfolds=2, rng=np.random.default_rng(1))
+    pred = compute_predicted_values(post, partition=part, seed=0,
+                                    verbose=False)
+    assert pred.shape == (post.samples * post.n_chains, m.ny, m.ns)
+    assert np.isfinite(pred).all()
+    assert np.all((pred >= 0) & (pred <= 1))
+
+
+def test_compute_predicted_values_training(fitted_probit):
+    m, post = fitted_probit
+    pred = compute_predicted_values(post, seed=0)
+    assert pred.shape[1:] == (m.ny, m.ns)
+    assert np.isfinite(pred).all()
+
+
+# ---------------------------------------------------------------------------
+# gradients
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fitted_xdata():
+    rng = np.random.default_rng(7)
+    ny, ns = 50, 4
+    xdf = pd.DataFrame({"x1": rng.standard_normal(ny),
+                        "x2": rng.standard_normal(ny)})
+    Y = ((xdf["x1"].values[:, None] + rng.standard_normal((ny, ns))) > 0
+         ).astype(float)
+    units = [f"u{i % 8}" for i in range(ny)]
+    rl = HmscRandomLevel(units=units)
+    set_priors_random_level(rl, nf_max=2, nf_min=2)
+    m = Hmsc(Y=Y, x_data=xdf, x_formula="~x1+x2", distr="probit",
+             study_design=pd.DataFrame({"lvl": units}),
+             ran_levels={"lvl": rl})
+    post = sample_mcmc(m, samples=20, transient=20, n_chains=1, seed=0,
+                       nf_cap=2)
+    return m, post
+
+
+def test_construct_gradient_and_predict(fitted_xdata):
+    m, post = fitted_xdata
+    gr = construct_gradient(m, "x1", ngrid=11)
+    assert len(gr["XDataNew"]) == 11
+    assert np.all(np.diff(gr["XDataNew"]["x1"]) > 0)
+    # non-focal regressed on focal (type 2 default): roughly constant ~ 0 slope sim
+    assert gr["studyDesignNew"].shape == (11, m.nr)
+    assert gr["rLNew"]["lvl"].N == m.ranLevels[0].N + 1
+    pred = predict(post, gradient=gr, expected=True, seed=0)
+    assert pred.shape[1] == 11
+    # occupancy should increase along the x1 gradient (strong positive signal)
+    mp = pred.mean(axis=(0, 2))
+    assert mp[-1] > mp[0]
+
+
+def test_construct_gradient_non_focal_modes(fitted_xdata):
+    m, _ = fitted_xdata
+    gr1 = construct_gradient(m, "x1", {"x2": [1]}, ngrid=5)
+    assert np.allclose(gr1["XDataNew"]["x2"],
+                       np.asarray(m.x_data["x2"]).mean())
+    gr3 = construct_gradient(m, "x1", {"x2": [3, 1.5]}, ngrid=5)
+    assert np.allclose(gr3["XDataNew"]["x2"], 1.5)
+
+
+def test_prepare_gradient(fitted_xdata):
+    m, post = fitted_xdata
+    xnew = pd.DataFrame({"x1": [0.0, 1.0], "x2": [0.0, 0.0]})
+    gr = prepare_gradient(m, xnew)
+    pred = predict(post, gradient=gr, expected=True, seed=0)
+    assert pred.shape[1] == 2
