@@ -115,8 +115,38 @@ def sample_prior(hM, spec, data_par, rng: np.random.Generator) -> dict:
         rec[f"Alpha_{r}"] = alpha_idx
         rec[f"nfMask_{r}"] = np.ones(nf_max)
 
+    # selection: the recorded-prior Beta carries the same Bernoulli(q)
+    # zero-mass per block that record_sample's masking induces
+    for sel in hM.x_select:
+        on = rng.uniform(size=len(sel.q)) < sel.q
+        off_species = ~on[sel.sp_group]
+        Beta[np.ix_(sel.cov_group, off_species)] = 0.0
+
+    wRRR_raw = None
+    if hM.nc_rrr > 0:
+        DeltaRRR = np.concatenate([rng.gamma(hM.a1RRR, 1 / hM.b1RRR, 1),
+                                   rng.gamma(hM.a2RRR, 1 / hM.b2RRR,
+                                             hM.nc_rrr - 1)])
+        PsiRRR = rng.gamma(hM.nuRRR / 2, 2 / hM.nuRRR,
+                           (hM.nc_rrr, hM.nc_orrr))
+        tau = np.cumprod(DeltaRRR)
+        wRRR_raw = rng.standard_normal((hM.nc_rrr, hM.nc_orrr)) \
+            / np.sqrt(PsiRRR * tau[:, None])
+        rs = hM.xrrr_scale_par[1]
+        rec.update(wRRR=wRRR_raw / rs[None, :], PsiRRR=PsiRRR,
+                   DeltaRRR=DeltaRRR)
+
     # back-transform to original scale (combineParameters), numpy mirror
     Beta_t, Gamma_t, V_t = _combine_np(hM, Beta, Gamma, V)
+    if wRRR_raw is not None and hM.x_intercept_ind is not None:
+        # absorb the XRRR centering constant into the intercept, matching
+        # record_sample's invariant (raw XRRR reproduces the scaled design)
+        rm, rs = hM.xrrr_scale_par
+        cK = (wRRR_raw * (rm / rs)[None, :]).sum(axis=1)     # (nc_rrr,)
+        ncn = hM.nc_nrrr
+        ii = hM.x_intercept_ind
+        Beta_t[ii] -= (cK[:, None] * Beta_t[ncn:]).sum(axis=0)
+        Gamma_t[ii] -= (cK[:, None] * Gamma_t[ncn:]).sum(axis=0)
     rec.update(Beta=Beta_t, Gamma=Gamma_t, V=V_t, sigma=sigma,
                rho=hM.rhopw[rho_idx, 0] if hM.C is not None else 0.0)
     return rec

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from ..model import Hmsc
 from ..precompute import compute_data_parameters
 from .structs import (DEFAULT_NF_CAP, build_model_data, build_spec, build_state)
-from .sweep import make_sweep, record_sample
+from .sweep import effective_spec_data, make_sweep, record_sample
 from . import updaters as U
 
 __all__ = ["sample_mcmc"]
@@ -42,7 +42,8 @@ def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin):
 
     def run_chain(data, state, key):
         key, k0 = jax.random.split(key)
-        state = U.update_z(spec, data, state, k0)   # reference inits Z via one updateZ pass
+        spec0, data0 = effective_spec_data(spec, data, state)
+        state = U.update_z(spec0, data0, state, k0)  # reference inits Z via one updateZ pass
 
         def one_iter(carry, _):
             state, key = carry
@@ -106,6 +107,18 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
               for s in chain_seeds]
     state0 = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
     keys = jax.vmap(jax.random.PRNGKey)(jnp.asarray(chain_seeds))
+
+    # structural gates for the opt-in collapsed updaters (reference
+    # auto-gating, sampleMcmc.R:123-152; see updaters_marginal)
+    if updater and (updater.get("Gamma2") is True
+                    or updater.get("GammaEta") is True):
+        from .updaters_marginal import gamma_eta_gates
+        gates = gamma_eta_gates(spec, mGamma=hM.mGamma)
+        updater = dict(updater)
+        for name in ("Gamma2", "GammaEta"):
+            if updater.get(name) is True and gates[name]:
+                print(f"Setting updater${name}=FALSE: {gates[name]}")
+                updater[name] = False
 
     updater_items = (tuple(sorted(updater.items())) if updater else None)
     fn = _compiled_runner(spec, updater_items, adapt_nf,

@@ -115,6 +115,7 @@ class ModelData(struct.PyTreeNode):
     sigma_fixed: Any             # (ns,) fixed sigma^2 values for the rest
     mGamma: Any                  # (nc*nt,)
     iUGamma: Any                 # (nc*nt, nc*nt)
+    UGamma: Any                  # (nc*nt, nc*nt) (collapsed updaters)
     V0: Any                      # (nc, nc)
     aSigma: Any                  # (ns,)
     bSigma: Any                  # (ns,)
@@ -124,6 +125,17 @@ class ModelData(struct.PyTreeNode):
     U: Any = None                # (ns, ns) eigenvectors of C
     UTr: Any = None              # (ns, nt) U' Tr
     levels: tuple = ()
+    # reduced-rank regression: scaled XRRR covariates
+    XRRRs: Any = None            # (ny, nc_orrr)
+    nuRRR: Any = None            # () shrinkage hyperparams for wRRR
+    a1RRR: Any = None
+    b1RRR: Any = None
+    a2RRR: Any = None
+    b2RRR: Any = None
+    # spike-and-slab variable selection groups (one entry per XSelect)
+    sel_cov: tuple = ()          # ((nc,) 1.0-where-switched masks)
+    sel_spg: tuple = ()          # ((ns,) int32 species-group index)
+    sel_q: tuple = ()            # ((n_groups,) prior inclusion probs)
     # back-transform parameters (combineParameters at record time)
     x_scale_par: Any = None      # (2, nc_nrrr)
     tr_scale_par: Any = None     # (2, nt)
@@ -249,7 +261,8 @@ def build_model_data(hM: Hmsc, data_par: DataParams, spec: ModelSpec,
         X=f(hM.XScaled), Tr=f(hM.TrScaled),
         distr_family=jnp.asarray(hM.distr[:, 0], dtype=jnp.int32),
         distr_estsig=f(est), sigma_fixed=f(fixed_vals),
-        mGamma=f(hM.mGamma), iUGamma=f(iUGamma), V0=f(hM.V0),
+        mGamma=f(hM.mGamma), iUGamma=f(iUGamma), UGamma=f(hM.UGamma),
+        V0=f(hM.V0),
         aSigma=f(hM.aSigma), bSigma=f(hM.bSigma),
         levels=tuple(levels),
         x_scale_par=f(hM.x_scale_par),
@@ -262,6 +275,19 @@ def build_model_data(hM: Hmsc, data_par: DataParams, spec: ModelSpec,
     )
     if hM.nc_rrr > 0:
         kw["xrrr_scale_par"] = f(hM.xrrr_scale_par)
+        kw["XRRRs"] = f(hM.XRRRScaled)
+        kw.update(nuRRR=f(hM.nuRRR), a1RRR=f(hM.a1RRR), b1RRR=f(hM.b1RRR),
+                  a2RRR=f(hM.a2RRR), b2RRR=f(hM.b2RRR))
+    if hM.ncsel > 0:
+        sel_cov, sel_spg, sel_q = [], [], []
+        for sel in hM.x_select:
+            cov = np.zeros(hM.nc)
+            cov[sel.cov_group] = 1.0
+            sel_cov.append(f(cov))
+            sel_spg.append(jnp.asarray(sel.sp_group, dtype=jnp.int32))
+            sel_q.append(f(sel.q))
+        kw.update(sel_cov=tuple(sel_cov), sel_spg=tuple(sel_spg),
+                  sel_q=tuple(sel_q))
     if spec.has_phylo:
         kw.update(rhopw=f(hM.rhopw), Qeig=f(data_par.Qeig),
                   logdetQ=f(data_par.logdetQ), U=f(data_par.U),
@@ -285,12 +311,18 @@ def build_state(hM: Hmsc, spec: ModelSpec, seed: int,
                    nf_mask=f(lv["nf_mask"]))
         for lv in p["levels"])
 
-    # linear predictor as the Z starting point
+    # linear predictor as the Z starting point (RRR columns appended from the
+    # initial wRRR draw, like the reference's X = [X1A, XRRR wRRR'])
     Beta = np.asarray(p["Beta"], dtype=float)
+    Xs = np.asarray(hM.XScaled)
+    if hM.nc_rrr > 0:
+        XB = np.asarray(hM.XRRRScaled) @ np.asarray(p["wRRR"]).T
+        Xs = (np.concatenate([Xs, np.broadcast_to(XB, (hM.ns,) + XB.shape)], axis=2)
+              if spec.x_is_list else np.concatenate([Xs, XB], axis=1))
     if spec.x_is_list:
-        L = np.einsum("jyc,cj->yj", np.asarray(hM.XScaled), Beta)
+        L = np.einsum("jyc,cj->yj", Xs, Beta)
     else:
-        L = np.asarray(hM.XScaled) @ Beta
+        L = Xs @ Beta
     for r in range(spec.nr):
         lv = p["levels"][r]
         lam = lv["Lambda"] * lv["nf_mask"][:, None, None]

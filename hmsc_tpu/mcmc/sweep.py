@@ -9,14 +9,28 @@ The sweep is a pure function ``(data, state, key) -> state`` suitable for
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 
 from . import updaters as U
+from . import updaters_sel as USel
 from .spatial import update_alpha, update_eta_spatial
 from .structs import GibbsState, ModelData, ModelSpec
 
-__all__ = ["make_sweep", "record_sample"]
+__all__ = ["make_sweep", "record_sample", "effective_spec_data"]
+
+
+def effective_spec_data(spec: ModelSpec, data: ModelData, state: GibbsState):
+    """(spec, data) with the state-dependent effective design in force —
+    RRR columns appended, selection zeroing applied (no-op otherwise)."""
+    if spec.nc_rrr == 0 and spec.ncsel == 0:
+        return spec, data
+    Xeff, per_species = USel.effective_design(spec, data, state)
+    spec_x = (dataclasses.replace(spec, x_is_list=True)
+              if per_species and not spec.x_is_list else spec)
+    return spec_x, data.replace(X=Xeff)
 
 
 def make_sweep(spec: ModelSpec, updater: dict | None = None,
@@ -24,22 +38,61 @@ def make_sweep(spec: ModelSpec, updater: dict | None = None,
     updater = updater or {}
     on = lambda name: updater.get(name, True) is not False
     adapt_nf = adapt_nf or tuple(0 for _ in range(spec.nr))
+    # RRR appends columns and selection zeroes per-species blocks: both make
+    # the in-force design state-dependent, so downstream updaters see a
+    # per-sweep effective X (and the per-species design path when selecting)
+    has_dynamic_x = spec.nc_rrr > 0 or spec.ncsel > 0
+    spec_x = (dataclasses.replace(spec, x_is_list=True)
+              if spec.ncsel > 0 and not spec.x_is_list else spec)
+
+    def with_eff_x(data, state):
+        if not has_dynamic_x:
+            return data
+        Xeff, _ = USel.effective_design(spec, data, state)
+        return data.replace(X=Xeff)
+
+    # collapsed updaters are opt-in (see updaters_marginal module docstring);
+    # the sampler validates their structural gates before enabling
+    want = lambda name: updater.get(name, False) is True
 
     def sweep(data: ModelData, state: GibbsState, key) -> GibbsState:
         state = state.replace(it=state.it + 1)
-        ks = jax.random.split(key, 8)
+        ks = jax.random.split(key, 12)
+        data_x = with_eff_x(data, state)
 
+        if want("Gamma2"):
+            from .updaters_marginal import update_gamma2
+            state = update_gamma2(spec_x, data_x, state, ks[10])
+        if want("GammaEta"):
+            from .updaters_marginal import update_gamma_eta
+            for r in range(spec.nr):
+                state = update_gamma_eta(spec_x, data_x, state, r,
+                                         jax.random.fold_in(ks[11], r))
         if on("BetaLambda"):
-            state = U.update_beta_lambda(spec, data, state, ks[0])
+            state = U.update_beta_lambda(spec_x, data_x, state, ks[0])
+        if has_dynamic_x and spec.nr > 0:
+            LRan_total = sum(U.level_loading(data.levels[r], state.levels[r])
+                             for r in range(spec.nr))
+        elif has_dynamic_x:
+            LRan_total = jnp.zeros_like(state.Z)
+        if spec.nc_rrr > 0 and on("wRRR"):
+            state = USel.update_w_rrr(spec, data, state, ks[8], LRan_total)
+            data_x = with_eff_x(data, state)
+        if spec.ncsel > 0 and on("BetaSel"):
+            state = USel.update_beta_sel(spec, data, state, ks[9], LRan_total)
+            data_x = with_eff_x(data, state)
         if on("GammaV"):
             state = U.update_gamma_v(spec, data, state, ks[1])
         if spec.has_phylo and on("Rho"):
             state = U.update_rho(spec, data, state, ks[2])
         if on("LambdaPriors"):
             state = U.update_lambda_priors(spec, data, state, ks[3])
+        if spec.nc_rrr > 0 and on("wRRRPriors"):
+            state = USel.update_w_rrr_priors(spec, data, state,
+                                             jax.random.fold_in(ks[8], 1))
 
         if on("Eta") and spec.nr > 0:
-            LFix = U.linear_fixed(spec, data, state.Beta)
+            LFix = U.linear_fixed(spec_x, data_x, state.Beta)
             LRan = [U.level_loading(data.levels[r], state.levels[r])
                     for r in range(spec.nr)]
             for r in range(spec.nr):
@@ -67,9 +120,9 @@ def make_sweep(spec: ModelSpec, updater: dict | None = None,
                     state = state.replace(levels=tuple(levels))
 
         if on("InvSigma"):
-            state = U.update_inv_sigma(spec, data, state, ks[6])
+            state = U.update_inv_sigma(spec_x, data_x, state, ks[6])
         if on("Z"):
-            state = U.update_z(spec, data, state, ks[7])
+            state = U.update_z(spec_x, data_x, state, ks[7])
 
         # factor-count adaptation during burn-in (iter <= adaptNf[r])
         for r in range(spec.nr):
@@ -99,6 +152,15 @@ def record_sample(spec: ModelSpec, data: ModelData, state: GibbsState) -> dict:
     Gamma = state.Gamma
     iV = state.iV
 
+    # selection: zero the switched-off covariate blocks FIRST, so the
+    # centering/intercept corrections below operate on the effective Beta
+    # (the reference zeroes after back-transform, combineParameters.R:45-53,
+    # which mis-absorbs off-block slab coefficients into the intercept when
+    # X is centered)
+    if spec.ncsel > 0:
+        from .updaters_sel import selection_mask
+        Beta = Beta * selection_mask(spec, data, state.BetaSel).T
+
     # traits: Gamma columns back to raw-trait scale
     tm, ts = data.tr_scale_par[0], data.tr_scale_par[1]
     Gamma = Gamma / ts[None, :]
@@ -114,8 +176,6 @@ def record_sample(spec: ModelSpec, data: ModelData, state: GibbsState) -> dict:
         [xs, jnp.ones(spec.nc - ncn, dtype=xs.dtype)]) if spec.nc > ncn else xs
     mean_rows = jnp.concatenate(
         [xmean, jnp.zeros(spec.nc - ncn, dtype=xmean.dtype)]) if spec.nc > ncn else xmean
-    if spec.nc_rrr > 0 and data.xrrr_scale_par is not None:
-        pass  # XRRR back-transform handled with the wRRR extras (P7)
     Beta = Beta / scale_rows[:, None]
     Gamma = Gamma / scale_rows[:, None]
     if data.x_intercept_ind is not None:
@@ -126,6 +186,22 @@ def record_sample(spec: ModelSpec, data: ModelData, state: GibbsState) -> dict:
         Gamma = Gamma.at[ii].add(-corrG)
     iV_t = iV * scale_rows[:, None] * scale_rows[None, :]
     V = jnp.linalg.inv(iV_t)
+
+    # RRR: back-transform wRRR so raw XRRR reproduces the scaled design
+    # (XB_raw @ wRRR_rec' == XRRRScaled @ wRRR'), with the centering constant
+    # absorbed into the intercept row of Beta/Gamma.  The reference instead
+    # divides Beta's RRR rows by XRRRScalePar[,k] (combineParameters.R:30-43),
+    # which mixes per-original-covariate scales into per-component rows; the
+    # invariant above is the one predict()/WAIC rely on.
+    wRRR = state.wRRR
+    if spec.nc_rrr > 0 and data.xrrr_scale_par is not None:
+        rm, rs = data.xrrr_scale_par[0], data.xrrr_scale_par[1]
+        wRRR = state.wRRR / rs[None, :]
+        if data.x_intercept_ind is not None:
+            ii = data.x_intercept_ind
+            cK = (state.wRRR * (rm / rs)[None, :]).sum(axis=1)  # (nc_rrr,)
+            Beta = Beta.at[ii].add(-(cK[:, None] * Beta[ncn:]).sum(axis=0))
+            Gamma = Gamma.at[ii].add(-(cK[:, None] * Gamma[ncn:]).sum(axis=0))
 
     rec = {
         "Beta": Beta,
@@ -144,7 +220,7 @@ def record_sample(spec: ModelSpec, data: ModelData, state: GibbsState) -> dict:
         rec[f"Alpha_{r}"] = lv.alpha_idx
         rec[f"nfMask_{r}"] = lv.nf_mask
     if spec.nc_rrr > 0:
-        rec["wRRR"] = state.wRRR
+        rec["wRRR"] = wRRR
         rec["PsiRRR"] = state.PsiRRR
         rec["DeltaRRR"] = state.DeltaRRR
     return rec

@@ -17,9 +17,9 @@ from ..ops.rand import polya_gamma, truncated_normal, wishart
 from .structs import GibbsState, LevelState, ModelData, ModelSpec
 
 __all__ = ["linear_fixed", "level_loading", "update_z", "update_beta_lambda",
-           "update_gamma_v", "update_rho", "update_lambda_priors",
-           "update_eta_nonspatial", "update_inv_sigma", "update_nf",
-           "eta_star", "lambda_effective"]
+           "update_gamma_v", "gamma_given_beta", "update_rho",
+           "update_lambda_priors", "update_eta_nonspatial",
+           "update_inv_sigma", "update_nf", "eta_star", "lambda_effective"]
 
 _NB_R = 1e3  # Poisson as the r->inf limit of NB (reference updateZ.R:68)
 
@@ -311,6 +311,35 @@ def _beta_given_lambda_phylo(spec, data, state, key):
 # updateGammaV / updateRho (reference R/updateGammaV.R, R/updateRho.R)
 # ---------------------------------------------------------------------------
 
+def _phylo_trq(spec, data, state):
+    """(TrQ = iQ Tr, TtQT = Tr' iQ Tr) in the phylo eigenbasis (identity
+    weights without phylogeny)."""
+    if spec.has_phylo:
+        e = data.Qeig[state.rho_idx]
+        se = jnp.sqrt(e)
+        UTs = data.UTr / se[:, None]
+        TrQ = data.U @ (UTs / se[:, None])                # iQ Tr (ns, nt)
+        TtQT = UTs.T @ UTs
+    else:
+        TrQ = data.Tr
+        TtQT = data.Tr.T @ data.Tr
+    return TrQ, TtQT
+
+
+def gamma_given_beta(spec: ModelSpec, data: ModelData, state: GibbsState,
+                     key) -> GibbsState:
+    """Gamma | Beta, iV: Gaussian full conditional with precision
+    iUGamma + kron(Tr' iQ Tr, iV) (reference updateGammaV.R:30-32)."""
+    TrQ, TtQT = _phylo_trq(spec, data, state)
+    prec = data.iUGamma + jnp.kron(TtQT, state.iV)
+    rhs = data.iUGamma @ data.mGamma \
+        + ((state.iV @ state.Beta) @ TrQ).T.reshape(-1)
+    L = chol_spd(prec)
+    eps = jax.random.normal(key, rhs.shape, dtype=rhs.dtype)
+    gvec = sample_mvn_prec(L, rhs, eps)
+    return state.replace(Gamma=gvec.reshape(spec.nt, spec.nc).T)
+
+
 def update_gamma_v(spec: ModelSpec, data: ModelData, state: GibbsState,
                    key) -> GibbsState:
     """Conjugate draws: iV ~ Wishart(f0+ns, (E iQ E' + V0)^{-1}), then Gamma
@@ -325,26 +354,14 @@ def update_gamma_v(spec: ModelSpec, data: ModelData, state: GibbsState,
         # and the Gram products are exactly symmetric PSD
         Et = (E @ data.U) / se[None, :]
         A = Et @ Et.T
-        UTs = data.UTr / se[:, None]
-        TrQ = data.U @ (UTs / se[:, None])                # iQ Tr (ns, nt)
-        TtQT = UTs.T @ UTs
     else:
         A = E @ E.T
-        TrQ = data.Tr
-        TtQT = data.Tr.T @ data.Tr
 
     Lw = chol_spd(A + data.V0)
     T = solve_triangular(Lw.T,
                          jnp.eye(spec.nc, dtype=A.dtype), lower=False)  # T T' = (A+V0)^{-1}
     iV = wishart(kv, spec.f0 + spec.ns, T)
-
-    prec = data.iUGamma + jnp.kron(TtQT, iV)
-    rhs = data.iUGamma @ data.mGamma + ((iV @ state.Beta) @ TrQ).T.reshape(-1)
-    L = chol_spd(prec)
-    eps = jax.random.normal(kg, rhs.shape, dtype=rhs.dtype)
-    gvec = sample_mvn_prec(L, rhs, eps)
-    Gamma = gvec.reshape(spec.nt, spec.nc).T
-    return state.replace(Gamma=Gamma, iV=iV)
+    return gamma_given_beta(spec, data, state.replace(iV=iV), kg)
 
 
 def update_rho(spec: ModelSpec, data: ModelData, state: GibbsState,

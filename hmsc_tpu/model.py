@@ -32,13 +32,19 @@ FIXED_SIGMA2 = {1: 1.0, 2: 1.0, 3: 1e-2}
 
 class XSelect:
     """One spike-and-slab variable-selection group (reference
-    ``R/updateBetaSel.R``): covariate columns ``cov_group`` are switched on/off
-    jointly for each species group, with prior inclusion probabilities ``q``."""
+    ``R/updateBetaSel.R``): covariate columns ``cov_group`` (0-based indices
+    into X) are switched on/off jointly for each species group, with prior
+    inclusion probability ``q[g]`` for species group ``g``; ``sp_group`` maps
+    each species to its group (0-based)."""
 
     def __init__(self, cov_group, sp_group, q):
         self.cov_group = np.atleast_1d(np.asarray(cov_group, dtype=int))
         self.sp_group = np.asarray(sp_group, dtype=int)
         self.q = np.atleast_1d(np.asarray(q, dtype=float))
+        if self.sp_group.ndim != 1:
+            raise ValueError("Hmsc.setData: spGroup for XSelect must be a vector with one entry per species")
+        if self.sp_group.min(initial=0) < 0 or self.sp_group.max(initial=0) >= len(self.q):
+            raise ValueError("Hmsc.setData: spGroup for XSelect must index into q")
 
 
 class Hmsc:
@@ -146,8 +152,10 @@ class Hmsc:
         self.ncsel = len(x_select)
         self.x_select = x_select
         for sel in x_select:
-            if sel.cov_group.max(initial=0) >= self.nc + 1 or sel.cov_group.max(initial=0) > self.nc:
+            if sel.cov_group.max(initial=0) >= self.nc:
                 raise ValueError("Hmsc.setData: covGroup for XSelect cannot have values greater than number of columns in X")
+            if sel.sp_group.shape != (self.ns,):
+                raise ValueError("Hmsc.setData: spGroup for XSelect must be a vector with one entry per species")
 
         # ---- reduced-rank regression covariates -------------------------
         self.nc_nrrr = self.nc
