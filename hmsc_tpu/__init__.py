@@ -20,6 +20,10 @@ from .post import (Posterior, pool_mcmc_chains, compute_associations,
                    compute_variance_partitioning)
 from .predict import (predict, predict_latent_factor, compute_predicted_values,
                       create_partition, construct_gradient, prepare_gradient)
+from .utils.checkpoint import (save_checkpoint, load_checkpoint,
+                               concat_posteriors)
+from .plots import (plot_beta, plot_gamma, plot_gradient,
+                    plot_variance_partitioning, bi_plot)
 
 # reference-style camelCase aliases
 sampleMcmc = sample_mcmc
@@ -52,6 +56,9 @@ __all__ = [
     "evaluate_model_fit", "compute_waic", "compute_variance_partitioning",
     "predict", "predict_latent_factor", "compute_predicted_values",
     "create_partition", "construct_gradient", "prepare_gradient",
+    "save_checkpoint", "load_checkpoint", "concat_posteriors",
+    "plot_beta", "plot_gamma", "plot_gradient",
+    "plot_variance_partitioning", "bi_plot",
     "sampleMcmc", "setPriors", "computeDataParameters",
     "computeInitialParameters", "constructKnots", "poolMcmcChains",
     "computeAssociations", "convertToCodaObject", "alignPosterior",

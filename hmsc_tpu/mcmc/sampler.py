@@ -31,7 +31,8 @@ __all__ = ["sample_mcmc"]
 
 
 @functools.lru_cache(maxsize=64)
-def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin):
+def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin,
+                     skip_init_z):
     """One jitted chain-vmapped sampling program per static config.
 
     Keyed on the hashable (spec, updater toggles, scan lengths) so repeated
@@ -42,8 +43,11 @@ def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin):
 
     def run_chain(data, state, key):
         key, k0 = jax.random.split(key)
-        spec0, data0 = effective_spec_data(spec, data, state)
-        state = U.update_z(spec0, data0, state, k0)  # reference inits Z via one updateZ pass
+        if not skip_init_z:
+            # reference inits Z via one updateZ pass; a resumed or
+            # continuation segment keeps its carried Z
+            spec0, data0 = effective_spec_data(spec, data, state)
+            state = U.update_z(spec0, data0, state, k0)
 
         def one_iter(carry, _):
             state, key = carry
@@ -72,15 +76,28 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 nf_cap: int = DEFAULT_NF_CAP, dtype=jnp.float32,
                 data_par=None, from_prior: bool = False,
                 align_post: bool = True, mesh=None, chain_axis: str = "chains",
-                return_state: bool = False):
+                return_state: bool = False, verbose: int = 0,
+                init_state=None, profile_dir: str | None = None):
     """Run the blocked Gibbs sampler; returns a :class:`~hmsc_tpu.post.Posterior`.
 
     Arguments mirror the reference's ``sampleMcmc`` (samples/transient/thin/
-    nChains/initPar/adaptNf/updater/dataParList/fromPrior/alignPost); the
-    process-parallel ``nParallel`` is replaced by device parallelism via
-    ``mesh``.
+    nChains/initPar/adaptNf/updater/dataParList/fromPrior/alignPost/verbose);
+    the process-parallel ``nParallel`` is replaced by device parallelism via
+    ``mesh``.  Extras over the reference:
+
+    - ``verbose=N`` prints progress every N sweeps from inside the compiled
+      scan (device callback).
+    - ``init_state`` resumes chains from a saved carry state (see
+      ``hmsc_tpu.utils.checkpoint``); transient should usually be 0 then.
+    - ``profile_dir`` wraps the run in a ``jax.profiler`` trace.
+    - the returned Posterior carries ``timing`` = {setup_s, run_s} wall-clock
+      seconds (run_s includes compilation on first use of a config).
     """
+    import time
+
     from ..post.posterior import Posterior
+
+    t0 = time.perf_counter()
 
     if adapt_nf is None:
         adapt_nf = tuple(transient for _ in range(hM.nr))
@@ -103,10 +120,21 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         return Posterior(hM, spec, post, samples=samples, transient=transient,
                          thin=thin)
 
-    states = [build_state(hM, spec, int(s), init_par, dtype=dtype)
-              for s in chain_seeds]
-    state0 = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
-    keys = jax.vmap(jax.random.PRNGKey)(jnp.asarray(chain_seeds))
+    it0 = 0
+    if init_state is not None:
+        state0 = init_state                       # (chains, ...) carry pytree
+        lead = int(jax.tree.leaves(state0)[0].shape[0])
+        if lead != n_chains:
+            raise ValueError(f"init_state carries {lead} chains, n_chains={n_chains}")
+        it0 = int(np.asarray(state0.it).ravel()[0])
+        # a resumed run must not replay the original run's key stream: mix
+        # the carried iteration count into the seed derivation
+        rng = np.random.default_rng([0 if seed is None else int(seed), it0])
+        chain_seeds = rng.integers(0, 2**31 - 1, size=n_chains)
+    else:
+        states = [build_state(hM, spec, int(s), init_par, dtype=dtype)
+                  for s in chain_seeds]
+        state0 = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
 
     # structural gates for the opt-in collapsed updaters (reference
     # auto-gating, sampleMcmc.R:123-152; see updaters_marginal)
@@ -121,20 +149,63 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 updater[name] = False
 
     updater_items = (tuple(sorted(updater.items())) if updater else None)
-    fn = _compiled_runner(spec, updater_items, adapt_nf,
-                          int(samples), int(transient), int(thin))
+    sharding = None
     if mesh is not None:
         # shard the chain batch axis over the mesh; everything else replicates
         from jax.sharding import NamedSharding, PartitionSpec as P
-        sh = NamedSharding(mesh, P(chain_axis))
-        state0 = jax.tree.map(lambda x: jax.device_put(x, sh), state0)
-        keys = jax.device_put(keys, sh)
+        sharding = NamedSharding(mesh, P(chain_axis))
+        state0 = jax.tree.map(lambda x: jax.device_put(x, sharding), state0)
 
-    recs, final_state = fn(data, state0, keys)
+    # progress: verbose>0 splits the sample scan into host-level segments so
+    # the host prints between compiled chunks (the reference's per-iteration
+    # printout, sampleMcmc.R:317-324, at `verbose`-sweep granularity)
+    if verbose:
+        chunk = max(1, int(round(verbose / thin)))
+        seg_sizes = [chunk] * (int(samples) // chunk)
+        if int(samples) % chunk:
+            seg_sizes.append(int(samples) % chunk)
+    else:
+        seg_sizes = [int(samples)]
+    total_it = it0 + int(transient) + int(samples) * int(thin)
+
+    t1 = time.perf_counter()
+    import contextlib
+    ctx = (jax.profiler.trace(profile_dir) if profile_dir is not None
+           else contextlib.nullcontext())
+    with ctx:
+        recs_segs = []
+        state_cur = state0
+        trans_cur = int(transient)
+        skip_z = init_state is not None
+        for si, seg in enumerate(seg_sizes):
+            base = jax.vmap(jax.random.PRNGKey)(jnp.asarray(chain_seeds))
+            keys = (base if si == 0
+                    else jax.vmap(lambda k: jax.random.fold_in(k, si))(base))
+            if sharding is not None:
+                keys = jax.device_put(keys, sharding)
+            fn = _compiled_runner(spec, updater_items, adapt_nf, seg,
+                                  trans_cur, int(thin), skip_z)
+            recs, state_cur = fn(data, state_cur, keys)
+            recs_segs.append(recs)
+            trans_cur = 0
+            skip_z = True
+            if verbose:
+                it_now = int(np.asarray(state_cur.it).ravel()[0])
+                phase = "sampling" if it_now > it0 + transient else "transient"
+                print(f"iteration {it_now} of {total_it} ({phase})")
+        final_state = state_cur
+        if len(recs_segs) == 1:
+            recs = recs_segs[0]
+        else:
+            recs = jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=1),
+                                *recs_segs)
+        jax.block_until_ready(recs)
     recs = jax.tree.map(np.asarray, recs)        # (chains, samples, ...)
+    t2 = time.perf_counter()
 
     post = Posterior(hM, spec, recs, samples=samples, transient=transient,
                      thin=thin)
+    post.timing = {"setup_s": t1 - t0, "run_s": t2 - t1}
     if align_post and spec.nr > 0:
         from ..post.align import align_posterior
         for _ in range(5):

@@ -130,6 +130,9 @@ def update_z(spec: ModelSpec, data: ModelData, state: GibbsState, key) -> GibbsS
         s2 = 1.0 / (prec + w)
         mu = s2 * ((data.Y - _NB_R) / 2.0 + prec * (E - logr)) + logr
         z_p = mu + jnp.sqrt(s2) * jax.random.normal(k_pg2, mu.shape, dtype=mu.dtype)
+        # NaN guard: keep the previous Z for any non-finite cell (reference
+        # prints "Fail in Poisson Z update" and aborts the cell, updateZ.R:84-86)
+        z_p = jnp.where(jnp.isfinite(z_p), z_p, state.Z)
         Z = jnp.where(fam == 3, z_p, Z)
     if spec.has_na:
         z_na = E + std * jax.random.normal(k_na, E.shape, dtype=E.dtype)

@@ -1,0 +1,168 @@
+"""Visualization layer (L6, reference ``R/plotBeta.R:59-264``,
+``R/plotGamma.R:50-180``, ``R/plotGradient.R:63-210``,
+``R/plotVariancePartitioning.R:21-41``, ``R/biPlot.R:26-59``).
+
+Matplotlib-level presentation over the L4/L5 outputs; pure host-side.  Each
+function returns the matplotlib ``Axes`` so callers can restyle or save.
+``plot_beta``/``plot_gamma`` support the reference's three display modes:
+posterior mean, support (P(>0)), and sign-thresholded mean.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["plot_beta", "plot_gamma", "plot_gradient",
+           "plot_variance_partitioning", "bi_plot"]
+
+
+def _ax(ax):
+    if ax is not None:
+        return ax
+    import matplotlib.pyplot as plt
+    _, ax = plt.subplots()
+    return ax
+
+
+def _support_plot(est, row_names, col_names, plot_type, support_level, ax,
+                  title):
+    ax = _ax(ax)
+    mean = est["mean"]
+    if plot_type == "Mean":
+        M = mean
+    elif plot_type == "Support":
+        M = np.where(est["support"] > support_level, est["support"],
+                     np.where(est["supportNeg"] > support_level,
+                              -est["supportNeg"], 0.0))
+    elif plot_type == "Sign":
+        sig = (est["support"] > support_level) | (est["supportNeg"] > support_level)
+        M = np.where(sig, np.sign(mean), 0.0)
+    else:
+        raise ValueError("plotType must be 'Mean', 'Support' or 'Sign'")
+    vmax = np.max(np.abs(M)) or 1.0
+    im = ax.imshow(M, cmap="RdBu_r", vmin=-vmax, vmax=vmax, aspect="auto")
+    ax.set_xticks(range(len(col_names)))
+    ax.set_xticklabels(col_names, rotation=90, fontsize=7)
+    ax.set_yticks(range(len(row_names)))
+    ax.set_yticklabels(row_names, fontsize=7)
+    ax.set_title(title)
+    ax.figure.colorbar(im, ax=ax, shrink=0.8)
+    return ax
+
+
+def plot_beta(post, plot_type: str = "Support", support_level: float = 0.89,
+              ax=None):
+    """Heatmap of species' environmental responses Beta (covariates x
+    species), reference ``plotBeta.R`` (the optional phylo-tree side panel is
+    not drawn)."""
+    hM = post.hM
+    est = post.get_post_estimate("Beta")
+    return _support_plot(est, hM.cov_names, hM.sp_names, plot_type,
+                         support_level, ax, "Beta")
+
+
+def plot_gamma(post, plot_type: str = "Support", support_level: float = 0.89,
+               ax=None):
+    """Heatmap of trait effects Gamma (covariates x traits), reference
+    ``plotGamma.R``."""
+    hM = post.hM
+    est = post.get_post_estimate("Gamma")
+    return _support_plot(est, hM.cov_names, hM.tr_names, plot_type,
+                         support_level, ax, "Gamma")
+
+
+def plot_gradient(post, gradient, pred=None, measure: str = "S", index: int = 0,
+                  q=(0.25, 0.5, 0.75), show_data: bool = True, ax=None,
+                  seed: int = 0):
+    """Prediction along an environmental gradient with credible ribbons
+    (reference ``plotGradient.R``): ``measure``='S' species richness, 'Y'
+    one species (``index``), 'T' community-weighted mean trait (``index``)."""
+    from .predict import predict as _predict
+
+    hM = post.hM
+    if pred is None:
+        pred = _predict(post, gradient=gradient, expected=True, seed=seed)
+    xx = np.asarray(gradient["XDataNew"].iloc[:, 0], dtype=float)
+    if measure == "S":
+        stat = pred.sum(axis=2)                      # (n, ngrid)
+        label = "Summed response (richness)"
+    elif measure == "Y":
+        stat = pred[:, :, index]
+        label = f"{hM.sp_names[index]}"
+    elif measure == "T":
+        tw = pred @ hM.Tr[:, index]
+        stat = tw / np.maximum(pred.sum(axis=2), 1e-12)
+        label = f"CWM {hM.tr_names[index]}"
+    else:
+        raise ValueError("measure must be 'S', 'Y' or 'T'")
+    lo, med, hi = np.quantile(stat, q, axis=0)
+    ax = _ax(ax)
+    ax.fill_between(xx, lo, hi, alpha=0.3, color="#4477aa", lw=0)
+    ax.plot(xx, med, color="#4477aa")
+    ax.set_xlabel(str(gradient["XDataNew"].columns[0]))
+    ax.set_ylabel(label)
+    if show_data and measure == "S" and hM.x_data is not None:
+        try:
+            v = np.asarray(hM.x_data[gradient["XDataNew"].columns[0]], float)
+            ax.plot(v, np.nansum(hM.Y, axis=1), ".", color="#666666",
+                    markersize=3)
+        except Exception:
+            pass
+    return ax
+
+
+def plot_variance_partitioning(post, vp=None, ax=None, cmap: str = "tab20"):
+    """Stacked per-species bars of the variance shares (reference
+    ``plotVariancePartitioning.R``)."""
+    from .post.metrics import compute_variance_partitioning
+
+    hM = post.hM
+    if vp is None:
+        vp = compute_variance_partitioning(post)
+    vals = vp["vals"]
+    ax = _ax(ax)
+    import matplotlib.pyplot as plt
+
+    colors = plt.get_cmap(cmap)(np.linspace(0, 1, vals.shape[0]))
+    bottom = np.zeros(vals.shape[1])
+    xs = np.arange(vals.shape[1])
+    means = vals.mean(axis=1)
+    for i in range(vals.shape[0]):
+        ax.bar(xs, vals[i], bottom=bottom, color=colors[i],
+               label=f"{vp['names'][i]} (mean = {means[i]:.2f})")
+        bottom += vals[i]
+    ax.set_xticks(xs)
+    ax.set_xticklabels(hM.sp_names, rotation=90, fontsize=7)
+    ax.set_ylabel("Variance proportion")
+    ax.legend(fontsize=6, loc="upper right")
+    return ax
+
+
+def bi_plot(post, r: int = 0, factors=(0, 1), color_var=None, ax=None):
+    """Ordination of sites (posterior-mean Eta) against species loadings
+    (posterior-mean Lambda) for one random level (reference ``biPlot.R``)."""
+    hM = post.hM
+    eta = post.get_post_estimate("Eta", r=r)["mean"]       # (np, nf)
+    lam = post.get_post_estimate("Lambda", r=r)["mean"]    # (nf, ns[, ncr])
+    lam = lam[..., 0] if lam.ndim == 3 else lam
+    f1, f2 = factors
+    ax = _ax(ax)
+    c = None
+    if color_var is not None and hM.x_data is not None:
+        v = np.asarray(hM.x_data[color_var], dtype=float)
+        if len(v) == eta.shape[0]:           # one row per unit already
+            c = v
+        elif len(v) == hM.ny:                # map rows -> first row per unit
+            first_row = np.zeros(eta.shape[0], dtype=int)
+            first_row[hM.Pi[::-1, r]] = np.arange(hM.ny - 1, -1, -1)
+            c = v[first_row]
+    kw = {"c": c, "cmap": "viridis"} if c is not None else {}
+    ax.scatter(eta[:, f1], eta[:, f2], s=12, label="sites", **kw)
+    scale = (np.abs(eta[:, [f1, f2]]).max() /
+             max(np.abs(lam[[f1, f2]]).max(), 1e-12))
+    for j in range(hM.ns):
+        ax.annotate(hM.sp_names[j], (lam[f1, j] * scale, lam[f2, j] * scale),
+                    color="#bb3333", fontsize=8)
+    ax.set_xlabel(f"Latent factor {f1 + 1}")
+    ax.set_ylabel(f"Latent factor {f2 + 1}")
+    return ax

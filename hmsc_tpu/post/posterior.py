@@ -33,6 +33,7 @@ class Posterior:
         self.transient = transient
         self.thin = thin
         self.n_chains = next(iter(self.arrays.values())).shape[0] if self.arrays else 0
+        self.timing = None          # {"setup_s", "run_s"} set by sample_mcmc
 
     # ------------------------------------------------------------------
     def __getitem__(self, name: str) -> np.ndarray:

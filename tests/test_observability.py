@@ -1,0 +1,74 @@
+"""Observability + checkpoint tests: verbose progress, timing, Poisson NaN
+guard, save/resume (SURVEY.md §5; reference sampleMcmc.R:317-324,
+updateZ.R:84-86)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from hmsc_tpu import (concat_posteriors, load_checkpoint, sample_mcmc,
+                      save_checkpoint)
+
+from util import small_model
+
+
+def test_verbose_progress(capfd):
+    m = small_model(ny=20, ns=3, nc=2, distr="normal", n_units=5, seed=0)
+    sample_mcmc(m, samples=10, transient=10, n_chains=1, seed=1, nf_cap=2,
+                verbose=5)
+    out = capfd.readouterr().out + capfd.readouterr().err
+    assert "iteration" in out
+    assert "of 20" in out
+
+
+def test_timing_recorded():
+    m = small_model(ny=20, ns=3, nc=2, distr="normal", n_units=5, seed=0)
+    post = sample_mcmc(m, samples=5, transient=5, n_chains=1, seed=1, nf_cap=2)
+    assert post.timing is not None
+    assert post.timing["run_s"] > 0 and post.timing["setup_s"] > 0
+
+
+def test_poisson_nan_guard():
+    """An extreme Poisson count must not poison Z with non-finite values."""
+    m = small_model(ny=30, ns=3, nc=2, distr="poisson", n_units=6, seed=2)
+    m.Y[0, 0] = 1e6                      # absurd count
+    m.YScaled[0, 0] = 1e6
+    post = sample_mcmc(m, samples=10, transient=10, n_chains=1, seed=1,
+                       nf_cap=2)
+    for k in ("Beta", "Lambda_0", "sigma"):
+        assert np.isfinite(post.pooled(k)).all()
+
+
+def test_checkpoint_resume(tmp_path):
+    m = small_model(ny=30, ns=4, nc=2, distr="normal", n_units=6, seed=3)
+    post1, state = sample_mcmc(m, samples=15, transient=20, n_chains=2,
+                               seed=1, nf_cap=2, return_state=True,
+                               align_post=False)
+    path = os.fspath(tmp_path / "ck.npz")
+    save_checkpoint(path, post1, state)
+    post1b, state_b = load_checkpoint(path, m)
+    assert post1b.samples == 15 and post1b.n_chains == 2
+    for k, v in post1.arrays.items():
+        np.testing.assert_array_equal(v, post1b.arrays[k])
+
+    # resume: no new transient, chains continue from the carry state
+    post2 = sample_mcmc(m, samples=10, transient=0, n_chains=2, seed=2,
+                        nf_cap=2, init_state=state_b, align_post=False)
+    both = concat_posteriors(post1b, post2)
+    assert both.samples == 25
+    assert both.pooled("Beta").shape[0] == 50
+    assert np.isfinite(both.pooled("Beta")).all()
+    # the resumed segment must continue the same posterior region
+    m1 = post1.pooled("Beta").mean(axis=0)
+    m2 = post2.pooled("Beta").mean(axis=0)
+    assert np.corrcoef(m1.ravel(), m2.ravel())[0, 1] > 0.9
+
+
+def test_init_state_chain_mismatch(tmp_path):
+    m = small_model(ny=30, ns=4, nc=2, distr="normal", n_units=6, seed=3)
+    _, state = sample_mcmc(m, samples=3, transient=3, n_chains=2, seed=1,
+                           nf_cap=2, return_state=True)
+    with pytest.raises(ValueError):
+        sample_mcmc(m, samples=3, n_chains=3, seed=1, nf_cap=2,
+                    init_state=state)
