@@ -77,7 +77,8 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 data_par=None, from_prior: bool = False,
                 align_post: bool = True, mesh=None, chain_axis: str = "chains",
                 return_state: bool = False, verbose: int = 0,
-                init_state=None, profile_dir: str | None = None):
+                init_state=None, profile_dir: str | None = None,
+                rng_impl: str | None = None):
     """Run the blocked Gibbs sampler; returns a :class:`~hmsc_tpu.post.Posterior`.
 
     Arguments mirror the reference's ``sampleMcmc`` (samples/transient/thin/
@@ -92,6 +93,10 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
     - ``profile_dir`` wraps the run in a ``jax.profiler`` trace.
     - the returned Posterior carries ``timing`` = {setup_s, run_s} wall-clock
       seconds (run_s includes compilation on first use of a config).
+    - ``rng_impl`` picks the PRNG bit generator; default is the hardware
+      ``rbg`` on TPU backends (the probit Z update is RNG-throughput-bound
+      at scale) and ``threefry2x32`` elsewhere.  Reproducibility is bitwise
+      per (seed, impl), not across impls.
     """
     import time
 
@@ -177,8 +182,13 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         state_cur = state0
         trans_cur = int(transient)
         skip_z = init_state is not None
+        if rng_impl is None:
+            plat = jax.default_backend()
+            rng_impl = "rbg" if ("tpu" in plat or "axon" in plat) \
+                else "threefry2x32"
         for si, seg in enumerate(seg_sizes):
-            base = jax.vmap(jax.random.PRNGKey)(jnp.asarray(chain_seeds))
+            base = jax.vmap(lambda s: jax.random.key(s, impl=rng_impl))(
+                jnp.asarray(chain_seeds))
             keys = (base if si == 0
                     else jax.vmap(lambda k: jax.random.fold_in(k, si))(base))
             if sharding is not None:

@@ -116,3 +116,33 @@ def test_wishart_bartlett_matches_scipy_distribution():
     q_emp = np.quantile(x, [0.25, 0.5, 0.75])
     q_true = sps.chi2(df).ppf([0.25, 0.5, 0.75])
     assert np.allclose(q_emp, q_true, rtol=0.08)
+
+
+def test_truncnorm_probability_floor_finite():
+    """f32 ndtri overflows to -inf below ~1e-33; the quantile floor used by
+    truncated_normal must stay in ndtri's finite range (the 1000-species
+    bench chain blew up through exactly this path)."""
+    import jax.numpy as jnp
+    from jax.scipy.special import ndtri
+
+    from hmsc_tpu.ops.rand import _P_FLOOR
+
+    assert np.isfinite(float(ndtri(jnp.float32(_P_FLOOR))))
+
+
+def test_truncnorm_extreme_one_sided_all_finite():
+    """One-sided truncations at extreme means (|a| near and past the far-tail
+    switch) must produce finite draws for every uniform realisation."""
+    import jax
+    import jax.numpy as jnp
+
+    from hmsc_tpu.ops.rand import truncated_normal
+
+    key = jax.random.PRNGKey(0)
+    for mu in (-8.9, -9.5, -30.0, 8.9, 9.5, 30.0):
+        lb = jnp.where(mu < 0, 0.0, -jnp.inf)
+        ub = jnp.where(mu < 0, jnp.inf, 0.0)
+        x = truncated_normal(key, lb, ub, jnp.full((200_000,), mu), 1.0)
+        assert np.isfinite(np.asarray(x)).all(), mu
+        # draws respect the bound
+        assert (np.asarray(x) >= 0).all() if mu < 0 else (np.asarray(x) <= 0).all()
