@@ -21,7 +21,7 @@ _TINY = 1e-38  # smallest safe f32 normal-ish
 _P_FLOOR = 1e-30
 
 
-def truncated_normal(key, lower, upper, mean=0.0, std=1.0):
+def truncated_normal(key, lower, upper, mean=0.0, std=1.0, *, _u=None):
     """Truncated normal draw on [lower, upper], elementwise over the broadcast
     shape.  Replaces the per-cell ``rtruncnorm`` loop flagged as "often the
     bottleneck" (reference ``R/updateZ.R:59``) with one fused array op.
@@ -37,7 +37,11 @@ def truncated_normal(key, lower, upper, mean=0.0, std=1.0):
                                  jnp.shape(mean), jnp.shape(std))
     a = (jnp.broadcast_to(lower, shape) - mean) / std
     b = (jnp.broadcast_to(upper, shape) - mean) / std
-    u = jax.random.uniform(key, shape, minval=_TINY, maxval=1.0)
+    # _u: test hook to inject the uniform draw (the s==1.0 rounding overflow
+    # below is backend-dependent — TPU's non-FMA schedule hits it, CPU's FMA
+    # does not — so the regression test injects the adversarial u directly)
+    u = (jax.random.uniform(key, shape, minval=_TINY, maxval=1.0)
+         if _u is None else jnp.broadcast_to(_u, shape))
 
     # right-tail intervals: work with survival probs S(x) = Phi(-x)
     right = (a + jnp.clip(b, -1e30, 1e30)) > 0
@@ -52,7 +56,14 @@ def truncated_normal(key, lower, upper, mean=0.0, std=1.0):
 
     sa, sb = ndtr(-a2), ndtr(-b2)         # P(X > a2) >= P(X > b2)
     s = sb + u * (sa - sb)
-    x_r = -ndtri(jnp.clip(s, _P_FLOOR, 1.0))
+    # cap s strictly below 1: when the interval is unbounded on the reflected
+    # left (sa == 1), u near 1 rounds s to exactly 1.0 in f32 and ndtri(1) is
+    # +-inf — one such cell per ~1.7e7 draws, enough to poison a chain at the
+    # 1000x1000 bench scale.  1 - epsneg is the largest float below 1; the
+    # draw saturates at ~5.4 sigma into the unbounded side (f32), which is
+    # the inverse-CDF resolution there anyway.
+    s_ceil = 1.0 - jnp.finfo(s.dtype).epsneg
+    x_r = -ndtri(jnp.clip(s, _P_FLOOR, s_ceil))
 
     # far-tail fallback: past ~9 sigma the interval probability underflows
     # f32 and ndtri saturates; the exponential asymptotic (Robert 1995) is

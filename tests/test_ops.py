@@ -64,6 +64,33 @@ def test_truncated_normal_far_two_sided():
     assert (x < 9.3).mean() > 0.55
 
 
+def test_truncated_normal_extreme_uniform_finite():
+    """Regression (round-3 headline-bench divergence): with the interval
+    unbounded on one side, a uniform draw at the top of its f32 range rounds
+    the interpolated survival probability s = sb + u*(sa-sb) to exactly 1.0
+    on non-FMA schedules (TPU), and ndtri(1.0) = inf poisoned a whole chain
+    through one Z cell.  Inject the adversarial u (1 - 2^-24, the supremum of
+    jax.random.uniform's f32 output) on every branch combination and require
+    finite, in-bounds draws."""
+    key = jax.random.PRNGKey(0)
+    u_max = jnp.float32(1.0) - jnp.float32(2.0**-24)
+    cases = [
+        (0.0, jnp.inf, 0.0185),    # the observed failing cell: Y=1, E~0
+        (0.0, jnp.inf, -3.0),      # Y=1, E negative (right tail)
+        (-jnp.inf, 0.0, 0.0185),   # Y=0 mirror
+        (-jnp.inf, 0.0, 5.0),      # Y=0, E positive (left tail)
+        (0.0, jnp.inf, -12.0),     # far-tail asymptotic branch (a2 = 12 > FAR)
+        (-2.0, 2.0, 0.0),          # bounded interval
+    ]
+    for u in (u_max, jnp.float32(1e-38)):
+        for lb, ub, mean in cases:
+            x = truncated_normal(key, jnp.full(8, lb), jnp.full(8, ub),
+                                 jnp.float32(mean), 1.0, _u=u)
+            x = np.asarray(x)
+            assert np.all(np.isfinite(x)), (float(u), lb, ub, mean, x)
+            assert np.all(x >= lb) and np.all(x <= ub)
+
+
 def test_truncated_normal_two_sided():
     key = jax.random.PRNGKey(3)
     n = 200_000
