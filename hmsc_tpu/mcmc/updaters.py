@@ -13,7 +13,7 @@ import jax.numpy as jnp
 from jax.scipy.linalg import solve_triangular
 
 from ..ops.linalg import chol_spd, sample_mvn_prec
-from ..ops.rand import polya_gamma, truncated_normal, wishart
+from ..ops.rand import polya_gamma, standard_gamma, truncated_normal, wishart
 from .structs import GibbsState, LevelState, ModelData, ModelSpec
 
 __all__ = ["linear_fixed", "level_loading", "update_z", "update_beta_lambda",
@@ -401,7 +401,7 @@ def update_lambda_priors(spec: ModelSpec, data: ModelData, state: GibbsState,
 
         a_psi = lvd.nu[None, None, :] / 2 + 0.5
         b_psi = lvd.nu[None, None, :] / 2 + 0.5 * lam2 * tau[:, None, :]
-        psi = jax.random.gamma(kpsi, jnp.broadcast_to(a_psi, lam2.shape)) / b_psi
+        psi = standard_gamma(kpsi, jnp.broadcast_to(a_psi, lam2.shape)) / b_psi
 
         M = psi * lam2                                      # (nf, ns, ncr)
         Msum = M.sum(axis=1)                                # (nf, ncr)
@@ -418,7 +418,7 @@ def update_lambda_priors(spec: ModelSpec, data: ModelData, state: GibbsState,
                 b0 = lvd.b2
             tail = (tau[h:] * Msum[h:] * mask[h:, None]).sum(axis=0)
             bd = b0 + 0.5 * tail / delta[h]
-            draw = jax.random.gamma(keys[h], jnp.broadcast_to(ad, (ls.ncr,))) / bd
+            draw = standard_gamma(keys[h], jnp.broadcast_to(ad, (ls.ncr,))) / bd
             delta = delta.at[h].set(jnp.where(mask[h] > 0, draw, 1.0))
         new_levels.append(lv.replace(Psi=psi, Delta=delta))
     return state.replace(levels=tuple(new_levels))
@@ -478,7 +478,7 @@ def update_inv_sigma(spec: ModelSpec, data: ModelData, state: GibbsState,
     n_obs = data.Ymask.sum(axis=0)
     shape = data.aSigma + 0.5 * n_obs
     rate = data.bSigma + 0.5 * ((Eps * data.Ymask) ** 2).sum(axis=0)
-    draw = jax.random.gamma(key, shape) / rate
+    draw = standard_gamma(key, shape) / rate
     iSigma = jnp.where(data.distr_estsig > 0, draw, 1.0 / data.sigma_fixed)
     return state.replace(iSigma=iSigma)
 
@@ -517,11 +517,11 @@ def update_nf(spec: ModelSpec, data: ModelData, state: GibbsState, r: int,
     sel = jnp.where(do_add, onehot, 0.0)
     new_eta_col = jax.random.normal(k_eta, (ls.n_units,), dtype=lv.Eta.dtype)
     Eta = lv.Eta * (1 - sel)[None, :] + new_eta_col[:, None] * sel[None, :]
-    new_psi = jax.random.gamma(k_psi, jnp.broadcast_to(
+    new_psi = standard_gamma(k_psi, jnp.broadcast_to(
         lvd.nu[None, :] / 2, (spec.ns, ls.ncr))) / (lvd.nu[None, :] / 2)
     Psi = lv.Psi * (1 - sel)[:, None, None] \
         + new_psi[None] * sel[:, None, None]
-    new_del = jax.random.gamma(k_del, lvd.a2) / lvd.b2
+    new_del = standard_gamma(k_del, lvd.a2) / lvd.b2
     Delta = lv.Delta * (1 - sel)[:, None] + new_del[None, :] * sel[:, None]
     Lambda = lv.Lambda * (1 - sel)[:, None, None]
     alpha_idx = (lv.alpha_idx * (1 - sel.astype(jnp.int32))).astype(jnp.int32)

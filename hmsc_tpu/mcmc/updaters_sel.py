@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.linalg import chol_spd, sample_mvn_prec
+from ..ops.rand import standard_gamma
 from .structs import GibbsState, ModelData, ModelSpec
 
 __all__ = ["effective_design", "selection_mask", "append_rrr", "update_w_rrr",
@@ -115,7 +116,7 @@ def update_w_rrr_priors(spec: ModelSpec, data: ModelData, state: GibbsState,
     tau = jnp.cumprod(delta)
     a_psi = data.nuRRR / 2 + 0.5
     b_psi = data.nuRRR / 2 + 0.5 * lam2 * tau[:, None]
-    psi = jax.random.gamma(kpsi, jnp.broadcast_to(a_psi, lam2.shape)) / b_psi
+    psi = standard_gamma(kpsi, jnp.broadcast_to(a_psi, lam2.shape)) / b_psi
     M = psi * lam2
     Msum = M.sum(axis=1)                                  # (ncr,)
     keys = jax.random.split(kdel, ncr)
@@ -128,7 +129,7 @@ def update_w_rrr_priors(spec: ModelSpec, data: ModelData, state: GibbsState,
             ad = data.a2RRR + 0.5 * nco * (ncr - h)
             b0 = data.b2RRR
         bd = b0 + 0.5 * (tau[h:] * Msum[h:]).sum() / delta[h]
-        delta = delta.at[h].set(jax.random.gamma(keys[h], ad) / bd)
+        delta = delta.at[h].set(standard_gamma(keys[h], ad) / bd)
     return state.replace(PsiRRR=psi, DeltaRRR=delta)
 
 

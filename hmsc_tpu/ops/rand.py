@@ -11,8 +11,8 @@ import jax
 import jax.numpy as jnp
 from jax.scipy.special import ndtr, ndtri
 
-__all__ = ["truncated_normal", "polya_gamma", "wishart", "mvn_from_prec_chol",
-           "categorical_logits"]
+__all__ = ["truncated_normal", "standard_gamma", "polya_gamma", "wishart",
+           "mvn_from_prec_chol", "categorical_logits"]
 
 _TINY = 1e-38  # smallest safe f32 normal-ish
 # f32 ndtri overflows to -inf below ~1e-33 (ndtri(1e-38) = -inf while
@@ -79,6 +79,54 @@ def truncated_normal(key, lower, upper, mean=0.0, std=1.0, *, _u=None):
     return mean + std * x
 
 
+def standard_gamma(key, a, shape=None, n_rounds: int = 8):
+    """Standard Gamma(a, 1) draw, TPU-native.
+
+    ``jax.random.gamma`` lowers to a per-element rejection ``while_loop`` over
+    per-element split keys; on TPU that is ~35x slower than a same-shape
+    normal draw and was 94% of the whole Gibbs sweep at the 1000-species
+    bench scale.  This sampler vectorises Marsaglia-Tsang (2000) rejection
+    instead: ``n_rounds`` candidate batches are drawn up front as fused
+    whole-array normal/uniform ops and the first accepted candidate is
+    selected per element — no per-element keys, no data-dependent loop.
+
+    Exact on acceptance; the probability that all ``n_rounds`` candidates are
+    rejected is <= 0.05^n_rounds (~4e-11 at the default), in which case the
+    draw falls back to the distribution mode — far below Monte-Carlo
+    resolution.  Shapes a < 1 use the boost ``Ga(a) = Ga(a+1) * U^(1/a)``.
+    """
+    a = jnp.asarray(a)
+    if shape is None:
+        shape = a.shape
+    dtype = a.dtype if jnp.issubdtype(a.dtype, jnp.floating) \
+        else jnp.result_type(float)
+    a = jnp.broadcast_to(a, shape).astype(dtype)
+
+    boost = a < 1.0
+    a_eff = jnp.where(boost, a + 1.0, jnp.maximum(a, 1.0))
+    d = a_eff - 1.0 / 3.0
+    c = 1.0 / jnp.sqrt(9.0 * d)
+
+    kx, ku, kb = jax.random.split(key, 3)
+    cand = (n_rounds,) + tuple(shape)
+    x = jax.random.normal(kx, cand, dtype=dtype)
+    v = (1.0 + c[None] * x) ** 3
+    u = jax.random.uniform(ku, cand, dtype=dtype, minval=_TINY, maxval=1.0)
+    vsafe = jnp.where(v > 0, v, 1.0)
+    ok = (v > 0) & (jnp.log(u) < 0.5 * x * x + d[None] * (1.0 - v + jnp.log(vsafe)))
+
+    idx = jnp.argmax(ok, axis=0)                  # first accepting round
+    vsel = jnp.take_along_axis(vsafe, idx[None], axis=0)[0]
+    draw = d * jnp.where(jnp.any(ok, axis=0), vsel, 1.0)
+
+    # a < 1: multiply by U^(1/a).  boost is data-dependent under jit, so the
+    # uniform + pow run on every call; both are single fused elementwise ops,
+    # negligible next to the n_rounds candidate batches above.
+    ub = jax.random.uniform(kb, shape, dtype=dtype, minval=_TINY, maxval=1.0)
+    pow_ = ub ** (1.0 / jnp.where(boost, a, 1.0))
+    return jnp.where(boost, draw * pow_, draw)
+
+
 def _pg_moments(h, z):
     """Mean/variance of PG(h, z) from its cumulant generating function."""
     u = 0.5 * jnp.abs(z)
@@ -107,7 +155,7 @@ def polya_gamma(key, h, z, n_terms: int = 0):
     if n_terms > 0:
         ks = jnp.arange(1, n_terms + 1, dtype=jnp.result_type(float))
         denom = (ks - 0.5) ** 2 + (jnp.asarray(z)[..., None] / (2 * jnp.pi)) ** 2
-        g = jax.random.gamma(key, jnp.asarray(h)[..., None] * jnp.ones_like(denom))
+        g = standard_gamma(key, jnp.asarray(h)[..., None] * jnp.ones_like(denom))
         draw = (g / denom).sum(-1) / (2 * jnp.pi**2)
         # truncation loses mass in the tail terms; add its expected value
         mean, _ = _pg_moments(h, z)
@@ -127,7 +175,7 @@ def wishart(key, df, scale_factor):
     dtype = scale_factor.dtype
     # chi^2_{df-i} = 2 * Gamma((df-i)/2)
     dfs = (df - jnp.arange(p, dtype=dtype)) / 2.0
-    diag = jnp.sqrt(2.0 * jax.random.gamma(kc, dfs))
+    diag = jnp.sqrt(2.0 * standard_gamma(kc, dfs))
     A = jnp.tril(jax.random.normal(kn, (p, p), dtype=dtype), -1) + jnp.diag(diag)
     TA = scale_factor @ A
     return TA @ TA.T

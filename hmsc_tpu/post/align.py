@@ -1,9 +1,14 @@
-"""Post-hoc factor sign alignment across chains (reference
+"""Post-hoc sign alignment across chains (reference
 ``R/alignPosterior.R:18-100``, called 5x after sampling).
 
 Latent factors are identified only up to sign: for each level and factor, every
 sample's (Lambda, Eta) pair is sign-flipped to correlate positively with the
-cross-chain posterior-mean Lambda.  Host-side numpy over the stacked arrays.
+cross-chain posterior-mean Lambda.  Reduced-rank regression components carry
+the same ambiguity jointly in (wRRR, Beta/Gamma/V rows): each component is
+flipped against the posterior-mean wRRR, with the paired Beta/Gamma rows and
+V row+column flipped along (reference ``alignPosterior.R:77-100``; the
+reference anchors on chain 1's mean — here the mean pools all healthy chains).
+Host-side numpy over the stacked arrays.
 """
 
 from __future__ import annotations
@@ -13,12 +18,18 @@ import numpy as np
 __all__ = ["align_posterior"]
 
 
+def _good_mask(post) -> np.ndarray:
+    good = post.chain_health["good_chains"]
+    return good if good.any() else np.ones_like(good, dtype=bool)
+
+
 def align_posterior(post) -> None:
+    gmask = _good_mask(post)
     for r in range(post.spec.nr):
         lam = post.arrays[f"Lambda_{r}"]          # (c, s, nf, ns[, ncr])
         eta = post.arrays[f"Eta_{r}"]             # (c, s, np, nf)
         lam2 = lam[..., 0] if lam.ndim == 5 else lam
-        mean_lam = lam2.mean(axis=(0, 1))         # (nf, ns)
+        mean_lam = lam2[gmask].mean(axis=(0, 1))  # (nf, ns)
         # per-sample correlation sign against the cross-chain mean
         num = np.einsum("csfj,fj->csf", lam2, mean_lam)
         sign = np.where(num < 0, -1.0, 1.0)       # (c, s, nf)
@@ -30,3 +41,25 @@ def align_posterior(post) -> None:
         eta = eta * sign[:, :, None, :]
         post.arrays[f"Lambda_{r}"] = lam
         post.arrays[f"Eta_{r}"] = eta
+
+    spec = post.spec
+    if spec.nc_rrr > 0 and "wRRR" in post.arrays:
+        w = post.arrays["wRRR"]                   # (c, s, K, nc_orrr)
+        mean_w = w[gmask].mean(axis=(0, 1))       # (K, nc_orrr)
+        # centered correlation sign (the reference's cor(), :86)
+        wc = w - w.mean(axis=-1, keepdims=True)
+        mc = mean_w - mean_w.mean(axis=-1, keepdims=True)
+        num = np.einsum("cskj,kj->csk", wc, mc)
+        sign = np.where(num < 0, -1.0, 1.0)       # (c, s, K)
+        ncn = spec.nc_nrrr
+        post.arrays["wRRR"] = w * sign[..., None]
+        B = np.array(post.arrays["Beta"])
+        B[:, :, ncn:, :] = B[:, :, ncn:, :] * sign[..., None]
+        post.arrays["Beta"] = B
+        G = np.array(post.arrays["Gamma"])
+        G[:, :, ncn:, :] = G[:, :, ncn:, :] * sign[..., None]
+        post.arrays["Gamma"] = G
+        V = np.array(post.arrays["V"])
+        V[:, :, ncn:, :] = V[:, :, ncn:, :] * sign[..., None]
+        V[:, :, :, ncn:] = V[:, :, :, ncn:] * sign[:, :, None, :]
+        post.arrays["V"] = V

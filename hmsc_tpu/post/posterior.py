@@ -34,6 +34,15 @@ class Posterior:
         self.thin = thin
         self.n_chains = next(iter(self.arrays.values())).shape[0] if self.arrays else 0
         self.timing = None          # {"setup_s", "run_s"} set by sample_mcmc
+        # divergence health: first non-finite sweep per chain (-1 = clean),
+        # set by sample_mcmc; poisoned chains are excluded from pooled()
+        self.chain_health = {"first_bad_it": np.full(self.n_chains, -1),
+                             "good_chains": np.ones(self.n_chains, bool)}
+
+    def set_chain_health(self, first_bad_it: np.ndarray) -> None:
+        first_bad_it = np.asarray(first_bad_it)
+        self.chain_health = {"first_bad_it": first_bad_it,
+                             "good_chains": first_bad_it < 0}
 
     # ------------------------------------------------------------------
     def __getitem__(self, name: str) -> np.ndarray:
@@ -49,11 +58,17 @@ class Posterior:
         sub = Posterior(self.hM, self.spec, arrays,
                         samples=arrays["Beta"].shape[1],
                         transient=self.transient, thin=self.thin * thin)
+        sub.set_chain_health(self.chain_health["first_bad_it"])
         return sub
 
     def pooled(self, name: str) -> np.ndarray:
-        """(chains*samples, ...) flattened view (poolMcmcChains)."""
+        """(chains*samples, ...) flattened view (poolMcmcChains); chains whose
+        carry went non-finite (``chain_health``) are excluded so one diverged
+        chain cannot silently poison every pooled summary."""
         a = self.arrays[name]
+        good = self.chain_health["good_chains"]
+        if not good.all() and good.any():
+            a = a[good]
         return a.reshape((-1,) + a.shape[2:])
 
     def post_list(self) -> list[list[dict]]:
@@ -133,9 +148,15 @@ class Posterior:
 
 def pool_mcmc_chains(post: Posterior, start: int = 0, thin: int = 1) -> list[dict]:
     """Flatten postList[chains][samples] -> a flat list of sample dicts
-    (reference ``R/poolMcmcChains.R:19-27``)."""
+    (reference ``R/poolMcmcChains.R:19-27``).  Chains flagged non-finite in
+    ``chain_health`` are excluded, consistent with ``Posterior.pooled``;
+    ``post_list()`` itself still exposes every chain raw."""
     pl = post.post_list()
+    good = post.chain_health["good_chains"]
+    if not (good.any() and not good.all()):
+        good = np.ones(len(pl), bool)
     out = []
-    for chain in pl:
-        out.extend(chain[start::thin])
+    for c, chain in enumerate(pl):
+        if good[c]:
+            out.extend(chain[start::thin])
     return out

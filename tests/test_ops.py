@@ -8,7 +8,36 @@ import numpy as np
 import pytest
 from scipy import stats as sps
 
-from hmsc_tpu.ops.rand import polya_gamma, truncated_normal, wishart
+from hmsc_tpu.ops.rand import (polya_gamma, standard_gamma, truncated_normal,
+                               wishart)
+
+
+def test_standard_gamma_distribution():
+    """The vectorised Marsaglia-Tsang sampler (replacing jax.random.gamma,
+    which lowers to a per-element while_loop ~35x slower than a normal draw
+    on TPU) must match the exact Gamma law across the shape regimes the
+    Gibbs sweep uses: psi (a=2), nf-adapt psi (a=1.5), delta (a=50),
+    inv-sigma (a ~ ny/2), and the a<1 boost path."""
+    key = jax.random.PRNGKey(11)
+    n = 200_000
+    for i, a in enumerate((0.5, 1.0, 1.5, 2.0, 50.0, 500.0)):
+        x = np.asarray(standard_gamma(jax.random.fold_in(key, i),
+                                      jnp.full(n, a, jnp.float32)))
+        assert np.all(np.isfinite(x)) and np.all(x >= 0)
+        ks = sps.kstest(x, "gamma", args=(a,))
+        assert ks.statistic < 0.01, (a, ks.statistic)
+        assert abs(x.mean() - a) < 0.05 * np.sqrt(a)
+        assert abs(x.var() - a) < 0.1 * a
+
+
+def test_standard_gamma_broadcast_shapes():
+    key = jax.random.PRNGKey(1)
+    a = jnp.array([1.5, 2.0, 50.0])
+    x = standard_gamma(key, a, shape=(1000, 3))
+    assert x.shape == (1000, 3)
+    assert np.asarray(x).std(axis=0).shape == (3,)
+    s = standard_gamma(key, 2.0)
+    assert s.shape == ()
 
 
 def test_truncated_normal_onesided_moments():

@@ -81,6 +81,45 @@ def test_rrr_with_random_level():
     assert np.isfinite(pr).all()
 
 
+def test_rrr_sign_alignment():
+    """align_posterior must make wRRR sign-stable across chains: flipping a
+    whole chain's (wRRR, Beta/Gamma RRR rows, V row+col) is a posterior
+    symmetry, and alignment must undo it (reference alignPosterior.R:77-100)."""
+    from hmsc_tpu.post.align import align_posterior
+
+    m, _, _ = _rrr_model(seed=5)
+    post = sample_mcmc(m, samples=20, transient=40, n_chains=2, seed=4,
+                       align_post=False)
+    ncn = post.spec.nc_nrrr
+    # apply the sign symmetry to chain 1 wholesale
+    for name, flip in (("wRRR", "row"), ("Beta", "rrr_row"),
+                       ("Gamma", "rrr_row")):
+        a = np.array(post.arrays[name])
+        if flip == "row":
+            a[1] = -a[1]
+        else:
+            a[1, :, ncn:, :] = -a[1, :, ncn:, :]
+        post.arrays[name] = a
+    V = np.array(post.arrays["V"])
+    V[1, :, ncn:, :] = -V[1, :, ncn:, :]
+    V[1, :, :, ncn:] = -V[1, :, :, ncn:]
+    post.arrays["V"] = V
+
+    flipped_w = post.arrays["wRRR"].copy()
+    for _ in range(5):
+        align_posterior(post)
+    w = post.arrays["wRRR"]
+    # per-chain means now agree in sign and the flip is exactly undone on
+    # one of the chains (alignment can only multiply by +-1)
+    m0, m1 = w[0].mean(axis=0), w[1].mean(axis=0)
+    assert float(np.sum(m0 * m1)) > 0
+    assert np.allclose(np.abs(w), np.abs(flipped_w))
+    # the paired Beta rows moved with it: recorded draws still satisfy the
+    # linear-predictor invariant after alignment
+    Lp = posterior_linear_predictor(post)
+    assert np.isfinite(Lp).all()
+
+
 def test_rrr_backtransform_invariant():
     """Recorded (Beta, wRRR) against *raw* X/XRRR must reproduce the scaled
     design's linear predictor — the invariant record_sample maintains."""
