@@ -36,27 +36,42 @@ def _packer(n_leaves):
     return jax.jit(lambda *xs: jnp.concatenate([x.ravel() for x in xs]))
 
 
-def _fetch_records(recs):
-    """Device->host fetch of the recorded-sample pytree as ONE transfer.
+def _pack_records(recs):
+    """Pack the f32 leaves of a recorded-sample pytree into ONE device buffer.
 
     A per-leaf ``np.asarray`` pays the device round-trip latency once per
     parameter (9+ round-trips); on a remote-attached TPU that latency is
-    ~65 ms each and dominates the benchmark wall-clock.  Packing the float32
-    leaves into a single buffer on device makes the host copy one
-    latency + pure bandwidth."""
+    ~65 ms each and dominates the benchmark wall-clock.  The packed buffer
+    makes the host copy one latency + pure bandwidth, and — dispatched
+    asynchronously per segment — overlaps the copy with the next segment's
+    compute."""
     leaves, treedef = jax.tree.flatten(recs)
     f32 = [i for i, l in enumerate(leaves)
            if l.dtype == jnp.float32 and l.size > 0]
-    out = list(leaves)
     if len(f32) > 1:
         packed = _packer(len(f32))(*[leaves[i] for i in f32])
+        # retain only shapes for the packed leaves — holding the original
+        # device arrays until fetch time would double record HBM
+        shapes = {i: leaves[i].shape for i in f32}
+        for i in f32:
+            leaves[i] = None
+    else:
+        packed, shapes = None, {}
+    return packed, leaves, shapes, treedef, f32
+
+
+def _unpack_records(packed, leaves, shapes, treedef, f32):
+    """Host-side counterpart of :func:`_pack_records` (forces the fetch)."""
+    out = list(leaves)
+    if packed is not None:
         host = np.asarray(packed)
         off = 0
         for i in f32:
-            n = leaves[i].size
+            shape = shapes[i]
+            n = int(np.prod(shape))
             # copy: a view would pin the whole packed buffer in host memory
             # for as long as any single parameter array is kept alive
-            out[i] = host[off:off + n].reshape(leaves[i].shape).copy()
+            out[i] = host[off:off + n].reshape(shape).copy()
             off += n
     for i in range(len(out)):
         if not isinstance(out[i], np.ndarray):
@@ -217,6 +232,9 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         if int(samples) % chunk:
             seg_sizes.append(int(samples) % chunk)
     else:
+        # (measured: on the remote-attached chip, device->host copies do not
+        # overlap device compute, so splitting the scan to pipeline fetches
+        # only adds per-segment round-trip latency — keep one segment)
         seg_sizes = [int(samples)]
     total_it = it0 + int(transient) + int(samples) * int(thin)
 
@@ -246,7 +264,9 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
             fn = _compiled_runner(spec, updater_items, adapt_nf, seg,
                                   trans_cur, int(thin), skip_z)
             recs, state_cur, bad_cur, keys = fn(data, state_cur, keys, bad_cur)
-            recs_segs.append(recs)
+            # pack now (async on device); fetch below, overlapping later
+            # segments' compute
+            recs_segs.append(_pack_records(recs))
             trans_cur = 0
             skip_z = True
             if verbose:
@@ -254,13 +274,12 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 phase = "sampling" if it_now > it0 + transient else "transient"
                 print(f"iteration {it_now} of {total_it} ({phase})")
         final_state = state_cur
-        if len(recs_segs) == 1:
-            recs = recs_segs[0]
+        host_segs = [_unpack_records(*seg) for seg in recs_segs]
+        if len(host_segs) == 1:
+            recs = host_segs[0]
         else:
-            recs = jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=1),
-                                *recs_segs)
-        jax.block_until_ready(recs)
-    recs = _fetch_records(recs)                  # (chains, samples, ...)
+            recs = jax.tree.map(lambda *xs: np.concatenate(xs, axis=1),
+                                *host_segs)
     t2 = time.perf_counter()
 
     post = Posterior(hM, spec, recs, samples=samples, transient=transient,

@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 from jax.scipy.linalg import solve_triangular
 
-from ..ops.linalg import chol_spd, sample_mvn_prec
+from ..ops.linalg import chol_spd, sample_mvn_prec, sample_mvn_prec_batched
 from ..ops.rand import polya_gamma, standard_gamma, truncated_normal, wishart
 from .structs import GibbsState, LevelState, ModelData, ModelSpec
 
@@ -220,9 +220,8 @@ def _beta_lambda_joint(spec, data, state, key):
         [Mu_beta, jnp.zeros((spec.nf_total, spec.ns), dtype=G.dtype)], axis=0)  # (P, ns)
     rhs = jnp.einsum("jpq,qj->jp", P0, mu0) + state.iSigma[:, None] * rhs_lik
 
-    L = chol_spd(prec)
     eps = jax.random.normal(key, (spec.ns, P), dtype=G.dtype)
-    BL = sample_mvn_prec(L, rhs, eps)                     # (ns, P)
+    BL = sample_mvn_prec_batched(prec, rhs, eps)          # (ns, P)
     Beta, levels = _unstack_lambda(spec, BL.T, state)
     return state.replace(Beta=Beta, levels=levels)
 
@@ -246,9 +245,8 @@ def _lambda_given_beta(spec, data, state, key):
     prec = state.iSigma[:, None, None] * G \
         + jnp.eye(K, dtype=G.dtype)[None] * prior_lam.T[:, :, None]
     rhs = state.iSigma[:, None] * rhs_lik
-    L = chol_spd(prec)
     eps = jax.random.normal(key, (spec.ns, K), dtype=G.dtype)
-    Lam = sample_mvn_prec(L, rhs, eps)                    # (ns, K)
+    Lam = sample_mvn_prec_batched(prec, rhs, eps)         # (ns, K)
     _, levels = _unstack_lambda(
         spec, jnp.concatenate([state.Beta, Lam.T], axis=0), state)
     return state.replace(levels=levels)
@@ -460,9 +458,8 @@ def update_eta_nonspatial(spec, data, state, r: int, key, S):
     lvd, lv, ls = data.levels[r], state.levels[r], spec.levels[r]
     LiSL, F = _masked_level_gram(spec, data, lvd, ls, lv, state.iSigma, S)
     prec = LiSL + jnp.eye(ls.nf_max, dtype=F.dtype)[None]
-    L = chol_spd(prec)
     eps = jax.random.normal(key, F.shape, dtype=F.dtype)
-    eta = sample_mvn_prec(L, F, eps)                        # (np, nf)
+    eta = sample_mvn_prec_batched(prec, F, eps)             # (np, nf)
     return lv.replace(Eta=eta)
 
 

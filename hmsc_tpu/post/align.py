@@ -18,13 +18,8 @@ import numpy as np
 __all__ = ["align_posterior"]
 
 
-def _good_mask(post) -> np.ndarray:
-    good = post.chain_health["good_chains"]
-    return good if good.any() else np.ones_like(good, dtype=bool)
-
-
 def align_posterior(post) -> None:
-    gmask = _good_mask(post)
+    gmask = post.good_chain_mask()
     for r in range(post.spec.nr):
         lam = post.arrays[f"Lambda_{r}"]          # (c, s, nf, ns[, ncr])
         eta = post.arrays[f"Eta_{r}"]             # (c, s, np, nf)

@@ -44,6 +44,17 @@ class Posterior:
         self.chain_health = {"first_bad_it": first_bad_it,
                              "good_chains": first_bad_it < 0}
 
+    def good_chain_mask(self) -> np.ndarray:
+        """Effective chain mask for pooled summaries: excludes diverged
+        chains, except when every chain diverged (then nothing is excluded —
+        degenerate output is better than empty output, and the divergence
+        warnings have already fired).  The single source of truth for
+        pooled(), pool_mcmc_chains and align_posterior."""
+        good = self.chain_health["good_chains"]
+        if good.all() or not good.any():
+            return np.ones(self.n_chains, bool)
+        return good
+
     # ------------------------------------------------------------------
     def __getitem__(self, name: str) -> np.ndarray:
         return self.arrays[name]
@@ -66,8 +77,8 @@ class Posterior:
         carry went non-finite (``chain_health``) are excluded so one diverged
         chain cannot silently poison every pooled summary."""
         a = self.arrays[name]
-        good = self.chain_health["good_chains"]
-        if not good.all() and good.any():
+        good = self.good_chain_mask()
+        if not good.all():
             a = a[good]
         return a.reshape((-1,) + a.shape[2:])
 
@@ -152,9 +163,7 @@ def pool_mcmc_chains(post: Posterior, start: int = 0, thin: int = 1) -> list[dic
     ``chain_health`` are excluded, consistent with ``Posterior.pooled``;
     ``post_list()`` itself still exposes every chain raw."""
     pl = post.post_list()
-    good = post.chain_health["good_chains"]
-    if not (good.any() and not good.all()):
-        good = np.ones(len(pl), bool)
+    good = post.good_chain_mask()
     out = []
     for c, chain in enumerate(pl):
         if good[c]:

@@ -117,6 +117,18 @@ def compute_predicted_values(post, partition=None, partition_sp=None,
     post_n = post.samples * n_chains
     pred_array = np.full((post_n, hM.ny, hM.ns), np.nan)
 
+    def _fill_rows(pred):
+        """Pad a fold's posterior-predictive draws back to post_n rows when a
+        refit chain diverged (pooled() excludes it): cycle the healthy draws
+        so the fold's Monte-Carlo estimate stays valid and the shared
+        pred_array keeps one fixed draw axis."""
+        if pred.shape[0] == post_n:
+            return pred
+        if pred.shape[0] == 0:
+            raise RuntimeError("cross-validation fold refit: every chain "
+                               "diverged; no finite draws to predict from")
+        return pred[np.resize(np.arange(pred.shape[0]), post_n)]
+
     for ki, k in enumerate(folds):
         if verbose:
             print(f"Cross-validation, fold {ki + 1} out of {len(folds)}")
@@ -134,10 +146,11 @@ def compute_predicted_values(post, partition=None, partition_sp=None,
         X_val = (list(hM.X[:, val, :]) if hM.x_is_list else hM.X[val])
         XRRR_val = None if hM.nc_rrr == 0 else hM.XRRR[val]
         if partition_sp is None:
-            pred = predict(post1, X=X_val, XRRR=XRRR_val, study_design=sd_val,
-                           Yc=None if Yc is None else Yc[val],
-                           mcmc_step=mcmc_step, expected=expected,
-                           seed=int(rng.integers(2**31)))
+            pred = _fill_rows(predict(
+                post1, X=X_val, XRRR=XRRR_val, study_design=sd_val,
+                Yc=None if Yc is None else Yc[val],
+                mcmc_step=mcmc_step, expected=expected,
+                seed=int(rng.integers(2**31))))
         else:
             partition_sp = np.asarray(partition_sp)
             pred = np.full((post_n, int(val.sum()), hM.ns), np.nan)
@@ -145,10 +158,11 @@ def compute_predicted_values(post, partition=None, partition_sp=None,
                 val_sp = partition_sp == i
                 Yc_i = np.full((int(val.sum()), hM.ns), np.nan)
                 Yc_i[:, ~val_sp] = hM.Y[np.ix_(val, ~val_sp)]
-                pred2 = predict(post1, X=X_val, XRRR=XRRR_val,
-                                study_design=sd_val, Yc=Yc_i,
-                                mcmc_step=mcmc_step, expected=expected,
-                                seed=int(rng.integers(2**31)))
+                pred2 = _fill_rows(predict(
+                    post1, X=X_val, XRRR=XRRR_val,
+                    study_design=sd_val, Yc=Yc_i,
+                    mcmc_step=mcmc_step, expected=expected,
+                    seed=int(rng.integers(2**31))))
                 pred[:, :, val_sp] = pred2[:, :, val_sp]
         pred_array[:, val, :] = pred
     return pred_array
