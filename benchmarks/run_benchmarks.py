@@ -1,0 +1,175 @@
+"""Full benchmark sweep over the five BASELINE.md configs.
+
+Run on the TPU host:  ``python benchmarks/run_benchmarks.py``
+Writes one markdown table row per config and prints it; results are recorded
+in BENCHMARKS.md.  The headline driver contract stays in ``bench.py`` (one
+JSON line); this harness is the wide view: samples/sec/chip and ESS/sec for
+
+1. TD-scale probit JSDM, one unstructured level       (BASELINE.md config 1)
+2. 250 species, latent-factor shrinkage + adaptNf     (config 2)
+3. spatial levels: Full GP (np=200) and NNGP (np=1000) (config 3)
+4. traits + phylogeny (updateGammaV + updateRho)       (config 4)
+5. mixed normal/probit/lognormal-Poisson updateZ       (config 5)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from hmsc_tpu.model import Hmsc
+from hmsc_tpu.random_level import HmscRandomLevel, set_priors_random_level
+from hmsc_tpu.mcmc.sampler import sample_mcmc
+from hmsc_tpu.post.diagnostics import effective_size
+
+
+def _study(ny):
+    return pd.DataFrame({"sample": [f"s{i:05d}" for i in range(ny)]})
+
+
+def config1_td_probit(rng):
+    ny, ns = 50, 4
+    X = np.column_stack([np.ones(ny), rng.standard_normal(ny)])
+    Y = ((X @ rng.standard_normal((2, ns))
+          + rng.standard_normal((ny, ns))) > 0).astype(float)
+    study = _study(ny)
+    rl = HmscRandomLevel(units=study["sample"])
+    set_priors_random_level(rl, nf_max=2, nf_min=2)
+    m = Hmsc(Y=Y, X=X, distr="probit", study_design=study,
+             ran_levels={"sample": rl}, x_scale=False)
+    return m, dict(nf_cap=2)
+
+
+def config2_shrinkage(rng):
+    ny, ns, nf = 400, 250, 5
+    X = np.column_stack([np.ones(ny), rng.standard_normal((ny, 2))])
+    eta = rng.standard_normal((ny, nf))
+    lam = rng.standard_normal((nf, ns)) * (0.7 ** np.arange(nf))[:, None]
+    Y = ((X @ (rng.standard_normal((3, ns)) * 0.5) + eta @ lam
+          + rng.standard_normal((ny, ns))) > 0).astype(float)
+    study = _study(ny)
+    rl = HmscRandomLevel(units=study["sample"])
+    set_priors_random_level(rl, nf_max=10, nf_min=2)
+    m = Hmsc(Y=Y, X=X, distr="probit", study_design=study,
+             ran_levels={"sample": rl}, x_scale=False)
+    return m, dict(nf_cap=10)       # adapt_nf defaults to the transient
+
+def _spatial(rng, np_units, method, ny_per=2, **rl_kw):
+    ny, ns = np_units * ny_per, 50
+    units = [f"u{i:04d}" for i in range(np_units)]
+    unit_of = np.repeat(np.arange(np_units), ny_per)
+    xy = pd.DataFrame(rng.uniform(size=(np_units, 2)) * 10,
+                      index=units, columns=["x", "y"])
+    X = np.column_stack([np.ones(ny), rng.standard_normal(ny)])
+    eta = rng.standard_normal((np_units, 2))
+    lam = rng.standard_normal((2, ns))
+    L = X @ (rng.standard_normal((2, ns)) * 0.5) + eta[unit_of] @ lam
+    Y = ((L + rng.standard_normal((ny, ns))) > 0).astype(float)
+    study = pd.DataFrame({"plot": [units[u] for u in unit_of]})
+    rl = HmscRandomLevel(s_data=xy, s_method=method, **rl_kw)
+    set_priors_random_level(rl, nf_max=2, nf_min=2)
+    m = Hmsc(Y=Y, X=X, distr="probit", study_design=study,
+             ran_levels={"plot": rl}, x_scale=False)
+    return m, dict(nf_cap=2)
+
+
+def config3_spatial_full(rng):
+    return _spatial(rng, 200, "Full")
+
+
+def config3_spatial_nngp(rng):
+    return _spatial(rng, 1000, "NNGP", n_neighbours=10)
+
+
+def config4_traits_phylo(rng):
+    from hmsc_tpu.data.td import random_coalescent_corr
+    ny, ns, nt = 300, 200, 3
+    C = random_coalescent_corr(ns, rng)
+    Tr = np.column_stack([np.ones(ns), rng.standard_normal((ns, nt - 1))])
+    X = np.column_stack([np.ones(ny), rng.standard_normal((ny, 2))])
+    Gamma = rng.standard_normal((3, nt)) * 0.5
+    sqC = np.linalg.cholesky(0.5 * C + 0.5 * np.eye(ns) + 1e-6 * np.eye(ns))
+    Beta = Gamma @ Tr.T + 0.5 * rng.standard_normal((3, ns)) @ sqC.T
+    Y = X @ Beta + rng.standard_normal((ny, ns))
+    study = _study(ny)
+    rl = HmscRandomLevel(units=study["sample"])
+    set_priors_random_level(rl, nf_max=3, nf_min=2)
+    m = Hmsc(Y=Y, X=X, Tr=Tr, C=C, distr="normal", study_design=study,
+             ran_levels={"sample": rl}, x_scale=False)
+    return m, dict(nf_cap=3)
+
+
+def config5_mixed_distr(rng):
+    ny, ns = 300, 90
+    X = np.column_stack([np.ones(ny), rng.standard_normal(ny)])
+    L = X @ (rng.standard_normal((2, ns)) * 0.5)
+    Z = L + rng.standard_normal((ny, ns))
+    Y = np.empty((ny, ns))
+    distr = ["normal"] * 30 + ["probit"] * 30 + ["lognormal poisson"] * 30
+    Y[:, :30] = Z[:, :30]
+    Y[:, 30:60] = (Z[:, 30:60] > 0).astype(float)
+    Y[:, 60:] = rng.poisson(np.exp(np.clip(Z[:, 60:], -8, 4)))
+    study = _study(ny)
+    rl = HmscRandomLevel(units=study["sample"])
+    set_priors_random_level(rl, nf_max=2, nf_min=2)
+    m = Hmsc(Y=Y, X=X, distr=distr, study_design=study,
+             ran_levels={"sample": rl}, x_scale=False)
+    return m, dict(nf_cap=2)
+
+
+CONFIGS = [
+    ("1 TD probit + 1 level", config1_td_probit),
+    ("2 250-sp shrinkage + adaptNf", config2_shrinkage),
+    ("3a spatial Full np=200", config3_spatial_full),
+    ("3b spatial NNGP np=1000", config3_spatial_nngp),
+    ("4 traits + phylogeny", config4_traits_phylo),
+    ("5 mixed distr (norm/probit/logPois)", config5_mixed_distr),
+]
+
+SAMPLES, TRANSIENT, CHAINS = 250, 125, 4
+
+
+def run_one(name, builder):
+    rng = np.random.default_rng(42)
+    m, kw = builder(rng)
+    # compile warm-up
+    sample_mcmc(m, samples=SAMPLES, transient=TRANSIENT, n_chains=CHAINS,
+                seed=0, align_post=False, **kw)
+    t0 = time.time()
+    post = sample_mcmc(m, samples=SAMPLES, transient=TRANSIENT,
+                       n_chains=CHAINS, seed=1, align_post=False, **kw)
+    t = time.time() - t0
+    assert post.chain_health["good_chains"].all(), f"{name}: diverged chain"
+    B = post["Beta"]
+    assert np.isfinite(B).all(), f"{name}: non-finite Beta"
+    ess = np.asarray(effective_size(B.reshape(B.shape[0], B.shape[1], -1)))
+    rate = CHAINS * SAMPLES / t
+    row = {
+        "config": name, "ny": m.ny, "ns": m.ns,
+        "samples_per_s": round(rate, 1),
+        "ess_per_s_median": round(float(np.median(ess)) / t, 1),
+        "ess_per_s_min": round(float(np.min(ess)) / t, 2),
+        "wall_s": round(t, 2),
+    }
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def main():
+    rows = [run_one(name, b) for name, b in CONFIGS]
+    print("\n| config | ny | ns | samples/s/chip | med ESS/s | min ESS/s | wall (s) |")
+    print("|---|---|---|---|---|---|---|")
+    for r in rows:
+        print(f"| {r['config']} | {r['ny']} | {r['ns']} | {r['samples_per_s']} "
+              f"| {r['ess_per_s_median']} | {r['ess_per_s_min']} | {r['wall_s']} |")
+
+
+if __name__ == "__main__":
+    main()

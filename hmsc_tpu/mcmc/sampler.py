@@ -264,9 +264,11 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
             fn = _compiled_runner(spec, updater_items, adapt_nf, seg,
                                   trans_cur, int(thin), skip_z)
             recs, state_cur, bad_cur, keys = fn(data, state_cur, keys, bad_cur)
-            # pack now (async on device); fetch below, overlapping later
-            # segments' compute
+            # pack now (async on device); fetch below.  Drop the original
+            # record tree immediately — keeping it alive through the fetch
+            # would double record HBM (the pack holds the only live copy)
             recs_segs.append(_pack_records(recs))
+            del recs
             trans_cur = 0
             skip_z = True
             if verbose:

@@ -7,7 +7,16 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["effective_size", "gelman_rhat", "convert_to_coda_object"]
+__all__ = ["effective_size", "gelman_rhat", "convert_to_coda_object",
+           "CodaExport"]
+
+
+class CodaExport(dict):
+    """``{param: (array (chains, samples, k), labels)}`` with the coda
+    mcmc-window metadata as the ``window`` attribute — (start1, end1, thin) =
+    (transient + start*thin, transient + samples*thin, thin)."""
+
+    window: tuple | None = None
 
 
 def _autocov_fft(x: np.ndarray) -> np.ndarray:
@@ -70,56 +79,123 @@ def gelman_rhat(x: np.ndarray) -> np.ndarray:
     return np.where(W > 0, rhat, 1.0)
 
 
-def convert_to_coda_object(post, get_parameters=("Beta", "Gamma", "V", "sigma", "rho")):
-    """Named per-parameter chain arrays with reference-style labels
-    (``B[cov (C1), sp (S1)]``; reference convertToCodaObject.r:119-221).
-
-    Returns {param: (array (chains, samples, k), labels)}; factor-padded
-    parameters are exported at the static nf_max (zero-padded), matching the
-    reference's cross-chain zero-padding behaviour.
-    """
-    hM, spec = post.hM, post.spec
-    out = {}
-    for par in get_parameters:
-        if par not in post.arrays:
-            continue
-        a = post.arrays[par]
-        flat = a.reshape(a.shape[:2] + (-1,))
-        labels = _labels_for(par, hM, a.shape[2:])
-        out[par] = (flat, labels)
-    for r in range(spec.nr):
-        for par in ("Eta", "Lambda", "Alpha", "Psi", "Delta"):
-            key = f"{par}_{r}"
-            a = post.arrays[key]
-            if par == "Alpha":
-                # export as grid values like the reference (:204)
-                vals = hM.ranLevels[r].alphapw[:, 0] if spec.levels[r].spatial else None
-                if vals is not None:
-                    a = np.asarray(vals)[a]
-            flat = a.reshape(a.shape[:2] + (-1,))
-            out[key] = (flat, [f"{par}{r+1}[{i+1}]" for i in range(flat.shape[2])])
-        lam = post.arrays[f"Lambda_{r}"]
-        lam = lam[..., 0] if lam.ndim == 5 else lam
-        om = np.einsum("csfj,csfk->csjk", lam, lam)
-        out[f"Omega_{r}"] = (
-            om.reshape(om.shape[:2] + (-1,)),
-            [f"Omega{r+1}[{hM.sp_names[j]}, {hM.sp_names[k]}]"
-             for j in range(spec.ns) for k in range(spec.ns)])
+def _decorate(names, letter, flags):
+    """Reference name decoration (convertToCodaObject.r:56-91): keep the raw
+    name, the ``(S1)``-style number, or both per the two boolean flags."""
+    out = []
+    for i, n in enumerate(names):
+        parts = []
+        if flags[0]:
+            parts.append(str(n))
+        if flags[1]:
+            parts.append(f"({letter}{i + 1})")
+        out.append(" ".join(parts))
     return out
 
 
-def _labels_for(par, hM, shape):
-    if par == "Beta":
-        return [f"B[{c} (C{ci+1}), {s} (S{si+1})]"
-                for ci, c in enumerate(hM.cov_names) for si, s in enumerate(hM.sp_names)]
-    if par == "Gamma":
-        return [f"G[{c} (C{ci+1}), {t} (T{ti+1})]"
-                for ci, c in enumerate(hM.cov_names) for ti, t in enumerate(hM.tr_names)]
-    if par == "V":
-        return [f"V[{a}, {b}]" for a in hM.cov_names for b in hM.cov_names]
-    if par == "sigma":
-        return [f"Sig[{s}]" for s in hM.sp_names]
-    if par == "rho":
-        return ["Rho"]
-    n = int(np.prod(shape)) if shape else 1
-    return [f"{par}[{i+1}]" for i in range(n)]
+def convert_to_coda_object(post, start: int = 1,
+                           sp_names_numbers=(True, True),
+                           cov_names_numbers=(True, True),
+                           tr_names_numbers=(True, True),
+                           get_parameters=("Beta", "Gamma", "V", "sigma",
+                                           "rho")):
+    """Named per-parameter chain arrays with the reference's exact label
+    formats and vec orderings (``R/convertToCodaObject.r:36-221``):
+
+    - ``Beta``: ``B[cov, sp]``, covariate varying fastest (column-major vec);
+      ``Gamma``/``V`` analogous; ``sigma`` -> ``Sig[sp]``; ``rho`` only for
+      phylogenetic models.
+    - per level: ``Eta{r}[unit, factor{h}]`` (units fastest),
+      ``Lambda{r}``/``Psi{r}`` ``[sp, factor{h}]`` (species fastest),
+      ``Alpha{r}[factor{h}]`` exported as grid *values*,
+      ``Delta{r}[factor{h}]``, ``Omega{r}[sp, sp]``; factor-padded slots are
+      zero-filled like the reference's cross-chain nfMax padding (:173-218).
+    - ``start`` drops the first ``start-1`` recorded samples per chain
+      (reference ``postList[start:...]``); the returned :class:`CodaExport`
+      carries the mcmc-window metadata as its ``window`` attribute.
+    - raises if the factor count changed within a chain's selected window
+      (reference :168-169) — thin past the adaptation phase instead.
+
+    Returns a :class:`CodaExport`:
+    ``{param: (array (chains, kept_samples, k), labels)}``.
+    """
+    hM, spec = post.hM, post.spec
+    sp = _decorate(hM.sp_names, "S", sp_names_numbers)
+    cov = _decorate(hM.cov_names, "C", cov_names_numbers)
+    tr = _decorate(hM.tr_names, "T", tr_names_numbers)
+    sel = slice(start - 1, None)
+
+    out = CodaExport()
+    out.window = (post.transient + start * post.thin,
+                  post.transient + post.samples * post.thin, post.thin)
+    for par in get_parameters:
+        if par not in post.arrays:
+            continue
+        if par == "rho" and not spec.has_phylo:
+            continue                               # reference :40-42
+        a = post.arrays[par][:, sel]
+        if par in ("Beta", "Gamma", "V"):
+            # column-major vec: first index (covariate) varying fastest
+            flat = a.transpose(0, 1, 3, 2).reshape(a.shape[:2] + (-1,))
+            second = {"Beta": sp, "Gamma": tr, "V": cov}[par]
+            tag = {"Beta": "B", "Gamma": "G", "V": "V"}[par]
+            labels = [f"{tag}[{c}, {s}]" for s in second for c in cov]
+        elif par == "sigma":
+            flat = a.reshape(a.shape[:2] + (-1,))
+            labels = [f"Sig[{s}]" for s in sp]
+        elif par == "rho":                         # scalar grid value
+            flat = a.reshape(a.shape[:2] + (-1,))
+            labels = ["Rho"]
+        else:                                      # generic numbered fallback
+            flat = a.reshape(a.shape[:2] + (-1,))
+            labels = [f"{par}[{i + 1}]" for i in range(flat.shape[2])]
+        out[par] = (flat, labels)
+
+    for r in range(spec.nr):
+        mask = post.arrays[f"nfMask_{r}"][:, sel]  # (c, s, nf_max)
+        nf_per = mask.sum(axis=2)
+        if (nf_per != nf_per[:, :1]).any():
+            raise ValueError("HMSC: number of latent factors was changing "
+                             "in selected sequence of samples")
+        units = hM.ranLevels[r].pi
+        nf_max = mask.shape[2]
+        facs = [f"factor{h + 1}" for h in range(nf_max)]
+
+        eta = post.arrays[f"Eta_{r}"][:, sel] * mask[:, :, None, :]
+        out[f"Eta_{r}"] = (
+            eta.transpose(0, 1, 3, 2).reshape(eta.shape[:2] + (-1,)),
+            [f"Eta{r + 1}[{u}, {f}]" for f in facs for u in units])
+
+        lam = post.arrays[f"Lambda_{r}"][:, sel]
+        lam = lam[..., 0] if lam.ndim == 5 else lam
+        out[f"Lambda_{r}"] = (
+            lam.reshape(lam.shape[:2] + (-1,)),
+            [f"Lambda{r + 1}[{s}, {f}]" for f in facs for s in sp])
+
+        om = np.einsum("csfj,csfk->csjk", lam, lam)
+        out[f"Omega_{r}"] = (
+            om.reshape(om.shape[:2] + (-1,)),
+            [f"Omega{r + 1}[{a_}, {b}]" for b in sp for a_ in sp])
+
+        psi = post.arrays[f"Psi_{r}"][:, sel]
+        psi = psi[..., 0] if psi.ndim == 5 else psi
+        psi = psi * mask[:, :, :, None]
+        out[f"Psi_{r}"] = (
+            psi.reshape(psi.shape[:2] + (-1,)),
+            [f"Psi{r + 1}[{s}, {f}]" for f in facs for s in sp])
+
+        delta = post.arrays[f"Delta_{r}"][:, sel]
+        delta = delta[..., 0] if delta.ndim == 4 else delta
+        out[f"Delta_{r}"] = (
+            delta * mask,
+            [f"Delta{r + 1}[{f}]" for f in facs])
+
+        alpha = post.arrays[f"Alpha_{r}"][:, sel]
+        if spec.levels[r].spatial is not None:
+            vals = np.asarray(hM.ranLevels[r].alphapw)[:, 0]
+            alpha = vals[alpha] * mask
+        else:
+            alpha = alpha * mask
+        out[f"Alpha_{r}"] = (
+            alpha, [f"Alpha{r + 1}[{f}]" for f in facs])
+    return out

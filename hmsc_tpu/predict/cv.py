@@ -124,9 +124,6 @@ def compute_predicted_values(post, partition=None, partition_sp=None,
         pred_array keeps one fixed draw axis."""
         if pred.shape[0] == post_n:
             return pred
-        if pred.shape[0] == 0:
-            raise RuntimeError("cross-validation fold refit: every chain "
-                               "diverged; no finite draws to predict from")
         return pred[np.resize(np.arange(pred.shape[0]), post_n)]
 
     for ki, k in enumerate(folds):
@@ -140,6 +137,13 @@ def compute_predicted_values(post, partition=None, partition_sp=None,
             transient=post.transient, n_chains=n_chains, init_par=init_par,
             updater=updater, nf_cap=nf_cap or DEFAULT_NF_CAP,
             seed=int(rng.integers(2**31)))
+        if not post1.chain_health["good_chains"].any():
+            # good_chain_mask() falls back to "exclude nothing" when every
+            # chain diverged, so this must be caught here, loudly, before
+            # NaN draws flow into the shared pred_array
+            raise RuntimeError(
+                f"cross-validation fold {ki + 1}: every refit chain "
+                "diverged; no finite draws to predict from")
         sd_val = (pd.DataFrame({name: np.asarray(hM.df_pi[r])[val]
                                 for r, name in enumerate(hM.rl_names)})
                   if hM.nr > 0 else None)

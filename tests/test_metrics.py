@@ -135,3 +135,53 @@ def test_posterior_linear_predictor_consistency(fitted_normal):
     # for a normal model the posterior-mean predictor should correlate with Y
     c = np.corrcoef(L.mean(axis=0).ravel(), m.Y.ravel())[0, 1]
     assert c > 0.5
+
+
+def test_convert_to_coda_labels(fitted_probit):
+    """Label formats and vec orderings must match the reference
+    (convertToCodaObject.r:119-221): B[cov (C1), sp (S1)] with covariate
+    varying fastest, Eta{r}[unit, factor], Lambda{r}[sp, factor]."""
+    from hmsc_tpu import convert_to_coda_object
+
+    m, post = fitted_probit
+    coda = convert_to_coda_object(post)
+    assert "window" not in coda            # metadata is an attribute, not a key
+    B, labels = coda["Beta"]
+    assert B.shape == (2, 25, m.nc * m.ns)
+    assert labels[0] == f"B[{m.cov_names[0]} (C1), {m.sp_names[0]} (S1)]"
+    # covariate varies fastest (column-major vec like R)
+    assert labels[1] == f"B[{m.cov_names[1]} (C2), {m.sp_names[0]} (S1)]"
+    a = post.arrays["Beta"]
+    np.testing.assert_array_equal(B[:, :, 1], a[:, :, 1, 0])
+    # per-level labels carry unit / species names
+    eta, elab = coda["Eta_0"]
+    units = m.ranLevels[0].pi
+    assert elab[0] == f"Eta1[{units[0]}, factor1]"
+    assert elab[1] == f"Eta1[{units[1]}, factor1]"
+    lam, llab = coda["Lambda_0"]
+    assert llab[0] == f"Lambda1[{m.sp_names[0]} (S1), factor1]"
+    # sigma named per species; no rho without phylogeny
+    assert coda["sigma"][1][0] == f"Sig[{m.sp_names[0]} (S1)]"
+    assert "rho" not in coda
+    # name-number toggles (reference spNamesNumbers etc.)
+    coda2 = convert_to_coda_object(post, sp_names_numbers=(True, False),
+                                   cov_names_numbers=(False, True))
+    assert coda2["Beta"][1][0] == f"B[(C1), {m.sp_names[0]}]"
+    # start window drops early samples and reports the mcmc window
+    coda3 = convert_to_coda_object(post, start=11)
+    assert coda3["Beta"][0].shape[1] == 15
+    assert coda3.window == (25 + 11 * 1, 25 + 25 * 1, 1)
+
+
+def test_convert_to_coda_ragged_nf_error(fitted_probit):
+    from hmsc_tpu import convert_to_coda_object
+
+    m, post = fitted_probit
+    import copy
+    p2 = copy.copy(post)
+    p2.arrays = dict(post.arrays)
+    mask = post.arrays["nfMask_0"].copy()
+    mask[0, -1, -1] = 1.0 - mask[0, -1, -1]       # nf changes mid-chain
+    p2.arrays["nfMask_0"] = mask
+    with pytest.raises(ValueError, match="number of latent factors"):
+        convert_to_coda_object(p2)
