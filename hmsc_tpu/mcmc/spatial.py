@@ -6,9 +6,17 @@ Three methods, as in the reference:
 - ``Full``  — exact GP; the (np*nf) coupled precision (block-diagonal iW(alpha_h)
   plus the factor coupling) is assembled dense and factorised once.
 - ``NNGP``  — Vecchia sparse precision stored as neighbour-index/coefficient
-  grids; the precision is densified on the fly from gathers (a dense np x np
-  build beats sparse scatter on TPU for the supported np range; a CG-based
-  matrix-free path is the scale-out extension).
+  grids.  Below ``_NNGP_DENSE_MAX`` coefficients the precision is densified
+  on the fly from gathers (a dense np x np build beats sparse scatter on TPU
+  for moderate np); above it, a **matrix-free CG sampler** takes over: the
+  Vecchia factor is only ever *applied* (gathers + one segment_sum per
+  matvec), the draw is exact-by-construction via perturbation optimisation
+  (rhs perturbed with RiW' eps for the prior term and per-cell
+  sqrt(iSigma)-weighted noise for the likelihood term, so the CG solution
+  has exactly the full-conditional law up to CG tolerance), and the current
+  Eta warm-starts the solve.  This is the regime the reference recommends
+  NNGP for (>1000 units, vignette_4_spatial.Rmd:171-175) but cannot reach
+  with its own dense (np*nf)^2 cholesky.
 - ``GPP``   — knot-based predictive process: Woodbury identity with per-site
   nf x nf batched blocks and an (nf*nK) knot correction.
 """
@@ -24,6 +32,10 @@ from .structs import GibbsState, LevelState, ModelData, ModelSpec
 from .updaters import _masked_level_gram, lambda_effective
 
 __all__ = ["update_eta_spatial", "update_alpha"]
+
+# above this many (units x factors) coefficients, NNGP Eta switches from the
+# dense joint cholesky to the matrix-free CG sampler
+_NNGP_DENSE_MAX = 4096
 
 
 def _gather_iW(lvd, alpha_idx):
@@ -55,6 +67,9 @@ def update_eta_spatial(spec: ModelSpec, data: ModelData, state: GibbsState,
     if ls.spatial == "GPP":
         return _eta_gpp(spec, data, state, r, key, S)
     npr, nf = ls.n_units, ls.nf_max
+    if (ls.spatial == "NNGP" and ls.x_dim == 0
+            and npr * nf > _NNGP_DENSE_MAX):
+        return _eta_nngp_cg(spec, data, state, r, key, S)
     LiSL, F = _masked_level_gram(spec, data, lvd, ls, lv, state.iSigma, S)
 
     if ls.spatial == "Full":
@@ -76,6 +91,54 @@ def update_eta_spatial(spec: ModelSpec, data: ModelData, state: GibbsState,
     L = chol_spd(big)
     eps = jax.random.normal(key, rhs.shape, dtype=rhs.dtype)
     eta = sample_mvn_prec(L, rhs, eps).reshape(nf, npr).T
+    return lv.replace(Eta=eta)
+
+
+def _eta_nngp_cg(spec, data, state, r, key, S, tol: float = 1e-5,
+                 maxiter: int = 500):
+    """Matrix-free NNGP Eta draw for large np (see module docstring).
+
+    The full-conditional precision is ``P = blkdiag_f(RiW_f' RiW_f) +
+    unitdiag(LiSL_u)``.  A draw x ~ N(P^{-1} b, P^{-1}) is obtained by
+    perturbation optimisation: solve ``P x = b~`` with
+    ``b~ = F + RiW' eps1 + sum_rows lam sqrt(iSigma) xi`` — the two
+    perturbations have covariances exactly equal to the prior and likelihood
+    precision terms, so Cov(x) = P^{-1} (P) P^{-1} = P^{-1} exactly; CG only
+    ever applies the sparse Vecchia factor via gathers + one segment_sum.
+    """
+    lvd, lv, ls = data.levels[r], state.levels[r], spec.levels[r]
+    npr, nf = ls.n_units, ls.nf_max
+    LiSL, F = _masked_level_gram(spec, data, lvd, ls, lv, state.iSigma, S)
+    lam = lambda_effective(lv)[:, :, 0]               # (nf, ns)
+    coef = lvd.nn_coef[lv.alpha_idx]                  # (nf, np, k)
+    sqD = jnp.sqrt(lvd.nn_D[lv.alpha_idx])            # (nf, np)
+    nn = lvd.nn_idx                                   # (np, k)
+    k_nb = nn.shape[1]
+
+    def riw_t(u):
+        """RiW' u for each factor; u, out: (np, nf)."""
+        t = u / sqD.T
+        contrib = -jnp.einsum("fik,if->ikf", coef, t)  # (np, k, nf)
+        return t + jax.ops.segment_sum(
+            contrib.reshape(npr * k_nb, nf), nn.reshape(-1), num_segments=npr)
+
+    def pmv(x):
+        """P x: Vecchia prior applied as RiW'(RiW x) + per-unit blocks."""
+        xg = x[nn]                                     # (np, k, nf)
+        red = jnp.einsum("fik,ikf->if", coef, xg)
+        Rx = (x - red) / sqD.T
+        return riw_t(Rx) + jnp.einsum("ufg,ug->uf", LiSL, x)
+
+    k1, k2 = jax.random.split(key)
+    eps1 = jax.random.normal(k1, (npr, nf), dtype=F.dtype)
+    xi = jax.random.normal(k2, S.shape, dtype=F.dtype)
+    w = xi * jnp.sqrt(state.iSigma)[None, :]
+    if spec.has_na:
+        w = w * data.Ymask
+    b = F + riw_t(eps1) + jax.ops.segment_sum(
+        w @ lam.T, lvd.pi_row, num_segments=npr)
+    eta, _ = jax.scipy.sparse.linalg.cg(pmv, b, x0=lv.Eta, tol=tol,
+                                        maxiter=maxiter)
     return lv.replace(Eta=eta)
 
 

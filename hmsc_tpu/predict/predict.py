@@ -9,13 +9,19 @@ at once, and the conditional-prediction MCMC refinement (``Yc`` +
 ``mcmc_step``, reference ``predict.R:181-198``) is a jitted
 ``lax.scan`` vmapped over draws instead of an interpreted per-sample loop.
 
-Deviations from the reference, both latent bugs there:
+Deviations from the reference (latent bugs there):
 
 - conditional prediction on *spatial* levels: the reference passes
   ``rLPar=object$rLPar`` which is never populated (``predict.R:185``), so its
-  spatial conditional updates crash.  We run the conditional Eta refresh under
-  the unstructured N(0,1) prior for spatial levels (the kriged draw remains
-  the starting point), which runs and is exact for non-spatial levels.
+  spatial conditional updates crash.  Here the conditional Eta refresh uses
+  the level's *actual* GP prior: the exponential-kernel precision over the
+  prediction units, built per posterior draw from the recorded alpha of each
+  factor (exact for ``Full``; also used for NNGP/GPP levels, where it is the
+  exact version of their approximation).  The joint (np x nf) system couples
+  units exactly like the training-side spatial updateEta.  Levels larger than
+  ``_SPATIAL_COND_MAX`` coefficients, covariate-dependent levels, and
+  non-spatial levels use the unstructured N(0,1) prior (exact for the
+  latter).
 - ``predict.R:174,192`` uses ``object$ny`` where the new-data row count
   belongs; we use the new row count.
 """
@@ -28,6 +34,11 @@ from ..utils.formula import design_matrix
 from .latent import predict_latent_factor
 
 __all__ = ["predict"]
+
+# above this many (units x factors) coefficients per level, the conditional
+# Eta refresh falls back to the unstructured prior rather than factorising
+# the joint spatial system per draw
+_SPATIAL_COND_MAX = 1500
 
 
 def _new_design(hM, x_data, X):
@@ -110,12 +121,13 @@ def predict(post, x_data=None, X=None, xrrr_data=None, XRRR=None,
     sigma = post.pooled("sigma")                        # (n, ns)
 
     # ---- latent factors at prediction units ------------------------------
-    eta_pred, pi_new, x_row_new = [], [], []
+    will_condition = Yc is not None and not np.all(np.isnan(Yc))
+    eta_pred, pi_new, x_row_new, spatial_prior = [], [], [], []
     for r in range(hM.nr):
         rL = ran_levels[hM.rl_names[r]]
         units_pred = sorted(set(labels[r]))
         post_eta = post.pooled(f"Eta_{r}")              # (n, np, nf)
-        post_alpha = post.pooled(f"Alpha_{r}")          # (n, nf)
+        post_alpha = post.pooled(f"Alpha_{r}")          # (n, nf) grid indices
         ep = predict_latent_factor(units_pred, hM.pi_names[r], post_eta,
                                    post_alpha, rL,
                                    predict_mean=predict_eta_mean,
@@ -129,14 +141,33 @@ def predict(post, x_data=None, X=None, xrrr_data=None, XRRR=None,
         else:
             x_row_new.append(np.ones((ny_new, 1)))
 
+        # spatial levels: distance matrix over the same units_pred ordering
+        # and the recorded per-draw, per-factor GP ranges -> exact prior
+        # precision inside the conditional refresh (see module docstring)
+        nf_r = post_alpha.shape[1]
+        usable = (will_condition
+                  and spec.levels[r].spatial is not None
+                  and spec.levels[r].x_dim == 0
+                  and len(units_pred) * nf_r <= _SPATIAL_COND_MAX)
+        if not usable:
+            spatial_prior.append(None)
+            continue
+        if rL.dist_mat is not None:
+            D = rL.dist_for(units_pred)
+        else:
+            xy = rL.coords_for(units_pred)
+            D = np.linalg.norm(xy[:, None, :] - xy[None, :, :], axis=-1)
+        alpha_vals = np.asarray(rL.alphapw, dtype=float)[:, 0][post_alpha]
+        spatial_prior.append((D, alpha_vals))
+
     L = _lin_pred(hM, spec, Xn, x_is_list, XRRR, post, Beta, eta_pred, pi_new,
                   x_row_new)
 
     # ---- conditional prediction: refine Eta with extra MCMC steps --------
-    if Yc is not None and not np.all(np.isnan(Yc)):
+    if will_condition:
         eta_pred = _conditional_mcmc(hM, spec, post, Xn, x_is_list, XRRR, Beta,
                                      sigma, Yc, eta_pred, pi_new, x_row_new, L,
-                                     mcmc_step, rng)
+                                     mcmc_step, rng, spatial_prior)
         L = _lin_pred(hM, spec, Xn, x_is_list, XRRR, post, Beta, eta_pred,
                       pi_new, x_row_new)
 
@@ -198,10 +229,18 @@ def _lin_pred(hM, spec, Xn, x_is_list, XRRR, post, Beta, eta_pred, pi_new,
 
 
 def _conditional_mcmc(hM, spec, post, Xn, x_is_list, XRRR, Beta, sigma, Yc,
-                      eta_pred, pi_new, x_row_new, L, mcmc_step, rng):
+                      eta_pred, pi_new, x_row_new, L, mcmc_step, rng,
+                      spatial_prior=None):
     """``mcmc_step`` iterations of (updateEta, updateZ) per posterior draw,
     conditioning on the observed cells of Yc — vmapped over draws and run as
-    one jitted scan (reference ``predict.R:181-198``)."""
+    one jitted scan (reference ``predict.R:181-198``).
+
+    ``spatial_prior[r]`` is ``(D, alpha_vals)`` for spatial levels — the
+    distance matrix over prediction units and the per-draw, per-factor GP
+    range values — making the Eta refresh use the exact exponential-kernel
+    prior precision (the capability the reference intends but crashes on,
+    ``predict.R:185``); ``None`` falls back to the unstructured N(0,1) prior.
+    """
     import jax
     import jax.numpy as jnp
 
@@ -238,6 +277,16 @@ def _conditional_mcmc(hM, spec, post, Xn, x_is_list, XRRR, Beta, sigma, Yc,
     pi_r = [jnp.asarray(pi_new[r]) for r in range(hM.nr)]
     xrow_r = [jnp.asarray(x_row_new[r], dtype=jnp.float32) for r in range(hM.nr)]
     np_r = [eta_pred[r].shape[1] for r in range(hM.nr)]
+    if spatial_prior is None:
+        spatial_prior = [None] * hM.nr
+    # distance matrices are draw-invariant closures; alpha values are
+    # per-draw vmapped inputs (dummy zeros for non-spatial levels)
+    D_r = [None if sp is None else jnp.asarray(sp[0], dtype=jnp.float32)
+           for sp in spatial_prior]
+    alpha_r = tuple(
+        jnp.zeros((n_draws, nf_r[r]), dtype=jnp.float32) if spatial_prior[r] is None
+        else jnp.asarray(spatial_prior[r][1], dtype=jnp.float32)
+        for r in range(hM.nr))
     iSig = jnp.asarray(1.0 / np.asarray(sigma), dtype=jnp.float32)  # (n, ns)
     LFix0 = jnp.asarray(L, dtype=jnp.float32) - sum(
         _loading_np(eta_r[r], pi_r[r], xrow_r[r], lam_r[r])
@@ -273,38 +322,84 @@ def _conditional_mcmc(hM, spec, post, Xn, x_is_list, XRRR, Beta, sigma, Yc,
             z = jnp.where((fam == 3) & (mask > 0), zp, z)
         return z
 
-    def one_draw(LFix, lams, etas, isig, key):
+    def one_draw(LFix, lams, etas, isig, alphas, key):
+        from jax.scipy.linalg import cho_solve, solve_triangular
+
+        # step-invariant per level: the likelihood gram LiSL (lam/isig/mask
+        # only) and the cholesky of the full-conditional precision — spatial:
+        # joint blkdiag_f(iW(alpha_f)) + unit blocks (the training-side
+        # spatial updateEta structure, reference updateEta.R:110-135);
+        # unstructured: per-unit nf x nf.  Only the rhs changes across the
+        # mcmc_step scan, so factorise once per posterior draw.
+        lam2_r, lisl_r, chol_r = [], [], []
+        for r in range(hM.nr):
+            lam = lams[r]
+            lam2 = lam if lam.ndim == 2 else jnp.einsum(
+                "fjk,uk->ufj", lam, x_unit_r[r])
+            if lam.ndim == 2:
+                rows = jnp.einsum("fj,gj,j,ij->ifg", lam, lam, isig, mask)
+                LiSL = jax.ops.segment_sum(rows, pi_r[r],
+                                           num_segments=np_r[r])
+            else:
+                Mu_cnt = jax.ops.segment_sum(mask, pi_r[r],
+                                             num_segments=np_r[r])
+                LiSL = jnp.einsum("ufj,ugj,j,uj->ufg", lam2, lam2, isig,
+                                  Mu_cnt)
+            lam2_r.append(lam2)
+            lisl_r.append(LiSL)
+            npr, nf = np_r[r], nf_r[r]
+            if D_r[r] is not None:
+                D = D_r[r]
+                eyeu = jnp.eye(npr, dtype=D.dtype)
+
+                def iW_of(a):
+                    safe = jnp.maximum(a, 1e-6)
+                    W = jnp.where(a > 0, jnp.exp(-D / safe), eyeu)
+                    W = W + 1e-5 * eyeu       # f32 far-range conditioning
+                    Lw = jnp.linalg.cholesky(W)
+                    return cho_solve((Lw, True), eyeu)
+
+                iW = jax.vmap(iW_of)(alphas[r])       # (nf, np, np)
+                P4 = jnp.einsum("fuv,fg->ufvg", iW,
+                                jnp.eye(nf, dtype=D.dtype))
+                u_idx = jnp.arange(npr)
+                P4 = P4.at[u_idx, :, u_idx, :].add(LiSL)
+                chol_r.append(jnp.linalg.cholesky(
+                    P4.reshape(npr * nf, npr * nf)))
+            else:
+                chol_r.append(jnp.linalg.cholesky(
+                    LiSL + jnp.eye(nf, dtype=LiSL.dtype)[None]))
+
         def step(carry, k):
             z, etas = carry
             ks = jax.random.split(k, 2 + hM.nr)
-            # Eta update per level (N(0,1) prior; see module docstring)
+            # Eta update per level (spatial GP prior where available,
+            # N(0,1) otherwise; see module docstring)
             for r in range(hM.nr):
                 others = sum(loading(etas[q], lams[q], pi_r[q], xrow_r[q])
                              for q in range(hM.nr) if q != r)
                 S = z - LFix - (others if hM.nr > 1 else 0.0)
                 lam = lams[r]
-                lam2 = lam if lam.ndim == 2 else jnp.einsum(
-                    "fjk,uk->ufj", lam, x_unit_r[r])
                 if lam.ndim == 2:
-                    # NA-aware per-unit gram (Yc cells outside the mask carry
-                    # no likelihood weight)
-                    rows = jnp.einsum("fj,gj,j,ij->ifg", lam, lam, isig, mask)
-                    LiSL = jax.ops.segment_sum(rows, pi_r[r],
-                                               num_segments=np_r[r])
+                    # NA-aware rhs (Yc cells outside the mask carry no
+                    # likelihood weight)
                     Fr = jax.ops.segment_sum((S * isig[None, :] * mask) @ lam.T,
                                              pi_r[r], num_segments=np_r[r])
                 else:
-                    Mu_cnt = jax.ops.segment_sum(mask, pi_r[r],
-                                                 num_segments=np_r[r])
-                    LiSL = jnp.einsum("ufj,ugj,j,uj->ufg", lam2, lam2, isig,
-                                      Mu_cnt)
                     T = jax.ops.segment_sum(S * isig[None, :] * mask, pi_r[r],
                                             num_segments=np_r[r])
-                    Fr = jnp.einsum("uj,ufj->uf", T, lam2)
-                nf = nf_r[r]
-                prec = LiSL + jnp.eye(nf, dtype=S.dtype)[None]
-                Lc = jnp.linalg.cholesky(prec)
-                from jax.scipy.linalg import cho_solve, solve_triangular
+                    Fr = jnp.einsum("uj,ufj->uf", T, lam2_r[r])
+                npr, nf = np_r[r], nf_r[r]
+                Lc = chol_r[r]
+                if D_r[r] is not None:
+                    rhs = Fr.reshape(npr * nf)
+                    mean = cho_solve((Lc, True), rhs)
+                    eps = jax.random.normal(ks[2 + r], rhs.shape,
+                                            dtype=rhs.dtype)
+                    noise = solve_triangular(Lc.T, eps, lower=False)
+                    etas = (etas[:r] + ((mean + noise).reshape(npr, nf),)
+                            + etas[r + 1:])
+                    continue
                 mean = cho_solve((Lc, True), Fr[..., None])[..., 0]
                 eps = jax.random.normal(ks[2 + r], mean.shape, dtype=mean.dtype)
                 noise = solve_triangular(jnp.swapaxes(Lc, -1, -2),
@@ -330,8 +425,8 @@ def _conditional_mcmc(hM, spec, post, Xn, x_is_list, XRRR, Beta, sigma, Yc,
     keys = jax.vmap(jax.random.PRNGKey)(
         jnp.asarray(rng.integers(0, 2**31 - 1, size=n_draws)))
     etas0 = tuple(eta_r)
-    run = jax.jit(jax.vmap(one_draw, in_axes=(0, 0, 0, 0, 0)))
-    etas_out = run(LFix0, tuple(lam_r), etas0, iSig, keys)
+    run = jax.jit(jax.vmap(one_draw, in_axes=(0, 0, 0, 0, 0, 0)))
+    etas_out = run(LFix0, tuple(lam_r), etas0, iSig, alpha_r, keys)
     return [np.asarray(e) for e in etas_out]
 
 

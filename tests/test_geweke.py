@@ -16,6 +16,10 @@ from hmsc_tpu.model import Hmsc
 from hmsc_tpu.random_level import HmscRandomLevel, set_priors_random_level
 from hmsc_tpu.mcmc.sampler import sample_mcmc
 
+import pytest as _pytest
+
+pytestmark = _pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def geweke_pair():

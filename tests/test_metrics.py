@@ -41,6 +41,7 @@ def test_auc_rank_implementation():
     assert np.allclose(_rank(np.array([3.0, 1.0, 2.0])), [3, 1, 2])
 
 
+@pytest.mark.slow
 def test_evaluate_model_fit_probit(fitted_probit):
     m, post = fitted_probit
     pred = compute_predicted_values(post, seed=0)
@@ -54,6 +55,7 @@ def test_evaluate_model_fit_probit(fitted_probit):
     assert np.all(mf["RMSE"] >= 0)
 
 
+@pytest.mark.slow
 def test_evaluate_model_fit_normal(fitted_normal):
     m, post = fitted_normal
     pred = compute_predicted_values(post, seed=0)
@@ -62,6 +64,7 @@ def test_evaluate_model_fit_normal(fitted_normal):
     assert np.nanmean(mf["R2"]) > 0.2          # X carries real signal
 
 
+@pytest.mark.slow
 def test_evaluate_model_fit_poisson():
     m = small_model(ny=50, ns=4, nc=2, distr="poisson", n_units=8, seed=9)
     post = sample_mcmc(m, samples=20, transient=20, n_chains=1, seed=3,
@@ -72,6 +75,7 @@ def test_evaluate_model_fit_poisson():
             "C.SR2", "C.RMSE"} <= set(mf)
 
 
+@pytest.mark.slow
 def test_waic_probit_magnitude(fitted_probit):
     """Reference tests/testthat/test-WAIC.R pins WAIC(TD$m) ~ 0.8 for a probit
     fit: per-unit WAIC of a few probit species should land well inside (0, 5)."""
@@ -81,6 +85,7 @@ def test_waic_probit_magnitude(fitted_probit):
     assert 0.1 < w < 5.0
 
 
+@pytest.mark.slow
 def test_waic_normal_vs_bad_model(fitted_normal):
     """WAIC must order a fitted model above one with shuffled responses."""
     m, post = fitted_normal
@@ -96,6 +101,7 @@ def test_waic_normal_vs_bad_model(fitted_normal):
     assert w_good < w_bad
 
 
+@pytest.mark.slow
 def test_waic_poisson_gh():
     m = small_model(ny=40, ns=3, nc=2, distr="poisson", n_units=8, seed=11)
     post = sample_mcmc(m, samples=15, transient=15, n_chains=1, seed=4,
@@ -104,6 +110,7 @@ def test_waic_poisson_gh():
     assert np.isfinite(w)
 
 
+@pytest.mark.slow
 def test_variance_partitioning(fitted_probit):
     m, post = fitted_probit
     vp = compute_variance_partitioning(post)
@@ -117,6 +124,7 @@ def test_variance_partitioning(fitted_probit):
     assert np.all((vp["R2T"]["Beta"] >= 0) & (vp["R2T"]["Beta"] <= 1))
 
 
+@pytest.mark.slow
 def test_variance_partitioning_grouping(fitted_probit):
     m, post = fitted_probit
     vp = compute_variance_partitioning(post, group=[1, 1],
@@ -125,6 +133,7 @@ def test_variance_partitioning_grouping(fitted_probit):
     np.testing.assert_allclose(vp["vals"].sum(axis=0), 1.0, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_posterior_linear_predictor_consistency(fitted_normal):
     """The recorded (back-transformed) Beta against raw X must reproduce the
     scaled-space linear predictor: combineParameters' invariant."""
@@ -137,6 +146,7 @@ def test_posterior_linear_predictor_consistency(fitted_normal):
     assert c > 0.5
 
 
+@pytest.mark.slow
 def test_convert_to_coda_labels(fitted_probit):
     """Label formats and vec orderings must match the reference
     (convertToCodaObject.r:119-221): B[cov (C1), sp (S1)] with covariate
@@ -173,6 +183,7 @@ def test_convert_to_coda_labels(fitted_probit):
     assert coda3.window == (25 + 11 * 1, 25 + 25 * 1, 1)
 
 
+@pytest.mark.slow
 def test_convert_to_coda_ragged_nf_error(fitted_probit):
     from hmsc_tpu import convert_to_coda_object
 

@@ -229,3 +229,40 @@ def test_gamma_v_moments():
         acc += np.linalg.solve(prec, rhs).reshape(spec.nt, spec.nc).T
     mean_G = acc / 500
     assert np.allclose(G_draws.mean(0), mean_G, atol=0.1 + 0.05 * np.abs(mean_G).max())
+
+
+# ---------------------------------------------------------------------------
+# NNGP Eta: matrix-free CG sampler vs dense joint draw (same law)
+# ---------------------------------------------------------------------------
+
+def test_eta_nngp_cg_matches_dense():
+    """The perturbation-optimisation CG draw must follow the same Gaussian
+    full conditional as the dense (np*nf)^2 factorisation: compare per-unit
+    means and variances over many draws from a fixed state."""
+    from hmsc_tpu.mcmc import spatial as SP
+
+    m = small_model(distr="normal", spatial="NNGP", ny=60, ns=6, n_units=20,
+                    nf=2, seed=17, n_neighbours=5)
+    spec, data, state, _ = build_all(m, seed=7, nf_cap=2)
+    S = np.asarray(state.Z) - np.asarray(
+        __import__("hmsc_tpu.mcmc.updaters", fromlist=["linear_fixed"])
+        .linear_fixed(spec, data, state.Beta))
+    import jax.numpy as jnp
+    S = jnp.asarray(S)
+
+    dense = _draws(lambda k: SP.update_eta_spatial(
+        spec, data, state, 0, k, S).Eta, n=600, seed=1)
+    old = SP._NNGP_DENSE_MAX
+    SP._NNGP_DENSE_MAX = 0                  # force the CG path
+    try:
+        cg = _draws(lambda k: SP.update_eta_spatial(
+            spec, data, state, 0, k, S).Eta, n=600, seed=2)
+    finally:
+        SP._NNGP_DENSE_MAX = old
+    dense, cg = np.asarray(dense), np.asarray(cg)
+    assert np.isfinite(cg).all()
+    sd = dense.std(axis=0)
+    assert np.allclose(dense.mean(axis=0), cg.mean(axis=0),
+                       atol=4 * sd.max() / np.sqrt(600) + 1e-3)
+    assert np.allclose(dense.std(axis=0), cg.std(axis=0), rtol=0.25,
+                       atol=0.02)

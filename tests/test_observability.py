@@ -12,6 +12,10 @@ from hmsc_tpu import (concat_posteriors, load_checkpoint, sample_mcmc,
 
 from util import small_model
 
+import pytest as _pytest
+
+pytestmark = _pytest.mark.slow
+
 
 def test_verbose_progress(capfd):
     m = small_model(ny=20, ns=3, nc=2, distr="normal", n_units=5, seed=0)

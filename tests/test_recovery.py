@@ -18,6 +18,10 @@ from hmsc_tpu.mcmc import updaters as U
 
 from util import build_all, small_model
 
+import pytest as _pytest
+
+pytestmark = _pytest.mark.slow
+
 
 def test_beta_recovery_probit():
     """Vignette-2-style check: posterior-mean Beta correlates > 0.9 with the
@@ -173,3 +177,32 @@ def test_multidevice_mesh_chains():
     assert np.isfinite(beta).all()
     # chains must differ (independent streams)
     assert np.std(beta.mean(axis=(1, 2, 3))) > 0
+
+
+def test_nngp_large_np_matrix_free():
+    """NNGP at np=5000 (the regime the reference recommends NNGP for but
+    cannot reach with dense (np*nf)^2 factorisations) must sample via the
+    matrix-free CG path without materialising the dense precision."""
+    import pandas as pd
+    from hmsc_tpu import Hmsc, sample_mcmc
+    from hmsc_tpu.random_level import HmscRandomLevel, set_priors_random_level
+    from hmsc_tpu.mcmc.spatial import _NNGP_DENSE_MAX
+
+    rng = np.random.default_rng(3)
+    n_units, ns, nf = 5000, 10, 2
+    assert n_units * nf > _NNGP_DENSE_MAX    # the CG gate engages
+    units = [f"u{i:04d}" for i in range(n_units)]
+    xy = pd.DataFrame(rng.uniform(size=(n_units, 2)) * 20, index=units,
+                      columns=["x", "y"])
+    X = np.column_stack([np.ones(n_units), rng.standard_normal(n_units)])
+    Y = X @ (rng.standard_normal((2, ns)) * 0.5) + rng.standard_normal((n_units, ns))
+    study = pd.DataFrame({"plot": units})
+    rl = HmscRandomLevel(s_data=xy, s_method="NNGP", n_neighbours=8)
+    set_priors_random_level(rl, nf_max=nf, nf_min=nf)
+    m = Hmsc(Y=Y, X=X, distr="normal", study_design=study,
+             ran_levels={"plot": rl}, x_scale=False)
+    post = sample_mcmc(m, samples=3, transient=3, n_chains=1, seed=1,
+                       nf_cap=nf, align_post=False)
+    assert post.chain_health["good_chains"].all()
+    for k in ("Beta", "Eta_0", "Alpha_0"):
+        assert np.isfinite(post.pooled(k)).all()
