@@ -71,7 +71,12 @@ def sample_prior(hM, spec, data_par, rng: np.random.Generator) -> dict:
 
     est = hM.distr[:, 1] == 1
     sigma = np.array([FIXED_SIGMA2[int(f)] for f in hM.distr[:, 0]], dtype=float)
-    sigma[est] = rng.gamma(hM.aSigma[est], 1.0 / hM.bSigma[est])
+    # prior: iSigma ~ Gamma(aSigma, rate bSigma) — the law updateInvSigma's
+    # conjugate draw implies.  The reference's samplePrior.R:34 instead draws
+    # *sigma* from that gamma, contradicting its own updater (updateInvSigma.R
+    # shape aSigma + n/2 on iSigma); the successive-conditional Geweke tier
+    # exposes that inconsistency, so we follow the updater.
+    sigma[est] = 1.0 / rng.gamma(hM.aSigma[est], 1.0 / hM.bSigma[est])
 
     if hM.C is None:
         rho_idx = 0

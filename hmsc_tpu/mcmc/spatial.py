@@ -139,6 +139,12 @@ def _eta_nngp_cg(spec, data, state, r, key, S, tol: float = 1e-5,
         w @ lam.T, lvd.pi_row, num_segments=npr)
     eta, _ = jax.scipy.sparse.linalg.cg(pmv, b, x0=lv.Eta, tol=tol,
                                         maxiter=maxiter)
+    # cg returns its current iterate at maxiter with no signal; a stalled
+    # solve would silently bias the chain.  Check the relative residual and
+    # poison the draw to NaN instead — the sampler's divergence containment
+    # then reports the chain and first bad sweep loudly.
+    res = jnp.linalg.norm(pmv(eta) - b) / jnp.maximum(jnp.linalg.norm(b), 1e-30)
+    eta = jnp.where(res < 1e-3, eta, jnp.nan)
     return lv.replace(Eta=eta)
 
 

@@ -331,7 +331,7 @@ def _conditional_mcmc(hM, spec, post, Xn, x_is_list, XRRR, Beta, sigma, Yc,
         # spatial updateEta structure, reference updateEta.R:110-135);
         # unstructured: per-unit nf x nf.  Only the rhs changes across the
         # mcmc_step scan, so factorise once per posterior draw.
-        lam2_r, lisl_r, chol_r = [], [], []
+        lam2_r, chol_r = [], []
         for r in range(hM.nr):
             lam = lams[r]
             lam2 = lam if lam.ndim == 2 else jnp.einsum(
@@ -346,7 +346,6 @@ def _conditional_mcmc(hM, spec, post, Xn, x_is_list, XRRR, Beta, sigma, Yc,
                 LiSL = jnp.einsum("ufj,ugj,j,uj->ufg", lam2, lam2, isig,
                                   Mu_cnt)
             lam2_r.append(lam2)
-            lisl_r.append(LiSL)
             npr, nf = np_r[r], nf_r[r]
             if D_r[r] is not None:
                 D = D_r[r]

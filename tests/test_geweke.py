@@ -16,9 +16,7 @@ from hmsc_tpu.model import Hmsc
 from hmsc_tpu.random_level import HmscRandomLevel, set_priors_random_level
 from hmsc_tpu.mcmc.sampler import sample_mcmc
 
-import pytest as _pytest
-
-pytestmark = _pytest.mark.slow
+pytestmark = pytest.mark.slow
 
 
 @pytest.fixture(scope="module")
@@ -100,3 +98,122 @@ def test_eta_lambda_prior_scale(geweke_pair):
     q = [0.25, 0.5, 0.75]
     assert np.allclose(np.quantile(l_post, q), np.quantile(l_prior, q),
                        atol=0.3)
+
+
+# ---------------------------------------------------------------------------
+# Successive-conditional Geweke (round-3): redraw Y | state between sweeps,
+# so the *likelihood* paths (probit truncnorm, PG-Poisson, NA-free grams)
+# run inside the consistency loop — the stationary law of the state is then
+# the prior (Geweke 2004 "getting it right", successive-conditional sampler).
+# ---------------------------------------------------------------------------
+
+def _successive_conditional(distr, seed, n_rec=600, thin=12, transient=1200):
+    import jax
+    import jax.numpy as jnp
+
+    from hmsc_tpu.mcmc.structs import (build_model_data, build_spec,
+                                       build_state)
+    from hmsc_tpu.mcmc.sweep import make_sweep
+    from hmsc_tpu.mcmc import updaters as U
+    from hmsc_tpu.precompute import compute_data_parameters
+
+    rng = np.random.default_rng(seed)
+    ny, ns, n_units = 12, 4, 5
+    X = np.column_stack([np.ones(ny), rng.standard_normal(ny)])
+    Y0 = np.zeros((ny, ns))
+    Y0[0, :] = 1.0                       # any valid starting Y
+    units = [f"u{i % n_units}" for i in range(ny)]
+    study = pd.DataFrame({"lvl": units})
+    rl = HmscRandomLevel(units=study["lvl"])
+    set_priors_random_level(rl, nf_max=2, nf_min=2)
+    m = Hmsc(Y=Y0, X=X, distr=distr, study_design=study,
+             ran_levels={"lvl": rl}, x_scale=False)
+    if distr != "probit":
+        # keep the latent scale small so lognormal-Poisson counts stay in
+        # the NB(r=1000)-limit's design regime (lambda << r); outside it the
+        # augmentation's approximation bias (shared with the reference's
+        # BayesLogit r=1000 path) dominates the Geweke comparison
+        from hmsc_tpu.model import set_priors
+        set_priors(m, V0=0.04 * np.eye(m.nc), f0=m.nc + 10,
+                   UGamma=0.04 * np.eye(m.nc * m.nt))
+
+    spec = build_spec(m, 2)
+    data = build_model_data(m, compute_data_parameters(m), spec)
+    state = build_state(m, spec, seed=seed)
+    sweep = make_sweep(spec, None, (0,))
+    fam = int(m.distr[0, 0])
+
+    def redraw_y(state_, key):
+        """Jointly refresh (Z, Y) from p(z, Y | theta, Eta): z fresh from the
+        latent Gaussian, Y through the observation model, and the chain's Z
+        replaced by z.  Replacing BOTH keeps (Y, Z) jointly consistent, which
+        matters for updaters that are Markov moves using the previous Z (the
+        PG-Poisson update) rather than full conditional refreshes."""
+        E = U.total_loading(spec, data, state_)
+        std = state_.iSigma[None, :] ** -0.5
+        k1, k2 = jax.random.split(key)
+        z = E + std * jax.random.normal(k1, E.shape, dtype=E.dtype)
+        if fam == 2:
+            Y = (z > 0).astype(z.dtype)
+        elif fam == 3:
+            lam = jnp.exp(jnp.clip(z, -30.0, 15.0))
+            Y = jax.random.poisson(k2, lam).astype(z.dtype)
+        else:
+            Y = z
+        return Y, state_.replace(Z=z)
+
+    n_iter = transient + n_rec * thin
+
+    def one(carry, k):
+        Y, state_ = carry
+        k1, k2 = jax.random.split(k)
+        state_ = sweep(data.replace(Y=Y), state_, k1)
+        Y, state_ = redraw_y(state_, k2)
+        return (Y, state_), (state_.Beta, state_.Gamma,
+                             state_.levels[0].Lambda, state_.iSigma)
+
+    keys = jax.random.split(jax.random.PRNGKey(seed), n_iter)
+    run = jax.jit(lambda c, ks: jax.lax.scan(one, c, ks))
+    (_, _), (B, G, L, iS) = run((jnp.asarray(m.YScaled), state), keys)
+    sel = slice(transient, None, thin)
+    return (np.asarray(B)[sel], np.asarray(G)[sel], np.asarray(L)[sel],
+            np.asarray(iS)[sel], m)
+
+
+def _prior_draws(m, n, seed):
+    prior = sample_mcmc(m, samples=n, n_chains=1, seed=seed, from_prior=True,
+                        align_post=False)
+    return prior
+
+
+def test_successive_conditional_probit():
+    B, G, L, iS, m = _successive_conditional("probit", seed=3)
+    prior = _prior_draws(m, 2000, seed=5)
+    bp = prior["Beta"].reshape(-1, *B.shape[1:])
+    q = [0.25, 0.5, 0.75]
+    iqr = np.quantile(bp, 0.75) - np.quantile(bp, 0.25)
+    assert np.allclose(np.quantile(B, q, axis=0), np.quantile(bp, q, axis=0),
+                       atol=0.4 * max(iqr, 1.0))
+    gp = prior["Gamma"].reshape(-1, *G.shape[1:])
+    assert np.allclose(np.quantile(G, q, axis=0), np.quantile(gp, q, axis=0),
+                       atol=0.4)
+    lp = prior["Lambda_0"].reshape(-1, *L.shape[1:])
+    assert np.allclose(np.quantile(L, q), np.quantile(lp, q), atol=0.35)
+    assert np.allclose(iS, 1.0)          # probit: sigma fixed
+
+
+def test_successive_conditional_lognormal_poisson():
+    """PG-augmented lognormal-Poisson Z update inside the Geweke loop.  The
+    NB(r=1000) limit + moment-matched PG are approximations (shared with the
+    reference's BayesLogit r=1000 path), so tolerances are looser."""
+    B, G, L, iS, m = _successive_conditional("lognormal poisson", seed=11)
+    assert np.isfinite(B).all() and np.isfinite(iS).all()
+    prior = _prior_draws(m, 2000, seed=7)
+    bp = prior["Beta"].reshape(-1, *B.shape[1:])
+    q = [0.25, 0.5, 0.75]
+    iqr = np.quantile(bp, 0.75) - np.quantile(bp, 0.25)
+    assert np.allclose(np.quantile(B, q, axis=0), np.quantile(bp, q, axis=0),
+                       atol=0.6 * max(iqr, 1.0))
+    # sigma is estimated for lognormal poisson: compare against its prior
+    sp = prior["sigma"].reshape(-1, *iS.shape[1:])
+    assert abs(np.median(1.0 / iS) - np.median(sp)) < 0.5 * np.median(sp) + 0.3

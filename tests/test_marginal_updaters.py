@@ -23,9 +23,7 @@ from hmsc_tpu.precompute import compute_data_parameters
 
 from util import small_model
 
-import pytest as _pytest
-
-pytestmark = _pytest.mark.slow
+pytestmark = pytest.mark.slow
 
 
 def _tiny(spatial=None, ny=12, ns=3, n_units=4, nf=2, seed=0):

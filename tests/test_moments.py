@@ -245,8 +245,7 @@ def test_eta_nngp_cg_matches_dense():
                     nf=2, seed=17, n_neighbours=5)
     spec, data, state, _ = build_all(m, seed=7, nf_cap=2)
     S = np.asarray(state.Z) - np.asarray(
-        __import__("hmsc_tpu.mcmc.updaters", fromlist=["linear_fixed"])
-        .linear_fixed(spec, data, state.Beta))
+        U.linear_fixed(spec, data, state.Beta))
     import jax.numpy as jnp
     S = jnp.asarray(S)
 

@@ -12,9 +12,7 @@ from hmsc_tpu import (concat_posteriors, load_checkpoint, sample_mcmc,
 
 from util import small_model
 
-import pytest as _pytest
-
-pytestmark = _pytest.mark.slow
+pytestmark = pytest.mark.slow
 
 
 def test_verbose_progress(capfd):

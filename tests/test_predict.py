@@ -13,9 +13,7 @@ from hmsc_tpu.random_level import set_priors_random_level
 
 from util import small_model
 
-import pytest as _pytest
-
-pytestmark = _pytest.mark.slow
+pytestmark = pytest.mark.slow
 
 
 @pytest.fixture(scope="module")

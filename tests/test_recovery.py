@@ -18,9 +18,7 @@ from hmsc_tpu.mcmc import updaters as U
 
 from util import build_all, small_model
 
-import pytest as _pytest
-
-pytestmark = _pytest.mark.slow
+pytestmark = pytest.mark.slow
 
 
 def test_beta_recovery_probit():

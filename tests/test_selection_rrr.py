@@ -17,9 +17,7 @@ from hmsc_tpu.mcmc.structs import build_model_data, build_spec, build_state
 from hmsc_tpu.mcmc import updaters_sel as USel
 from hmsc_tpu.precompute import compute_data_parameters
 
-import pytest as _pytest
-
-pytestmark = _pytest.mark.slow
+pytestmark = pytest.mark.slow
 
 
 def _rrr_model(ny=80, ns=6, nco=5, seed=0, scale=True, with_level=False):
