@@ -149,7 +149,7 @@ def main():
     # 4 chains on one chip, vs the measured reference-style engine
     ny, ns, nf = 1000, 1000, 8
     hM2, Y2, X2 = _config(ny=ny, ns=ns, nf=nf)
-    rate_big = _tpu_rate(hM2, samples=50, transient=10, n_chains=n_chains,
+    rate_big = _tpu_rate(hM2, samples=200, transient=10, n_chains=n_chains,
                          nf=nf)
 
     # measured baseline: reference-style numpy engine (same sweep structure,

@@ -144,7 +144,8 @@ def _eta_nngp_cg(spec, data, state, r, key, S, tol: float = 1e-5,
     # poison the draw to NaN instead — the sampler's divergence containment
     # then reports the chain and first bad sweep loudly.
     res = jnp.linalg.norm(pmv(eta) - b) / jnp.maximum(jnp.linalg.norm(b), 1e-30)
-    eta = jnp.where(res < 1e-3, eta, jnp.nan)
+    thresh = max(100.0 * tol, 1e-3)       # scales with the requested tol
+    eta = jnp.where(res < thresh, eta, jnp.nan)
     return lv.replace(Eta=eta)
 
 

@@ -5,11 +5,21 @@
 Both accelerate mixing of the Beta–Gamma–Eta hierarchy by integrating
 parameters out of a conditional draw.  They are exact Gibbs moves and fully
 optional: the TPU sweep's batched joint BetaLambda update already removes the
-per-species bottleneck that motivates them in the reference, and their dense
-O((ns*nc)^3) factorisations dominate at scale, so they default OFF here and
-are enabled with ``updater={"Gamma2": True, "GammaEta": True}`` (the
-reference enables them by default whenever its structural gates pass,
+per-species bottleneck that motivates them in the reference, so they default
+OFF here and are enabled with ``updater={"Gamma2": True, "GammaEta": True}``
+(the reference enables them by default whenever its structural gates pass,
 ``sampleMcmc.R:123-152,206-216``).
+
+The default was **measured, not assumed** (round 3, TPU v5e, probit + one
+unstructured level, 4 chains; see BENCHMARKS.md): enabling GammaEta loses on
+throughput and min ESS/s at every scale tried, and on median ESS/s at all
+but the largest (where it is within noise, 11.3 -> 11.5) —
+TD-scale (50x4): 2174 -> 1490 samples/s, median ESS/s 723 -> 409;
+mid (400x250): 1080 -> 364 samples/s, ESS/s 174 -> 91;
+headline (1000x1000): 198 -> 48 samples/s, min ESS/s 4.1 -> 1.5.
+The collapsed move pays its dense algebra without buying mixing this engine
+does not already get from the batched joint (Beta, Lambda) draw, so
+reference-default parity here would be a regression.
 
 Design notes (TPU-first restatement, not a translation):
 
