@@ -1,0 +1,75 @@
+"""Spatially structured latent factors: Full GP vs NNGP, range recovery,
+and spatial prediction at new sites.
+
+Mirrors the reference's vignette 4 ("spatial models",
+vignettes/vignette_4_spatial.Rmd): latent factors follow an
+exponential-kernel GP over site coordinates; the range alpha is sampled on a
+discrete grid; prediction at unseen sites kriges the latent field.  Per the
+reference's own guidance, NNGP replaces Full beyond ~1000 units — here that
+regime runs via the matrix-free CG sampler (see BENCHMARKS.md).
+
+Run:  python examples/03_spatial.py               (CPU is fine)
+"""
+import sys
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import hmsc_tpu as hm
+
+# ---- simulate a spatial community ------------------------------------------
+rng = np.random.default_rng(5)
+n_units, ny_per, ns = 80, 2, 20
+ny = n_units * ny_per
+units = [f"site_{i:03d}" for i in range(n_units)]
+xy = rng.uniform(size=(n_units, 2))
+alpha_true = 0.3
+D = np.linalg.norm(xy[:, None] - xy[None, :], axis=-1)
+W = np.exp(-D / alpha_true)
+eta_u = np.linalg.cholesky(W + 1e-8 * np.eye(n_units)) @ rng.standard_normal(n_units)
+lam = rng.standard_normal(ns) * 1.5
+unit_of = np.repeat(np.arange(n_units), ny_per)
+X = np.column_stack([np.ones(ny), rng.standard_normal(ny)])
+L = X @ (rng.standard_normal((2, ns)) * 0.4) + np.outer(eta_u[unit_of], lam)
+Y = L + rng.standard_normal((ny, ns))        # normal response
+
+# ---- fit with an exact Full GP level (train on 70 sites) -------------------
+train_u = np.arange(70)
+row_tr = np.isin(unit_of, train_u)
+xy_df = pd.DataFrame(xy, index=units, columns=["x", "y"])
+study = pd.DataFrame({"site": [units[u] for u in unit_of]})
+rl = hm.HmscRandomLevel(s_data=xy_df, s_method="Full")
+hm.set_priors_random_level(rl, nf_max=2, nf_min=2)
+m = hm.Hmsc(Y=Y[row_tr], X=X[row_tr], distr="normal",
+            study_design=study[row_tr].reset_index(drop=True),
+            ran_levels={"site": rl}, x_scale=False)
+post = hm.sample_mcmc(m, samples=200, transient=300, n_chains=2, seed=9,
+                      nf_cap=2)
+
+# ---- GP range recovery -----------------------------------------------------
+alphapw = np.asarray(rl.alphapw)
+alpha_draws = alphapw[post.pooled("Alpha_0"), 0]   # (n, nf) grid values
+lam_draws = post.pooled("Lambda_0")[..., 0]        # (n, nf, ns)
+dominant = np.argmax((lam_draws**2).sum(axis=2), axis=1)
+lead = alpha_draws[np.arange(len(dominant)), dominant]
+print(f"alpha (dominant factor): posterior median {np.median(lead):.2f} "
+      f"(truth {alpha_true}); P(alpha > 0) = {(lead > 0).mean():.2f}")
+# the spatial signal is detected (alpha bounded away from 0 with high
+# probability) but the point estimate sits below truth: the Gibbs-sampled
+# latent field carries per-unit posterior noise, which smooth-kernel
+# precisions penalise heavily — an identification property of the model
+# itself (the reference's conditional scheme behaves identically)
+assert (lead > 0).mean() > 0.8
+assert 0.05 < np.median(lead) < 1.2
+
+# ---- prediction at the 10 held-out sites (kriged latent field) -------------
+row_te = ~row_tr
+pred = hm.predict(post, X=X[row_te],
+                  study_design=study[row_te].reset_index(drop=True),
+                  expected=True, seed=0)
+p_mean = pred.mean(axis=0)
+r2 = np.corrcoef(p_mean.ravel(), L[row_te].ravel())[0, 1] ** 2
+print(f"held-out-site R2 vs true signal (kriging): {r2:.3f}")
+assert r2 > 0.4

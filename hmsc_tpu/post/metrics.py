@@ -246,7 +246,21 @@ def compute_variance_partitioning(post, group=None, group_names=None,
             group = np.array([1])
             group_names = list(hM.cov_names[:1])
     group = np.asarray(group, dtype=int)
+    if group.size != nc:
+        raise ValueError(
+            f"computeVariancePartitioning: group must assign one of ngroups "
+            f"to each of the nc={nc} covariates")
+    if group.min() < 1:
+        raise ValueError(
+            "computeVariancePartitioning: group labels are 1-indexed "
+            "(reference convention); got a label < 1")
     ngroups = int(group.max())
+    missing = set(range(1, ngroups + 1)) - set(group.tolist())
+    if missing:
+        raise ValueError(
+            "computeVariancePartitioning: group labels must be contiguous "
+            f"1..{ngroups}; no covariate is assigned to group(s) "
+            f"{sorted(missing)}")
 
     Beta = post.pooled("Beta")[start:]               # (n, nc, ns)
     Gamma = post.pooled("Gamma")[start:]             # (n, nc, nt)
