@@ -91,6 +91,10 @@ def make_sweep(spec: ModelSpec, updater: dict | None = None,
             state = USel.update_w_rrr_priors(spec, data, state,
                                              jax.random.fold_in(ks[8], 1))
 
+        # E_shared: the current linear predictor, threaded through the sweep
+        # tail (Eta -> InvSigma -> Z) so total_loading's padding-bound small-K
+        # matmuls run once instead of three times per sweep
+        E_shared = None
         if on("Eta") and spec.nr > 0:
             LFix = U.linear_fixed(spec_x, data_x, state.Beta)
             LRan = [U.level_loading(data.levels[r], state.levels[r])
@@ -109,6 +113,9 @@ def make_sweep(spec: ModelSpec, updater: dict | None = None,
                 levels[r] = lv
                 state = state.replace(levels=tuple(levels))
                 LRan[r] = U.level_loading(data.levels[r], state.levels[r])
+            E_shared = LFix
+            for r in range(spec.nr):
+                E_shared = E_shared + LRan[r]
 
         if on("Alpha"):
             for r in range(spec.nr):
@@ -120,9 +127,10 @@ def make_sweep(spec: ModelSpec, updater: dict | None = None,
                     state = state.replace(levels=tuple(levels))
 
         if on("InvSigma"):
-            state = U.update_inv_sigma(spec_x, data_x, state, ks[6])
+            state = U.update_inv_sigma(spec_x, data_x, state, ks[6],
+                                       E=E_shared)
         if on("Z"):
-            state = U.update_z(spec_x, data_x, state, ks[7])
+            state = U.update_z(spec_x, data_x, state, ks[7], E=E_shared)
 
         # factor-count adaptation during burn-in (iter <= adaptNf[r])
         for r in range(spec.nr):

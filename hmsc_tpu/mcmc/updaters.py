@@ -104,12 +104,16 @@ def _unstack_lambda(spec: ModelSpec, BL: jnp.ndarray, state: GibbsState):
 # updateZ (reference R/updateZ.R:4-94)
 # ---------------------------------------------------------------------------
 
-def update_z(spec: ModelSpec, data: ModelData, state: GibbsState, key) -> GibbsState:
+def update_z(spec: ModelSpec, data: ModelData, state: GibbsState, key,
+             E=None) -> GibbsState:
     """Latent-response data augmentation: normal copies Y, probit draws
     truncated normals for the whole ny x ns block at once, (lognormal-)Poisson
     uses Polya-Gamma augmentation of the NB(r=1000) limit; NA cells are imputed
-    from the linear predictor."""
-    E = total_loading(spec, data, state)
+    from the linear predictor.  ``E`` may pass in the current linear predictor
+    (the sweep shares one total_loading across its tail — the small-K matmuls
+    are MXU-padding-bound, so recomputes are pure waste)."""
+    if E is None:
+        E = total_loading(spec, data, state)
     std = state.iSigma[None, :] ** -0.5
     fam = data.distr_family[None, :]
     k_tn, k_pg, k_pg2, k_na = jax.random.split(key, 4)
@@ -468,10 +472,10 @@ def update_eta_nonspatial(spec, data, state, r: int, key, S):
 # ---------------------------------------------------------------------------
 
 def update_inv_sigma(spec: ModelSpec, data: ModelData, state: GibbsState,
-                     key) -> GibbsState:
+                     key, E=None) -> GibbsState:
     if not spec.any_estimated_sigma:
         return state
-    Eps = state.Z - total_loading(spec, data, state)
+    Eps = state.Z - (total_loading(spec, data, state) if E is None else E)
     n_obs = data.Ymask.sum(axis=0)
     shape = data.aSigma + 0.5 * n_obs
     rate = data.bSigma + 0.5 * ((Eps * data.Ymask) ** 2).sum(axis=0)

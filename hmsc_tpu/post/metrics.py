@@ -261,6 +261,10 @@ def compute_variance_partitioning(post, group=None, group_names=None,
             "computeVariancePartitioning: group labels must be contiguous "
             f"1..{ngroups}; no covariate is assigned to group(s) "
             f"{sorted(missing)}")
+    if group_names is not None and len(group_names) != ngroups:
+        raise ValueError(
+            f"computeVariancePartitioning: groupnames has "
+            f"{len(group_names)} entries but group defines {ngroups} groups")
 
     Beta = post.pooled("Beta")[start:]               # (n, nc, ns)
     Gamma = post.pooled("Gamma")[start:]             # (n, nc, nt)
