@@ -79,6 +79,55 @@ def _unpack_records(packed, leaves, shapes, treedef, f32):
     return jax.tree.unflatten(treedef, out)
 
 
+# species-dimension index per array field (before any leading chain axis);
+# fields not listed are replicated over the species mesh axis
+_SPECIES_DIMS = {
+    "Z": 1, "Beta": 1, "iSigma": 0, "Lambda": 1, "Psi": 1,
+    "Y": 1, "Ymask": 1, "Tr": 0, "distr_family": 0,
+    "distr_estsig": 0, "sigma_fixed": 0, "aSigma": 0, "bSigma": 0,
+}
+
+# guard against silent drift: every key must name a real struct field
+from .structs import GibbsState as _GS, LevelState as _LS, ModelData as _MD  # noqa: E402
+_known = {f.name for cls in (_GS, _LS, _MD)
+          for f in __import__("dataclasses").fields(cls)}
+_stale = set(_SPECIES_DIMS) - _known
+assert not _stale, f"_SPECIES_DIMS names unknown struct fields: {_stale}"
+del _GS, _LS, _MD, _known, _stale
+
+
+def _shard_species(tree, mesh, spec, sp_axis, lead=None):
+    """Place a (state or data) pytree on the mesh: optional leading chain
+    axis, species dims from ``_SPECIES_DIMS`` on ``sp_axis``, everything
+    else replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    # device_put requires even shards; the caller gates divisibility
+    sp_ok = sp_axis is not None
+
+    def put(path, leaf):
+        if not hasattr(leaf, "ndim") or leaf.ndim == 0:
+            return leaf
+        name = None
+        for p in reversed(path):
+            n = getattr(p, "name", None)
+            if n is not None:
+                name = n
+                break
+        ax = [None] * leaf.ndim
+        off = 0
+        if lead is not None:
+            ax[0] = lead
+            off = 1
+        d = _SPECIES_DIMS.get(name) if sp_ok else None
+        if d is not None and d + off < leaf.ndim \
+                and leaf.shape[d + off] == spec.ns:
+            ax[d + off] = sp_axis
+        return jax.device_put(leaf, NamedSharding(mesh, P(*ax)))
+
+    return jax.tree_util.tree_map_with_path(put, tree)
+
+
 @functools.lru_cache(maxsize=64)
 def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin,
                      skip_init_z):
@@ -138,6 +187,7 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 nf_cap: int = DEFAULT_NF_CAP, dtype=jnp.float32,
                 data_par=None, from_prior: bool = False,
                 align_post: bool = True, mesh=None, chain_axis: str = "chains",
+                species_axis: str = "species",
                 return_state: bool = False, verbose: int = 0,
                 init_state=None, profile_dir: str | None = None,
                 rng_impl: str | None = None):
@@ -218,10 +268,28 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
     updater_items = (tuple(sorted(updater.items())) if updater else None)
     sharding = None
     if mesh is not None:
-        # shard the chain batch axis over the mesh; everything else replicates
+        # chains are the data-parallel axis; if the mesh also names a
+        # `species_axis`, the species dimension of every site x species array
+        # is sharded over it (model parallelism: per-species updaters run
+        # fully local, the cross-species reductions — E E' in updateGammaV,
+        # the factor grams in updateEta, the rho quadratic — become XLA
+        # collectives riding ICI).  This replaces the reference's
+        # chains-only SOCK parallelism with dp x tp over one mesh.
         from jax.sharding import NamedSharding, PartitionSpec as P
+        sp = species_axis if species_axis in mesh.axis_names else None
+        if sp is not None and spec.ns % int(mesh.shape[sp]) != 0:
+            import warnings
+            warnings.warn(
+                f"mesh names a '{sp}' axis of size {int(mesh.shape[sp])} but "
+                f"ns={spec.ns} is not divisible by it; species arrays are "
+                "replicated (chains-only parallelism) — pad or regroup "
+                "species to engage model parallelism", RuntimeWarning,
+                stacklevel=2)
+            sp = None
         sharding = NamedSharding(mesh, P(chain_axis))
-        state0 = jax.tree.map(lambda x: jax.device_put(x, sharding), state0)
+        state0 = _shard_species(state0, mesh, spec, sp, lead=chain_axis)
+        if sp is not None:
+            data = _shard_species(data, mesh, spec, sp, lead=None)
 
     # progress: verbose>0 splits the sample scan into host-level segments so
     # the host prints between compiled chunks (the reference's per-iteration
