@@ -43,6 +43,11 @@ computePredictedValues = compute_predicted_values
 createPartition = create_partition
 constructGradient = construct_gradient
 prepareGradient = prepare_gradient
+plotBeta = plot_beta
+plotGamma = plot_gamma
+plotGradient = plot_gradient
+plotVariancePartitioning = plot_variance_partitioning
+biPlot = bi_plot
 
 __version__ = "0.1.0"
 
@@ -64,5 +69,6 @@ __all__ = [
     "computeAssociations", "convertToCodaObject", "alignPosterior",
     "evaluateModelFit", "computeWAIC", "computeVariancePartitioning",
     "predictLatentFactor", "computePredictedValues", "createPartition",
-    "constructGradient", "prepareGradient",
+    "constructGradient", "prepareGradient", "plotBeta", "plotGamma",
+    "plotGradient", "plotVariancePartitioning", "biPlot",
 ]

@@ -31,12 +31,17 @@ __all__ = ["sample_mcmc"]
 
 
 @functools.lru_cache(maxsize=16)
-def _packer(n_leaves):
+def _packer(n_leaves, cast=None):
     """Jitted raveled-concat: one contiguous device buffer per fetch."""
-    return jax.jit(lambda *xs: jnp.concatenate([x.ravel() for x in xs]))
+    def pack(*xs):
+        flat = [x.ravel() for x in xs]
+        if cast is not None:
+            flat = [x.astype(cast) for x in flat]
+        return jnp.concatenate(flat)
+    return jax.jit(pack)
 
 
-def _pack_records(recs):
+def _pack_records(recs, record_dtype=None):
     """Pack the f32 leaves of a recorded-sample pytree into ONE device buffer.
 
     A per-leaf ``np.asarray`` pays the device round-trip latency once per
@@ -48,8 +53,12 @@ def _pack_records(recs):
     leaves, treedef = jax.tree.flatten(recs)
     f32 = [i for i, l in enumerate(leaves)
            if l.dtype == jnp.float32 and l.size > 0]
+    if len(f32) == 1 and record_dtype is not None:
+        # single-leaf records skip packing but must still quantise
+        i = f32[0]
+        leaves[i] = jax.jit(lambda x: x.astype(record_dtype))(leaves[i])
     if len(f32) > 1:
-        packed = _packer(len(f32))(*[leaves[i] for i in f32])
+        packed = _packer(len(f32), record_dtype)(*[leaves[i] for i in f32])
         # retain only shapes for the packed leaves — holding the original
         # device arrays until fetch time would double record HBM
         shapes = {i: leaves[i].shape for i in f32}
@@ -65,6 +74,8 @@ def _unpack_records(packed, leaves, shapes, treedef, f32):
     out = list(leaves)
     if packed is not None:
         host = np.asarray(packed)
+        if host.dtype != np.float32:          # record_dtype quantisation
+            host = host.astype(np.float32)
         off = 0
         for i in f32:
             shape = shapes[i]
@@ -76,6 +87,8 @@ def _unpack_records(packed, leaves, shapes, treedef, f32):
     for i in range(len(out)):
         if not isinstance(out[i], np.ndarray):
             out[i] = np.asarray(out[i])
+        if out[i].dtype == jnp.bfloat16:      # single-leaf record_dtype path
+            out[i] = out[i].astype(np.float32)
     return jax.tree.unflatten(treedef, out)
 
 
@@ -190,7 +203,7 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 species_axis: str = "species",
                 return_state: bool = False, verbose: int = 0,
                 init_state=None, profile_dir: str | None = None,
-                rng_impl: str | None = None):
+                rng_impl: str | None = None, record_dtype=None):
     """Run the blocked Gibbs sampler; returns a :class:`~hmsc_tpu.post.Posterior`.
 
     Arguments mirror the reference's ``sampleMcmc`` (samples/transient/thin/
@@ -209,6 +222,12 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
       ``rbg`` on TPU backends (the probit Z update is RNG-throughput-bound
       at scale) and ``threefry2x32`` elsewhere.  Reproducibility is bitwise
       per (seed, impl), not across impls.
+    - ``record_dtype`` (e.g. ``jnp.bfloat16``) quantises recorded draws
+      before the device->host fetch, halving posterior transfer bytes; the
+      in-sweep state stays f32 (the chain itself is unaffected) and draws
+      are widened back to f32 on the host.  bf16 keeps f32 range with ~3
+      significant digits — well below Monte-Carlo error for summary use, but
+      the default (``None``) records exact f32 draws.
     """
     import time
 
@@ -335,7 +354,7 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
             # pack now (async on device); fetch below.  Drop the original
             # record tree immediately — keeping it alive through the fetch
             # would double record HBM (the pack holds the only live copy)
-            recs_segs.append(_pack_records(recs))
+            recs_segs.append(_pack_records(recs, record_dtype))
             del recs
             trans_cur = 0
             skip_z = True

@@ -13,7 +13,8 @@ import jax.numpy as jnp
 from jax.scipy.linalg import solve_triangular
 
 from ..ops.linalg import chol_spd, sample_mvn_prec, sample_mvn_prec_batched
-from ..ops.rand import polya_gamma, standard_gamma, truncated_normal, wishart
+from ..ops.rand import (polya_gamma, standard_gamma,
+                        truncated_normal_onesided, wishart)
 from .structs import GibbsState, LevelState, ModelData, ModelSpec
 
 __all__ = ["linear_fixed", "level_loading", "update_z", "update_beta_lambda",
@@ -122,10 +123,9 @@ def update_z(spec: ModelSpec, data: ModelData, state: GibbsState, key,
     if spec.any_normal:
         Z = jnp.where(fam == 1, data.Y, Z)
     if spec.any_probit:
-        pos = data.Y > 0.5
-        lb = jnp.where(pos, 0.0, -jnp.inf)
-        ub = jnp.where(pos, jnp.inf, 0.0)
-        z_tn = truncated_normal(k_tn, lb, ub, E, std)
+        # probit truncation is always one-sided (Y=1 -> Z>0, Y=0 -> Z<0), so
+        # the specialised op spends 1 ndtr + 1 ndtri per cell instead of 2+1
+        z_tn = truncated_normal_onesided(k_tn, 0.0, data.Y > 0.5, E, std)
         Z = jnp.where(fam == 2, z_tn, Z)
     if spec.any_poisson:
         logr = jnp.log(_NB_R)

@@ -11,8 +11,9 @@ import jax
 import jax.numpy as jnp
 from jax.scipy.special import ndtr, ndtri
 
-__all__ = ["truncated_normal", "standard_gamma", "polya_gamma", "wishart",
-           "mvn_from_prec_chol", "categorical_logits"]
+__all__ = ["truncated_normal", "truncated_normal_onesided", "standard_gamma",
+           "polya_gamma", "wishart", "mvn_from_prec_chol",
+           "categorical_logits"]
 
 _TINY = 1e-38  # smallest safe f32 normal-ish
 # f32 ndtri overflows to -inf below ~1e-33 (ndtri(1e-38) = -inf while
@@ -76,6 +77,45 @@ def truncated_normal(key, lower, upper, mean=0.0, std=1.0, *, _u=None):
     x = jnp.where(a2 > FAR, x_far, x_r)
     x = jnp.clip(x, a2, b2)                # guard the clipped-quantile edges
     x = jnp.where(right, x, -x)
+    return mean + std * x
+
+
+def truncated_normal_onesided(key, bound, is_lower, mean=0.0, std=1.0, *,
+                              _u=None):
+    """One-sided truncated normal: X > bound where ``is_lower`` is true,
+    X < bound where false, elementwise.
+
+    The probit Z augmentation (reference ``R/updateZ.R:43-63``) only ever
+    truncates on one side (Y=1 -> Z > 0, Y=0 -> Z < 0), and for a one-sided
+    interval one of the two survival probabilities in the general
+    :func:`truncated_normal` is exactly 0 — but its ``ndtr`` is still
+    evaluated over the whole array.  This op drops it: 1 ndtr + 1 ndtri per
+    cell instead of 2 + 1, with the same survival-parameterisation accuracy
+    and the same Robert (1995) exponential far-tail fallback.  On the
+    1000x1000 probit bench the Z update is ~2/3 of the sweep, so the saved
+    transcendental is a real win.
+    """
+    shape = jnp.broadcast_shapes(jnp.shape(bound), jnp.shape(is_lower),
+                                 jnp.shape(mean), jnp.shape(std))
+    is_lower = jnp.broadcast_to(is_lower, shape)
+    # reflect upper-bounded cells into the right-tail parameterisation:
+    # X < b  <=>  -X > -b, with X standardized to W = (X - mean)/std
+    t = (jnp.broadcast_to(bound, shape) - mean) / std
+    t = jnp.where(is_lower, t, -t)
+    u = (jax.random.uniform(key, shape, minval=_TINY, maxval=1.0)
+         if _u is None else jnp.broadcast_to(_u, shape))
+
+    sa = ndtr(-t)                          # P(W > t)
+    s = u * sa
+    # same f32 rounding guards as truncated_normal: s can round to 1.0 when
+    # sa == 1 and u ~ 1 (ndtri(1) = inf), and underflows past ~9 sigma
+    s_ceil = 1.0 - jnp.finfo(s.dtype).epsneg
+    x_r = -ndtri(jnp.clip(s, _P_FLOOR, s_ceil))
+    lam = jnp.maximum(t, 1.0)
+    x_far = t - jnp.log1p(-u) / lam        # (X | X > t) ~ t + Exp(lam)/1
+    x = jnp.where(t > 9.0, x_far, x_r)
+    x = jnp.maximum(x, t)                  # guard the clipped-quantile edge
+    x = jnp.where(is_lower, x, -x)
     return mean + std * x
 
 

@@ -128,11 +128,14 @@ class Posterior:
         return a
 
     # ------------------------------------------------------------------
-    def get_post_estimate(self, par: str, r: int = 0, q=()):
+    def get_post_estimate(self, par: str, r: int = 0, q=(), x=None):
         """Posterior mean / support / quantiles for a parameter
         (reference ``R/getPostEstimate.R:32-79``).  Derived parameters
-        ``Omega`` (= Lambda' Lambda per level) and ``OmegaCor`` supported."""
-        a = self._param_array(par, r)
+        ``Omega`` (= Lambda' Lambda per level) and ``OmegaCor`` supported; for
+        covariate-dependent levels (xDim > 0) ``x`` weights the Lambda slices
+        before the crossproduct — the association matrix *at* covariate value
+        x (reference ``:47-57``; default x = (1, 0, ...), the intercept)."""
+        a = self._param_array(par, r, x=x)
         out = {
             "mean": a.mean(axis=0),
             "support": (a > 0).mean(axis=0),
@@ -142,11 +145,26 @@ class Posterior:
             out["q"] = np.quantile(a, q, axis=0)
         return out
 
-    def _param_array(self, par: str, r: int = 0) -> np.ndarray:
+    def _param_array(self, par: str, r: int = 0, x=None) -> np.ndarray:
         """Pooled (draws, ...) array for a named or derived parameter."""
+        if x is not None and par not in ("Omega", "OmegaCor"):
+            raise ValueError(f"x only applies to Omega/OmegaCor, not {par!r}")
         if par in ("Omega", "OmegaCor"):
             lam = self.pooled(f"Lambda_{r}")          # (n, nf, ns, ncr)
-            lam = lam[..., 0] if lam.ndim == 4 else lam
+            if lam.ndim == 3 and x is not None:
+                raise ValueError(
+                    f"level {r} has no covariate-dependent associations "
+                    "(xDim == 0); x has no effect there")
+            if lam.ndim == 4:
+                if x is None:
+                    lam = lam[..., 0]
+                else:
+                    xv = np.asarray(x, dtype=lam.dtype)
+                    if xv.shape != (lam.shape[-1],):
+                        raise ValueError(
+                            f"x must have length ncr={lam.shape[-1]} "
+                            f"for level {r}, got shape {xv.shape}")
+                    lam = np.einsum("nfjk,k->nfj", lam, xv)
             om = np.einsum("nfj,nfk->njk", lam, lam)
             if par == "OmegaCor":
                 d = np.sqrt(np.maximum(np.einsum("njj->nj", om), 1e-12))

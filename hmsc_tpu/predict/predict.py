@@ -244,7 +244,7 @@ def _conditional_mcmc(hM, spec, post, Xn, x_is_list, XRRR, Beta, sigma, Yc,
     import jax
     import jax.numpy as jnp
 
-    from ..ops.rand import truncated_normal
+    from ..ops.rand import truncated_normal_onesided
 
     # scale Yc for y-scaled normal species so it lives on the Z scale
     m, s = hM.y_scale_par
@@ -305,10 +305,8 @@ def _conditional_mcmc(hM, spec, post, Xn, x_is_list, XRRR, Beta, sigma, Yc,
         if any_normal:
             z = jnp.where((fam == 1) & (mask > 0), Yc0, z)
         if any_probit:
-            pos = Yc0 > 0.5
-            lb = jnp.where(pos, 0.0, -jnp.inf)
-            ub = jnp.where(pos, jnp.inf, 0.0)
-            ztn = truncated_normal(k2, lb, ub, E, std)
+            # one-sided truncation, same specialisation as the sweep's updateZ
+            ztn = truncated_normal_onesided(k2, 0.0, Yc0 > 0.5, E, std)
             z = jnp.where((fam == 2) & (mask > 0), ztn, z)
         if any_poisson:
             from ..ops.rand import polya_gamma

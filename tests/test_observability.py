@@ -123,3 +123,22 @@ def test_init_state_chain_mismatch(tmp_path):
     with pytest.raises(ValueError):
         sample_mcmc(m, samples=3, n_chains=3, seed=1, nf_cap=2,
                     init_state=state)
+
+
+def test_record_dtype_bf16_quantises_only_storage():
+    """record_dtype=bfloat16 halves posterior transfer bytes; it must leave
+    the chain itself untouched (same seed => draws equal up to bf16
+    quantisation, ~3 significant digits) and widen back to f32 on host."""
+    import jax.numpy as jnp
+
+    m = small_model(ny=40, ns=5, nc=2, distr="probit", n_units=8, seed=4)
+    kw = dict(samples=12, transient=5, n_chains=2, seed=7, nf_cap=2,
+              align_post=False)
+    p32 = sample_mcmc(m, **kw)
+    pbf = sample_mcmc(m, record_dtype=jnp.bfloat16, **kw)
+    a, b = p32.pooled("Beta"), pbf.pooled("Beta")
+    assert b.dtype == np.float32
+    assert a.shape == b.shape
+    # elementwise: identical draws quantised to bf16 (rel err <= 2^-8)
+    tol = 2.0**-7 * np.maximum(np.abs(a), 1e-3)
+    assert np.all(np.abs(a - b) <= tol), np.abs(a - b).max()

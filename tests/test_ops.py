@@ -129,6 +129,51 @@ def test_truncated_normal_two_sided():
     assert abs(x.std() - ref.std()) < 0.01
 
 
+def test_truncated_normal_onesided_matches_scipy():
+    """The specialised probit op (1 ndtr + 1 ndtri) against scipy truncnorm in
+    both orientations, with the mean on the allowed and the excluded side."""
+    from hmsc_tpu.ops.rand import truncated_normal_onesided
+    key = jax.random.PRNGKey(11)
+    n = 200_000
+    cases = [  # (is_lower, mean, std)
+        (True, 1.3, 0.7),    # Z > 0, mean on the allowed side
+        (True, -2.5, 1.0),   # Z > 0, mean excluded (right-tail draw)
+        (False, -1.3, 0.7),  # Z < 0, mean allowed
+        (False, 4.0, 1.0),   # Z < 0, mean excluded (left-tail draw)
+    ]
+    for i, (low, mu, sd) in enumerate(cases):
+        x = np.asarray(truncated_normal_onesided(
+            jax.random.fold_in(key, i), 0.0, jnp.full(n, low), mu, sd))
+        a, b = ((0 - mu) / sd, np.inf) if low else (-np.inf, (0 - mu) / sd)
+        ref = sps.truncnorm(a, b, loc=mu, scale=sd)
+        assert np.all(np.isfinite(x))
+        assert np.all(x >= 0) if low else np.all(x <= 0)
+        assert abs(x.mean() - ref.mean()) < 0.05 * max(1.0, abs(ref.mean()))
+        assert abs(x.std() - ref.std()) < 0.05 * max(0.1, ref.std())
+
+
+def test_truncated_normal_onesided_far_tail_and_extreme_u():
+    """Far-tail asymptotic branch and the adversarial f32 uniform (supremum
+    of jax.random.uniform's range) that poisoned a chain through the general
+    op in round 2 — the specialised op must be finite and in-bounds too."""
+    from hmsc_tpu.ops.rand import truncated_normal_onesided
+    key = jax.random.PRNGKey(13)
+    n = 100_000
+    for t in (12.0, 40.0):  # bound at 0, mean -t => standardized threshold t
+        x = np.asarray(truncated_normal_onesided(
+            jax.random.fold_in(key, int(t)), 0.0, jnp.full(n, True), -t, 1.0))
+        assert np.all(np.isfinite(x)) and np.all(x >= 0)
+        assert abs(float(x.mean()) - 1.0 / t) < 2e-2 * t
+    u_max = jnp.float32(1.0) - jnp.float32(2.0**-24)
+    for u in (u_max, jnp.float32(1e-38)):
+        for low, mu in [(True, 0.0185), (True, -3.0), (False, 0.0185),
+                        (False, 5.0), (True, -12.0)]:
+            x = np.asarray(truncated_normal_onesided(
+                key, 0.0, jnp.full(8, low), jnp.float32(mu), 1.0, _u=u))
+            assert np.all(np.isfinite(x)), (float(u), low, mu, x)
+            assert np.all(x >= 0) if low else np.all(x <= 0)
+
+
 def test_sample_mvn_prec_batched_matches_generic():
     """The unrolled small-P cholesky/solve path must agree with the generic
     chol_spd + sample_mvn_prec pipeline (same jitter, same draw) to f32
