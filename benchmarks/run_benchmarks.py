@@ -136,6 +136,39 @@ CONFIGS = [
 SAMPLES, TRANSIENT, CHAINS = 250, 125, 4
 
 
+def baseline_rate(name, m, nf, n_iter=4):
+    """Reference-style NumPy engine sweeps/sec for this config (one chain,
+    one process — the R package's per-core unit; see reference_engine.py for
+    why the ratio is conservative)."""
+    from reference_engine import (ReferenceEngine, spatial_full_grids,
+                                  nngp_grids)
+
+    rng = np.random.default_rng(0)
+    fam = np.asarray(m.distr[:, 0], dtype=int)
+    X = np.asarray(m.X, dtype=float)
+    Y = np.asarray(m.Y, dtype=float)
+    pi_row = np.asarray(m.Pi[:, 0]) if m.nr else None
+    kw = {}
+    rl = m.ranLevels[0] if m.nr else None
+    if rl is not None and getattr(rl, "s", None) is not None:
+        coords = np.asarray(rl.s, dtype=float)
+        if rl.spatial_method == "Full":
+            D = np.sqrt(((coords[:, None] - coords[None]) ** 2).sum(-1))
+            kw["spatial"] = ("full", spatial_full_grids(D))
+        else:
+            kw["spatial"] = ("nngp", nngp_grids(
+                coords, n_neighbours=rl.n_neighbours or 10))
+    if m.C is not None:
+        kw["C"] = np.asarray(m.C, dtype=float)
+        kw["Tr"] = np.asarray(m.Tr, dtype=float)
+    eng = ReferenceEngine(Y, X, fam, nf=nf, rng=rng, pi_row=pi_row, **kw)
+    eng.sweep()                                   # BLAS warm-up, untimed
+    t0 = time.time()
+    for _ in range(n_iter):
+        eng.sweep()
+    return n_iter / (time.time() - t0)
+
+
 def run_one(name, builder):
     rng = np.random.default_rng(42)
     m, kw = builder(rng)
@@ -151,12 +184,14 @@ def run_one(name, builder):
     assert np.isfinite(B).all(), f"{name}: non-finite Beta"
     ess = np.asarray(effective_size(B.reshape(B.shape[0], B.shape[1], -1)))
     rate = CHAINS * SAMPLES / t
+    base = baseline_rate(name, m, nf=kw.get("nf_cap", 2))
     row = {
         "config": name, "ny": m.ny, "ns": m.ns,
         "samples_per_s": round(rate, 1),
         "ess_per_s_median": round(float(np.median(ess)) / t, 1),
         "ess_per_s_min": round(float(np.min(ess)) / t, 2),
         "wall_s": round(t, 2),
+        "vs_baseline": round(rate / base, 1),
     }
     print(json.dumps(row), flush=True)
     return row
@@ -164,11 +199,13 @@ def run_one(name, builder):
 
 def main():
     rows = [run_one(name, b) for name, b in CONFIGS]
-    print("\n| config | ny | ns | samples/s/chip | med ESS/s | min ESS/s | wall (s) |")
-    print("|---|---|---|---|---|---|---|")
+    print("\n| config | ny | ns | samples/s/chip | med ESS/s | min ESS/s "
+          "| wall (s) | vs baseline |")
+    print("|---|---|---|---|---|---|---|---|")
     for r in rows:
         print(f"| {r['config']} | {r['ny']} | {r['ns']} | {r['samples_per_s']} "
-              f"| {r['ess_per_s_median']} | {r['ess_per_s_min']} | {r['wall_s']} |")
+              f"| {r['ess_per_s_median']} | {r['ess_per_s_min']} | {r['wall_s']} "
+              f"| {r['vs_baseline']} |")
 
 
 if __name__ == "__main__":

@@ -22,6 +22,7 @@ from .predict import (predict, predict_latent_factor, compute_predicted_values,
                       create_partition, construct_gradient, prepare_gradient)
 from .utils.checkpoint import (save_checkpoint, load_checkpoint,
                                concat_posteriors)
+from .utils.mesh import make_mesh
 from .plots import (plot_beta, plot_gamma, plot_gradient,
                     plot_variance_partitioning, bi_plot)
 
@@ -61,7 +62,7 @@ __all__ = [
     "evaluate_model_fit", "compute_waic", "compute_variance_partitioning",
     "predict", "predict_latent_factor", "compute_predicted_values",
     "create_partition", "construct_gradient", "prepare_gradient",
-    "save_checkpoint", "load_checkpoint", "concat_posteriors",
+    "save_checkpoint", "load_checkpoint", "concat_posteriors", "make_mesh",
     "plot_beta", "plot_gamma", "plot_gradient",
     "plot_variance_partitioning", "bi_plot",
     "sampleMcmc", "setPriors", "computeDataParameters",

@@ -280,3 +280,24 @@ def test_covariate_dependent_association_recovery():
     # x of the wrong length must be rejected
     with pytest.raises(ValueError):
         post.get_post_estimate("Omega", r=0, x=[1.0, 0.0, 0.0])
+
+
+def test_make_mesh_layouts():
+    """make_mesh builds the 1-D and 2-D layouts from available devices.
+    (End-to-end sampling over a 2-D mesh is covered by
+    test_multidevice_chains_by_species_mesh; this test is pure host logic —
+    no fresh XLA compile late in the suite.)"""
+    from hmsc_tpu import make_mesh
+
+    mesh1 = make_mesh()
+    assert mesh1.axis_names == ("chains",) and mesh1.size == 8
+    mesh2 = make_mesh(species_shards=4)
+    assert mesh2.axis_names == ("chains", "species")
+    assert mesh2.shape["chains"] == 2 and mesh2.shape["species"] == 4
+    assert mesh2.devices.shape == (2, 4)
+    mesh3 = make_mesh(n_chains=2, species_shards=2)
+    assert mesh3.shape["chains"] == 2 and mesh3.shape["species"] == 2
+    with pytest.raises(ValueError):
+        make_mesh(species_shards=3)      # 3 does not divide 8
+    with pytest.raises(ValueError):
+        make_mesh(n_chains=4, species_shards=4)  # 16 > 8 devices
