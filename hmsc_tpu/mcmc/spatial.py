@@ -203,12 +203,11 @@ def _eta_gpp(spec, data, state, r, key, S):
 
 # ---------------------------------------------------------------------------
 
-def update_alpha(spec: ModelSpec, data: ModelData, state: GibbsState, r: int,
-                 key) -> LevelState:
-    """Per-factor categorical draw of the GP range on the alphapw grid:
-    log p_g  =  log prior_g - 0.5 log|W_g| - 0.5 eta' iW_g eta."""
-    lvd, lv, ls = data.levels[r], state.levels[r], spec.levels[r]
-    eta = lv.Eta                                    # (np, nf)
+def eta_quad_grid(lvd, ls, eta):
+    """(v, ld): per-factor prior quadratics eta_h' iW_g eta_h, both (nf, G),
+    over the whole alpha grid.  Single source of the Full/NNGP/GPP prior
+    algebra — consumed by update_alpha (full grid) and by the interweaving
+    scale move (gathered at each factor's current alpha)."""
     if ls.spatial == "Full":
         v = jnp.einsum("hu,guv,hv->hg", eta.T, lvd.iWg, eta.T)
         ld = lvd.detWg[None, :]
@@ -225,6 +224,15 @@ def update_alpha(spec: ModelSpec, data: ModelData, state: GibbsState, r: int,
         t2 = jnp.einsum("hgm,gmn,hgn->hg", Et, lvd.iFg, Et)
         v = jnp.where(lvd.alphapw[None, :, 0] == 0, q_full[:, None], t1 - t2)
         ld = lvd.detDg[None, :]
+    return v, ld
+
+
+def update_alpha(spec: ModelSpec, data: ModelData, state: GibbsState, r: int,
+                 key) -> LevelState:
+    """Per-factor categorical draw of the GP range on the alphapw grid:
+    log p_g  =  log prior_g - 0.5 log|W_g| - 0.5 eta' iW_g eta."""
+    lvd, lv, ls = data.levels[r], state.levels[r], spec.levels[r]
+    v, ld = eta_quad_grid(lvd, ls, lv.Eta)
     loglike = jnp.log(lvd.alphapw[None, :, 1]) - 0.5 * ld - 0.5 * v
     idx = jax.random.categorical(key, loglike, axis=-1).astype(jnp.int32)
     idx = jnp.where(lv.nf_mask > 0, idx, 0)

@@ -57,7 +57,7 @@ def make_sweep(spec: ModelSpec, updater: dict | None = None,
 
     def sweep(data: ModelData, state: GibbsState, key) -> GibbsState:
         state = state.replace(it=state.it + 1)
-        ks = jax.random.split(key, 12)
+        ks = jax.random.split(key, 13)
         data_x = with_eff_x(data, state)
 
         if want("Gamma2"):
@@ -125,6 +125,11 @@ def make_sweep(spec: ModelSpec, updater: dict | None = None,
                     levels = list(state.levels)
                     levels[r] = lv
                     state = state.replace(levels=tuple(levels))
+
+        # beyond-reference: per-factor (Eta, Lambda) scale interweaving.
+        # Leaves the Eta*Lambda loading invariant, so E_shared stays valid
+        if spec.nr > 0 and on("Interweave"):
+            state = U.interweave_scale(spec, data, state, ks[12])
 
         if on("InvSigma"):
             state = U.update_inv_sigma(spec_x, data_x, state, ks[6],

@@ -49,6 +49,8 @@ def make_mesh(n_chains: int | None = None, species_shards: int = 1,
         n_chain_devs = n // species_shards
     else:
         n_chain_devs = int(n_chains)
+        if n_chain_devs < 1:
+            raise ValueError(f"n_chains={n_chains} must be >= 1")
     if n_chain_devs * species_shards > n:
         raise ValueError(
             f"{n_chain_devs} chain-devices x {species_shards} species shards "
