@@ -331,3 +331,54 @@ def test_interweave_preserves_stationary_distribution():
         res[tag] = (np.sqrt((lamd ** 2).sum(-1)).mean(), se.mean())
     assert abs(res["plain"][0] - res["iw"][0]) < 0.05 * res["plain"][0], res
     assert abs(res["plain"][1] - res["iw"][1]) < 0.05 * res["plain"][1], res
+
+
+def test_distmat_level_end_to_end():
+    """Distance-matrix random level (reference HmscRandomLevel(distMat=),
+    Full method only): sampling must run finite and put posterior alpha mass
+    away from zero when eta is strongly distance-correlated."""
+    rng = np.random.default_rng(31)
+    n_units, per, ns = 40, 4, 8
+    ny = n_units * per
+    xy = rng.uniform(size=(n_units, 2))
+    D = np.sqrt(((xy[:, None] - xy[None]) ** 2).sum(-1))
+    W = np.exp(-D / 0.4)
+    eta = np.linalg.cholesky(W + 1e-8 * np.eye(n_units)) \
+        @ rng.standard_normal(n_units)
+    lam = rng.standard_normal(ns) * 1.5
+    unit_of = np.repeat(np.arange(n_units), per)
+    Y = eta[unit_of][:, None] * lam[None, :] \
+        + 0.7 * rng.standard_normal((ny, ns))
+    units = [f"u{i:02d}" for i in range(n_units)]
+    dm = pd.DataFrame(D, index=units, columns=units)
+    study = pd.DataFrame({"plot": np.array(units)[unit_of]})
+    rl = HmscRandomLevel(dist_mat=dm)
+    set_priors_random_level(rl, nf_max=2, nf_min=2)
+    m = Hmsc(Y=Y, X=np.ones((ny, 1)), distr="normal", study_design=study,
+             ran_levels={"plot": rl}, x_scale=False)
+    post = sample_mcmc(m, samples=100, transient=100, n_chains=2, seed=4,
+                       nf_cap=2)
+    assert post.chain_health["good_chains"].all()
+    a = np.asarray(post["Alpha_0"], dtype=int)
+    alphapw = m.ranLevels[0].alphapw
+    lead = np.linalg.norm(np.asarray(post["Lambda_0"], float),
+                          axis=(-2, -1)).reshape(-1, a.shape[-1]).argmax(1)
+    vals = alphapw[a.reshape(-1, a.shape[-1])[np.arange(len(lead)), lead], 0]
+    assert (vals > 0).mean() > 0.6, (vals > 0).mean()
+
+
+def test_per_species_x_list_end_to_end():
+    """Per-species design matrices (reference Hmsc(X=list), Hmsc.R:182-262):
+    species j's response driven by its OWN covariate column must be
+    recovered, proving the per-species X path is exercised end-to-end and
+    not collapsed to a shared design."""
+    rng = np.random.default_rng(33)
+    ny, ns = 250, 6
+    covs = rng.standard_normal((ny, ns))       # one personal covariate each
+    beta1 = np.linspace(1.0, 2.0, ns)
+    X_list = [np.column_stack([np.ones(ny), covs[:, j]]) for j in range(ns)]
+    Y = beta1[None, :] * covs + 0.5 * rng.standard_normal((ny, ns))
+    m = Hmsc(Y=Y, X=X_list, distr="normal", x_scale=False)
+    post = sample_mcmc(m, samples=150, transient=150, n_chains=2, seed=6)
+    bhat = np.asarray(post["Beta"], float).reshape(-1, 2, ns).mean(0)
+    assert np.all(np.abs(bhat[1] - beta1) < 0.25), bhat[1]
