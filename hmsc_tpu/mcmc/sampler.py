@@ -295,6 +295,12 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         # collectives riding ICI).  This replaces the reference's
         # chains-only SOCK parallelism with dp x tp over one mesh.
         from jax.sharding import NamedSharding, PartitionSpec as P
+        n_chain_devs = int(mesh.shape[chain_axis])
+        if n_chains % n_chain_devs:
+            raise ValueError(
+                f"n_chains={n_chains} must be a multiple of the mesh's "
+                f"'{chain_axis}' extent ({n_chain_devs}) so chains lay out "
+                "evenly over devices")
         sp = species_axis if species_axis in mesh.axis_names else None
         if sp is not None and spec.ns % int(mesh.shape[sp]) != 0:
             import warnings
