@@ -290,12 +290,21 @@ def compute_variance_partitioning(post, group=None, group_names=None,
         fixedsplit1[:, :, k] = np.einsum("ncj,jcd,ndj->nj", Beta[:, s],
                                          cM[np.ix_(range(ns), s, s)],
                                          Beta[:, s])
-    # random-level variance per species: sum_h lambda_h^2
+    # random-level variance per species: sum_h lambda_h^2.  For a
+    # covariate-dependent level the per-unit variance is (lambda_h' x_u)^2,
+    # so average over units: lambda_h' E[x x'] lambda_h.  (The reference's
+    # own xDim>0 line `t(Lambda[factor,])*Lambda[factor,]` is shape-invalid
+    # R, computeVariancePartitioning.R:159 — this is the intended quantity.)
     random1 = np.empty((n_draws, ns, nr))
     for r in range(nr):
         lam = post.pooled(f"Lambda_{r}")[start:]
-        lam = lam[..., 0] if lam.ndim == 4 else lam
-        random1[:, :, r] = (lam**2).sum(axis=1)
+        if lam.ndim == 4 and lam.shape[-1] > 1:
+            xu = hM.ranLevels[r].x_for(hM.pi_names[r])
+            M2 = xu.T @ xu / xu.shape[0]                   # (ncr, ncr)
+            random1[:, :, r] = np.einsum("nhjk,kl,nhjl->nj", lam, M2, lam)
+        else:
+            lam = lam[..., 0] if lam.ndim == 4 else lam
+            random1[:, :, r] = (lam**2).sum(axis=1)
 
     if nr > 0:
         tot = fixed1 + random1.sum(axis=2)

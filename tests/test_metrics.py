@@ -196,3 +196,26 @@ def test_convert_to_coda_ragged_nf_error(fitted_probit):
     p2.arrays["nfMask_0"] = mask
     with pytest.raises(ValueError, match="number of latent factors"):
         convert_to_coda_object(p2)
+
+
+def test_variance_partitioning_xdim_level():
+    """Covariate-dependent levels: per-species random variance must be the
+    covariate-averaged quadratic lambda' E[xx'] lambda (the reference's own
+    xDim>0 line is shape-invalid R, computeVariancePartitioning.R:159), and
+    shares must still sum to one."""
+    from util import small_model
+    from hmsc_tpu.mcmc.sampler import sample_mcmc
+
+    m = small_model(ny=60, ns=5, nc=2, distr="normal", n_units=12, x_dim=2,
+                    seed=9)
+    post = sample_mcmc(m, samples=30, transient=30, n_chains=2, seed=2,
+                       nf_cap=2)
+    vp = compute_variance_partitioning(post)
+    vals = np.asarray(vp["vals"])
+    assert np.allclose(vals.sum(0), 1, atol=1e-5)
+    # manual recomputation of the level share for one draw
+    lam = post.pooled("Lambda_0")                     # (n, nf, ns, ncr)
+    xu = m.ranLevels[0].x_for(m.pi_names[0])
+    M2 = xu.T @ xu / xu.shape[0]
+    manual = np.einsum("nhjk,kl,nhjl->nj", lam, M2, lam)
+    assert manual.shape == (lam.shape[0], 5) and np.all(manual >= 0)
