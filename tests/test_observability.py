@@ -142,3 +142,34 @@ def test_record_dtype_bf16_quantises_only_storage():
     # elementwise: identical draws quantised to bf16 (rel err <= 2^-8)
     tol = 2.0**-7 * np.maximum(np.abs(a), 1e-3)
     assert np.all(np.abs(a - b) <= tol), np.abs(a - b).max()
+
+
+@pytest.mark.slow
+def test_float64_mode_subprocess():
+    """MIGRATION.md promises f64 verification runs via dtype=jnp.float64 +
+    JAX_ENABLE_X64.  x64 must be enabled before jax initialises, so drive it
+    in a subprocess and require a finite float64 posterior."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    _ROOT = Path(__file__).resolve().parent.parent
+
+    code = (
+        "import sys; sys.path.insert(0, %r); sys.path.insert(0, %r)\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import jax.numpy as jnp, numpy as np\n"
+        "from util import small_model\n"
+        "from hmsc_tpu.mcmc.sampler import sample_mcmc\n"
+        "m = small_model(ny=40, ns=5, nc=2, distr='probit', n_units=8, seed=4)\n"
+        "p = sample_mcmc(m, samples=8, transient=4, n_chains=1, seed=7,\n"
+        "                nf_cap=2, dtype=jnp.float64, align_post=False)\n"
+        "B = p.pooled('Beta')\n"
+        "assert B.dtype == np.float64 and np.isfinite(B).all()\n"
+        "print('F64OK')\n"
+    ) % (str(_ROOT), str(_ROOT / "tests"))
+    env = dict(os.environ, JAX_ENABLE_X64="1", JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert "F64OK" in out.stdout, (out.stdout, out.stderr[-2000:])
