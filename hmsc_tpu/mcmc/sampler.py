@@ -222,6 +222,9 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
       ``rbg`` on TPU backends (the probit Z update is RNG-throughput-bound
       at scale) and ``threefry2x32`` elsewhere.  Reproducibility is bitwise
       per (seed, impl), not across impls.
+    - ``updater={"Interweave": False}`` disables the beyond-reference
+      per-factor (Eta, Lambda) scale interweaving (on by default; targets
+      the identical posterior — see ``updaters.interweave_scale``).
     - ``record_dtype`` (e.g. ``jnp.bfloat16``) quantises recorded draws
       before the device->host fetch, halving posterior transfer bytes; the
       in-sweep state stays f32 (the chain itself is unaffected) and draws
