@@ -195,10 +195,11 @@ def compute_waic(post, ghN: int = 11) -> float:
         L += t.sum(axis=2)
     sel = fam == 2
     if sel.any():
-        # unit-sd probit log-lik, like the reference (computeWAIC.R:97-99)
-        pz1 = log_ndtr(E[:, :, sel])
-        pz0 = log_ndtr(-E[:, :, sel])
-        t = pz1 * Y[None, :, sel] + pz0 * (1 - Y[None, :, sel])
+        # unit-sd probit log-lik, like the reference (computeWAIC.R:97-99);
+        # Y is 0/1 so select between the two tails rather than multiplying
+        # two (n, ny, ns)-sized products
+        Ey = E[:, :, sel]
+        t = np.where(Y[None, :, sel] > 0.5, log_ndtr(Ey), log_ndtr(-Ey))
         t[:, na[:, sel]] = 0.0
         L += t.sum(axis=2)
     sel = fam == 3

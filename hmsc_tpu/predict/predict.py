@@ -172,17 +172,22 @@ def predict(post, x_data=None, X=None, xrrr_data=None, XRRR=None,
                       pi_new, x_row_new)
 
     # ---- observation model: link + response sampling ---------------------
+    # (keep everything in the posterior's f32: the (n, ny, ns) block is
+    # ~1 GB at the 1000-species scale and the f64 upcasts scipy/np.random
+    # default to double both memory traffic and wall-clock)
     if expected:
         Z = L
     else:
-        Z = L + np.sqrt(sigma)[:, None, :] * rng.standard_normal(L.shape)
+        eps = rng.standard_normal(L.shape, dtype=L.dtype) \
+            if np.issubdtype(L.dtype, np.floating) else rng.standard_normal(L.shape)
+        Z = L + np.sqrt(sigma)[:, None, :] * eps
     fam = hM.distr[:, 0][None, None, :]
     out = Z.copy()
     probit = fam == 2
     if probit.any():
         if expected:
-            from scipy.stats import norm
-            out = np.where(probit, norm.cdf(Z), out)
+            from scipy.special import ndtr
+            out = np.where(probit, ndtr(Z).astype(Z.dtype, copy=False), out)
         else:
             out = np.where(probit, (Z > 0).astype(Z.dtype), out)
     pois = fam == 3
