@@ -203,7 +203,8 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 species_axis: str = "species",
                 return_state: bool = False, verbose: int = 0,
                 init_state=None, profile_dir: str | None = None,
-                rng_impl: str | None = None, record_dtype=None):
+                rng_impl: str | None = None, record_dtype=None,
+                retry_diverged: int = 0):
     """Run the blocked Gibbs sampler; returns a :class:`~hmsc_tpu.post.Posterior`.
 
     Arguments mirror the reference's ``sampleMcmc`` (samples/transient/thin/
@@ -222,6 +223,11 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
       ``rbg`` on TPU backends (the probit Z update is RNG-throughput-bound
       at scale) and ``threefry2x32`` elsewhere.  Reproducibility is bitwise
       per (seed, impl), not across impls.
+    - ``retry_diverged=N`` re-runs any chain whose carry went non-finite
+      (fresh initial state and key stream, same config, burn-in covering the
+      original chain's progress, up to N attempts) and splices the
+      replacement into the returned posterior; the default 0 keeps the
+      exclude-and-warn containment only.
     - ``updater={"Interweave": False}`` disables the beyond-reference
       per-factor (Eta, Lambda) scale interweaving (on by default; targets
       the identical posterior — see ``updaters.interweave_scale``).
@@ -396,6 +402,41 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
             f"{int(first_bad[c])} (of {total_it}); its draws are excluded "
             f"from pooled summaries (see Posterior.chain_health)",
             RuntimeWarning, stacklevel=2)
+
+    # opt-in restart: re-run just the poisoned chains with a fresh key
+    # stream and splice the replacements in (chains are independent, so the
+    # spliced posterior targets the same distribution)
+    if retry_diverged > 0 and (first_bad >= 0).any():
+        bad = np.nonzero(first_bad >= 0)[0]
+        # always re-initialise from scratch: a poisoned carry state (the
+        # init_state case) would diverge again immediately
+        sub = sample_mcmc(hM, samples=samples,
+                          transient=max(int(transient), it0), thin=thin,
+                          n_chains=len(bad), seed=int(rng.integers(2**31 - 1)),
+                          init_par=init_par, adapt_nf=adapt_nf,
+                          updater=updater, nf_cap=nf_cap, dtype=dtype,
+                          data_par=data_par, align_post=False, verbose=verbose,
+                          rng_impl=rng_impl, record_dtype=record_dtype,
+                          retry_diverged=retry_diverged - 1,
+                          return_state=return_state)
+        if return_state:
+            sub, sub_state = sub
+
+            def _splice(a, b):
+                a = np.asarray(a).copy()
+                a[bad] = np.asarray(b)
+                return jnp.asarray(a)
+            final_state = jax.tree.map(_splice, final_state, sub_state)
+        for k in post.arrays:
+            a = post.arrays[k]
+            if not a.flags.writeable:        # np.asarray views of jax buffers
+                a = a.copy()
+            a[bad] = sub.arrays[k]
+            post.arrays[k] = a
+        first_bad = first_bad.copy()
+        first_bad[bad] = sub.chain_health["first_bad_it"]
+        post.set_chain_health(first_bad)
+
     if align_post and spec.nr > 0:
         from ..post.align import align_posterior
         for _ in range(5):
