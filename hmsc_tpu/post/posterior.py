@@ -59,17 +59,21 @@ class Posterior:
     def __getitem__(self, name: str) -> np.ndarray:
         return self.arrays[name]
 
-    def subset(self, start: int = 0, thin: int = 1) -> "Posterior":
+    def subset(self, start: int = 0, thin: int = 1,
+               chain_index=None) -> "Posterior":
         """New Posterior keeping every ``thin``-th recorded sample from
-        ``start`` on, per chain (the reference's poolMcmcChains start/thin
-        window, ``poolMcmcChains.R:19-27``)."""
-        if start == 0 and thin == 1:
+        ``start`` on, per chain, optionally restricted to ``chain_index``
+        (the reference's poolMcmcChains/getPostEstimate start/thin/chainIndex
+        window, ``poolMcmcChains.R:19-27``, ``getPostEstimate.R:30``)."""
+        if start == 0 and thin == 1 and chain_index is None:
             return self
-        arrays = {k: v[:, start::thin] for k, v in self.arrays.items()}
+        ci = (np.arange(self.n_chains) if chain_index is None
+              else np.atleast_1d(np.asarray(chain_index, dtype=int)))
+        arrays = {k: v[ci][:, start::thin] for k, v in self.arrays.items()}
         sub = Posterior(self.hM, self.spec, arrays,
                         samples=arrays["Beta"].shape[1],
                         transient=self.transient, thin=self.thin * thin)
-        sub.set_chain_health(self.chain_health["first_bad_it"])
+        sub.set_chain_health(self.chain_health["first_bad_it"][ci])
         return sub
 
     def pooled(self, name: str) -> np.ndarray:
@@ -128,14 +132,18 @@ class Posterior:
         return a
 
     # ------------------------------------------------------------------
-    def get_post_estimate(self, par: str, r: int = 0, q=(), x=None):
+    def get_post_estimate(self, par: str, r: int = 0, q=(), x=None,
+                          chain_index=None, start: int = 0, thin: int = 1):
         """Posterior mean / support / quantiles for a parameter
         (reference ``R/getPostEstimate.R:32-79``).  Derived parameters
         ``Omega`` (= Lambda' Lambda per level) and ``OmegaCor`` supported; for
         covariate-dependent levels (xDim > 0) ``x`` weights the Lambda slices
         before the crossproduct — the association matrix *at* covariate value
-        x (reference ``:47-57``; default x = (1, 0, ...), the intercept)."""
-        a = self._param_array(par, r, x=x)
+        x (reference ``:47-57``; default x = (1, 0, ...), the intercept).
+        ``chain_index``/``start``/``thin`` window the pooled draws like the
+        reference's arguments of the same names."""
+        p = self.subset(start, thin, chain_index)
+        a = p._param_array(par, r, x=x)
         out = {
             "mean": a.mean(axis=0),
             "support": (a > 0).mean(axis=0),
