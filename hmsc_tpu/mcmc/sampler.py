@@ -87,7 +87,10 @@ def _unpack_records(packed, leaves, shapes, treedef, f32):
     for i in range(len(out)):
         if not isinstance(out[i], np.ndarray):
             out[i] = np.asarray(out[i])
-        if out[i].dtype == jnp.bfloat16:      # single-leaf record_dtype path
+        # single-leaf record_dtype path: widen any narrow float (bf16, f16)
+        # back to f32; leave f64-mode records untouched
+        dt = out[i].dtype
+        if jnp.issubdtype(dt, jnp.floating) and dt.itemsize < 4:
             out[i] = out[i].astype(np.float32)
     return jax.tree.unflatten(treedef, out)
 
@@ -141,9 +144,26 @@ def _shard_species(tree, mesh, spec, sp_axis, lead=None):
     return jax.tree_util.tree_map_with_path(put, tree)
 
 
+# names accepted by sample_mcmc(record=...); per-level variants ("Eta_0")
+# are also accepted
+_RECORDABLE = {"Beta", "Gamma", "V", "sigma", "rho", "Eta", "Lambda", "Psi",
+               "Delta", "Alpha", "wRRR", "PsiRRR", "DeltaRRR"}
+
+
+def _keep_record(name: str, record) -> bool:
+    """Whether a recorded-sample key survives the ``record=`` selection.
+    Beta and the per-level nfMask bookkeeping are always kept (posterior
+    windowing and ragged-nf trimming need them)."""
+    if record is None or name == "Beta" or name.startswith("nfMask"):
+        return True
+    head, _, tail = name.rpartition("_")
+    base = head if tail.isdigit() else name
+    return name in record or base in record
+
+
 @functools.lru_cache(maxsize=64)
 def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin,
-                     skip_init_z):
+                     skip_init_z, record=None):
     """One jitted chain-vmapped sampling program per static config.
 
     Keyed on the hashable (spec, updater toggles, scan lengths) so repeated
@@ -186,6 +206,9 @@ def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin,
         def sample_step(carry, _):
             carry, _ = jax.lax.scan(one_iter, carry, None, length=thin)
             rec = record_sample(spec, data, carry[0])
+            if record is not None:
+                rec = {k: v for k, v in rec.items()
+                       if _keep_record(k, record)}
             return carry, rec
 
         carry, recs = jax.lax.scan(sample_step, carry, None, length=samples)
@@ -204,7 +227,7 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 return_state: bool = False, verbose: int = 0,
                 init_state=None, profile_dir: str | None = None,
                 rng_impl: str | None = None, record_dtype=None,
-                retry_diverged: int = 0):
+                retry_diverged: int = 0, record=None):
     """Run the blocked Gibbs sampler; returns a :class:`~hmsc_tpu.post.Posterior`.
 
     Arguments mirror the reference's ``sampleMcmc`` (samples/transient/thin/
@@ -222,7 +245,9 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
     - ``rng_impl`` picks the PRNG bit generator; default is the hardware
       ``rbg`` on TPU backends (the probit Z update is RNG-throughput-bound
       at scale) and ``threefry2x32`` elsewhere.  Reproducibility is bitwise
-      per (seed, impl), not across impls.
+      per (seed, impl, package version) — not across impls, and not across
+      releases (the sweep's internal key-splitting layout may change when
+      updaters are added, which re-derives every subkey).
     - ``retry_diverged=N`` re-runs any chain whose carry went non-finite
       (fresh initial state and key stream, same config, burn-in covering the
       original chain's progress, up to N attempts) and splices the
@@ -231,12 +256,25 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
     - ``updater={"Interweave": False}`` disables the beyond-reference
       per-factor (Eta, Lambda) scale interweaving (on by default; targets
       the identical posterior — see ``updaters.interweave_scale``).
+    - ``nf_cap`` bounds the per-level latent factor count (static XLA
+      shapes; the reference instead grows nf up to ns).  Pick it a little
+      above the factor count you expect; if burn-in adaptation saturates the
+      cap the run warns and records the blocked-attempt counts in
+      ``Posterior.nf_saturation`` — raise ``nf_cap`` and refit then.
     - ``record_dtype`` (e.g. ``jnp.bfloat16``) quantises recorded draws
       before the device->host fetch, halving posterior transfer bytes; the
       in-sweep state stays f32 (the chain itself is unaffected) and draws
       are widened back to f32 on the host.  bf16 keeps f32 range with ~3
       significant digits — well below Monte-Carlo error for summary use, but
       the default (``None``) records exact f32 draws.
+    - ``record=("Beta", "Lambda", ...)`` restricts which parameters are
+      recorded (default: everything, like the reference).  On a
+      remote-attached device the posterior transfer is the dominant
+      end-to-end cost at scale, and e.g. Eta at np=1000+ units is the
+      largest block while CV / WAIC / variance partitioning never read it.
+      Accepts base names (applied across levels) or per-level names
+      (``"Eta_0"``); Beta and the nfMask bookkeeping are always kept.
+      Un-recorded parameters raise a clear KeyError downstream.
     """
     import time
 
@@ -244,6 +282,7 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
 
     t0 = time.perf_counter()
 
+    adapt_nf_arg = adapt_nf          # pre-resolution value, for retry_diverged
     if adapt_nf is None:
         adapt_nf = tuple(transient for _ in range(hM.nr))
     else:
@@ -252,6 +291,28 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         raise ValueError("transient parameter should be no less than any element of adaptNf parameter")
 
     spec = build_spec(hM, nf_cap)
+    if record is not None:
+        if isinstance(record, str):
+            record = (record,)
+        level_pars = {"Eta", "Lambda", "Psi", "Delta", "Alpha"}
+        bad = []
+        for k in record:
+            head, _, tail = k.rpartition("_")
+            if tail.isdigit():
+                # suffixed names: only per-level parameters carry a level
+                # index, and it must name an existing level — anything else
+                # would pass validation yet silently record nothing
+                if head not in level_pars or int(tail) >= spec.nr:
+                    bad.append(k)
+            elif k not in _RECORDABLE:
+                bad.append(k)
+        if bad:
+            raise ValueError(
+                f"record: unknown parameter name(s) {bad}; valid names are "
+                f"{sorted(_RECORDABLE)} (per-level parameters "
+                f"{sorted(level_pars)} also accept a _<level> suffix "
+                f"below nr={spec.nr})")
+        record = tuple(sorted(set(record)))
     if data_par is None:
         data_par = compute_data_parameters(hM)
     data = build_model_data(hM, data_par, spec, dtype=dtype)
@@ -364,7 +425,7 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
             keys = jax.device_put(keys, sharding)
         for si, seg in enumerate(seg_sizes):
             fn = _compiled_runner(spec, updater_items, adapt_nf, seg,
-                                  trans_cur, int(thin), skip_z)
+                                  trans_cur, int(thin), skip_z, record)
             recs, state_cur, bad_cur, keys = fn(data, state_cur, keys, bad_cur)
             # pack now (async on device); fetch below.  Drop the original
             # record tree immediately — keeping it alive through the fetch
@@ -403,22 +464,38 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
             f"from pooled summaries (see Posterior.chain_health)",
             RuntimeWarning, stacklevel=2)
 
+    # factor-cap saturation counts per chain (warned about below, after a
+    # possible retry_diverged splice replaces chains and their counts)
+    nf_sat_counts = {r: np.asarray(final_state.levels[r].nf_sat).reshape(-1)
+                     for r in range(spec.nr)}
+
     # opt-in restart: re-run just the poisoned chains with a fresh key
     # stream and splice the replacements in (chains are independent, so the
     # spliced posterior targets the same distribution)
     if retry_diverged > 0 and (first_bad >= 0).any():
         bad = np.nonzero(first_bad >= 0)[0]
         # always re-initialise from scratch: a poisoned carry state (the
-        # init_state case) would diverge again immediately
+        # init_state case) would diverge again immediately.  Burn-in covers
+        # the original chain's total progress (it0 + transient), adapt_nf is
+        # re-derived from the caller's argument against that burn-in (a
+        # resumed run's resolved (0,...) must not skip adaptation in a
+        # from-scratch restart), and the mesh is forwarded when the retry
+        # chain count still lays out evenly over its chain axis (so an
+        # HBM-bound species-sharded model can fit during the retry too)
+        sub_mesh = mesh
+        if mesh is not None and len(bad) % int(mesh.shape[chain_axis]) != 0:
+            sub_mesh = None
         sub = sample_mcmc(hM, samples=samples,
-                          transient=max(int(transient), it0), thin=thin,
+                          transient=int(transient) + it0, thin=thin,
                           n_chains=len(bad), seed=int(rng.integers(2**31 - 1)),
-                          init_par=init_par, adapt_nf=adapt_nf,
+                          init_par=init_par, adapt_nf=adapt_nf_arg,
                           updater=updater, nf_cap=nf_cap, dtype=dtype,
                           data_par=data_par, align_post=False, verbose=verbose,
+                          mesh=sub_mesh, chain_axis=chain_axis,
+                          species_axis=species_axis,
                           rng_impl=rng_impl, record_dtype=record_dtype,
                           retry_diverged=retry_diverged - 1,
-                          return_state=return_state)
+                          record=record, return_state=return_state)
         if return_state:
             sub, sub_state = sub
 
@@ -436,6 +513,26 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         first_bad = first_bad.copy()
         first_bad[bad] = sub.chain_health["first_bad_it"]
         post.set_chain_health(first_bad)
+        for r in range(spec.nr):          # replacement chains' counts
+            nf_sat_counts[r] = nf_sat_counts[r].copy()
+            nf_sat_counts[r][bad] = sub.nf_saturation[r]
+
+    # factor-cap observability: warn when burn-in adaptation wanted to add
+    # factors past the static nf_max cap — the residual associations may be
+    # rank-starved and the user should consider a larger nf_cap (the
+    # reference grows unbounded to nfMax=ns, updateNf.R:26)
+    post.nf_saturation = nf_sat_counts
+    for r in range(spec.nr):
+        cnt = nf_sat_counts[r]
+        if (cnt > 0).any():
+            import warnings
+            warnings.warn(
+                f"random level '{spec.levels[r].name}': factor adaptation "
+                f"hit the nf_max cap ({spec.levels[r].nf_max}) and wanted to "
+                f"add more factors ({cnt.tolist()} blocked attempts per "
+                "chain); residual associations may be rank-starved — raise "
+                "nf_cap in sample_mcmc (or the level's nf_max prior) and "
+                "refit", RuntimeWarning, stacklevel=2)
 
     if align_post and spec.nr > 0:
         from ..post.align import align_posterior

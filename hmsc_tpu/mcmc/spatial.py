@@ -205,9 +205,8 @@ def _eta_gpp(spec, data, state, r, key, S):
 
 def eta_quad_grid(lvd, ls, eta):
     """(v, ld): per-factor prior quadratics eta_h' iW_g eta_h, both (nf, G),
-    over the whole alpha grid.  Single source of the Full/NNGP/GPP prior
-    algebra — consumed by update_alpha (full grid) and by the interweaving
-    scale move (gathered at each factor's current alpha)."""
+    over the whole alpha grid.  Consumed by update_alpha; the interweaving
+    scale move uses the single-point :func:`eta_quad_at` instead."""
     if ls.spatial == "Full":
         v = jnp.einsum("hu,guv,hv->hg", eta.T, lvd.iWg, eta.T)
         ld = lvd.detWg[None, :]
@@ -225,6 +224,33 @@ def eta_quad_grid(lvd, ls, eta):
         v = jnp.where(lvd.alphapw[None, :, 0] == 0, q_full[:, None], t1 - t2)
         ld = lvd.detDg[None, :]
     return v, ld
+
+
+def eta_quad_at(lvd, ls, eta, alpha_idx):
+    """(nf,) prior quadratic eta_h' iW(alpha_h) eta_h at each factor's
+    *current* alpha only — same algebra as :func:`eta_quad_grid` with the
+    grid axis gathered away up front (the interweaving move needs one point
+    per factor; evaluating the whole 101-point grid for it roughly doubled
+    the update_alpha-scale prior cost per sweep)."""
+    if ls.spatial == "Full":
+        iW = lvd.iWg[alpha_idx]                               # (nf, np, np)
+        return jnp.einsum("hu,huv,hv->h", eta.T, iW, eta.T)
+    if ls.spatial == "NNGP":
+        coef = lvd.nn_coef[alpha_idx]                         # (nf, np, k)
+        D = lvd.nn_D[alpha_idx]                               # (nf, np)
+        eta_nn = eta[lvd.nn_idx]                              # (np, k, nf)
+        pred = jnp.einsum("hik,ikh->hi", coef, eta_nn)        # (nf, np)
+        res = eta.T - pred
+        return (res**2 / D).sum(axis=1)
+    # GPP
+    idD = lvd.idDg[alpha_idx]                                 # (nf, np)
+    W12 = lvd.idDW12g[alpha_idx]                              # (nf, np, nK)
+    iF = lvd.iFg[alpha_idx]                                   # (nf, nK, nK)
+    t1 = jnp.einsum("hu,uh->h", idD, eta**2)
+    Et = jnp.einsum("uh,hum->hm", eta, W12)                   # (nf, nK)
+    t2 = jnp.einsum("hm,hmn,hn->h", Et, iF, Et)
+    q_full = jnp.einsum("uh,uh->h", eta, eta)
+    return jnp.where(lvd.alphapw[alpha_idx, 0] == 0, q_full, t1 - t2)
 
 
 def update_alpha(spec: ModelSpec, data: ModelData, state: GibbsState, r: int,

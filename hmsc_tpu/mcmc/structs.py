@@ -46,6 +46,10 @@ class LevelSpec:
     n_alpha: int                  # alpha-grid size (0 if non-spatial)
     n_neighbours: int = 0
     n_knots: int = 0
+    # True when nf_max was cut below the user's prior bound min(rL.nf_max,
+    # ns) by the static nf_cap — only then is blocked factor growth a cap
+    # artifact worth warning about (a deliberate nf_min=nf_max freeze is not)
+    nf_capped: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -152,6 +156,10 @@ class LevelState(struct.PyTreeNode):
     Delta: Any                   # (nf_max, ncr); 1.0 on inactive slots
     alpha_idx: Any               # (nf_max,) int32
     nf_mask: Any                 # (nf_max,) 1.0 active
+    # () int32: adaptation events that wanted to ADD a factor but were
+    # blocked by the static nf_max cap (factor-cap observability; the
+    # reference grows unbounded to nfMax=ns, updateNf.R:26)
+    nf_sat: Any = 0
 
 
 class GibbsState(struct.PyTreeNode):
@@ -182,6 +190,7 @@ def build_spec(hM: Hmsc, nf_cap: int = DEFAULT_NF_CAP) -> ModelSpec:
         level_specs.append(LevelSpec(
             name=hM.rl_names[r], n_units=int(hM.np_[r]), nf_max=nf_max,
             nf_min=nf_min, ncr=max(rL.x_dim, 1), x_dim=rL.x_dim,
+            nf_capped=nf_max < min(rL.nf_max, hM.ns),
             spatial=spatial,
             n_alpha=0 if spatial is None else rL.alphapw.shape[0],
             n_neighbours=int(rL.n_neighbours or 10) if spatial == "NNGP" else 0,
@@ -308,7 +317,8 @@ def build_state(hM: Hmsc, spec: ModelSpec, seed: int,
         LevelState(Eta=f(lv["Eta"]), Lambda=f(lv["Lambda"]), Psi=f(lv["Psi"]),
                    Delta=f(lv["Delta"]),
                    alpha_idx=jnp.asarray(lv["alpha_idx"], dtype=jnp.int32),
-                   nf_mask=f(lv["nf_mask"]))
+                   nf_mask=f(lv["nf_mask"]),
+                   nf_sat=jnp.asarray(0, dtype=jnp.int32))
         for lv in p["levels"])
 
     # linear predictor as the Z starting point (RRR columns appended from the

@@ -127,8 +127,10 @@ def make_sweep(spec: ModelSpec, updater: dict | None = None,
                     state = state.replace(levels=tuple(levels))
 
         # beyond-reference: per-factor (Eta, Lambda) scale interweaving.
-        # Leaves the Eta*Lambda loading invariant, so E_shared stays valid
-        if spec.nr > 0 and on("Interweave"):
+        # Leaves the Eta*Lambda loading invariant, so E_shared stays valid.
+        # Gated on the updaters it perturbs: a frozen Eta/BetaLambda run
+        # (debugging, conditional sampling) must not see drifting Eta/Lambda
+        if spec.nr > 0 and on("Interweave") and on("Eta") and on("BetaLambda"):
             state = U.interweave_scale(spec, data, state, ks[12])
 
         if on("InvSigma"):

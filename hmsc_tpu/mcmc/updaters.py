@@ -482,9 +482,8 @@ def _eta_prior_quad(lvd, lv, ls) -> jnp.ndarray:
     updateAlpha, gathered at alpha_idx)."""
     if ls.spatial is None:
         return (lv.Eta ** 2).sum(axis=0)
-    from .spatial import eta_quad_grid
-    v, _ = eta_quad_grid(lvd, ls, lv.Eta)                # (nf, G)
-    return jnp.take_along_axis(v, lv.alpha_idx[:, None], axis=1)[:, 0]
+    from .spatial import eta_quad_at
+    return eta_quad_at(lvd, ls, lv.Eta, lv.alpha_idx)
 
 
 def interweave_scale(spec: ModelSpec, data: ModelData, state: GibbsState,
@@ -564,9 +563,20 @@ def update_nf(spec: ModelSpec, data: ModelData, state: GibbsState, r: int,
     redundant = (mask > 0) & (small_prop >= 1.0)
     num_red = redundant.sum()
 
-    add_ok = (nf < ls.nf_max) & (it > 20) & (num_red == 0) \
+    grow_wanted = (it > 20) & (num_red == 0) \
         & jnp.all(jnp.where(mask > 0, small_prop < 0.995, True))
+    add_ok = (nf < ls.nf_max) & grow_wanted
     drop_ok = (num_red > 0) & (nf > ls.nf_min)
+    # factor-cap observability: count adaptation events where growth was
+    # wanted but the static nf_cap blocked it (the sampler warns post-run
+    # when nonzero).  Only when the cap — not the user's own
+    # min(rL.nf_max, ns) bound, which the reference also honours
+    # (updateNf.R:26) — is the binding constraint.
+    if ls.nf_capped:
+        nf_sat = lv.nf_sat + (adapt & grow_wanted
+                              & (nf >= ls.nf_max)).astype(jnp.int32)
+    else:
+        nf_sat = lv.nf_sat
 
     # --- append one factor in slot `nf` -----------------------------------
     slot = jnp.minimum(nf.astype(jnp.int32), ls.nf_max - 1)
@@ -608,4 +618,5 @@ def update_nf(spec: ModelSpec, data: ModelData, state: GibbsState, r: int,
         Delta=jnp.where(do_drop, Delta_d, Delta),
         alpha_idx=jnp.where(do_drop, alpha_d, alpha_idx),
         nf_mask=jnp.where(do_drop, mask_drop, mask_add),
+        nf_sat=nf_sat,
     )

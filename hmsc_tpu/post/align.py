@@ -21,8 +21,9 @@ __all__ = ["align_posterior"]
 def align_posterior(post) -> None:
     gmask = post.good_chain_mask()
     for r in range(post.spec.nr):
+        if f"Lambda_{r}" not in post.arrays:      # record=-restricted run
+            continue
         lam = post.arrays[f"Lambda_{r}"]          # (c, s, nf, ns[, ncr])
-        eta = post.arrays[f"Eta_{r}"]             # (c, s, np, nf)
         lam2 = lam[..., 0] if lam.ndim == 5 else lam
         mean_lam = lam2[gmask].mean(axis=(0, 1))  # (nf, ns)
         # per-sample correlation sign against the cross-chain mean
@@ -33,9 +34,10 @@ def align_posterior(post) -> None:
             lam = lam * sign[..., None, None]
         else:
             lam = lam * sign[..., None]
-        eta = eta * sign[:, :, None, :]
         post.arrays[f"Lambda_{r}"] = lam
-        post.arrays[f"Eta_{r}"] = eta
+        if f"Eta_{r}" in post.arrays:
+            post.arrays[f"Eta_{r}"] = (post.arrays[f"Eta_{r}"]
+                                       * sign[:, :, None, :])
 
     spec = post.spec
     if spec.nc_rrr > 0 and "wRRR" in post.arrays:
@@ -51,10 +53,12 @@ def align_posterior(post) -> None:
         B = np.array(post.arrays["Beta"])
         B[:, :, ncn:, :] = B[:, :, ncn:, :] * sign[..., None]
         post.arrays["Beta"] = B
-        G = np.array(post.arrays["Gamma"])
-        G[:, :, ncn:, :] = G[:, :, ncn:, :] * sign[..., None]
-        post.arrays["Gamma"] = G
-        V = np.array(post.arrays["V"])
-        V[:, :, ncn:, :] = V[:, :, ncn:, :] * sign[..., None]
-        V[:, :, :, ncn:] = V[:, :, :, ncn:] * sign[:, :, None, :]
-        post.arrays["V"] = V
+        if "Gamma" in post.arrays:
+            G = np.array(post.arrays["Gamma"])
+            G[:, :, ncn:, :] = G[:, :, ncn:, :] * sign[..., None]
+            post.arrays["Gamma"] = G
+        if "V" in post.arrays:
+            V = np.array(post.arrays["V"])
+            V[:, :, ncn:, :] = V[:, :, ncn:, :] * sign[..., None]
+            V[:, :, :, ncn:] = V[:, :, :, ncn:] * sign[:, :, None, :]
+            post.arrays["V"] = V

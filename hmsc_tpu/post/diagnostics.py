@@ -42,23 +42,23 @@ def effective_size(x: np.ndarray) -> np.ndarray:
     # combine chains (rank-normalised would be arviz-style; plain mean here)
     var_w = acov[:, 0].mean(axis=0)
     rho = acov.mean(axis=0) / np.where(var_w == 0, 1.0, var_w)
-    # Geyer: sum consecutive pairs while positive & monotone
+    # Geyer: sum consecutive pairs while positive & monotone — vectorised
+    # over entries (a full Beta/Omega ESS pass on a 1000-species model has
+    # ~10^6 entries; the interpreted per-entry loop took hours there)
     trail = rho.shape[1:]
-    rho2 = rho.reshape(n, -1)
-    ess = np.empty(rho2.shape[1])
-    for j in range(rho2.shape[1]):
-        t = 1
-        s = 0.0
-        prev = np.inf
-        while t + 1 < n:
-            pair = rho2[t, j] + rho2[t + 1, j]
-            if pair < 0:
-                break
-            pair = min(pair, prev)
-            s += pair
-            prev = pair
-            t += 2
-        ess[j] = m * n / (1.0 + 2.0 * s)
+    rho2 = rho.reshape(n, -1)                    # (n, K)
+    T = (n - 1) // 2                             # lag pairs (1,2),(3,4),...
+    if T == 0:
+        s = np.zeros(rho2.shape[1])
+    else:
+        P = rho2[1:2 * T + 1].reshape(T, 2, -1).sum(axis=1)   # (T, K)
+        neg = P < 0
+        first_neg = np.where(neg.any(axis=0), neg.argmax(axis=0), T)
+        valid = np.arange(T)[:, None] < first_neg[None, :]
+        # adjusted[t] = min(raw[0..t]): the monotone (non-increasing) pass
+        Pm = np.minimum.accumulate(P, axis=0)
+        s = np.where(valid, Pm, 0.0).sum(axis=0)
+    ess = m * n / (1.0 + 2.0 * s)
     return ess.reshape(trail) if trail else float(ess[0])
 
 
@@ -146,6 +146,18 @@ def convert_to_coda_object(post, start: int = 1,
         elif par == "rho":                         # scalar grid value
             flat = a.reshape(a.shape[:2] + (-1,))
             labels = ["Rho"]
+        elif par in ("wRRR", "PsiRRR"):
+            # (c, s, nc_rrr, nc_orrr): component varying fastest, like Beta's
+            # column-major vec; original-covariate names when known
+            flat = a.transpose(0, 1, 3, 2).reshape(a.shape[:2] + (-1,))
+            comp = [f"XRRR_{k + 1}" for k in range(a.shape[2])]
+            onames = getattr(hM, "xrrr_names", None) \
+                or [f"XRRRcov_{j + 1}" for j in range(a.shape[3])]
+            ocov = _decorate(onames, "C", cov_names_numbers)
+            labels = [f"{par}[{c}, {o}]" for o in ocov for c in comp]
+        elif par == "DeltaRRR":
+            flat = a.reshape(a.shape[:2] + (-1,))
+            labels = [f"DeltaRRR[XRRR_{k + 1}]" for k in range(flat.shape[2])]
         else:                                      # generic numbered fallback
             flat = a.reshape(a.shape[:2] + (-1,))
             labels = [f"{par}[{i + 1}]" for i in range(flat.shape[2])]
@@ -161,41 +173,48 @@ def convert_to_coda_object(post, start: int = 1,
         nf_max = mask.shape[2]
         facs = [f"factor{h + 1}" for h in range(nf_max)]
 
-        eta = post.arrays[f"Eta_{r}"][:, sel] * mask[:, :, None, :]
-        out[f"Eta_{r}"] = (
-            eta.transpose(0, 1, 3, 2).reshape(eta.shape[:2] + (-1,)),
-            [f"Eta{r + 1}[{u}, {f}]" for f in facs for u in units])
+        # record=-restricted runs may lack some level parameters; export
+        # whichever were recorded
+        if f"Eta_{r}" in post.arrays:
+            eta = post.arrays[f"Eta_{r}"][:, sel] * mask[:, :, None, :]
+            out[f"Eta_{r}"] = (
+                eta.transpose(0, 1, 3, 2).reshape(eta.shape[:2] + (-1,)),
+                [f"Eta{r + 1}[{u}, {f}]" for f in facs for u in units])
 
-        lam = post.arrays[f"Lambda_{r}"][:, sel]
-        lam = lam[..., 0] if lam.ndim == 5 else lam
-        out[f"Lambda_{r}"] = (
-            lam.reshape(lam.shape[:2] + (-1,)),
-            [f"Lambda{r + 1}[{s}, {f}]" for f in facs for s in sp])
+        if f"Lambda_{r}" in post.arrays:
+            lam = post.arrays[f"Lambda_{r}"][:, sel]
+            lam = lam[..., 0] if lam.ndim == 5 else lam
+            out[f"Lambda_{r}"] = (
+                lam.reshape(lam.shape[:2] + (-1,)),
+                [f"Lambda{r + 1}[{s}, {f}]" for f in facs for s in sp])
 
-        om = np.einsum("csfj,csfk->csjk", lam, lam)
-        out[f"Omega_{r}"] = (
-            om.reshape(om.shape[:2] + (-1,)),
-            [f"Omega{r + 1}[{a_}, {b}]" for b in sp for a_ in sp])
+            om = np.einsum("csfj,csfk->csjk", lam, lam)
+            out[f"Omega_{r}"] = (
+                om.reshape(om.shape[:2] + (-1,)),
+                [f"Omega{r + 1}[{a_}, {b}]" for b in sp for a_ in sp])
 
-        psi = post.arrays[f"Psi_{r}"][:, sel]
-        psi = psi[..., 0] if psi.ndim == 5 else psi
-        psi = psi * mask[:, :, :, None]
-        out[f"Psi_{r}"] = (
-            psi.reshape(psi.shape[:2] + (-1,)),
-            [f"Psi{r + 1}[{s}, {f}]" for f in facs for s in sp])
+        if f"Psi_{r}" in post.arrays:
+            psi = post.arrays[f"Psi_{r}"][:, sel]
+            psi = psi[..., 0] if psi.ndim == 5 else psi
+            psi = psi * mask[:, :, :, None]
+            out[f"Psi_{r}"] = (
+                psi.reshape(psi.shape[:2] + (-1,)),
+                [f"Psi{r + 1}[{s}, {f}]" for f in facs for s in sp])
 
-        delta = post.arrays[f"Delta_{r}"][:, sel]
-        delta = delta[..., 0] if delta.ndim == 4 else delta
-        out[f"Delta_{r}"] = (
-            delta * mask,
-            [f"Delta{r + 1}[{f}]" for f in facs])
+        if f"Delta_{r}" in post.arrays:
+            delta = post.arrays[f"Delta_{r}"][:, sel]
+            delta = delta[..., 0] if delta.ndim == 4 else delta
+            out[f"Delta_{r}"] = (
+                delta * mask,
+                [f"Delta{r + 1}[{f}]" for f in facs])
 
-        alpha = post.arrays[f"Alpha_{r}"][:, sel]
-        if spec.levels[r].spatial is not None:
-            vals = np.asarray(hM.ranLevels[r].alphapw)[:, 0]
-            alpha = vals[alpha] * mask
-        else:
-            alpha = alpha * mask
-        out[f"Alpha_{r}"] = (
-            alpha, [f"Alpha{r + 1}[{f}]" for f in facs])
+        if f"Alpha_{r}" in post.arrays:
+            alpha = post.arrays[f"Alpha_{r}"][:, sel]
+            if spec.levels[r].spatial is not None:
+                vals = np.asarray(hM.ranLevels[r].alphapw)[:, 0]
+                alpha = vals[alpha] * mask
+            else:
+                alpha = alpha * mask
+            out[f"Alpha_{r}"] = (
+                alpha, [f"Alpha{r + 1}[{f}]" for f in facs])
     return out

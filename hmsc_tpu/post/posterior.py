@@ -57,6 +57,10 @@ class Posterior:
 
     # ------------------------------------------------------------------
     def __getitem__(self, name: str) -> np.ndarray:
+        if name not in self.arrays:
+            raise KeyError(
+                f"{name!r} was not recorded in this run — re-sample without "
+                "the sample_mcmc(record=...) restriction, or include it")
         return self.arrays[name]
 
     def subset(self, start: int = 0, thin: int = 1,
@@ -80,6 +84,10 @@ class Posterior:
         """(chains*samples, ...) flattened view (poolMcmcChains); chains whose
         carry went non-finite (``chain_health``) are excluded so one diverged
         chain cannot silently poison every pooled summary."""
+        if name not in self.arrays:
+            raise KeyError(
+                f"{name!r} was not recorded in this run — re-sample without "
+                "the sample_mcmc(record=...) restriction, or include it")
         a = self.arrays[name]
         good = self.good_chain_mask()
         if not good.all():
@@ -92,23 +100,28 @@ class Posterior:
         (reference combineParameters.R:57)."""
         out = []
         nr = self.spec.nr
+        # record=-restricted posteriors carry None for un-recorded entries,
+        # like the reference's absent-extras (wRRR) slots
+        get = lambda k, c, s: (self.arrays[k][c, s]
+                               if k in self.arrays else None)
         for c in range(self.n_chains):
             chain = []
             for s in range(self.arrays["Beta"].shape[1]):
                 d = {
                     "Beta": self.arrays["Beta"][c, s],
-                    "wRRR": self.arrays["wRRR"][c, s] if "wRRR" in self.arrays else None,
-                    "Gamma": self.arrays["Gamma"][c, s],
-                    "V": self.arrays["V"][c, s],
-                    "rho": float(self.arrays["rho"][c, s]),
-                    "sigma": self.arrays["sigma"][c, s],
+                    "wRRR": get("wRRR", c, s),
+                    "Gamma": get("Gamma", c, s),
+                    "V": get("V", c, s),
+                    "rho": (float(self.arrays["rho"][c, s])
+                            if "rho" in self.arrays else None),
+                    "sigma": get("sigma", c, s),
                     "Eta": [self._trim(c, s, r, "Eta") for r in range(nr)],
                     "Lambda": [self._trim(c, s, r, "Lambda") for r in range(nr)],
                     "Alpha": [self._trim(c, s, r, "Alpha") for r in range(nr)],
                     "Psi": [self._trim(c, s, r, "Psi") for r in range(nr)],
                     "Delta": [self._trim(c, s, r, "Delta") for r in range(nr)],
-                    "PsiRRR": self.arrays["PsiRRR"][c, s] if "PsiRRR" in self.arrays else None,
-                    "DeltaRRR": self.arrays["DeltaRRR"][c, s] if "DeltaRRR" in self.arrays else None,
+                    "PsiRRR": get("PsiRRR", c, s),
+                    "DeltaRRR": get("DeltaRRR", c, s),
                 }
                 chain.append(d)
             out.append(chain)
@@ -116,7 +129,9 @@ class Posterior:
 
     def _trim(self, c, s, r, what):
         """Cut a factor-padded array down to its active factors (the
-        reference's ragged nf shapes)."""
+        reference's ragged nf shapes).  None when not recorded."""
+        if f"{what}_{r}" not in self.arrays:
+            return None
         mask = self.arrays[f"nfMask_{r}"][c, s] > 0
         a = self.arrays[f"{what}_{r}"][c, s]
         if what == "Eta":

@@ -14,14 +14,23 @@ Deviations from the reference (latent bugs there):
 - conditional prediction on *spatial* levels: the reference passes
   ``rLPar=object$rLPar`` which is never populated (``predict.R:185``), so its
   spatial conditional updates crash.  Here the conditional Eta refresh uses
-  the level's *actual* GP prior: the exponential-kernel precision over the
-  prediction units, built per posterior draw from the recorded alpha of each
-  factor (exact for ``Full``; also used for NNGP/GPP levels, where it is the
-  exact version of their approximation).  The joint (np x nf) system couples
-  units exactly like the training-side spatial updateEta.  Levels larger than
-  ``_SPATIAL_COND_MAX`` coefficients, covariate-dependent levels, and
-  non-spatial levels use the unstructured N(0,1) prior (exact for the
-  latter).
+  the level's *actual* GP prior, per spatial method and at any scale:
+
+  * ``NNGP`` — Vecchia neighbour structures built over the prediction units
+    at the alpha grid values visited by the posterior, applied matrix-free
+    inside a CG sampler (same perturbation-optimisation draw as the
+    training-side ``mcmc/spatial._eta_nngp_cg``) — the >1000-unit regime the
+    reference recommends NNGP for works at prediction time too;
+  * ``GPP`` — knot-based double-Woodbury draw over the prediction units
+    (the training-side ``_eta_gpp`` structure);
+  * ``Full`` (and any spatial level with covariate-dependent loadings) —
+    exact exponential-kernel precision per draw, joint (np x nf) system,
+    processed in draw chunks sized to memory up to
+    ``_SPATIAL_COND_DENSE_MAX`` coefficients.
+
+  Only a dense level beyond ``_SPATIAL_COND_DENSE_MAX`` falls back to the
+  unstructured N(0,1) prior, and that downgrade emits a ``RuntimeWarning``.
+  Non-spatial levels use the N(0,1) prior (exact for them).
 - ``predict.R:174,192`` uses ``object$ny`` where the new-data row count
   belongs; we use the new row count.
 """
@@ -35,10 +44,14 @@ from .latent import predict_latent_factor
 
 __all__ = ["predict"]
 
-# above this many (units x factors) coefficients per level, the conditional
-# Eta refresh falls back to the unstructured prior rather than factorising
-# the joint spatial system per draw
-_SPATIAL_COND_MAX = 1500
+# above this many (units x factors) coefficients, a *dense* spatial level
+# (Full, or covariate-dependent NNGP/GPP) falls back to the unstructured
+# prior with a RuntimeWarning; NNGP/GPP levels with unit loadings use their
+# own sparse structure and have no cap
+_SPATIAL_COND_DENSE_MAX = 20000
+# device-memory budget (bytes) for the per-chunk joint dense precisions in
+# the conditional refresh; sets how many posterior draws vmap together
+_COND_DENSE_MEM_BUDGET = 2.5e9
 
 
 def _new_design(hM, x_data, X):
@@ -141,24 +154,11 @@ def predict(post, x_data=None, X=None, xrrr_data=None, XRRR=None,
         else:
             x_row_new.append(np.ones((ny_new, 1)))
 
-        # spatial levels: distance matrix over the same units_pred ordering
-        # and the recorded per-draw, per-factor GP ranges -> exact prior
-        # precision inside the conditional refresh (see module docstring)
-        nf_r = post_alpha.shape[1]
-        usable = (will_condition
-                  and spec.levels[r].spatial is not None
-                  and spec.levels[r].x_dim == 0
-                  and len(units_pred) * nf_r <= _SPATIAL_COND_MAX)
-        if not usable:
-            spatial_prior.append(None)
-            continue
-        if rL.dist_mat is not None:
-            D = rL.dist_for(units_pred)
-        else:
-            xy = rL.coords_for(units_pred)
-            D = np.linalg.norm(xy[:, None, :] - xy[None, :, :], axis=-1)
-        alpha_vals = np.asarray(rL.alphapw, dtype=float)[:, 0][post_alpha]
-        spatial_prior.append((D, alpha_vals))
+        # spatial levels: per-method prior structures over the units_pred
+        # ordering, at the alpha grid values the posterior actually visits
+        # (see module docstring and _spatial_cond_info)
+        spatial_prior.append(_spatial_cond_info(
+            hM, spec, rL, r, units_pred, post_alpha, will_condition))
 
     L = _lin_pred(hM, spec, Xn, x_is_list, XRRR, post, Beta, eta_pred, pi_new,
                   x_row_new)
@@ -233,6 +233,61 @@ def _lin_pred(hM, spec, Xn, x_is_list, XRRR, post, Beta, eta_pred, pi_new,
     return np.asarray(L)
 
 
+def _spatial_cond_info(hM, spec, rL, r, units_pred, post_alpha,
+                       will_condition):
+    """Per-level prior descriptor for the conditional Eta refresh.
+
+    Returns ``None`` (unstructured N(0,1) prior — exact for non-spatial
+    levels, loudly-warned fallback otherwise), or one of
+
+    - ``("dense", D, alpha_vals)`` — exact exponential-kernel precision per
+      draw (Full method, or spatial levels with covariate-dependent
+      loadings), bounded by ``_SPATIAL_COND_DENSE_MAX``;
+    - ``("nngp", lp, idx)`` — Vecchia neighbour structures over the
+      prediction units at the alpha grid values the posterior visits
+      (``precompute._nngp_grids``), ``idx`` (n_draws, nf) indices into them;
+    - ``("gpp", lp, idx)`` — knot-based grids over the prediction units
+      (``precompute._gpp_grids``), same indexing.
+    """
+    if not will_condition or spec.levels[r].spatial is None:
+        return None
+    import warnings
+
+    from ..precompute import _gpp_grids, _nngp_grids
+
+    method = rL.spatial_method
+    post_alpha = np.asarray(post_alpha)
+    n_coef = len(units_pred) * post_alpha.shape[1]
+    x0 = spec.levels[r].x_dim == 0
+    if method in ("NNGP", "GPP") and x0:
+        uniq, inv = np.unique(post_alpha, return_inverse=True)
+        alphas = np.asarray(rL.alphapw, dtype=float)[uniq, 0]
+        idx = inv.reshape(post_alpha.shape).astype(np.int32)
+        s = rL.coords_for(units_pred)
+        if method == "NNGP":
+            lp = _nngp_grids(s, rL.n_neighbours or 10, alphas)
+        else:
+            lp = _gpp_grids(s, np.asarray(rL.s_knot, dtype=float), alphas)
+        return (method.lower(), lp, idx)
+    if n_coef <= _SPATIAL_COND_DENSE_MAX:
+        if rL.dist_mat is not None:
+            D = rL.dist_for(units_pred)
+        else:
+            xy = rL.coords_for(units_pred)
+            D = np.linalg.norm(xy[:, None, :] - xy[None, :, :], axis=-1)
+        alpha_vals = np.asarray(rL.alphapw, dtype=float)[:, 0][post_alpha]
+        return ("dense", D, alpha_vals)
+    warnings.warn(
+        f"conditional prediction: spatial level '{hM.rl_names[r]}' "
+        f"({method}{'' if x0 else ', covariate-dependent loadings'}) has "
+        f"{n_coef} unit x factor coefficients, beyond the dense-path cap "
+        f"{_SPATIAL_COND_DENSE_MAX}; its conditional Eta refresh falls back "
+        "to the unstructured N(0,1) prior, so conditional predictions will "
+        "be less well calibrated than the training-side spatial model",
+        RuntimeWarning, stacklevel=3)
+    return None
+
+
 def _conditional_mcmc(hM, spec, post, Xn, x_is_list, XRRR, Beta, sigma, Yc,
                       eta_pred, pi_new, x_row_new, L, mcmc_step, rng,
                       spatial_prior=None):
@@ -240,12 +295,14 @@ def _conditional_mcmc(hM, spec, post, Xn, x_is_list, XRRR, Beta, sigma, Yc,
     conditioning on the observed cells of Yc — vmapped over draws and run as
     one jitted scan (reference ``predict.R:181-198``).
 
-    ``spatial_prior[r]`` is ``(D, alpha_vals)`` for spatial levels — the
-    distance matrix over prediction units and the per-draw, per-factor GP
-    range values — making the Eta refresh use the exact exponential-kernel
-    prior precision (the capability the reference intends but crashes on,
-    ``predict.R:185``); ``None`` falls back to the unstructured N(0,1) prior.
+    ``spatial_prior[r]`` is a :func:`_spatial_cond_info` descriptor — the Eta
+    refresh uses the level's actual GP prior per spatial method (the
+    capability the reference intends but crashes on, ``predict.R:185``);
+    ``None`` falls back to the unstructured N(0,1) prior.  Draws are
+    processed in memory-sized chunks when a dense spatial level is present.
     """
+    import warnings
+
     import jax
     import jax.numpy as jnp
 
@@ -284,14 +341,34 @@ def _conditional_mcmc(hM, spec, post, Xn, x_is_list, XRRR, Beta, sigma, Yc,
     np_r = [eta_pred[r].shape[1] for r in range(hM.nr)]
     if spatial_prior is None:
         spatial_prior = [None] * hM.nr
-    # distance matrices are draw-invariant closures; alpha values are
-    # per-draw vmapped inputs (dummy zeros for non-spatial levels)
-    D_r = [None if sp is None else jnp.asarray(sp[0], dtype=jnp.float32)
-           for sp in spatial_prior]
-    alpha_r = tuple(
-        jnp.zeros((n_draws, nf_r[r]), dtype=jnp.float32) if spatial_prior[r] is None
-        else jnp.asarray(spatial_prior[r][1], dtype=jnp.float32)
-        for r in range(hM.nr))
+    # prior structures are draw-invariant closures; the per-draw vmapped
+    # input is either the alpha *values* (dense: kernel built per draw) or
+    # grid *indices* into the precomputed pred-unit structures (nngp/gpp)
+    mode_r = [None if sp is None else sp[0] for sp in spatial_prior]
+    D_r, nngp_r, gpp_r, alpha_in = [], [], [], []
+    for r in range(hM.nr):
+        sp = spatial_prior[r]
+        D_r.append(None)
+        nngp_r.append(None)
+        gpp_r.append(None)
+        if sp is None:
+            alpha_in.append(jnp.zeros((n_draws, nf_r[r]), dtype=jnp.float32))
+        elif sp[0] == "dense":
+            D_r[r] = jnp.asarray(sp[1], dtype=jnp.float32)
+            alpha_in.append(jnp.asarray(sp[2], dtype=jnp.float32))
+        elif sp[0] == "nngp":
+            lp = sp[1]
+            nngp_r[r] = (jnp.asarray(lp.nn_idx, dtype=jnp.int32),
+                         jnp.asarray(lp.nn_coef, dtype=jnp.float32),
+                         jnp.asarray(lp.nn_D, dtype=jnp.float32))
+            alpha_in.append(jnp.asarray(sp[2], dtype=jnp.int32))
+        else:  # gpp
+            lp = sp[1]
+            gpp_r[r] = (jnp.asarray(lp.idDg, dtype=jnp.float32),
+                        jnp.asarray(lp.idDW12g, dtype=jnp.float32),
+                        jnp.asarray(lp.Fg, dtype=jnp.float32))
+            alpha_in.append(jnp.asarray(sp[2], dtype=jnp.int32))
+    alpha_r = tuple(alpha_in)
     iSig = jnp.asarray(1.0 / np.asarray(sigma), dtype=jnp.float32)  # (n, ns)
     LFix0 = jnp.asarray(L, dtype=jnp.float32) - sum(
         _loading_np(eta_r[r], pi_r[r], xrow_r[r], lam_r[r])
@@ -303,24 +380,27 @@ def _conditional_mcmc(hM, spec, post, Xn, x_is_list, XRRR, Beta, sigma, Yc,
             return rows @ lam
         return jnp.einsum("yf,yk,fjk->yj", rows, xrow, lam)
 
-    def z_given_yc(E, z_prev, isig, k1, k2):
-        """One updateZ pass against the observed Yc cells."""
+    def z_given_yc(E, z_prev, isig, key):
+        """One updateZ pass against the observed Yc cells — one key per draw
+        site, so families stay independent even if the disjoint-cell layout
+        ever changes."""
+        k_base, k_probit, k_pg, k_poisz = jax.random.split(key, 4)
         std = isig[None, :] ** -0.5
-        z = E + std * jax.random.normal(k1, E.shape, dtype=E.dtype)
+        z = E + std * jax.random.normal(k_base, E.shape, dtype=E.dtype)
         if any_normal:
             z = jnp.where((fam == 1) & (mask > 0), Yc0, z)
         if any_probit:
             # one-sided truncation, same specialisation as the sweep's updateZ
-            ztn = truncated_normal_onesided(k2, 0.0, Yc0 > 0.5, E, std)
+            ztn = truncated_normal_onesided(k_probit, 0.0, Yc0 > 0.5, E, std)
             z = jnp.where((fam == 2) & (mask > 0), ztn, z)
         if any_poisson:
             from ..ops.rand import polya_gamma
             logr = jnp.log(1e3)
-            w = polya_gamma(k2, Yc0 + 1e3, z_prev - logr)
+            w = polya_gamma(k_pg, Yc0 + 1e3, z_prev - logr)
             prec_z = isig[None, :]
             s2 = 1.0 / (prec_z + w)
             mu = s2 * ((Yc0 - 1e3) / 2.0 + prec_z * (E - logr)) + logr
-            zp = mu + jnp.sqrt(s2) * jax.random.normal(k1, mu.shape,
+            zp = mu + jnp.sqrt(s2) * jax.random.normal(k_poisz, mu.shape,
                                                        dtype=mu.dtype)
             z = jnp.where((fam == 3) & (mask > 0), zp, z)
         return z
@@ -329,12 +409,15 @@ def _conditional_mcmc(hM, spec, post, Xn, x_is_list, XRRR, Beta, sigma, Yc,
         from jax.scipy.linalg import cho_solve, solve_triangular
 
         # step-invariant per level: the likelihood gram LiSL (lam/isig/mask
-        # only) and the cholesky of the full-conditional precision — spatial:
-        # joint blkdiag_f(iW(alpha_f)) + unit blocks (the training-side
-        # spatial updateEta structure, reference updateEta.R:110-135);
+        # only) and the factorisation / closures of the full-conditional
+        # precision — dense spatial: joint blkdiag_f(iW(alpha_f)) + unit
+        # blocks (the training-side spatial updateEta structure, reference
+        # updateEta.R:110-135); nngp: Vecchia factor gathered at each
+        # factor's alpha (applied matrix-free, as mcmc/spatial._eta_nngp_cg);
+        # gpp: double-Woodbury blocks (as mcmc/spatial._eta_gpp);
         # unstructured: per-unit nf x nf.  Only the rhs changes across the
         # mcmc_step scan, so factorise once per posterior draw.
-        lam2_r, chol_r = [], []
+        lam2_r, solver_r = [], []
         for r in range(hM.nr):
             lam = lams[r]
             lam2 = lam if lam.ndim == 2 else jnp.einsum(
@@ -350,7 +433,7 @@ def _conditional_mcmc(hM, spec, post, Xn, x_is_list, XRRR, Beta, sigma, Yc,
                                   Mu_cnt)
             lam2_r.append(lam2)
             npr, nf = np_r[r], nf_r[r]
-            if D_r[r] is not None:
+            if mode_r[r] == "dense":
                 D = D_r[r]
                 eyeu = jnp.eye(npr, dtype=D.dtype)
 
@@ -366,16 +449,40 @@ def _conditional_mcmc(hM, spec, post, Xn, x_is_list, XRRR, Beta, sigma, Yc,
                                 jnp.eye(nf, dtype=D.dtype))
                 u_idx = jnp.arange(npr)
                 P4 = P4.at[u_idx, :, u_idx, :].add(LiSL)
-                chol_r.append(jnp.linalg.cholesky(
-                    P4.reshape(npr * nf, npr * nf)))
+                solver_r.append(("dense", jnp.linalg.cholesky(
+                    P4.reshape(npr * nf, npr * nf))))
+            elif mode_r[r] == "nngp":
+                nn, coef_g, Dg = nngp_r[r]
+                coef = coef_g[alphas[r]]              # (nf, np, k)
+                sqD = jnp.sqrt(Dg[alphas[r]])         # (nf, np)
+                solver_r.append(("nngp", (nn, coef, sqD, LiSL)))
+            elif mode_r[r] == "gpp":
+                idDg, M1g, Fg = gpp_r[r]
+                idD = idDg[alphas[r]]                 # (nf, np)
+                M1 = M1g[alphas[r]]                   # (nf, np, nK)
+                Fm = Fg[alphas[r]]                    # (nf, nK, nK)
+                nK = M1.shape[2]
+                A = LiSL + jnp.eye(nf, dtype=idD.dtype)[None] \
+                    * idD.T[:, :, None]               # (np, nf, nf)
+                LA = jnp.linalg.cholesky(A)
+                iA = jax.vmap(lambda Lc: solve_triangular(
+                    Lc.T, solve_triangular(Lc, jnp.eye(nf, dtype=idD.dtype),
+                                           lower=True), lower=False))(LA)
+                MtAM = jnp.einsum("hum,uhg,gun->hmgn", M1, iA, M1)
+                H = -MtAM
+                fi = jnp.arange(nf)
+                H = H.at[fi, :, fi, :].add(Fm)
+                LH = jnp.linalg.cholesky(H.reshape(nf * nK, nf * nK))
+                LiA = jnp.linalg.cholesky(iA)
+                solver_r.append(("gpp", (M1, iA, LiA, LH, nK)))
             else:
-                chol_r.append(jnp.linalg.cholesky(
-                    LiSL + jnp.eye(nf, dtype=LiSL.dtype)[None]))
+                solver_r.append(("none", jnp.linalg.cholesky(
+                    LiSL + jnp.eye(nf, dtype=LiSL.dtype)[None])))
 
         def step(carry, k):
-            z, etas = carry
-            ks = jax.random.split(k, 2 + hM.nr)
-            # Eta update per level (spatial GP prior where available,
+            z, etas, fail = carry
+            kz = jax.random.fold_in(k, 0)
+            # Eta update per level (the level's GP prior where available,
             # N(0,1) otherwise; see module docstring)
             for r in range(hM.nr):
                 others = sum(loading(etas[q], lams[q], pi_r[q], xrow_r[q])
@@ -392,44 +499,128 @@ def _conditional_mcmc(hM, spec, post, Xn, x_is_list, XRRR, Beta, sigma, Yc,
                                             num_segments=np_r[r])
                     Fr = jnp.einsum("uj,ufj->uf", T, lam2_r[r])
                 npr, nf = np_r[r], nf_r[r]
-                Lc = chol_r[r]
-                if D_r[r] is not None:
+                mode, payload = solver_r[r]
+                kr = jax.random.fold_in(k, 1 + r)
+                if mode == "dense":
+                    Lc = payload
                     rhs = Fr.reshape(npr * nf)
                     mean = cho_solve((Lc, True), rhs)
-                    eps = jax.random.normal(ks[2 + r], rhs.shape,
-                                            dtype=rhs.dtype)
+                    eps = jax.random.normal(kr, rhs.shape, dtype=rhs.dtype)
                     noise = solve_triangular(Lc.T, eps, lower=False)
-                    etas = (etas[:r] + ((mean + noise).reshape(npr, nf),)
-                            + etas[r + 1:])
-                    continue
-                mean = cho_solve((Lc, True), Fr[..., None])[..., 0]
-                eps = jax.random.normal(ks[2 + r], mean.shape, dtype=mean.dtype)
-                noise = solve_triangular(jnp.swapaxes(Lc, -1, -2),
-                                         eps[..., None], lower=False)[..., 0]
-                etas = etas[:r] + (mean + noise,) + etas[r + 1:]
+                    eta_new = (mean + noise).reshape(npr, nf)
+                elif mode == "nngp":
+                    nn, coef, sqD, LiSL_l = payload
+                    k_nb = nn.shape[1]
+
+                    def riw_t(u):
+                        """RiW' u per factor; u, out: (np, nf)."""
+                        t = u / sqD.T
+                        contrib = -jnp.einsum("fik,if->ikf", coef, t)
+                        return t + jax.ops.segment_sum(
+                            contrib.reshape(npr * k_nb, nf), nn.reshape(-1),
+                            num_segments=npr)
+
+                    def pmv(x):
+                        xg = x[nn]                    # (np, k, nf)
+                        red = jnp.einsum("fik,ikf->if", coef, xg)
+                        Rx = (x - red) / sqD.T
+                        return riw_t(Rx) + jnp.einsum("ufg,ug->uf", LiSL_l, x)
+
+                    ka, kb = jax.random.split(kr)
+                    eps1 = jax.random.normal(ka, (npr, nf), dtype=Fr.dtype)
+                    xi = jax.random.normal(kb, mask.shape, dtype=Fr.dtype)
+                    w = xi * jnp.sqrt(isig)[None, :] * mask
+                    b = Fr + riw_t(eps1) + jax.ops.segment_sum(
+                        w @ lam.T, pi_r[r], num_segments=npr)
+                    eta_new, _ = jax.scipy.sparse.linalg.cg(
+                        pmv, b, x0=etas[r], tol=1e-5, maxiter=500)
+                    # count stalled solves; the maxiter iterate is kept (an
+                    # approximate draw) and the host warns post-run
+                    res = jnp.linalg.norm(pmv(eta_new) - b) \
+                        / jnp.maximum(jnp.linalg.norm(b), 1e-30)
+                    fail = fail + (res >= 1e-3).astype(jnp.int32)
+                elif mode == "gpp":
+                    M1, iA, LiA, LH, nK = payload
+                    iA_rhs = jnp.einsum("uhg,ug->uh", iA, Fr)
+                    Mt = jnp.einsum("hum,uh->hm", M1, iA_rhs).reshape(-1)
+                    corr = solve_triangular(
+                        LH.T, solve_triangular(LH, Mt, lower=True),
+                        lower=False).reshape(nf, nK)
+                    Mx = jnp.einsum("hum,hm->uh", M1, corr)
+                    mean = iA_rhs + jnp.einsum("uhg,ug->uh", iA, Mx)
+                    ka, kb = jax.random.split(kr)
+                    eps1 = jax.random.normal(ka, (npr, nf), dtype=Fr.dtype)
+                    noise1 = jnp.einsum("uhg,ug->uh", LiA, eps1)
+                    eps2 = jax.random.normal(kb, (nf * nK,), dtype=Fr.dtype)
+                    w2 = solve_triangular(LH.T, eps2,
+                                          lower=False).reshape(nf, nK)
+                    Mw = jnp.einsum("hum,hm->uh", M1, w2)
+                    eta_new = mean + noise1 + jnp.einsum("uhg,ug->uh", iA, Mw)
+                else:
+                    Lc = payload
+                    mean = cho_solve((Lc, True), Fr[..., None])[..., 0]
+                    eps = jax.random.normal(kr, mean.shape, dtype=mean.dtype)
+                    noise = solve_triangular(jnp.swapaxes(Lc, -1, -2),
+                                             eps[..., None], lower=False)[..., 0]
+                    eta_new = mean + noise
+                etas = etas[:r] + (eta_new,) + etas[r + 1:]
             # Z update against Yc
             E = LFix + sum(loading(etas[r], lams[r], pi_r[r], xrow_r[r])
                            for r in range(hM.nr))
-            z = z_given_yc(E, z, isig, ks[0], ks[1])
-            return (z, etas), None
+            z = z_given_yc(E, z, isig, kz)
+            return (z, etas, fail), None
 
         # initial Z draw against Yc before the refinement loop, mirroring
         # the reference's Z = updateZ(...) at predict.R:183 — so even
         # mcmc_step=1 refines Eta against Yc-informed Z
         E0 = LFix + sum(loading(etas[r], lams[r], pi_r[r], xrow_r[r])
                         for r in range(hM.nr))
-        key, k1, k2 = jax.random.split(key, 3)
-        z0 = z_given_yc(E0, E0, isig, k1, k2)
+        key, k0 = jax.random.split(key)
+        z0 = z_given_yc(E0, E0, isig, k0)
         keys = jax.random.split(key, mcmc_step)
-        (z, etas), _ = jax.lax.scan(step, (z0, etas), keys)
-        return etas
+        fail0 = jnp.zeros((), dtype=jnp.int32)
+        (z, etas, fail), _ = jax.lax.scan(step, (z0, etas, fail0), keys)
+        return etas, fail
 
     keys = jax.vmap(jax.random.PRNGKey)(
         jnp.asarray(rng.integers(0, 2**31 - 1, size=n_draws)))
     etas0 = tuple(eta_r)
     run = jax.jit(jax.vmap(one_draw, in_axes=(0, 0, 0, 0, 0, 0)))
-    etas_out = run(LFix0, tuple(lam_r), etas0, iSig, alpha_r, keys)
-    return [np.asarray(e) for e in etas_out]
+    args = (LFix0, tuple(lam_r), etas0, iSig, alpha_r, keys)
+
+    # dense spatial levels hold a (np*nf)^2 joint precision per draw; chunk
+    # the draw axis so the vmapped working set stays inside the budget
+    dense_bytes = sum((np_r[r] * nf_r[r]) ** 2 * 4
+                      for r in range(hM.nr) if mode_r[r] == "dense")
+    chunk = n_draws if not dense_bytes else max(
+        1, min(n_draws, int(_COND_DENSE_MEM_BUDGET // (dense_bytes * 3))))
+    if chunk >= n_draws:
+        etas_out, fails = run(*args)
+        n_fail = int(np.asarray(fails).sum())
+        etas_list = [np.asarray(e) for e in etas_out]
+    else:
+        # pad to a whole number of chunks: one compiled shape, drop the tail
+        n_pad = -(-n_draws // chunk) * chunk
+        sel = jnp.asarray(np.r_[np.arange(n_draws),
+                                np.full(n_pad - n_draws, n_draws - 1)])
+        args = jax.tree.map(lambda a: a[sel], args)
+        outs, n_fail = [], 0
+        for c0 in range(0, n_pad, chunk):
+            eo, fl = run(*jax.tree.map(lambda a: a[c0:c0 + chunk], args))
+            outs.append([np.asarray(e) for e in eo])
+            # padded duplicates re-run real draws; don't double-count their
+            # stalls
+            real = (c0 + np.arange(chunk)) < n_draws
+            n_fail += int(np.asarray(fl)[real].sum())
+        etas_list = [np.concatenate([o[r] for o in outs], axis=0)[:n_draws]
+                     for r in range(hM.nr)]
+    if n_fail:
+        warnings.warn(
+            f"conditional prediction: the NNGP Eta CG solve stalled in "
+            f"{n_fail} (draw, step, level) instances; those draws keep the "
+            "maxiter iterate (an approximate refresh)", RuntimeWarning,
+            stacklevel=3)
+    return etas_list
 
 
 def _loading_np(eta, pi, xrow, lam):

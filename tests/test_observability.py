@@ -55,6 +55,74 @@ def test_poisson_nan_guard():
         assert np.isfinite(post.pooled(k)).all()
 
 
+def test_record_selection():
+    """sample_mcmc(record=...) must drop unselected blocks from the posterior
+    (cutting device->host transfer), keep summaries over the kept ones
+    working, and fail loudly on unknown names or un-recorded access."""
+    m = small_model(ny=30, ns=4, nc=2, distr="normal", n_units=6, seed=0)
+    post = sample_mcmc(m, samples=10, transient=10, n_chains=2, seed=1,
+                       nf_cap=2, record=("Beta", "Lambda", "sigma"))
+    assert "Lambda_0" in post.arrays and "sigma" in post.arrays
+    for dropped in ("Eta_0", "Psi_0", "Gamma", "V"):
+        assert dropped not in post.arrays
+    assert "nfMask_0" in post.arrays          # bookkeeping always kept
+    # summaries over recorded params still work (incl. sign alignment)
+    om = post.get_post_estimate("Omega")
+    assert om["mean"].shape == (m.ns, m.ns)
+    with pytest.raises(KeyError, match="not recorded"):
+        post.pooled("Eta_0")
+    # coda export covers exactly what was recorded
+    from hmsc_tpu import convert_to_coda_object
+    coda = convert_to_coda_object(post)
+    assert "Lambda_0" in coda and "Eta_0" not in coda
+    with pytest.raises(ValueError, match="unknown parameter"):
+        sample_mcmc(m, samples=2, transient=2, n_chains=1, seed=1,
+                    record=("Betta",))
+
+    # per-level names and full recording agree on the shared draws
+    full = sample_mcmc(m, samples=10, transient=10, n_chains=2, seed=1,
+                       nf_cap=2)
+    np.testing.assert_allclose(full.arrays["Lambda_0"],
+                               post.arrays["Lambda_0"], rtol=1e-6)
+
+
+def test_nf_cap_saturation_warns():
+    """A model whose true factor rank exceeds nf_cap must trigger the
+    factor-cap warning and record blocked-attempt counts (round-3 verdict
+    missing #4: saturation must not be silent)."""
+    import pandas as pd
+
+    from hmsc_tpu import Hmsc, HmscRandomLevel
+    from hmsc_tpu.random_level import set_priors_random_level
+
+    rng = np.random.default_rng(2)
+    ny, ns, n_units, nf_true = 150, 10, 30, 5
+    units = [f"u{i:02d}" for i in rng.integers(0, n_units, ny)]
+    for i in range(n_units):
+        units[i] = f"u{i:02d}"
+    uidx = np.array([int(u[1:]) for u in units])
+    Eta = rng.standard_normal((n_units, nf_true))
+    Lam = rng.standard_normal((nf_true, ns)) * 1.5
+    Y = Eta[uidx] @ Lam + 0.3 * rng.standard_normal((ny, ns))
+    study = pd.DataFrame({"lvl": units})
+    rl = HmscRandomLevel(units=study["lvl"])
+    set_priors_random_level(rl, nf_max=8, nf_min=2)
+    m = Hmsc(Y=Y, X=np.ones((ny, 1)), distr="normal", study_design=study,
+             ran_levels={"lvl": rl})
+    with pytest.warns(RuntimeWarning, match="nf_max cap"):
+        post = sample_mcmc(m, samples=10, transient=150, n_chains=1, seed=1,
+                           nf_cap=2)
+    assert (post.nf_saturation[0] > 0).any()
+
+    # a generously-capped fit must not warn
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        post2 = sample_mcmc(m, samples=5, transient=30, n_chains=1, seed=1,
+                            nf_cap=8)
+    assert (post2.nf_saturation[0] == 0).all()
+
+
 def test_divergence_containment():
     """A chain whose carry goes non-finite must be reported (chain index +
     first bad sweep) and excluded from pooled summaries — not returned as
