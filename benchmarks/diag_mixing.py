@@ -1,0 +1,112 @@
+"""Mixing diagnosis of the slow Beta tail (round-4 item: configs 2 / 3b).
+
+Fits the BENCHMARKS.md config-2 (250-species shrinkage) and config-3b
+(NNGP np=1000) models, computes per-entry ESS for Beta, and reports where
+the slowest entries live: which covariate, which species, and how strongly
+those species load on the shrinkage-tail (high-index) factors — the
+candidate coupling for an extended (Delta_h, Lambda_{>=h}) interweave move.
+
+Run on the TPU host: ``python benchmarks/diag_mixing.py [config2|config3b]``.
+Prints a small JSON report; findings land in BENCHMARKS.md.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from hmsc_tpu.model import Hmsc
+from hmsc_tpu.random_level import HmscRandomLevel, set_priors_random_level
+from hmsc_tpu.mcmc.sampler import sample_mcmc
+from hmsc_tpu.post.diagnostics import effective_size
+
+
+def config2(rng):
+    ny, ns, nf = 400, 250, 5
+    X = np.column_stack([np.ones(ny), rng.standard_normal((ny, 2))])
+    eta = rng.standard_normal((ny, nf))
+    lam = rng.standard_normal((nf, ns)) * (0.7 ** np.arange(nf))[:, None]
+    Y = ((X @ (rng.standard_normal((3, ns)) * 0.5) + eta @ lam
+          + rng.standard_normal((ny, ns))) > 0).astype(float)
+    study = pd.DataFrame({"sample": [f"s{i:05d}" for i in range(ny)]})
+    rl = HmscRandomLevel(units=study["sample"])
+    set_priors_random_level(rl, nf_max=10, nf_min=2)
+    m = Hmsc(Y=Y, X=X, distr="probit", study_design=study,
+             ran_levels={"sample": rl}, x_scale=False)
+    return m, dict(nf_cap=10)
+
+
+def config3b(rng):
+    np_units, ny_per, ns = 1000, 2, 50
+    ny = np_units * ny_per
+    units = [f"u{i:04d}" for i in range(np_units)]
+    unit_of = np.repeat(np.arange(np_units), ny_per)
+    xy = pd.DataFrame(rng.uniform(size=(np_units, 2)) * 10,
+                      index=units, columns=["x", "y"])
+    X = np.column_stack([np.ones(ny), rng.standard_normal(ny)])
+    eta = rng.standard_normal((np_units, 2))
+    lam = rng.standard_normal((2, ns))
+    L = X @ (rng.standard_normal((2, ns)) * 0.5) + eta[unit_of] @ lam
+    Y = ((L + rng.standard_normal((ny, ns))) > 0).astype(float)
+    study = pd.DataFrame({"plot": [units[u] for u in unit_of]})
+    rl = HmscRandomLevel(s_data=xy, s_method="NNGP", n_neighbours=10)
+    set_priors_random_level(rl, nf_max=2, nf_min=2)
+    m = Hmsc(Y=Y, X=X, distr="probit", study_design=study,
+             ran_levels={"plot": rl}, x_scale=False)
+    return m, dict(nf_cap=2)
+
+
+def diagnose(name, samples=250, transient=125, thin=4, n_chains=4, seed=11):
+    rng = np.random.default_rng(0)
+    m, kw = (config2 if name == "config2" else config3b)(rng)
+    post = sample_mcmc(m, samples=samples, transient=transient, thin=thin,
+                       n_chains=n_chains, seed=seed, **kw)
+    B = post["Beta"]                                  # (c, s, nc, ns)
+    ess = effective_size(B)                           # (nc, ns)
+    lam = post.pooled("Lambda_0")
+    lam = lam[..., 0] if lam.ndim == 4 else lam       # (n, nf, ns)
+    mask = post.pooled("nfMask_0")                    # (n, nf)
+    nf_act = int(mask.sum(axis=1).max())
+    lam_abs = np.abs(lam).mean(axis=0)                # (nf, ns)
+    delta = post.pooled("Delta_0")
+    delta = delta[..., 0] if delta.ndim == 3 else delta
+
+    flat = ess.ravel()
+    order = np.argsort(flat)
+    nc, ns = ess.shape
+    worst = []
+    for k in order[:10]:
+        c, j = divmod(int(k), ns)
+        worst.append({
+            "cov": c, "sp": int(j), "ess": float(flat[k]),
+            "loading_by_factor": [round(float(lam_abs[h, j]), 3)
+                                  for h in range(nf_act)],
+        })
+    # tail-loading correlation: is low ESS explained by high-index factors?
+    tail = lam_abs[nf_act // 2:nf_act].sum(axis=0) if nf_act > 1 else lam_abs[0]
+    head = lam_abs[:max(nf_act // 2, 1)].sum(axis=0)
+    ess_sp = ess.min(axis=0)
+    report = {
+        "config": name,
+        "n_draws": int(B.shape[0] * B.shape[1]),
+        "ess_min": float(ess.min()), "ess_median": float(np.median(ess)),
+        "nf_active": nf_act,
+        "delta_mean": [round(float(d), 2) for d in delta.mean(axis=0)[:nf_act]],
+        "corr_minESS_tailloading": float(np.corrcoef(ess_sp, tail)[0, 1]),
+        "corr_minESS_headloading": float(np.corrcoef(ess_sp, head)[0, 1]),
+        "worst_entries": worst,
+        "run_s": post.timing["run_s"],
+    }
+    print(json.dumps(report, indent=1))
+    return report
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "config2"
+    diagnose(which)

@@ -52,9 +52,12 @@ def phylo_grids(C, n_grid=101):
     return rhos, out
 
 
-def spatial_full_grids(D, n_grid=101):
-    """Per-alpha W = exp(-D/alpha) grids (``computeDataParameters.R:54-81``)."""
-    alphas = np.linspace(0, D.max() * np.sqrt(2), n_grid)
+def spatial_full_grids(D, n_grid=101, alphas=None):
+    """Per-alpha W = exp(-D/alpha) grids (``computeDataParameters.R:54-81``).
+    ``alphas`` overrides the grid values (the parity tier passes the fitted
+    model's alphapw grid so both engines share one discrete prior)."""
+    if alphas is None:
+        alphas = np.linspace(0, D.max() * np.sqrt(2), n_grid)
     out = []
     for a in alphas:
         W = np.eye(D.shape[0]) if a == 0 else np.exp(-D / a)
@@ -108,7 +111,7 @@ class ReferenceEngine:
     """One chain of the reference's blocked Gibbs sweep in NumPy."""
 
     def __init__(self, Y, X, distr_fam, nf, rng, pi_row=None, C=None, Tr=None,
-                 spatial=None):
+                 spatial=None, alpha_prior_w=None, rho_prior_w=None):
         ny, ns = Y.shape
         self.Y, self.X, self.rng = Y, X, rng
         self.fam = distr_fam                    # (ns,) 1=normal 2=probit 3=pois
@@ -120,6 +123,10 @@ class ReferenceEngine:
         self.Tr = np.ones((ns, 1)) if Tr is None else Tr
         self.C = C
         self.spatial = spatial                  # None | ("full", grids) | ("nngp", grids)
+        # optional discrete-grid prior weights (the parity tier passes the
+        # fitted model's rhopw/alphapw weights; None = flat, as for timing)
+        self.alpha_prior_w = alpha_prior_w
+        self.rho_prior_w = rho_prior_w
         if C is not None:
             self.rho_grid, self.Qg = phylo_grids(C)
             self.rho_idx = 50
@@ -153,9 +160,16 @@ class ReferenceEngine:
             z = self.Z[:, j]
             u = 0.5 * np.abs(z - logr); us = np.maximum(u, 1e-3)
             h = self.Y[:, j] + r_nb
-            w = np.maximum(h * np.tanh(us) / (4 * us)
-                           + rng.standard_normal(z.shape)
-                           * np.sqrt(h / 24.0), 1e-6)
+            # moment-matched PG(h, z-logr): exact CGF mean/variance (at
+            # h >= 1000 the Gaussian is exact to below MC error)
+            t = np.tanh(us); sech2 = 1.0 - t * t
+            small = u < 1e-3
+            pg_mean = np.where(small, h / 4.0 * (1.0 - u * u / 3.0),
+                               h * t / (4.0 * us))
+            pg_var = np.where(small, h / 24.0,
+                              h * (t - us * sech2) / (16.0 * us**3))
+            w = np.maximum(pg_mean + rng.standard_normal(z.shape)
+                           * np.sqrt(pg_var), 1e-6)
             s2 = 1.0 / (self.iSigma[j][None] + w)
             mu = s2 * ((self.Y[:, j] - r_nb) / 2 + self.iSigma[j][None]
                        * (E[:, j] - logr)) + logr
@@ -231,6 +245,8 @@ class ReferenceEngine:
                 W = np.linalg.solve(R, E.T)       # RQg^-1 E'  (ns, nc)
                 v = float(np.sum((W @ RiV) ** 2))  # ||RQg^-1 E' RiV||^2
                 logp[gi] = -0.5 * self.nc * ld - 0.5 * v
+            if self.rho_prior_w is not None:
+                logp += np.log(self.rho_prior_w)
             logp -= logp.max()
             p = np.exp(logp); p /= p.sum()
             self.rho_idx = rng.choice(len(p), p=p)
@@ -286,6 +302,8 @@ class ReferenceEngine:
                 for gi, (iW, RiW, ldW) in enumerate(grids):
                     v = float(np.sum((RiW.T @ self.Eta[:, h]) ** 2))
                     logp[gi] = -0.5 * ldW - 0.5 * v
+                if self.alpha_prior_w is not None:
+                    logp += np.log(self.alpha_prior_w)
                 logp -= logp.max()
                 p = np.exp(logp); p /= p.sum()
                 self.alpha_idx[h] = rng.choice(len(p), p=p)
@@ -310,6 +328,8 @@ class ReferenceEngine:
                     # log|W| = sum log D for the unit-triangular Vecchia
                     # factor, so the prior density is -0.5*ldD - 0.5*v
                     logp[gi] = -0.5 * ldD - 0.5 * v
+                if self.alpha_prior_w is not None:
+                    logp += np.log(self.alpha_prior_w)
                 logp -= logp.max()
                 p = np.exp(logp); p /= p.sum()
                 self.alpha_idx[h] = rng.choice(len(p), p=p)

@@ -273,7 +273,9 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
       end-to-end cost at scale, and e.g. Eta at np=1000+ units is the
       largest block while CV / WAIC / variance partitioning never read it.
       Accepts base names (applied across levels) or per-level names
-      (``"Eta_0"``); Beta and the nfMask bookkeeping are always kept.
+      (``"Eta_0"``); Beta and the nfMask bookkeeping are always kept, and
+      sign-alignment references are force-included (Lambda whenever the
+      corresponding Eta is recorded; wRRR on reduced-rank models).
       Un-recorded parameters raise a clear KeyError downstream.
     """
     import time
@@ -312,7 +314,18 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 f"{sorted(_RECORDABLE)} (per-level parameters "
                 f"{sorted(level_pars)} also accept a _<level> suffix "
                 f"below nr={spec.nr})")
-        record = tuple(sorted(set(record)))
+        rec_set = set(record)
+        # sign-alignment coupling: Eta flips with Lambda's sign, and Beta's
+        # RRR rows flip with wRRR's — recording one without its sign
+        # reference would leave it silently sign-mixed across chains, so the
+        # reference array is force-included (both are small blocks)
+        for k in list(rec_set):
+            head, _, tail = k.rpartition("_")
+            if k == "Eta" or (tail.isdigit() and head == "Eta"):
+                rec_set.add("Lambda" if k == "Eta" else f"Lambda_{tail}")
+        if spec.nc_rrr > 0:
+            rec_set.add("wRRR")
+        record = tuple(sorted(rec_set))
     if data_par is None:
         data_par = compute_data_parameters(hM)
     data = build_model_data(hM, data_par, spec, dtype=dtype)

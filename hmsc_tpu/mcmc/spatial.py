@@ -31,11 +31,103 @@ from ..ops.linalg import chol_spd, sample_mvn_prec
 from .structs import GibbsState, LevelState, ModelData, ModelSpec
 from .updaters import _masked_level_gram, lambda_effective
 
-__all__ = ["update_eta_spatial", "update_alpha"]
+__all__ = ["update_eta_spatial", "update_alpha", "vecchia_ops",
+           "vecchia_cg_draw", "gpp_factor", "gpp_draw"]
 
 # above this many (units x factors) coefficients, NNGP Eta switches from the
 # dense joint cholesky to the matrix-free CG sampler
 _NNGP_DENSE_MAX = 4096
+
+
+# ---------------------------------------------------------------------------
+# shared NNGP / GPP precision algebra — one source for the training-side
+# updaters below AND the conditional-prediction refresh
+# (predict/predict._conditional_mcmc), so a numerics fix lands in both
+# ---------------------------------------------------------------------------
+
+def vecchia_ops(nn, coef, sqD, LiSL):
+    """Matrix-free apply closures for the NNGP full-conditional precision
+    ``P = blkdiag_f(RiW_f' RiW_f) + unitdiag(LiSL_u)``.
+
+    ``nn`` (np, k) neighbour indices; ``coef`` (nf, np, k) autoregressive
+    coefficients and ``sqD`` (nf, np) sqrt conditional variances at each
+    factor's alpha; ``LiSL`` (np, nf, nf) per-unit likelihood gram.
+    Returns ``(riw_t, pmv)``: RiW' u and the full P x, both (np, nf)."""
+    npr, k_nb = nn.shape
+    nf = LiSL.shape[-1]
+
+    def riw_t(u):
+        t = u / sqD.T
+        contrib = -jnp.einsum("fik,if->ikf", coef, t)   # (np, k, nf)
+        return t + jax.ops.segment_sum(
+            contrib.reshape(npr * k_nb, nf), nn.reshape(-1),
+            num_segments=npr)
+
+    def pmv(x):
+        xg = x[nn]                                      # (np, k, nf)
+        red = jnp.einsum("fik,ikf->if", coef, xg)
+        Rx = (x - red) / sqD.T
+        return riw_t(Rx) + jnp.einsum("ufg,ug->uf", LiSL, x)
+
+    return riw_t, pmv
+
+
+def vecchia_cg_draw(riw_t, pmv, F, b_like, eps1, x0, tol=1e-5, maxiter=500):
+    """Perturbation-optimisation draw x ~ N(P^{-1}(F), P^{-1}) via CG.
+
+    ``b_like`` must be noise with covariance equal to the likelihood part of
+    P (sum of lam sqrt(iSigma)-weighted normals per unit); ``eps1`` (np, nf)
+    standard normals feed the prior part through RiW'.  Returns the iterate
+    and its relative residual — the caller decides the stall policy (the
+    sweep poisons to NaN for divergence containment; conditional prediction
+    keeps the iterate and warns)."""
+    b = F + riw_t(eps1) + b_like
+    x, _ = jax.scipy.sparse.linalg.cg(pmv, b, x0=x0, tol=tol,
+                                      maxiter=maxiter)
+    res = jnp.linalg.norm(pmv(x) - b) / jnp.maximum(jnp.linalg.norm(b),
+                                                    1e-30)
+    return x, res
+
+
+def gpp_factor(LiSL, idD, M1, Fm):
+    """Step-invariant factorisation of the GPP full-conditional
+    ``P = A - M F_blk^{-1} M'`` with ``A = LiSL + unitdiag(idD)`` (reference
+    updateEta.R:148-196).  ``idD`` (nf, np), ``M1`` (nf, np, nK), ``Fm``
+    (nf, nK, nK); returns the payload consumed by :func:`gpp_draw`."""
+    npr, nf = LiSL.shape[0], LiSL.shape[-1]
+    nK = M1.shape[2]
+    A = LiSL + jnp.eye(nf, dtype=idD.dtype)[None] * idD.T[:, :, None]
+    LA = chol_spd(A)
+    iA = jax.vmap(lambda Lc: solve_triangular(
+        Lc.T, solve_triangular(Lc, jnp.eye(nf, dtype=idD.dtype), lower=True),
+        lower=False))(LA)                               # (np, nf, nf)
+    # H = blockdiag(F_h) - M' iA M   over the (nf*nK) knot space
+    MtAM = jnp.einsum("hum,uhg,gun->hmgn", M1, iA, M1)
+    H = -MtAM
+    fi = jnp.arange(nf)
+    H = H.at[fi, :, fi, :].add(Fm)
+    LH = chol_spd(H.reshape(nf * nK, nf * nK))
+    LiA = jnp.linalg.cholesky(iA)
+    return M1, iA, LiA, LH, nK
+
+
+def gpp_draw(payload, F, eps1, eps2):
+    """Exact draw eta ~ N(P^{-1} F, P^{-1}) from a :func:`gpp_factor`
+    payload: mean via double Woodbury, noise as LiA eps1 + iA M LH^{-T} eps2
+    (covariance exactly P^{-1})."""
+    M1, iA, LiA, LH, nK = payload
+    nf = iA.shape[-1]
+    iA_rhs = jnp.einsum("uhg,ug->uh", iA, F)
+    Mt_iA_rhs = jnp.einsum("hum,uh->hm", M1, iA_rhs).reshape(-1)
+    corr = solve_triangular(
+        LH.T, solve_triangular(LH, Mt_iA_rhs, lower=True),
+        lower=False).reshape(nf, nK)
+    Mx = jnp.einsum("hum,hm->uh", M1, corr)
+    mean = iA_rhs + jnp.einsum("uhg,ug->uh", iA, Mx)
+    noise1 = jnp.einsum("uhg,ug->uh", LiA, eps1)
+    w = solve_triangular(LH.T, eps2, lower=False).reshape(nf, nK)
+    Mw = jnp.einsum("hum,hm->uh", M1, w)
+    return mean + noise1 + jnp.einsum("uhg,ug->uh", iA, Mw)
 
 
 def _gather_iW(lvd, alpha_idx):
@@ -112,22 +204,7 @@ def _eta_nngp_cg(spec, data, state, r, key, S, tol: float = 1e-5,
     lam = lambda_effective(lv)[:, :, 0]               # (nf, ns)
     coef = lvd.nn_coef[lv.alpha_idx]                  # (nf, np, k)
     sqD = jnp.sqrt(lvd.nn_D[lv.alpha_idx])            # (nf, np)
-    nn = lvd.nn_idx                                   # (np, k)
-    k_nb = nn.shape[1]
-
-    def riw_t(u):
-        """RiW' u for each factor; u, out: (np, nf)."""
-        t = u / sqD.T
-        contrib = -jnp.einsum("fik,if->ikf", coef, t)  # (np, k, nf)
-        return t + jax.ops.segment_sum(
-            contrib.reshape(npr * k_nb, nf), nn.reshape(-1), num_segments=npr)
-
-    def pmv(x):
-        """P x: Vecchia prior applied as RiW'(RiW x) + per-unit blocks."""
-        xg = x[nn]                                     # (np, k, nf)
-        red = jnp.einsum("fik,ikf->if", coef, xg)
-        Rx = (x - red) / sqD.T
-        return riw_t(Rx) + jnp.einsum("ufg,ug->uf", LiSL, x)
+    riw_t, pmv = vecchia_ops(lvd.nn_idx, coef, sqD, LiSL)
 
     k1, k2 = jax.random.split(key)
     eps1 = jax.random.normal(k1, (npr, nf), dtype=F.dtype)
@@ -135,15 +212,13 @@ def _eta_nngp_cg(spec, data, state, r, key, S, tol: float = 1e-5,
     w = xi * jnp.sqrt(state.iSigma)[None, :]
     if spec.has_na:
         w = w * data.Ymask
-    b = F + riw_t(eps1) + jax.ops.segment_sum(
-        w @ lam.T, lvd.pi_row, num_segments=npr)
-    eta, _ = jax.scipy.sparse.linalg.cg(pmv, b, x0=lv.Eta, tol=tol,
-                                        maxiter=maxiter)
+    b_like = jax.ops.segment_sum(w @ lam.T, lvd.pi_row, num_segments=npr)
+    eta, res = vecchia_cg_draw(riw_t, pmv, F, b_like, eps1, x0=lv.Eta,
+                               tol=tol, maxiter=maxiter)
     # cg returns its current iterate at maxiter with no signal; a stalled
     # solve would silently bias the chain.  Check the relative residual and
     # poison the draw to NaN instead — the sampler's divergence containment
     # then reports the chain and first bad sweep loudly.
-    res = jnp.linalg.norm(pmv(eta) - b) / jnp.maximum(jnp.linalg.norm(b), 1e-30)
     thresh = max(100.0 * tol, 1e-3)       # scales with the requested tol
     eta = jnp.where(res < thresh, eta, jnp.nan)
     return lv.replace(Eta=eta)
@@ -161,43 +236,14 @@ def _eta_gpp(spec, data, state, r, key, S):
     idD = lvd.idDg[lv.alpha_idx]                  # (nf, np)
     alpha0 = (lvd.alphapw[lv.alpha_idx, 0] == 0)  # alpha=0 slots: W=I
     idD = jnp.where(alpha0[:, None], 1.0, idD)
-    A = LiSL + jnp.eye(nf, dtype=F.dtype)[None] * idD.T[:, :, None]  # (np, nf, nf)
-    LA = chol_spd(A)
-    iA = jax.vmap(lambda Lc: solve_triangular(
-        Lc.T, solve_triangular(Lc, jnp.eye(nf, dtype=F.dtype), lower=True),
-        lower=False))(LA)                         # (np, nf, nf)
-
     M1 = lvd.idDW12g[lv.alpha_idx]                # (nf, np, nK)
     M1 = jnp.where(alpha0[:, None, None], 0.0, M1)
     Fm = lvd.Fg[lv.alpha_idx]                     # (nf, nK, nK)
-    # H = blockdiag(F_h) - M' iA M   over the (nf*nK) knot space
-    MtAM = jnp.einsum("hum,uhg,gun->hmgn", M1, iA, M1)
-    H = -MtAM
-    fi = jnp.arange(nf)
-    H = H.at[fi, :, fi, :].add(Fm)
-    H = H.reshape(nf * nK, nf * nK)
-    LH = chol_spd(H)
-
-    # mean = iA rhs + iA M H^{-1} M' iA rhs;  rhs per (u, h)
-    iA_rhs = jnp.einsum("uhg,ug->uh", iA, F)
-    Mt_iA_rhs = jnp.einsum("hum,uh->hm", M1, iA_rhs).reshape(-1)
-    corr = solve_triangular(
-        LH.T, solve_triangular(LH, Mt_iA_rhs, lower=True), lower=False)
-    corr = corr.reshape(nf, nK)
-    Mx = jnp.einsum("hum,hm->uh", M1, corr)
-    iAM_corr = jnp.einsum("uhg,ug->uh", iA, Mx)
-    mean = iA_rhs + iAM_corr
-
+    payload = gpp_factor(LiSL, idD, M1, Fm)
     k1, k2 = jax.random.split(key)
     eps1 = jax.random.normal(k1, (npr, nf), dtype=F.dtype)
-    # LiA: lower cholesky of iA per unit
-    LiA = jnp.linalg.cholesky(iA)
-    noise1 = jnp.einsum("uhg,ug->uh", LiA, eps1)
     eps2 = jax.random.normal(k2, (nf * nK,), dtype=F.dtype)
-    w = solve_triangular(LH.T, eps2, lower=False).reshape(nf, nK)
-    Mw = jnp.einsum("hum,hm->uh", M1, w)
-    noise2 = jnp.einsum("uhg,ug->uh", iA, Mw)
-    eta = mean + noise1 + noise2
+    eta = gpp_draw(payload, F, eps1, eps2)
     return lv.replace(Eta=eta)
 
 

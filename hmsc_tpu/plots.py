@@ -24,21 +24,26 @@ def _ax(ax):
     return ax
 
 
+def _mode_matrix(est, plot_type, support_level):
+    """The displayed matrix for the reference's three plot modes."""
+    mean = est["mean"]
+    if plot_type == "Mean":
+        return mean
+    if plot_type == "Support":
+        return np.where(est["support"] > support_level, est["support"],
+                        np.where(est["supportNeg"] > support_level,
+                                 -est["supportNeg"], 0.0))
+    if plot_type == "Sign":
+        sig = (est["support"] > support_level) \
+            | (est["supportNeg"] > support_level)
+        return np.where(sig, np.sign(mean), 0.0)
+    raise ValueError("plotType must be 'Mean', 'Support' or 'Sign'")
+
+
 def _support_plot(est, row_names, col_names, plot_type, support_level, ax,
                   title):
     ax = _ax(ax)
-    mean = est["mean"]
-    if plot_type == "Mean":
-        M = mean
-    elif plot_type == "Support":
-        M = np.where(est["support"] > support_level, est["support"],
-                     np.where(est["supportNeg"] > support_level,
-                              -est["supportNeg"], 0.0))
-    elif plot_type == "Sign":
-        sig = (est["support"] > support_level) | (est["supportNeg"] > support_level)
-        M = np.where(sig, np.sign(mean), 0.0)
-    else:
-        raise ValueError("plotType must be 'Mean', 'Support' or 'Sign'")
+    M = _mode_matrix(est, plot_type, support_level)
     vmax = np.max(np.abs(M)) or 1.0
     im = ax.imshow(M, cmap="RdBu_r", vmin=-vmax, vmax=vmax, aspect="auto")
     ax.set_xticks(range(len(col_names)))
@@ -51,14 +56,60 @@ def _support_plot(est, row_names, col_names, plot_type, support_level, ax,
 
 
 def plot_beta(post, plot_type: str = "Support", support_level: float = 0.89,
-              ax=None):
+              ax=None, *, plot_tree: bool = False):
     """Heatmap of species' environmental responses Beta (covariates x
-    species), reference ``plotBeta.R`` (the optional phylo-tree side panel is
-    not drawn)."""
+    species), reference ``plotBeta.R``.
+
+    ``plot_tree=True`` draws the phylogeny side panel (reference
+    ``plotBeta.R:59-264``, which renders the ``ape`` tree): species move to
+    the y-axis ordered by an average-linkage dendrogram of the phylogenetic
+    correlation ``C`` (distance ``1 - C``), drawn left of the heatmap with
+    leaves aligned to the rows.  Requires a model built with ``C``.
+    """
     hM = post.hM
     est = post.get_post_estimate("Beta")
-    return _support_plot(est, hM.cov_names, hM.sp_names, plot_type,
-                         support_level, ax, "Beta")
+    if not plot_tree:
+        return _support_plot(est, hM.cov_names, hM.sp_names, plot_type,
+                             support_level, ax, "Beta")
+    if hM.C is None:
+        raise ValueError(
+            "Hmsc.plotBeta: plot_tree requires a model with a phylogenetic "
+            "correlation matrix C")
+    if ax is not None:
+        raise ValueError(
+            "Hmsc.plotBeta: plot_tree draws its own two-panel figure; "
+            "the ax argument cannot be combined with it")
+    import matplotlib.pyplot as plt
+    from scipy.cluster import hierarchy
+    from scipy.spatial.distance import squareform
+
+    D = 1.0 - np.asarray(hM.C, dtype=float)
+    D = np.clip((D + D.T) / 2.0, 0.0, None)
+    np.fill_diagonal(D, 0.0)
+    Z = hierarchy.linkage(squareform(D, checks=False), method="average")
+    fig, (ax_t, ax_h) = plt.subplots(
+        1, 2, figsize=(9, max(4, 0.3 * hM.ns + 2)),
+        gridspec_kw={"width_ratios": [1, 3], "wspace": 0.02})
+    dn = hierarchy.dendrogram(Z, orientation="left", ax=ax_t, no_labels=True,
+                              color_threshold=0,
+                              above_threshold_color="#555555")
+    order = dn["leaves"]                        # bottom-to-top species order
+    M = _mode_matrix(est, plot_type, support_level)[:, order].T  # (ns, nc)
+    vmax = np.max(np.abs(M)) or 1.0
+    # dendrogram leaf h sits at y = 5 + 10 h; the extent puts heatmap row h
+    # exactly there so the panels align
+    im = ax_h.imshow(M, cmap="RdBu_r", vmin=-vmax, vmax=vmax, aspect="auto",
+                     origin="lower", extent=(-0.5, M.shape[1] - 0.5,
+                                             0, 10 * hM.ns))
+    ax_t.set_ylim(0, 10 * hM.ns)
+    ax_t.set_axis_off()
+    ax_h.set_yticks(5 + 10 * np.arange(hM.ns))
+    ax_h.set_yticklabels([hM.sp_names[j] for j in order], fontsize=7)
+    ax_h.set_xticks(range(len(hM.cov_names)))
+    ax_h.set_xticklabels(hM.cov_names, rotation=90, fontsize=7)
+    ax_h.set_title("Beta")
+    fig.colorbar(im, ax=ax_h, shrink=0.8)
+    return ax_h
 
 
 def plot_gamma(post, plot_type: str = "Support", support_level: float = 0.89,

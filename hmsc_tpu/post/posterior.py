@@ -34,6 +34,10 @@ class Posterior:
         self.thin = thin
         self.n_chains = next(iter(self.arrays.values())).shape[0] if self.arrays else 0
         self.timing = None          # {"setup_s", "run_s"} set by sample_mcmc
+        # {level: (chains,) int} blocked factor-growth attempts per chain,
+        # set by sample_mcmc (empty when unknown, e.g. from_prior/subset-free
+        # construction)
+        self.nf_saturation = {}
         # divergence health: first non-finite sweep per chain (-1 = clean),
         # set by sample_mcmc; poisoned chains are excluded from pooled()
         self.chain_health = {"first_bad_it": np.full(self.n_chains, -1),
@@ -78,6 +82,8 @@ class Posterior:
                         samples=arrays["Beta"].shape[1],
                         transient=self.transient, thin=self.thin * thin)
         sub.set_chain_health(self.chain_health["first_bad_it"][ci])
+        sub.nf_saturation = {r: np.asarray(v)[ci]
+                             for r, v in self.nf_saturation.items()}
         return sub
 
     def pooled(self, name: str) -> np.ndarray:

@@ -452,29 +452,18 @@ def _conditional_mcmc(hM, spec, post, Xn, x_is_list, XRRR, Beta, sigma, Yc,
                 solver_r.append(("dense", jnp.linalg.cholesky(
                     P4.reshape(npr * nf, npr * nf))))
             elif mode_r[r] == "nngp":
+                from ..mcmc.spatial import vecchia_ops
                 nn, coef_g, Dg = nngp_r[r]
                 coef = coef_g[alphas[r]]              # (nf, np, k)
                 sqD = jnp.sqrt(Dg[alphas[r]])         # (nf, np)
-                solver_r.append(("nngp", (nn, coef, sqD, LiSL)))
+                solver_r.append(("nngp", vecchia_ops(nn, coef, sqD, LiSL)))
             elif mode_r[r] == "gpp":
+                from ..mcmc.spatial import gpp_factor
                 idDg, M1g, Fg = gpp_r[r]
-                idD = idDg[alphas[r]]                 # (nf, np)
-                M1 = M1g[alphas[r]]                   # (nf, np, nK)
-                Fm = Fg[alphas[r]]                    # (nf, nK, nK)
-                nK = M1.shape[2]
-                A = LiSL + jnp.eye(nf, dtype=idD.dtype)[None] \
-                    * idD.T[:, :, None]               # (np, nf, nf)
-                LA = jnp.linalg.cholesky(A)
-                iA = jax.vmap(lambda Lc: solve_triangular(
-                    Lc.T, solve_triangular(Lc, jnp.eye(nf, dtype=idD.dtype),
-                                           lower=True), lower=False))(LA)
-                MtAM = jnp.einsum("hum,uhg,gun->hmgn", M1, iA, M1)
-                H = -MtAM
-                fi = jnp.arange(nf)
-                H = H.at[fi, :, fi, :].add(Fm)
-                LH = jnp.linalg.cholesky(H.reshape(nf * nK, nf * nK))
-                LiA = jnp.linalg.cholesky(iA)
-                solver_r.append(("gpp", (M1, iA, LiA, LH, nK)))
+                # pred-unit grids degrade to the identity prior naturally at
+                # alpha=0 (W12=0, dD=1 in precompute._gpp_grids) — no guard
+                solver_r.append(("gpp", gpp_factor(
+                    LiSL, idDg[alphas[r]], M1g[alphas[r]], Fg[alphas[r]])))
             else:
                 solver_r.append(("none", jnp.linalg.cholesky(
                     LiSL + jnp.eye(nf, dtype=LiSL.dtype)[None])))
@@ -509,53 +498,26 @@ def _conditional_mcmc(hM, spec, post, Xn, x_is_list, XRRR, Beta, sigma, Yc,
                     noise = solve_triangular(Lc.T, eps, lower=False)
                     eta_new = (mean + noise).reshape(npr, nf)
                 elif mode == "nngp":
-                    nn, coef, sqD, LiSL_l = payload
-                    k_nb = nn.shape[1]
-
-                    def riw_t(u):
-                        """RiW' u per factor; u, out: (np, nf)."""
-                        t = u / sqD.T
-                        contrib = -jnp.einsum("fik,if->ikf", coef, t)
-                        return t + jax.ops.segment_sum(
-                            contrib.reshape(npr * k_nb, nf), nn.reshape(-1),
-                            num_segments=npr)
-
-                    def pmv(x):
-                        xg = x[nn]                    # (np, k, nf)
-                        red = jnp.einsum("fik,ikf->if", coef, xg)
-                        Rx = (x - red) / sqD.T
-                        return riw_t(Rx) + jnp.einsum("ufg,ug->uf", LiSL_l, x)
-
+                    from ..mcmc.spatial import vecchia_cg_draw
+                    riw_t, pmv = payload
                     ka, kb = jax.random.split(kr)
                     eps1 = jax.random.normal(ka, (npr, nf), dtype=Fr.dtype)
                     xi = jax.random.normal(kb, mask.shape, dtype=Fr.dtype)
-                    w = xi * jnp.sqrt(isig)[None, :] * mask
-                    b = Fr + riw_t(eps1) + jax.ops.segment_sum(
-                        w @ lam.T, pi_r[r], num_segments=npr)
-                    eta_new, _ = jax.scipy.sparse.linalg.cg(
-                        pmv, b, x0=etas[r], tol=1e-5, maxiter=500)
+                    b_like = jax.ops.segment_sum(
+                        (xi * jnp.sqrt(isig)[None, :] * mask) @ lam.T,
+                        pi_r[r], num_segments=npr)
+                    eta_new, res = vecchia_cg_draw(riw_t, pmv, Fr, b_like,
+                                                   eps1, x0=etas[r])
                     # count stalled solves; the maxiter iterate is kept (an
                     # approximate draw) and the host warns post-run
-                    res = jnp.linalg.norm(pmv(eta_new) - b) \
-                        / jnp.maximum(jnp.linalg.norm(b), 1e-30)
                     fail = fail + (res >= 1e-3).astype(jnp.int32)
                 elif mode == "gpp":
-                    M1, iA, LiA, LH, nK = payload
-                    iA_rhs = jnp.einsum("uhg,ug->uh", iA, Fr)
-                    Mt = jnp.einsum("hum,uh->hm", M1, iA_rhs).reshape(-1)
-                    corr = solve_triangular(
-                        LH.T, solve_triangular(LH, Mt, lower=True),
-                        lower=False).reshape(nf, nK)
-                    Mx = jnp.einsum("hum,hm->uh", M1, corr)
-                    mean = iA_rhs + jnp.einsum("uhg,ug->uh", iA, Mx)
+                    from ..mcmc.spatial import gpp_draw
+                    nK = payload[-1]
                     ka, kb = jax.random.split(kr)
                     eps1 = jax.random.normal(ka, (npr, nf), dtype=Fr.dtype)
-                    noise1 = jnp.einsum("uhg,ug->uh", LiA, eps1)
                     eps2 = jax.random.normal(kb, (nf * nK,), dtype=Fr.dtype)
-                    w2 = solve_triangular(LH.T, eps2,
-                                          lower=False).reshape(nf, nK)
-                    Mw = jnp.einsum("hum,hm->uh", M1, w2)
-                    eta_new = mean + noise1 + jnp.einsum("uhg,ug->uh", iA, Mw)
+                    eta_new = gpp_draw(payload, Fr, eps1, eps2)
                 else:
                     Lc = payload
                     mean = cho_solve((Lc, True), Fr[..., None])[..., 0]
