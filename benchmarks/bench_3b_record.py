@@ -1,0 +1,67 @@
+"""Config-3b (NNGP np=1000) throughput vs recorded-parameter selection.
+
+Round-3 verdict weak #2: config 3b was the one axis below the 50x standard
+(9.3x), known to be transfer-bound — Eta (np=1000 x nf per draw) is the
+largest recorded block and CV/WAIC/variance-partitioning never read it.
+This probe measures samples/sec for (a) full recording, (b) record= without
+Eta, (c) b + bf16 record_dtype, against the NumPy reference engine's
+sweeps/sec, and prints one JSON line per variant.
+
+Run on the TPU host: ``python benchmarks/bench_3b_record.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+import jax.numpy as jnp
+
+from run_benchmarks import (CHAINS, SAMPLES, TRANSIENT, baseline_rate,
+                            config3_spatial_nngp)
+from hmsc_tpu.mcmc.sampler import sample_mcmc
+
+
+def rate(m, kw, reps=3, **extra):
+    sample_mcmc(m, samples=SAMPLES, transient=TRANSIENT, n_chains=CHAINS,
+                seed=0, align_post=False, **kw, **extra)     # compile
+    t = np.inf
+    for rep in range(reps):
+        t0 = time.time()
+        post = sample_mcmc(m, samples=SAMPLES, transient=TRANSIENT,
+                           n_chains=CHAINS, seed=1 + rep, align_post=False,
+                           **kw, **extra)
+        t = min(t, time.time() - t0)
+        assert np.isfinite(post["Beta"]).all()
+    return CHAINS * SAMPLES / t, CHAINS * (SAMPLES + TRANSIENT) / t
+
+
+def main():
+    rng = np.random.default_rng(42)
+    m, kw = config3_spatial_nngp(rng)
+    base = baseline_rate("3b", m, nf=kw.get("nf_cap", 2))
+    no_eta = ("Beta", "Lambda", "Psi", "Delta", "Alpha", "sigma")
+    variants = [
+        ("full", {}),
+        ("record_no_eta", {"record": no_eta}),
+        ("record_no_eta_bf16", {"record": no_eta,
+                                "record_dtype": jnp.bfloat16}),
+    ]
+    for name, extra in variants:
+        r_samp, r_sweep = rate(m, kw, **extra)
+        print(json.dumps({
+            "variant": name,
+            "samples_per_s": round(r_samp, 1),
+            "vs_baseline": round(r_sweep / base, 1),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -62,11 +62,12 @@ def config3b(rng):
     return m, dict(nf_cap=2)
 
 
-def diagnose(name, samples=250, transient=125, thin=4, n_chains=4, seed=11):
+def diagnose(name, samples=250, transient=125, thin=4, n_chains=4, seed=11,
+             updater=None):
     rng = np.random.default_rng(0)
     m, kw = (config2 if name == "config2" else config3b)(rng)
     post = sample_mcmc(m, samples=samples, transient=transient, thin=thin,
-                       n_chains=n_chains, seed=seed, **kw)
+                       n_chains=n_chains, seed=seed, updater=updater, **kw)
     B = post["Beta"]                                  # (c, s, nc, ns)
     ess = effective_size(B)                           # (nc, ns)
     lam = post.pooled("Lambda_0")
@@ -92,6 +93,9 @@ def diagnose(name, samples=250, transient=125, thin=4, n_chains=4, seed=11):
     tail = lam_abs[nf_act // 2:nf_act].sum(axis=0) if nf_act > 1 else lam_abs[0]
     head = lam_abs[:max(nf_act // 2, 1)].sum(axis=0)
     ess_sp = ess.min(axis=0)
+    # the translation-ridge coordinate: per-factor Eta column means
+    eta = post["Eta_0"]                               # (c, s, np, nf)
+    ess_eta_mean = effective_size(eta.mean(axis=2))   # (nf,)
     report = {
         "config": name,
         "n_draws": int(B.shape[0] * B.shape[1]),
@@ -100,6 +104,8 @@ def diagnose(name, samples=250, transient=125, thin=4, n_chains=4, seed=11):
         "delta_mean": [round(float(d), 2) for d in delta.mean(axis=0)[:nf_act]],
         "corr_minESS_tailloading": float(np.corrcoef(ess_sp, tail)[0, 1]),
         "corr_minESS_headloading": float(np.corrcoef(ess_sp, head)[0, 1]),
+        "ess_eta_colmean": [round(float(v), 1)
+                            for v in ess_eta_mean[:nf_act]],
         "worst_entries": worst,
         "run_s": post.timing["run_s"],
     }
@@ -109,4 +115,7 @@ def diagnose(name, samples=250, transient=125, thin=4, n_chains=4, seed=11):
 
 if __name__ == "__main__":
     which = sys.argv[1] if len(sys.argv) > 1 else "config2"
-    diagnose(which)
+    upd = None
+    if len(sys.argv) > 2 and sys.argv[2] == "nointerweave":
+        upd = {"Interweave": False}
+    diagnose(which, updater=upd)

@@ -256,6 +256,10 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
     - ``updater={"Interweave": False}`` disables the beyond-reference
       per-factor (Eta, Lambda) scale interweaving (on by default; targets
       the identical posterior — see ``updaters.interweave_scale``).
+      ``updater={"InterweaveLocation": True}`` additionally enables the
+      (Eta, Beta_intercept) location move (exact, Geweke-validated, but no
+      measured ESS gain at benchmark scales — see
+      ``updaters.interweave_location``).
     - ``nf_cap`` bounds the per-level latent factor count (static XLA
       shapes; the reference instead grows nf up to ns).  Pick it a little
       above the factor count you expect; if burn-in adaptation saturates the

@@ -126,12 +126,20 @@ def make_sweep(spec: ModelSpec, updater: dict | None = None,
                     levels[r] = lv
                     state = state.replace(levels=tuple(levels))
 
-        # beyond-reference: per-factor (Eta, Lambda) scale interweaving.
-        # Leaves the Eta*Lambda loading invariant, so E_shared stays valid.
-        # Gated on the updaters it perturbs: a frozen Eta/BetaLambda run
-        # (debugging, conditional sampling) must not see drifting Eta/Lambda
-        if spec.nr > 0 and on("Interweave") and on("Eta") and on("BetaLambda"):
-            state = U.interweave_scale(spec, data, state, ks[12])
+        # beyond-reference: per-factor (Eta, Lambda) scale interweaving
+        # (default on; measured 2x ESS on association scales) and the
+        # opt-in (Eta, Beta_intercept) location move (no measured gain at
+        # config-2 scale — see updaters.interweave_location).  Both leave
+        # the linear predictor invariant, so E_shared stays valid.  Gated on
+        # the updaters they perturb: a frozen Eta/BetaLambda run (debugging,
+        # conditional sampling) must not see drifting Eta/Lambda/Beta
+        iw_ok = spec.nr > 0 and on("Eta") and on("BetaLambda")
+        if iw_ok and (on("Interweave") or want("InterweaveLocation")):
+            kI1, kI2 = jax.random.split(ks[12])
+            if on("Interweave"):
+                state = U.interweave_scale(spec, data, state, kI1)
+            if want("InterweaveLocation"):
+                state = U.interweave_location(spec, data, state, kI2)
 
         if on("InvSigma"):
             state = U.update_inv_sigma(spec_x, data_x, state, ks[6],

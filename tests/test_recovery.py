@@ -333,6 +333,36 @@ def test_interweave_preserves_stationary_distribution():
     assert abs(res["plain"][1] - res["iw"][1]) < 0.05 * res["plain"][1], res
 
 
+def test_interweave_location_preserves_stationary_distribution():
+    """The opt-in (Eta, Beta_intercept) location move
+    (updaters.interweave_location) is exact Gibbs along the
+    likelihood-invariant translation orbit, so the posterior must be
+    IDENTICAL with and without it: compare long-run means of the intercept
+    Beta row and the Eta column mean on a model where the mean split is well
+    identified (shared units pin Eta)."""
+    rng = np.random.default_rng(9)
+    n_units, per, ns = 25, 5, 8
+    ny = n_units * per
+    unit_of = np.repeat(np.arange(n_units), per)
+    eta = rng.standard_normal(n_units)
+    lam = rng.standard_normal(ns)
+    Y = 0.7 + np.outer(eta[unit_of], lam) + 0.5 * rng.standard_normal((ny, ns))
+    study = pd.DataFrame({"u": [f"s{u:02d}" for u in unit_of]})
+    rl = HmscRandomLevel(units=study["u"])
+    set_priors_random_level(rl, nf_max=1, nf_min=1)
+    m = Hmsc(Y=Y, X=np.ones((ny, 1)), distr="normal", study_design=study,
+             ran_levels={"u": rl}, x_scale=False)
+    res = {}
+    for tag, upd in [("plain", None), ("loc", {"InterweaveLocation": True})]:
+        post = sample_mcmc(m, samples=1500, transient=500, n_chains=2,
+                           seed=13, nf_cap=1, updater=upd, align_post=False)
+        b0 = post.pooled("Beta")[:, 0, :].mean()
+        em = post.pooled("Eta_0")[:, :, 0].mean()
+        res[tag] = (b0, em)
+    assert abs(res["plain"][0] - res["loc"][0]) < 0.04, res
+    assert abs(res["plain"][1] - res["loc"][1]) < 0.04, res
+
+
 def test_distmat_level_end_to_end():
     """Distance-matrix random level (reference HmscRandomLevel(distMat=),
     Full method only): sampling must run finite and put posterior alpha mass
