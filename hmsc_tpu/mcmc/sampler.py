@@ -371,6 +371,23 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 print(f"Setting updater${name}=FALSE: {gates[name]}")
                 updater[name] = False
 
+    # structural gate for the opt-in location interweave (same print-style
+    # as the collapsed-updater gates above, so a silent no-op can't be
+    # mistaken for "the move doesn't help")
+    if updater and updater.get("InterweaveLocation") is True:
+        reason = None
+        if hM.x_intercept_ind is None:
+            reason = "the design has no intercept column to shift"
+        elif spec.x_is_list:
+            reason = "per-species design matrices"
+        elif spec.ncsel > 0:
+            reason = "variable selection's effective-Beta zeroing breaks " \
+                     "the move's likelihood invariance"
+        if reason:
+            print(f"Setting updater$InterweaveLocation=FALSE: {reason}")
+            updater = dict(updater)
+            updater["InterweaveLocation"] = False
+
     updater_items = (tuple(sorted(updater.items())) if updater else None)
     sharding = None
     if mesh is not None:

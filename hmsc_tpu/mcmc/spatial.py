@@ -299,6 +299,39 @@ def eta_quad_at(lvd, ls, eta, alpha_idx):
     return jnp.where(lvd.alphapw[alpha_idx, 0] == 0, q_full, t1 - t2)
 
 
+def eta_ones_forms_at(lvd, ls, eta, alpha_idx):
+    """``(1' iW_h 1, 1' iW_h eta_h)`` per factor at each factor's current
+    alpha, with ONE gather of the level's prior structures (the location
+    interweave needs both; three :func:`eta_quad_at` polarization calls
+    would triple the prior-quadratic cost)."""
+    npr = eta.shape[0]
+    if ls.spatial == "Full":
+        iW = lvd.iWg[alpha_idx]                               # (nf, np, np)
+        w = iW.sum(axis=2)                                    # iW_h @ 1
+        return w.sum(axis=1), jnp.einsum("hu,uh->h", w, eta)
+    if ls.spatial == "NNGP":
+        coef = lvd.nn_coef[alpha_idx]                         # (nf, np, k)
+        D = lvd.nn_D[alpha_idx]                               # (nf, np)
+        # RiW x rows: (x_i - sum_k A[i,k] x_nn[i,k]) / sqrt(D_i)
+        sqD = jnp.sqrt(D)
+        r1 = (1.0 - coef.sum(axis=2)) / sqD                   # RiW @ 1
+        pred = jnp.einsum("hik,ikh->hi", coef, eta[lvd.nn_idx])
+        re = (eta.T - pred) / sqD                             # RiW @ eta
+        return (r1**2).sum(axis=1), (r1 * re).sum(axis=1)
+    # GPP: x' iW y = sum_u idD x y - (x' M1) iF (M1' y); alpha=0 -> I
+    idD = lvd.idDg[alpha_idx]                                 # (nf, np)
+    W12 = lvd.idDW12g[alpha_idx]                              # (nf, np, nK)
+    iF = lvd.iFg[alpha_idx]                                   # (nf, nK, nK)
+    E1 = W12.sum(axis=1)                                      # 1' idDW12
+    Ee = jnp.einsum("uh,hum->hm", eta, W12)
+    q1 = idD.sum(axis=1) - jnp.einsum("hm,hmn,hn->h", E1, iF, E1)
+    s = jnp.einsum("hu,uh->h", idD, eta) \
+        - jnp.einsum("hm,hmn,hn->h", E1, iF, Ee)
+    zero = lvd.alphapw[alpha_idx, 0] == 0
+    return (jnp.where(zero, float(npr), q1),
+            jnp.where(zero, eta.sum(axis=0), s))
+
+
 def update_alpha(spec: ModelSpec, data: ModelData, state: GibbsState, r: int,
                  key) -> LevelState:
     """Per-factor categorical draw of the GP range on the alphapw grid:

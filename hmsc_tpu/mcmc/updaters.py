@@ -22,7 +22,7 @@ __all__ = ["linear_fixed", "level_loading", "update_z", "update_beta_lambda",
            "update_gamma_v", "gamma_given_beta", "update_rho",
            "update_lambda_priors", "update_eta_nonspatial",
            "update_inv_sigma", "update_nf", "eta_star", "lambda_effective",
-           "interweave_scale"]
+           "interweave_scale", "interweave_location"]
 
 _NB_R = 1e3  # Poisson as the r->inf limit of NB (reference updateZ.R:68)
 
@@ -573,13 +573,8 @@ def interweave_location(spec: ModelSpec, data: ModelData, state: GibbsState,
             q1 = jnp.full((ls.nf_max,), float(ls.n_units), dtype=lam.dtype)
             s = lv.Eta.sum(axis=0)                        # 1' eta_h
         else:
-            from .spatial import eta_quad_at
-            ones = jnp.ones_like(lv.Eta)
-            qo = eta_quad_at(lvd, ls, ones, lv.alpha_idx)      # 1' iW 1
-            qe = eta_quad_at(lvd, ls, lv.Eta, lv.alpha_idx)
-            qep = eta_quad_at(lvd, ls, lv.Eta + ones, lv.alpha_idx)
-            q1 = qo
-            s = 0.5 * (qep - qe - qo)                     # 1' iW eta_h
+            from .spatial import eta_ones_forms_at
+            q1, s = eta_ones_forms_at(lvd, ls, lv.Eta, lv.alpha_idx)
         if spec.has_phylo:
             e = data.Qeig[state.rho_idx]                  # (ns,)
             lamU = lam @ data.U
@@ -591,11 +586,9 @@ def interweave_location(spec: ModelSpec, data: ModelData, state: GibbsState,
         P = v00 * G + jnp.diag(jnp.where(mask > 0, q1, 1.0))
         b = jnp.where(mask > 0, bB - s, 0.0)
         L = chol_spd(P)
-        from jax.scipy.linalg import cho_solve
-        mean = cho_solve((L, True), b)
         z = jax.random.normal(jax.random.fold_in(key, r), b.shape,
                               dtype=b.dtype)
-        c = (mean + solve_triangular(L.T, z, lower=False)) * mask
+        c = sample_mvn_prec(L, b, z) * mask
         Beta = Beta.at[ii].add(-(c @ lam))
         new_levels.append(lv.replace(Eta=lv.Eta + c[None, :]))
     return state.replace(levels=tuple(new_levels), Beta=Beta)

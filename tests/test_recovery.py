@@ -204,6 +204,20 @@ def test_multidevice_chains_by_species_mesh():
     assert c > 0.99, c
 
 
+def test_multidevice_mesh_with_record_selection():
+    """record= must compose with the mesh path: the packed record fetch only
+    sees the kept leaves, and sharded chains still exclude Eta."""
+    from jax.sharding import Mesh
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ("chains",))
+    m = small_model(distr="probit", ny=30, ns=6, seed=83)
+    post = sample_mcmc(m, samples=10, transient=10, n_chains=8, seed=3,
+                       mesh=mesh, record=("Beta", "Lambda"))
+    assert "Eta_0" not in post.arrays and "Lambda_0" in post.arrays
+    assert np.isfinite(post["Beta"]).all()
+    assert post["Beta"].shape[:2] == (8, 10)
+
+
 def test_nngp_large_np_matrix_free():
     """NNGP at np=5000 (the regime the reference recommends NNGP for but
     cannot reach with dense (np*nf)^2 factorisations) must sample via the
