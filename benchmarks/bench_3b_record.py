@@ -53,6 +53,12 @@ def main():
         ("record_no_eta", {"record": no_eta}),
         ("record_no_eta_bf16", {"record": no_eta,
                                 "record_dtype": jnp.bfloat16}),
+        # cost attribution: recorded blocks at this config are only ~10 MB
+        # (~0.3 s of wall over the tunnel), so if record= barely moves the
+        # rate, the gap lives in compute — the ablations below bound the
+        # 101-point alpha scan and the NNGP Eta solve
+        ("ablate_alpha", {"updater": {"Alpha": False}}),
+        ("ablate_alpha_eta", {"updater": {"Alpha": False, "Eta": False}}),
     ]
     for name, extra in variants:
         r_samp, r_sweep = rate(m, kw, **extra)

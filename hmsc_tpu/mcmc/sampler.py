@@ -375,14 +375,9 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
     # as the collapsed-updater gates above, so a silent no-op can't be
     # mistaken for "the move doesn't help")
     if updater and updater.get("InterweaveLocation") is True:
-        reason = None
-        if hM.x_intercept_ind is None:
-            reason = "the design has no intercept column to shift"
-        elif spec.x_is_list:
-            reason = "per-species design matrices"
-        elif spec.ncsel > 0:
-            reason = "variable selection's effective-Beta zeroing breaks " \
-                     "the move's likelihood invariance"
+        from .updaters import location_gate
+        reason = location_gate(spec,
+                               has_intercept=hM.x_intercept_ind is not None)
         if reason:
             print(f"Setting updater$InterweaveLocation=FALSE: {reason}")
             updater = dict(updater)

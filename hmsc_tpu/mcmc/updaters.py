@@ -22,7 +22,7 @@ __all__ = ["linear_fixed", "level_loading", "update_z", "update_beta_lambda",
            "update_gamma_v", "gamma_given_beta", "update_rho",
            "update_lambda_priors", "update_eta_nonspatial",
            "update_inv_sigma", "update_nf", "eta_star", "lambda_effective",
-           "interweave_scale", "interweave_location"]
+           "interweave_scale", "interweave_location", "location_gate"]
 
 _NB_R = 1e3  # Poisson as the r->inf limit of NB (reference updateZ.R:68)
 
@@ -523,6 +523,21 @@ def interweave_scale(spec: ModelSpec, data: ModelData, state: GibbsState,
     return state.replace(levels=tuple(new_levels))
 
 
+def location_gate(spec: ModelSpec, has_intercept: bool) -> str | None:
+    """Why :func:`interweave_location` cannot run on this model, or ``None``
+    when eligible — the single source for both the updater's guard and the
+    sampler's opt-in gate message (a silent structural no-op must never look
+    like "the move doesn't help")."""
+    if not has_intercept:
+        return "the design has no intercept column to shift"
+    if spec.x_is_list:
+        return "per-species design matrices"
+    if spec.ncsel > 0:
+        return ("variable selection's effective-Beta zeroing breaks the "
+                "move's likelihood invariance")
+    return None
+
+
 def interweave_location(spec: ModelSpec, data: ModelData, state: GibbsState,
                         key) -> GibbsState:
     """Per-factor location move (Eta_h, Beta_int) -> (Eta_h + c_h 1,
@@ -547,13 +562,12 @@ def interweave_location(spec: ModelSpec, data: ModelData, state: GibbsState,
     The joint nf-dim Gaussian for c has precision
     ``P = diag(1' iW_h 1) + iV_int,int Lam iQ Lam'`` and linear term
     ``Lam iQ (R' iV e_int) - 1' iW_h eta_h`` with R = Beta - Gamma Tr'
-    (iQ = I without phylogeny); spatial prior quadratics come from
-    :func:`~hmsc_tpu.mcmc.spatial.eta_quad_at` by polarization.  Skipped
-    when there is no intercept column, with per-species designs, or under
-    variable selection (the effective-Beta zeroing breaks invariance);
-    covariate-dependent levels are left untouched (their factor term is not
-    row-constant)."""
-    if data.x_intercept_ind is None or spec.x_is_list or spec.ncsel > 0:
+    (iQ = I without phylogeny); the spatial ``(1'iW1, 1'iW eta)`` forms come
+    from :func:`~hmsc_tpu.mcmc.spatial.eta_ones_forms_at` in one structure
+    gather.  Structural eligibility lives in :func:`location_gate` (shared
+    with the sampler's opt-in gate message); covariate-dependent levels are
+    left untouched (their factor term is not row-constant)."""
+    if location_gate(spec, has_intercept=data.x_intercept_ind is not None):
         return state
     ii = data.x_intercept_ind
     Beta = state.Beta

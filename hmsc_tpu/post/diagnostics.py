@@ -20,13 +20,23 @@ class CodaExport(dict):
 
 
 def _autocov_fft(x: np.ndarray) -> np.ndarray:
-    """Autocovariance per chain along axis 1 via FFT; x (chains, n, ...)."""
+    """Autocovariance per chain along axis 1 via FFT; x (chains, n, ...).
+
+    Entries are processed in slices: the rfft intermediate is complex128 at
+    ~2n points per entry, so one shot over a 10^6-entry Beta/Omega pass
+    would materialise tens of GB."""
     n = x.shape[1]
     xc = x - x.mean(axis=1, keepdims=True)
     nfft = int(2 ** np.ceil(np.log2(2 * n)))
-    f = np.fft.rfft(xc, n=nfft, axis=1)
-    acov = np.fft.irfft(f * np.conj(f), n=nfft, axis=1)[:, :n]
-    return acov / n
+    flat = xc.reshape(x.shape[0], n, -1)
+    K = flat.shape[2]
+    step = max(1, int(2e8 // (x.shape[0] * nfft * 16)))   # ~200 MB complex
+    out = np.empty_like(flat)
+    for j0 in range(0, K, step):
+        f = np.fft.rfft(flat[:, :, j0:j0 + step], n=nfft, axis=1)
+        out[:, :, j0:j0 + step] = np.fft.irfft(
+            f * np.conj(f), n=nfft, axis=1)[:, :n]
+    return out.reshape(x.shape) / n
 
 
 def effective_size(x: np.ndarray) -> np.ndarray:

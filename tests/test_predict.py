@@ -397,6 +397,36 @@ def test_spatial_conditional_fallback_warns(monkeypatch):
     assert np.isfinite(p).all()
 
 
+def test_species_fold_conditional_cv_nngp():
+    """Species-fold conditional CV (partition_sp) on an NNGP spatial model
+    must route through the structured conditional refresh without any
+    fallback warning, and beat unconditional CV on the predicted species."""
+    import warnings
+
+    from scipy.stats import norm
+
+    post, X, Y, L_true, row_te, study_te = _spatial_cond_case(
+        "NNGP", n_neighbours=8)
+    m = post.hM
+    row_tr = ~row_te
+    ny_tr = int(row_tr.sum())
+    part = np.where(np.arange(ny_tr) < ny_tr // 2, 1, 2)   # 2 site folds
+    part_sp = np.repeat([1, 2], [6, 6])
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        pred_con = compute_predicted_values(
+            post, partition=part, partition_sp=part_sp, mcmc_step=5,
+            seed=0, verbose=False)
+        pred_unc = compute_predicted_values(post, partition=part, seed=0,
+                                            verbose=False)
+    assert pred_con.shape == (post.n_chains * post.samples, m.ny, m.ns)
+    assert np.isfinite(pred_con).all()
+    p_true = norm.cdf(L_true[row_tr])
+    err_con = np.mean((pred_con.mean(axis=0) - p_true) ** 2)
+    err_unc = np.mean((pred_unc.mean(axis=0) - p_true) ** 2)
+    assert err_con < err_unc, (err_con, err_unc)
+
+
 def test_nngp_conditional_at_scale_beats_unconditional():
     """Species-fold conditional prediction on an NNGP model with np=2100
     units (4200 unit x factor coefficients — the >1000-unit regime the
