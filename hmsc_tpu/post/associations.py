@@ -11,9 +11,13 @@ __all__ = ["compute_associations"]
 
 
 def compute_associations(post, start: int = 0, thin: int = 1):
+    # per-chain windowing like the reference's poolMcmcChains(start, thin)
+    # (slicing the pooled chain-concatenated axis would thin across chain
+    # boundaries)
+    post = post.subset(start, thin)
     out = []
     for r in range(post.spec.nr):
-        lam = post.pooled(f"Lambda_{r}")[start::thin]     # (n, nf, ns[, ncr])
+        lam = post.pooled(f"Lambda_{r}")                  # (n, nf, ns[, ncr])
         lam = lam[..., 0] if lam.ndim == 4 else lam
         om = np.einsum("nfj,nfk->njk", lam, lam)
         d = np.sqrt(np.maximum(np.einsum("njj->nj", om), 1e-30))

@@ -267,8 +267,10 @@ def compute_variance_partitioning(post, group=None, group_names=None,
             f"computeVariancePartitioning: groupnames has "
             f"{len(group_names)} entries but group defines {ngroups} groups")
 
-    Beta = post.pooled("Beta")[start:]               # (n, nc, ns)
-    Gamma = post.pooled("Gamma")[start:]             # (n, nc, nt)
+    # per-chain windowing like the reference's poolMcmcChains(start)
+    post = post.subset(start)
+    Beta = post.pooled("Beta")                       # (n, nc, ns)
+    Gamma = post.pooled("Gamma")                     # (n, nc, nt)
     n_draws = Beta.shape[0]
 
     X2 = hM.X if not hM.x_is_list else None
@@ -298,7 +300,7 @@ def compute_variance_partitioning(post, group=None, group_names=None,
     # R, computeVariancePartitioning.R:159 — this is the intended quantity.)
     random1 = np.empty((n_draws, ns, nr))
     for r in range(nr):
-        lam = post.pooled(f"Lambda_{r}")[start:]
+        lam = post.pooled(f"Lambda_{r}")
         if lam.ndim == 4 and lam.shape[-1] > 1:
             xu = hM.ranLevels[r].x_for(hM.pi_names[r])
             M2 = xu.T @ xu / xu.shape[0]                   # (ncr, ncr)

@@ -75,9 +75,15 @@ class Posterior:
         window, ``poolMcmcChains.R:19-27``, ``getPostEstimate.R:30``)."""
         if start == 0 and thin == 1 and chain_index is None:
             return self
-        ci = (np.arange(self.n_chains) if chain_index is None
-              else np.atleast_1d(np.asarray(chain_index, dtype=int)))
-        arrays = {k: v[ci][:, start::thin] for k, v in self.arrays.items()}
+        if chain_index is None:
+            # basic slicing only: views, not copies (a fancy chain index
+            # would transiently duplicate every recorded array — multi-GB
+            # for Eta at scale)
+            ci = np.arange(self.n_chains)
+            arrays = {k: v[:, start::thin] for k, v in self.arrays.items()}
+        else:
+            ci = np.atleast_1d(np.asarray(chain_index, dtype=int))
+            arrays = {k: v[ci][:, start::thin] for k, v in self.arrays.items()}
         sub = Posterior(self.hM, self.spec, arrays,
                         samples=arrays["Beta"].shape[1],
                         transient=self.transient, thin=self.thin * thin)
