@@ -123,20 +123,26 @@ def test_nf_cap_saturation_warns():
     assert (post2.nf_saturation[0] == 0).all()
 
 
+def _poisoned_state(m, chain, samples=5, transient=5, n_chains=2, seed=1):
+    """A resumable carry state with one chain's Beta poisoned to NaN — the
+    shared divergence-injection rig for the containment/retry tests."""
+    import jax.numpy as jnp
+
+    _, state = sample_mcmc(m, samples=samples, transient=transient,
+                           n_chains=n_chains, seed=seed, nf_cap=2,
+                           return_state=True, align_post=False)
+    bad_beta = np.array(state.Beta)
+    bad_beta[chain, 0, 0] = np.nan
+    return state.replace(Beta=jnp.asarray(bad_beta))
+
+
 def test_divergence_containment():
     """A chain whose carry goes non-finite must be reported (chain index +
     first bad sweep) and excluded from pooled summaries — not returned as
     silent garbage (round-2 verdict weak #1/#2; beats the reference's
     print-and-continue, updateZ.R:84-86)."""
-    import jax.numpy as jnp
-
     m = small_model(ny=30, ns=4, nc=2, distr="normal", n_units=6, seed=3)
-    _, state = sample_mcmc(m, samples=5, transient=5, n_chains=2, seed=1,
-                           nf_cap=2, return_state=True, align_post=False)
-    # inject a NaN into chain 1's Beta and resume
-    bad_beta = np.array(state.Beta)
-    bad_beta[1, 0, 0] = np.nan
-    state = state.replace(Beta=jnp.asarray(bad_beta))
+    state = _poisoned_state(m, chain=1)
     with pytest.warns(RuntimeWarning, match="chain 1 diverged"):
         post = sample_mcmc(m, samples=5, transient=0, n_chains=2, seed=2,
                            nf_cap=2, init_state=state, align_post=False)
@@ -247,14 +253,8 @@ def test_retry_diverged_restarts_chain():
     """retry_diverged=1 must re-run the poisoned chain and splice a healthy
     replacement into the posterior (VERDICT round-2 item 2: 'exclude or
     restart poisoned chains')."""
-    import jax.numpy as jnp
-
     m = small_model(ny=30, ns=4, nc=2, distr="normal", n_units=6, seed=3)
-    _, state = sample_mcmc(m, samples=5, transient=5, n_chains=2, seed=1,
-                           nf_cap=2, return_state=True, align_post=False)
-    bad_beta = np.array(state.Beta)
-    bad_beta[1, 0, 0] = np.nan
-    state = state.replace(Beta=jnp.asarray(bad_beta))
+    state = _poisoned_state(m, chain=1)
     with pytest.warns(RuntimeWarning, match="chain 1 diverged"):
         post, final = sample_mcmc(m, samples=5, transient=0, n_chains=2,
                                   seed=2, nf_cap=2, init_state=state,
@@ -265,3 +265,38 @@ def test_retry_diverged_restarts_chain():
     assert post.pooled("Beta").shape[0] == 10
     assert np.isfinite(post["Beta"]).all()
     assert np.isfinite(np.asarray(final.Beta)).all()
+
+
+def test_retry_diverged_forwards_species_mesh(monkeypatch):
+    """A species-sharded run (the HBM-fit case) must keep its mesh during a
+    retry_diverged restart when the retry chain count still lays out over
+    the mesh's chain axis (round-3 advisor finding: the retry used to run
+    unsharded and could OOM exactly where sharding was needed).  The
+    recursive call is spied on so a regression to mesh=None fails here."""
+    import jax
+    from jax.sharding import Mesh
+
+    import hmsc_tpu.mcmc.sampler as sampler_mod
+
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs.reshape(1, 8), ("chains", "species"))
+    m = small_model(ny=30, ns=8, nc=2, distr="normal", n_units=6, seed=3)
+    state = _poisoned_state(m, chain=0)
+
+    inner_meshes = []
+    real = sampler_mod.sample_mcmc
+
+    def spy(*args, **kw):
+        inner_meshes.append(kw.get("mesh"))
+        return real(*args, **kw)
+
+    # the retry recursion resolves sample_mcmc from the module globals, so
+    # the spy sees exactly the kwargs the sub-call receives
+    monkeypatch.setattr(sampler_mod, "sample_mcmc", spy)
+    with pytest.warns(RuntimeWarning, match="chain 0 diverged"):
+        post = real(m, samples=4, transient=0, n_chains=2, seed=2,
+                    nf_cap=2, init_state=state, align_post=False,
+                    retry_diverged=1, mesh=mesh)
+    assert inner_meshes and inner_meshes[0] is mesh     # forwarded, not None
+    assert list(post.chain_health["good_chains"]) == [True, True]
+    assert np.isfinite(post["Beta"]).all()
