@@ -23,6 +23,7 @@ from .predict import (predict, predict_latent_factor, compute_predicted_values,
 from .utils.checkpoint import (save_checkpoint, load_checkpoint,
                                concat_posteriors)
 from .utils.mesh import make_mesh
+from .utils.phylo import parse_newick, phylo_corr, vcv_from_newick
 from .plots import (plot_beta, plot_gamma, plot_gradient,
                     plot_variance_partitioning, bi_plot)
 
@@ -63,6 +64,7 @@ __all__ = [
     "predict", "predict_latent_factor", "compute_predicted_values",
     "create_partition", "construct_gradient", "prepare_gradient",
     "save_checkpoint", "load_checkpoint", "concat_posteriors", "make_mesh",
+    "parse_newick", "phylo_corr", "vcv_from_newick",
     "plot_beta", "plot_gamma", "plot_gradient",
     "plot_variance_partitioning", "bi_plot",
     "sampleMcmc", "setPriors", "computeDataParameters",

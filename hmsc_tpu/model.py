@@ -64,7 +64,7 @@ class Hmsc:
                  y_scale=False,
                  study_design=None, ran_levels=None, ran_levels_used=None,
                  tr_formula=None, tr_data=None, Tr=None, tr_scale=True,
-                 C=None,
+                 C=None, phylo_tree=None,
                  distr="normal", truncate_number_of_factors=True):
         # ---- response ----------------------------------------------------
         if hasattr(Y, "values"):  # pandas
@@ -232,7 +232,17 @@ class Hmsc:
             self.Tr, tr_scale, self.tr_intercept_ind)
 
         # ---- phylogeny ---------------------------------------------------
+        # either a correlation matrix C, or a tree converted to its Brownian
+        # correlation like the reference's ape::vcv.phylo path
+        # (R/Hmsc.R:501-509; trees arrive as Newick strings here)
         self.C = None
+        self.phylo_tree = None
+        if C is not None and phylo_tree is not None:
+            raise ValueError("Hmsc.setData: at maximum one of phyloTree and C arguments can be specified")
+        if phylo_tree is not None:
+            from .utils.phylo import phylo_corr
+            self.C, _ = phylo_corr(phylo_tree, self.sp_names)
+            self.phylo_tree = phylo_tree
         if C is not None:
             C = np.asarray(C, dtype=float)
             if C.shape != (self.ns, self.ns):

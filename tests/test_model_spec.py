@@ -164,6 +164,90 @@ class TestFormula:
         assert names == ["a"]
 
 
+class TestPhyloTree:
+    """Newick phylo_tree ingestion — the reference's ape::vcv.phylo path
+    (R/Hmsc.R:501-509), Brownian correlation with species reordering."""
+
+    NEWICK = "((A:1,B:1):1,(C:0.5,D:0.5):1.5);"
+    # root->MRCA shared depths: (A,B)=1, (C,D)=1.5, cross pairs 0;
+    # all root-to-leaf distances are 2 -> corr = shared/2
+
+    def test_vcv_and_corr(self):
+        from hmsc_tpu import phylo_corr, vcv_from_newick
+
+        V, leaves = vcv_from_newick(self.NEWICK)
+        assert leaves == ["A", "B", "C", "D"]
+        expect = np.array([[2, 1, 0, 0], [1, 2, 0, 0],
+                           [0, 0, 2, 1.5], [0, 0, 1.5, 2]], dtype=float)
+        np.testing.assert_allclose(V, expect)
+        C, order = phylo_corr(self.NEWICK, ["D", "A", "C", "B"])
+        assert order == ["D", "A", "C", "B"]
+        np.testing.assert_allclose(np.diag(C), 1.0)
+        assert C[0, 2] == pytest.approx(0.75)       # (D, C) = 1.5/2
+        assert C[1, 3] == pytest.approx(0.5)        # (A, B) = 1/2
+
+    def test_hmsc_accepts_tree(self):
+        Y = pd.DataFrame(_simple_y(ny=20, ns=4),
+                         columns=["B", "D", "A", "C"])
+        m = Hmsc(Y=Y, X=np.ones((20, 1)), distr="probit",
+                 phylo_tree=self.NEWICK)
+        assert m.C is not None and m.C.shape == (4, 4)
+        # tree leaves are reindexed to the Y column order (sp_names)
+        assert m.C[0, 2] == pytest.approx(0.5)      # (B, A)
+        assert m.C[1, 3] == pytest.approx(0.75)     # (D, C)
+        # matrix-vs-tree construction agree
+        m2 = Hmsc(Y=Y, X=np.ones((20, 1)), distr="probit", C=m.C)
+        np.testing.assert_allclose(m2.C, m.C)
+
+    def test_tree_and_C_exclusive(self):
+        with pytest.raises(ValueError, match="at maximum one of phyloTree"):
+            Hmsc(Y=_simple_y(ny=20, ns=4), X=np.ones((20, 1)),
+                 C=np.eye(4), phylo_tree=self.NEWICK)
+
+    def test_missing_species_rejected(self):
+        Y = pd.DataFrame(_simple_y(ny=20, ns=3), columns=["A", "B", "Zz"])
+        with pytest.raises(ValueError, match="missing species"):
+            Hmsc(Y=Y, X=np.ones((20, 1)), phylo_tree=self.NEWICK)
+
+    def test_quoted_names_comments_whitespace(self):
+        from hmsc_tpu import vcv_from_newick
+
+        V, leaves = vcv_from_newick(
+            "('sp one':2, [note]'sp two':2):0;")
+        assert leaves == ["sp one", "sp two"]
+        np.testing.assert_allclose(V, np.diag([2.0, 2.0]))
+        # whitespace/newlines between tokens (common in tree files)
+        V2, l2 = vcv_from_newick("(A:1,\n  (B:1, C:1):1\n);")
+        assert l2 == ["A", "B", "C"]
+        assert V2[1, 2] == pytest.approx(1.0)
+
+    def test_missing_branch_lengths_rejected(self):
+        from hmsc_tpu import vcv_from_newick
+
+        with pytest.raises(ValueError, match="branch lengths"):
+            vcv_from_newick("(A,(B,C));")
+        with pytest.raises(ValueError, match="branch lengths"):
+            vcv_from_newick("(A:1,(B:1,C:1));")   # internal edge missing
+
+    def test_deep_pectinate_tree(self):
+        """A 2000-leaf ladder tree must parse without recursion errors."""
+        from hmsc_tpu import vcv_from_newick
+
+        n = 2000
+        s = f"L0:{n}"
+        for k in range(1, n):
+            s = f"({s},L{k}:{n - k}):1"
+        V, leaves = vcv_from_newick(s + ";")
+        assert len(leaves) == n
+        # L0 sits under n-2 unit internal edges (the outermost is the root,
+        # length 0) plus its own branch of n
+        i0, i1 = leaves.index("L0"), leaves.index("L1")
+        assert V[i0, i0] == pytest.approx(2 * n - 2)
+        # L0 and L1 share everything above L0's and L1's own branches
+        assert V[i0, i1] == pytest.approx(n - 2)
+        assert np.all(np.diag(V) > 0)
+
+
 def test_td_fixture_builds(td):
     m = td["m"]
     assert m.ny == 50 and m.ns == 4 and m.nr == 2
