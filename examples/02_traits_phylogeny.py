@@ -31,6 +31,13 @@ Beta_true = (Gamma_true @ Tr.T
              + 0.4 * rng.standard_normal((2, ns)) @ np.linalg.cholesky(Q).T)
 Y = X @ Beta_true + rng.standard_normal((ny, ns))    # normal response
 
+# (a phylogeny can also enter as a Newick tree, like the reference's
+# phyloTree argument: Hmsc(..., phylo_tree="((sp1:1,sp2:1):1,...);") builds
+# the same Brownian correlation via hm.phylo_corr)
+tree = "((s1:1,s2:1):1,(s3:1,s4:1):1);"
+C_demo, _ = hm.phylo_corr(tree)
+assert np.isclose(C_demo[0, 1], 0.5)                 # shared depth / total
+
 # ---- fit -------------------------------------------------------------------
 study = pd.DataFrame({"sample": [f"u{i:03d}" for i in range(ny)]})
 rl = hm.HmscRandomLevel(units=study["sample"])
