@@ -148,7 +148,44 @@ def _tpu_rate(hM, samples, transient, n_chains, nf):
     return n_chains * samples / t, n_chains * (samples + transient) / t
 
 
+def _probe_device(timeout_s: int = 180):
+    """Fail fast and loudly if the accelerator is unreachable.
+
+    `jax.devices()` blocks forever when the remote-attached chip's tunnel is
+    down (observed: a multi-hour outage mid-round-4); probing in a killable
+    subprocess turns an indefinite hang into a clear nonzero exit the driver
+    can record."""
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; d = jax.devices(); "
+         "import jax.numpy as jnp; (jnp.ones((8, 8)) @ jnp.ones((8, 8)))"
+         ".block_until_ready(); print(d[0].platform)"],
+        capture_output=True, text=True, timeout=timeout_s)
+    if r.returncode != 0:
+        raise RuntimeError(f"device probe failed: {r.stderr[-500:]}")
+    return r.stdout.strip()
+
+
 def main():
+    import sys
+
+    try:
+        plat = _probe_device()
+    except Exception as e:                      # noqa: BLE001
+        print(f"bench.py: accelerator unreachable, aborting before the "
+              f"timed runs ({e})", file=sys.stderr)
+        raise SystemExit(2)
+    if plat == "cpu":
+        # a failed TPU init falls back to the CPU backend with a warning; a
+        # single-core run must never be recorded as a per-chip measurement
+        print("bench.py: JAX fell back to the CPU backend — refusing to "
+              "record a CPU run as samples/sec/chip", file=sys.stderr)
+        raise SystemExit(2)
+    print(f"bench.py: device probe ok ({plat})", file=sys.stderr)
+
     n_chains = 4
 
     # smoke config (BASELINE.md config 1): TD-scale probit
