@@ -248,6 +248,51 @@ class TestPhyloTree:
         assert np.all(np.diag(V) > 0)
 
 
+def test_construct_knots():
+    """Regular GPP knot grid over the bounding box with far-knot pruning
+    (reference constructKnots.R:26-49)."""
+    from hmsc_tpu import construct_knots
+
+    rng = np.random.default_rng(0)
+    s = rng.uniform(size=(40, 2))
+    k = construct_knots(s, n_knots=4)
+    assert k.shape == (16, 2)
+    assert k[:, 0].min() == pytest.approx(s[:, 0].min())
+    assert k[:, 1].max() == pytest.approx(s[:, 1].max())
+    # knot_dist grid + min_knot_dist pruning: data clustered in a corner
+    # drops knots far from any datum
+    s2 = rng.uniform(size=(30, 2)) * 0.2
+    s2 = np.vstack([s2, [[1.0, 1.0]]])
+    k_all = construct_knots(s2, knot_dist=0.25, min_knot_dist=10.0)
+    k_cut = construct_knots(s2, knot_dist=0.25, min_knot_dist=0.3)
+    assert 0 < len(k_cut) < len(k_all)
+
+
+def test_post_list_and_pooling(td):
+    """postList[[chain]][[sample]] schema parity (combineParameters'
+    13 elements, ragged-nf trimming) and poolMcmcChains flattening with
+    start/thin (reference poolMcmcChains.R:19-27)."""
+    from hmsc_tpu import pool_mcmc_chains, sample_mcmc
+
+    m = td["m"]
+    post = sample_mcmc(m, samples=6, transient=6, n_chains=2, seed=1,
+                       nf_cap=2)
+    pl = post.post_list()
+    assert len(pl) == 2 and len(pl[0]) == 6
+    d = pl[0][0]
+    assert set(d) == {"Beta", "wRRR", "Gamma", "V", "rho", "sigma", "Eta",
+                      "Lambda", "Alpha", "Psi", "Delta", "PsiRRR",
+                      "DeltaRRR"}
+    assert d["Beta"].shape == (m.nc, m.ns)
+    # ragged trim: Lambda_r is (nf_active, ns); Eta_r (np, nf_active)
+    nf_act = d["Lambda"][0].shape[0]
+    assert d["Eta"][0].shape == (m.np_[0], nf_act)
+    flat = pool_mcmc_chains(post)
+    assert len(flat) == 12
+    flat_w = pool_mcmc_chains(post, start=2, thin=2)
+    assert len(flat_w) == 2 * len(range(2, 6, 2))
+
+
 def test_td_fixture_builds(td):
     m = td["m"]
     assert m.ny == 50 and m.ns == 4 and m.nr == 2
