@@ -268,6 +268,7 @@ def test_construct_knots():
     assert 0 < len(k_cut) < len(k_all)
 
 
+@pytest.mark.slow
 def test_post_list_and_pooling(td):
     """postList[[chain]][[sample]] schema parity (combineParameters'
     13 elements, ragged-nf trimming) and poolMcmcChains flattening with
