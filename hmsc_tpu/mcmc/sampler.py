@@ -301,7 +301,17 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         if isinstance(record, str):
             record = (record,)
         level_pars = {"Eta", "Lambda", "Psi", "Delta", "Alpha"}
-        bad = []
+        # names the model structure never emits: accepting them would pass
+        # validation yet record nothing, and the user's later post[...] lookup
+        # would blame the record= restriction instead of the model itself
+        absent = set()
+        if not spec.has_phylo:
+            absent.add("rho")
+        if spec.nc_rrr == 0:
+            absent.update({"wRRR", "PsiRRR", "DeltaRRR"})
+        if spec.nr == 0:
+            absent.update(level_pars)
+        bad, structural = [], []
         for k in record:
             head, _, tail = k.rpartition("_")
             if tail.isdigit():
@@ -310,8 +320,17 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 # would pass validation yet silently record nothing
                 if head not in level_pars or int(tail) >= spec.nr:
                     bad.append(k)
+            elif k in absent:
+                structural.append(k)
             elif k not in _RECORDABLE:
                 bad.append(k)
+        if structural:
+            raise ValueError(
+                f"record: parameter(s) {structural} do not exist on this "
+                "model ('rho' needs a phylogeny (C=/phylo_tree=); "
+                "'wRRR'/'PsiRRR'/'DeltaRRR' need XRRRData; per-level "
+                "parameters need at least one random level) — the run "
+                "would silently record nothing for them")
         if bad:
             raise ValueError(
                 f"record: unknown parameter name(s) {bad}; valid names are "

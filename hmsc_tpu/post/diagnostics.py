@@ -31,7 +31,7 @@ def _autocov_fft(x: np.ndarray) -> np.ndarray:
     flat = xc.reshape(x.shape[0], n, -1)
     K = flat.shape[2]
     step = max(1, int(2e8 // (x.shape[0] * nfft * 16)))   # ~200 MB complex
-    out = np.empty_like(flat)
+    out = np.empty(flat.shape, dtype=np.float64)   # keep f64 even for f32 input
     for j0 in range(0, K, step):
         f = np.fft.rfft(flat[:, :, j0:j0 + step], n=nfft, axis=1)
         out[:, :, j0:j0 + step] = np.fft.irfft(

@@ -11,15 +11,24 @@ __all__ = ["parse_newick", "phylo_corr", "vcv_from_newick"]
 
 
 def _clean(newick: str) -> str:
-    """Strip [...] comments and whitespace outside quoted labels."""
+    """Strip [...] comments and whitespace outside quoted labels.
+
+    Inside a quoted label the Newick escape ``''`` (doubled apostrophe)
+    stands for a literal apostrophe and does not terminate the quote.
+    """
     out, depth, quoted = [], 0, False
-    for ch in newick:
+    i, n = 0, len(newick)
+    while i < n:
+        ch = newick[i]
         if quoted:
             out.append(ch)
             if ch == "'":
-                quoted = False
-            continue
-        if ch == "[":
+                if i + 1 < n and newick[i + 1] == "'":
+                    out.append("'")       # escaped quote: keep both, stay quoted
+                    i += 1
+                else:
+                    quoted = False
+        elif ch == "[":
             depth += 1
         elif ch == "]":
             depth = max(0, depth - 1)
@@ -29,6 +38,7 @@ def _clean(newick: str) -> str:
                 out.append(ch)
             elif not ch.isspace():
                 out.append(ch)
+        i += 1
     return "".join(out)
 
 
@@ -70,12 +80,20 @@ def parse_newick(newick: str):
     def read_label(i, node):
         """Optional name[:length] attached to ``node``; returns new i."""
         if i < len(s) and s[i] == "'":
-            j = i + 1
-            while j < len(s) and s[j] != "'":
+            # '' inside the label is the Newick escape for a literal quote
+            j, buf = i + 1, []
+            while j < len(s):
+                if s[j] == "'":
+                    if j + 1 < len(s) and s[j + 1] == "'":
+                        buf.append("'")
+                        j += 2
+                        continue
+                    break
+                buf.append(s[j])
                 j += 1
             if j >= len(s):
                 raise ValueError("Hmsc.parse_newick: unterminated quoted label")
-            names[node] = s[i + 1:j]
+            names[node] = "".join(buf)
             i = j + 1
         else:
             j = i
@@ -161,6 +179,12 @@ def vcv_from_newick(newick: str):
     leaves = [v for v in range(n_nodes) if not children[v]]
     if any(not names[v] for v in leaves):
         raise ValueError("Hmsc.vcv_from_newick: every leaf must be named")
+    leaf_names = [names[v] for v in leaves]
+    if len(set(leaf_names)) != len(leaf_names):
+        dup = sorted({n for n in leaf_names if leaf_names.count(n) > 1})
+        raise ValueError(
+            f"Hmsc.vcv_from_newick: duplicated leaf names {dup[:5]} — tip "
+            "labels must be unique (ape::vcv.phylo errors here too)")
     leaf_ix = {v: k for k, v in enumerate(leaves)}
     n = len(leaves)
     V = np.zeros((n, n))

@@ -221,6 +221,22 @@ class TestPhyloTree:
         assert l2 == ["A", "B", "C"]
         assert V2[1, 2] == pytest.approx(1.0)
 
+    def test_duplicate_leaf_names_rejected(self):
+        """Two identically-named tips must be an error, not a silent
+        last-one-wins match (ape errors on duplicated tip labels too)."""
+        from hmsc_tpu import vcv_from_newick
+
+        with pytest.raises(ValueError, match="duplicated leaf names"):
+            vcv_from_newick("((A:1,A:1):1,B:2);")
+
+    def test_quoted_label_doubled_quote_escape(self):
+        """Newick's '' escape inside a quoted label is a literal quote."""
+        from hmsc_tpu import vcv_from_newick
+
+        V, leaves = vcv_from_newick("('sp''s name':2,'plain':2);")
+        assert leaves == ["sp's name", "plain"]
+        np.testing.assert_allclose(V, np.diag([2.0, 2.0]))
+
     def test_missing_branch_lengths_rejected(self):
         from hmsc_tpu import vcv_from_newick
 

@@ -78,6 +78,21 @@ def test_record_selection():
     with pytest.raises(ValueError, match="unknown parameter"):
         sample_mcmc(m, samples=2, transient=2, n_chains=1, seed=1,
                     record=("Betta",))
+    # structurally-absent names: validation must name the actual cause
+    # instead of silently recording nothing (no phylogeny / no RRR here)
+    with pytest.raises(ValueError, match="do not exist on this model"):
+        sample_mcmc(m, samples=2, transient=2, n_chains=1, seed=1,
+                    record=("Beta", "rho"))
+    with pytest.raises(ValueError, match="do not exist on this model"):
+        sample_mcmc(m, samples=2, transient=2, n_chains=1, seed=1,
+                    record=("Beta", "wRRR"))
+    # bare per-level names on a model with no random levels: same class
+    from hmsc_tpu import Hmsc
+    m0 = Hmsc(Y=np.random.default_rng(0).normal(size=(20, 3)),
+              X=np.ones((20, 1)), distr="normal")
+    with pytest.raises(ValueError, match="do not exist on this model"):
+        sample_mcmc(m0, samples=2, transient=2, n_chains=1, seed=1,
+                    record=("Beta", "Eta"))
 
     # per-level names and full recording agree on the shared draws
     full = sample_mcmc(m, samples=10, transient=10, n_chains=2, seed=1,
