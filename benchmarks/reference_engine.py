@@ -68,20 +68,31 @@ def spatial_full_grids(D, n_grid=101, alphas=None):
     return alphas, out
 
 
-def nngp_grids(coords, n_neighbours=10, n_grid=101):
+def nngp_grids(coords, n_neighbours=10, n_grid=101, alphas=None,
+               neighbours=None):
     """Sparse Vecchia factors RiW = D^-1/2 (I - A) per alpha
-    (``computeDataParameters.R:82-136``)."""
+    (``computeDataParameters.R:82-136``).
+
+    ``alphas`` / ``neighbours`` override the grid values and the per-point
+    neighbour sets (the parity tier passes the fitted model's alphapw grid
+    and its neighbour graph: the graph is part of the model specification —
+    like GPP knots — so both engines must condition each point on the same
+    prior-point set for their Vecchia priors to coincide)."""
     import scipy.sparse as sp
     from scipy.spatial import cKDTree
 
     n = coords.shape[0]
-    nbrs = [np.array([], dtype=int)]
-    for i in range(1, n):
-        k = min(n_neighbours, i)
-        _, idx = cKDTree(coords[:i]).query(coords[i], k=k)
-        nbrs.append(np.atleast_1d(idx))
-    span = float(np.sqrt(((coords.max(0) - coords.min(0)) ** 2).sum()))
-    alphas = np.linspace(0, span, n_grid)
+    if neighbours is not None:
+        nbrs = [np.asarray(nb, dtype=int) for nb in neighbours]
+    else:
+        nbrs = [np.array([], dtype=int)]
+        for i in range(1, n):
+            k = min(n_neighbours, i)
+            _, idx = cKDTree(coords[:i]).query(coords[i], k=k)
+            nbrs.append(np.atleast_1d(idx))
+    if alphas is None:
+        span = float(np.sqrt(((coords.max(0) - coords.min(0)) ** 2).sum()))
+        alphas = np.linspace(0, span, n_grid)
     out = []
     for a in alphas:
         if a == 0:
@@ -101,6 +112,33 @@ def nngp_grids(coords, n_neighbours=10, n_grid=101):
         RiW = sp.diags(dvec ** -0.5) @ (sp.eye(n) + A)
         out.append((RiW.tocsr(), np.log(dvec).sum()))
     return alphas, out
+
+
+def gpp_grids(coords, knots, alphas):
+    """Knot-based predictive-process covariance grids in the dense
+    ``(iW, RiW, ldW)`` triple format of :func:`spatial_full_grids`
+    (``R/updateEta.R:148-196`` semantics): the FIC approximation
+    W = W12 W22^-1 W12' + diag(1 - diag(W12 W22^-1 W12')).  The reference
+    keeps this in Woodbury factors for speed; the parity tier only needs the
+    implied dense covariance, computed independently here."""
+    s, K = np.asarray(coords, float), np.asarray(knots, float)
+    n, nK = s.shape[0], K.shape[0]
+    d12 = np.sqrt(((s[:, None, :] - K[None, :, :]) ** 2).sum(-1))
+    d22 = np.sqrt(((K[:, None, :] - K[None, :, :]) ** 2).sum(-1))
+    out = []
+    for a in alphas:
+        if a == 0:
+            W = np.eye(n)
+        else:
+            W12 = np.exp(-d12 / a)
+            iW22 = np.linalg.inv(np.exp(-d22 / a) + 1e-10 * np.eye(nK))
+            Wt = W12 @ iW22 @ W12.T
+            W = Wt + np.diag(1.0 - np.diag(Wt))
+        W = W + 1e-8 * np.eye(n)
+        iW = np.linalg.inv(W)
+        RiW = np.linalg.cholesky(iW)
+        out.append((iW, RiW, np.linalg.slogdet(W)[1]))
+    return np.asarray(alphas, float), out
 
 
 # ---------------------------------------------------------------------------
@@ -318,8 +356,19 @@ class ReferenceEngine:
                 + sp.kron(sp.csc_matrix(G), sp.diags(self.counts))
             lu = spla.splu(M.tocsc())
             r = rhs.T.reshape(-1)
-            mean = lu.solve(r)
-            draw = mean + lu.solve(rng.standard_normal(nf * n))
+            # exact draw via the stacked square-root: M = B'B with
+            # B = [blockdiag(RiW_h); kron(Lg', diag(sqrt(counts)))], so
+            # Eta = M^-1 (r + B'z), z ~ N(0, I_2m), has the right N(mean,
+            # M^-1) law (cov = M^-1 B'B M^-1) without a sparse cholesky
+            z1 = rng.standard_normal((nf, n))
+            z2 = rng.standard_normal((nf, n))
+            Bt_z = np.empty((nf, n))
+            for h in range(nf):
+                RiW, _ = grids[self.alpha_idx[h]]
+                Bt_z[h] = RiW.T @ z1[h]
+            Lg = np.linalg.cholesky(G + 1e-12 * np.eye(nf))
+            Bt_z += Lg @ (z2 * np.sqrt(self.counts)[None, :])
+            draw = lu.solve(r + Bt_z.reshape(-1))
             self.Eta = draw.reshape(nf, n).T
             for h in range(nf):
                 logp = np.empty(len(alphas))

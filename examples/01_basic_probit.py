@@ -7,6 +7,7 @@ recover parameters, and evaluate fit.
 
 Run:  python examples/01_basic_probit.py          (CPU is fine)
 """
+import os
 import sys
 from pathlib import Path
 
@@ -16,9 +17,14 @@ import pandas as pd
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import hmsc_tpu as hm
 
+# smoke-test mode (tests/test_examples.py): tiny sizes exercise every code
+# path; the statistical recovery assertions need the full sizes and are
+# gated off
+TOY = os.environ.get("HMSC_TPU_EXAMPLES_TOY") == "1"
+
 # ---- simulate a community --------------------------------------------------
 rng = np.random.default_rng(1)
-ny, ns = 200, 30
+ny, ns = (40, 6) if TOY else (200, 30)
 X = np.column_stack([np.ones(ny), rng.standard_normal(ny)])   # intercept + env
 beta_true = np.vstack([rng.normal(0, 0.5, ns), rng.normal(1.0, 0.5, ns)])
 eta_true = rng.standard_normal((ny, 2))                       # 2 latent factors
@@ -32,8 +38,9 @@ rl = hm.HmscRandomLevel(units=study["sample"])
 m = hm.Hmsc(Y=Y, X=X, distr="probit", study_design=study,
             ran_levels={"sample": rl}, x_scale=False)
 
-post = hm.sample_mcmc(m, samples=250, transient=250, n_chains=2, seed=42,
-                      nf_cap=4, verbose=250)
+n_iter = 15 if TOY else 250
+post = hm.sample_mcmc(m, samples=n_iter, transient=n_iter, n_chains=2,
+                      seed=42, nf_cap=4, verbose=n_iter)
 
 # ---- convergence diagnostics (the reference's coda workflow) ---------------
 coda = hm.convertToCodaObject(post)
@@ -47,7 +54,7 @@ print(f"Beta Rhat: max {np.nanmax(rhat):.3f}")
 est = post.get_post_estimate("Beta")
 corr = np.corrcoef(est["mean"][1], beta_true[1])[0, 1]
 print(f"slope recovery correlation: {corr:.3f}")
-assert corr > 0.85
+assert TOY or corr > 0.85
 
 # ---- residual associations (Omega) -----------------------------------------
 assoc = hm.compute_associations(post)

@@ -8,6 +8,7 @@ residual association structure.
 
 Run:  python examples/02_traits_phylogeny.py      (CPU is fine)
 """
+import os
 import sys
 from pathlib import Path
 
@@ -18,9 +19,12 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import hmsc_tpu as hm
 from hmsc_tpu.data import random_coalescent_corr
 
+# smoke-test mode (tests/test_examples.py): tiny sizes, recovery asserts off
+TOY = os.environ.get("HMSC_TPU_EXAMPLES_TOY") == "1"
+
 # ---- simulate: traits drive responses, phylogeny correlates the residual ---
 rng = np.random.default_rng(7)
-ny, ns, nt = 250, 50, 2
+ny, ns, nt = (40, 8, 2) if TOY else (250, 50, 2)
 C = random_coalescent_corr(ns, rng)                  # phylogenetic correlation
 Tr = np.column_stack([np.ones(ns), rng.standard_normal(ns)])  # intercept+trait
 Gamma_true = np.array([[0.0, 0.0], [1.0, 0.8]])      # trait 1 -> env response
@@ -43,8 +47,9 @@ study = pd.DataFrame({"sample": [f"u{i:03d}" for i in range(ny)]})
 rl = hm.HmscRandomLevel(units=study["sample"])
 m = hm.Hmsc(Y=Y, X=X, Tr=Tr, C=C, distr="normal", study_design=study,
             ran_levels={"sample": rl}, x_scale=False)
-post = hm.sample_mcmc(m, samples=250, transient=250, n_chains=2, seed=3,
-                      nf_cap=2)
+n_iter = 15 if TOY else 250
+post = hm.sample_mcmc(m, samples=n_iter, transient=n_iter, n_chains=2,
+                      seed=3, nf_cap=2)
 
 # ---- trait effects and phylogenetic signal ---------------------------------
 g = post.get_post_estimate("Gamma")
@@ -52,7 +57,7 @@ print("Gamma posterior mean:\n", np.round(g["mean"], 2))
 print("Gamma truth:\n", Gamma_true)
 rho_draws = post.pooled("rho")
 print(f"rho: posterior mean {rho_draws.mean():.2f} (truth {rho_true})")
-assert abs(rho_draws.mean() - rho_true) < 0.35
+assert TOY or abs(rho_draws.mean() - rho_true) < 0.35
 
 # ---- variance partitioning (reference plotVariancePartitioning input) ------
 vp = hm.compute_variance_partitioning(post, group=[1, 1],

@@ -10,6 +10,7 @@ regime runs via the matrix-free CG sampler (see BENCHMARKS.md).
 
 Run:  python examples/03_spatial.py               (CPU is fine)
 """
+import os
 import sys
 from pathlib import Path
 
@@ -19,9 +20,12 @@ import pandas as pd
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import hmsc_tpu as hm
 
+# smoke-test mode (tests/test_examples.py): tiny sizes, recovery asserts off
+TOY = os.environ.get("HMSC_TPU_EXAMPLES_TOY") == "1"
+
 # ---- simulate a spatial community ------------------------------------------
 rng = np.random.default_rng(5)
-n_units, ny_per, ns = 80, 2, 20
+n_units, ny_per, ns = (24, 2, 5) if TOY else (80, 2, 20)
 ny = n_units * ny_per
 units = [f"site_{i:03d}" for i in range(n_units)]
 xy = rng.uniform(size=(n_units, 2))
@@ -36,7 +40,7 @@ L = X @ (rng.standard_normal((2, ns)) * 0.4) + np.outer(eta_u[unit_of], lam)
 Y = L + rng.standard_normal((ny, ns))        # normal response
 
 # ---- fit with an exact Full GP level (train on 70 sites) -------------------
-train_u = np.arange(70)
+train_u = np.arange(20 if TOY else 70)
 row_tr = np.isin(unit_of, train_u)
 xy_df = pd.DataFrame(xy, index=units, columns=["x", "y"])
 study = pd.DataFrame({"site": [units[u] for u in unit_of]})
@@ -45,7 +49,8 @@ hm.set_priors_random_level(rl, nf_max=2, nf_min=2)
 m = hm.Hmsc(Y=Y[row_tr], X=X[row_tr], distr="normal",
             study_design=study[row_tr].reset_index(drop=True),
             ran_levels={"site": rl}, x_scale=False)
-post = hm.sample_mcmc(m, samples=200, transient=300, n_chains=2, seed=9,
+post = hm.sample_mcmc(m, samples=15 if TOY else 200,
+                      transient=20 if TOY else 300, n_chains=2, seed=9,
                       nf_cap=2)
 
 # ---- GP range recovery -----------------------------------------------------
@@ -61,8 +66,8 @@ print(f"alpha (dominant factor): posterior median {np.median(lead):.2f} "
 # latent field carries per-unit posterior noise, which smooth-kernel
 # precisions penalise heavily — an identification property of the model
 # itself (the reference's conditional scheme behaves identically)
-assert (lead > 0).mean() > 0.8
-assert 0.05 < np.median(lead) < 1.2
+assert TOY or (lead > 0).mean() > 0.8
+assert TOY or 0.05 < np.median(lead) < 1.2
 
 # ---- prediction at the 10 held-out sites (kriged latent field) -------------
 row_te = ~row_tr
@@ -72,4 +77,4 @@ pred = hm.predict(post, X=X[row_te],
 p_mean = pred.mean(axis=0)
 r2 = np.corrcoef(p_mean.ravel(), L[row_te].ravel())[0, 1] ** 2
 print(f"held-out-site R2 vs true signal (kriging): {r2:.3f}")
-assert r2 > 0.4
+assert TOY or r2 > 0.4

@@ -15,6 +15,7 @@ on the 15 observed species there.
 
 Run:  python examples/05_conditional_prediction.py     (CPU is fine)
 """
+import os
 import sys
 from pathlib import Path
 
@@ -25,9 +26,12 @@ from scipy.stats import norm
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import hmsc_tpu as hm
 
+# smoke-test mode (tests/test_examples.py): tiny sizes, recovery asserts off
+TOY = os.environ.get("HMSC_TPU_EXAMPLES_TOY") == "1"
+
 # ---- simulate a spatial community ------------------------------------------
 rng = np.random.default_rng(23)
-n_units, ns = 200, 20
+n_units, ns = (48, 8) if TOY else (200, 20)
 units = [f"site_{i:03d}" for i in range(n_units)]
 xy = rng.uniform(size=(n_units, 2))
 D = np.linalg.norm(xy[:, None] - xy[None, :], axis=-1)
@@ -38,9 +42,10 @@ X = np.column_stack([np.ones(n_units), rng.standard_normal(n_units)])
 L = X @ (rng.standard_normal((2, ns)) * 0.4) + np.outer(eta_u, lam)
 Y = (L + rng.standard_normal((n_units, ns)) > 0).astype(float)
 
-train = np.arange(150)
-test = np.arange(150, n_units)
-held_species = np.arange(15, ns)                 # predict these 5
+n_train = 36 if TOY else 150
+train = np.arange(n_train)
+test = np.arange(n_train, n_units)
+held_species = np.arange(ns - 5, ns)             # predict these 5
 
 # ---- fit an NNGP spatial model on the training sites -----------------------
 xy_df = pd.DataFrame(xy, index=units, columns=["x", "y"])
@@ -49,7 +54,8 @@ hm.set_priors_random_level(rl, nf_max=2, nf_min=2)
 study_tr = pd.DataFrame({"site": [units[u] for u in train]})
 m = hm.Hmsc(Y=Y[train], X=X[train], distr="probit", study_design=study_tr,
             ran_levels={"site": rl}, x_scale=False)
-post = hm.sample_mcmc(m, samples=150, transient=300, n_chains=2, seed=3,
+post = hm.sample_mcmc(m, samples=10 if TOY else 150,
+                      transient=20 if TOY else 300, n_chains=2, seed=3,
                       nf_cap=2)
 
 # ---- predict the held-out species at the test sites ------------------------
@@ -64,7 +70,8 @@ p_unc = hm.predict(post, X=X[test], study_design=study_te,
 Yc = np.array(Y[test], dtype=float)
 Yc[:, held_species] = np.nan
 p_con = hm.predict(post, X=X[test], study_design=study_te, Yc=Yc,
-                   mcmc_step=10, expected=True, seed=0).mean(axis=0)
+                   mcmc_step=2 if TOY else 10, expected=True,
+                   seed=0).mean(axis=0)
 
 p_true = norm.cdf(L[np.ix_(test, held_species)])
 err_unc = np.mean((p_unc[:, held_species] - p_true) ** 2)
@@ -73,4 +80,4 @@ print(f"held-out species at new sites, MSE vs true probability:")
 print(f"  unconditional (kriging only): {err_unc:.4f}")
 print(f"  conditional on observed species: {err_con:.4f} "
       f"({err_con / err_unc:.0%} of unconditional)")
-assert err_con < err_unc
+assert TOY or err_con < err_unc

@@ -55,6 +55,64 @@ def _support_plot(est, row_names, col_names, plot_type, support_level, ax,
     return ax
 
 
+def _draw_c_dendrogram(ax_t, C):
+    """UPGMA dendrogram of the phylogenetic correlation matrix; returns the
+    bottom-to-top species order with leaf h at y = 5 + 10 h."""
+    from scipy.cluster import hierarchy
+    from scipy.spatial.distance import squareform
+
+    D = 1.0 - np.asarray(C, dtype=float)
+    D = np.clip((D + D.T) / 2.0, 0.0, None)
+    np.fill_diagonal(D, 0.0)
+    Z = hierarchy.linkage(squareform(D, checks=False), method="average")
+    dn = hierarchy.dendrogram(Z, orientation="left", ax=ax_t, no_labels=True,
+                              color_threshold=0,
+                              above_threshold_color="#555555")
+    return dn["leaves"]
+
+
+def _draw_phylogram(ax_t, newick, sp_names):
+    """The supplied tree itself, as the reference's ``ape::plot.phylo`` panel
+    (``plotBeta.R:59-264``): x = root-to-node distance (real branch lengths),
+    leaf h at y = 5 + 10 h (the shared row coordinate), internal nodes at the
+    mean of their children.  Trees covering more species than the model are
+    pruned to the modeled set.  Returns the bottom-to-top species order."""
+    from .utils.phylo import parse_newick, prune_parsed
+
+    sp = [str(s) for s in sp_names]
+    children, lengths, names = prune_parsed(*parse_newick(newick), sp)
+    n = len(children)
+    depth = np.zeros(n)
+    for v in range(n):                       # parents precede children
+        for c in children[v]:
+            depth[c] = depth[v] + lengths[c]
+    # leaf order: DFS in Newick child order, bottom-to-top
+    leaves, stack = [], [0]
+    while stack:
+        v = stack.pop()
+        if not children[v]:
+            leaves.append(v)
+        else:
+            stack.extend(reversed(children[v]))
+    y = np.zeros(n)
+    for i, v in enumerate(leaves):
+        y[v] = 5.0 + 10.0 * i
+    for v in range(n - 1, -1, -1):           # children before parents
+        if children[v]:
+            y[v] = np.mean([y[c] for c in children[v]])
+    for v in range(n):
+        for c in children[v]:
+            ax_t.plot([depth[v], depth[c]], [y[c], y[c]],
+                      color="#555555", lw=1.0)
+        if children[v]:
+            ys = [y[c] for c in children[v]]
+            ax_t.plot([depth[v], depth[v]], [min(ys), max(ys)],
+                      color="#555555", lw=1.0)
+    ax_t.set_xlim(-0.02 * max(depth.max(), 1e-12), depth.max() * 1.02)
+    pos = {name: i for i, name in enumerate(sp)}
+    return [pos[names[v]] for v in leaves]
+
+
 def plot_beta(post, plot_type: str = "Support", support_level: float = 0.89,
               ax=None, *, plot_tree: bool = False):
     """Heatmap of species' environmental responses Beta (covariates x
@@ -62,9 +120,12 @@ def plot_beta(post, plot_type: str = "Support", support_level: float = 0.89,
 
     ``plot_tree=True`` draws the phylogeny side panel (reference
     ``plotBeta.R:59-264``, which renders the ``ape`` tree): species move to
-    the y-axis ordered by an average-linkage dendrogram of the phylogenetic
-    correlation ``C`` (distance ``1 - C``), drawn left of the heatmap with
-    leaves aligned to the rows.  Requires a model built with ``C``.
+    the y-axis with the tree drawn left of the heatmap, leaves aligned to
+    the rows.  A model built with ``phylo_tree=`` draws the actual supplied
+    topology and branch lengths (pruned to the modeled species); a model
+    built with only ``C`` falls back to an average-linkage dendrogram of
+    the correlation matrix (distance ``1 - C``) — a reconstruction that is
+    exact for ultrametric trees only.
     """
     hM = post.hM
     est = post.get_post_estimate("Beta")
@@ -80,20 +141,14 @@ def plot_beta(post, plot_type: str = "Support", support_level: float = 0.89,
             "Hmsc.plotBeta: plot_tree draws its own two-panel figure; "
             "the ax argument cannot be combined with it")
     import matplotlib.pyplot as plt
-    from scipy.cluster import hierarchy
-    from scipy.spatial.distance import squareform
 
-    D = 1.0 - np.asarray(hM.C, dtype=float)
-    D = np.clip((D + D.T) / 2.0, 0.0, None)
-    np.fill_diagonal(D, 0.0)
-    Z = hierarchy.linkage(squareform(D, checks=False), method="average")
     fig, (ax_t, ax_h) = plt.subplots(
         1, 2, figsize=(9, max(4, 0.3 * hM.ns + 2)),
         gridspec_kw={"width_ratios": [1, 3], "wspace": 0.02})
-    dn = hierarchy.dendrogram(Z, orientation="left", ax=ax_t, no_labels=True,
-                              color_threshold=0,
-                              above_threshold_color="#555555")
-    order = dn["leaves"]                        # bottom-to-top species order
+    if getattr(hM, "phylo_tree", None) is not None:
+        order = _draw_phylogram(ax_t, hM.phylo_tree, hM.sp_names)
+    else:
+        order = _draw_c_dendrogram(ax_t, hM.C)
     M = _mode_matrix(est, plot_type, support_level)[:, order].T  # (ns, nc)
     vmax = np.max(np.abs(M)) or 1.0
     # dendrogram leaf h sits at y = 5 + 10 h; the extent puts heatmap row h

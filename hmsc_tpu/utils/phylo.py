@@ -7,7 +7,7 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["parse_newick", "phylo_corr", "vcv_from_newick"]
+__all__ = ["parse_newick", "phylo_corr", "prune_parsed", "vcv_from_newick"]
 
 
 def _clean(newick: str) -> str:
@@ -168,6 +168,50 @@ def parse_newick(newick: str):
         lengths = [lengths[v] for v in order]
         names = [names[v] for v in order]
     return children, lengths, names
+
+
+def prune_parsed(children, lengths, names, keep_leaves):
+    """Restrict a parsed tree to the leaves in ``keep_leaves`` (the
+    ``ape::keep.tip`` operation plotBeta needs when the supplied tree covers
+    more species than the model): dropped subtrees are removed and unary
+    chains are collapsed with branch lengths summed.  Returns a new
+    ``(children, lengths, names)`` triple with the same id contract as
+    :func:`parse_newick` (parents precede children, root is 0)."""
+    keep = set(map(str, keep_leaves))
+    n = len(children)
+    sub = [None] * n
+    for v in range(n - 1, -1, -1):           # children before parents
+        if not children[v]:
+            if names[v] in keep:
+                sub[v] = {"len": lengths[v], "ch": [], "name": names[v]}
+        else:
+            ch = [sub[c] for c in children[v] if sub[c] is not None]
+            if not ch:
+                continue
+            if len(ch) == 1:                 # collapse the unary chain
+                c = ch[0]
+                sub[v] = {"len": lengths[v] + c["len"], "ch": c["ch"],
+                          "name": c["name"]}
+            else:
+                sub[v] = {"len": lengths[v], "ch": ch, "name": names[v]}
+    root = sub[0]
+    if root is None:
+        raise ValueError(
+            "Hmsc.prune_parsed: no requested leaf is present in the tree")
+    root = dict(root, len=0.0)               # root carries no branch
+    out_ch, out_len, out_nm = [], [], []
+    stack = [(root, None)]
+    while stack:                             # parent-before-child ids
+        node, parent = stack.pop()
+        out_ch.append([])
+        out_len.append(node["len"])
+        out_nm.append(node["name"])
+        vid = len(out_ch) - 1
+        if parent is not None:
+            out_ch[parent].append(vid)
+        for c in reversed(node["ch"]):
+            stack.append((c, vid))
+    return out_ch, out_len, out_nm
 
 
 def vcv_from_newick(newick: str):
