@@ -68,6 +68,24 @@ def main():
             "vs_baseline": round(r_sweep / base, 1),
         }), flush=True)
 
+    # dense-vs-CG crossover A/B: at np=1000, nf=2 the dense path does a
+    # (2000x2000) joint cholesky per sweep; forcing the matrix-free Vecchia
+    # CG draw instead measures whether the crossover belongs below 2000
+    # coefficients on this chip (the threshold is part of the compile-cache
+    # key, so the mutation cannot be handed the stale dense program)
+    from hmsc_tpu.mcmc import spatial
+    old = spatial._NNGP_DENSE_MAX
+    try:
+        spatial._NNGP_DENSE_MAX = 0
+        r_samp, r_sweep = rate(m, kw)
+        print(json.dumps({
+            "variant": "eta_cg_forced",
+            "samples_per_s": round(r_samp, 1),
+            "vs_baseline": round(r_sweep / base, 1),
+        }), flush=True)
+    finally:
+        spatial._NNGP_DENSE_MAX = old
+
 
 if __name__ == "__main__":
     main()

@@ -25,6 +25,7 @@ from ..model import Hmsc
 from ..precompute import compute_data_parameters
 from .structs import (DEFAULT_NF_CAP, build_model_data, build_spec, build_state)
 from .sweep import effective_spec_data, make_sweep, record_sample
+from . import spatial
 from . import updaters as U
 
 __all__ = ["sample_mcmc"]
@@ -163,12 +164,16 @@ def _keep_record(name: str, record) -> bool:
 
 @functools.lru_cache(maxsize=64)
 def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin,
-                     skip_init_z, record=None):
+                     skip_init_z, record=None, nngp_dense_max=None):
     """One jitted chain-vmapped sampling program per static config.
 
     Keyed on the hashable (spec, updater toggles, scan lengths) so repeated
     ``sample_mcmc`` calls with the same shapes reuse the compiled executable
-    (XLA compilation is the dominant cost for small models)."""
+    (XLA compilation is the dominant cost for small models).
+    ``nngp_dense_max`` carries the current NNGP dense/CG crossover into the
+    key: the sweep reads it at trace time from the ``spatial`` module
+    global, so an A/B that mutates it must not be handed the stale cached
+    program."""
     updater = dict(updater_items) if updater_items else None
     sweep = make_sweep(spec, updater, adapt_nf)
 
@@ -260,6 +265,10 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
       (Eta, Beta_intercept) location move (exact, Geweke-validated, but no
       measured ESS gain at benchmark scales — see
       ``updaters.interweave_location``).
+      ``updater={"InterweaveDA": True}`` enables the ASIS flip of the
+      probit data augmentation on the intercept row (redraw the intercept
+      with the residual Z - Beta_int held fixed under the per-species sign
+      intervals — see ``updaters.interweave_da_intercept``).
     - ``nf_cap`` bounds the per-level latent factor count (static XLA
       shapes; the reference instead grows nf up to ns).  Pick it a little
       above the factor count you expect; if burn-in adaptation saturates the
@@ -402,6 +411,16 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
             updater = dict(updater)
             updater["InterweaveLocation"] = False
 
+    # structural gate for the opt-in probit-DA intercept interweave
+    if updater and updater.get("InterweaveDA") is True:
+        from .updaters import da_intercept_gate
+        reason = da_intercept_gate(
+            spec, has_intercept=hM.x_intercept_ind is not None)
+        if reason:
+            print(f"Setting updater$InterweaveDA=FALSE: {reason}")
+            updater = dict(updater)
+            updater["InterweaveDA"] = False
+
     updater_items = (tuple(sorted(updater.items())) if updater else None)
     sharding = None
     if mesh is not None:
@@ -473,7 +492,8 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
             keys = jax.device_put(keys, sharding)
         for si, seg in enumerate(seg_sizes):
             fn = _compiled_runner(spec, updater_items, adapt_nf, seg,
-                                  trans_cur, int(thin), skip_z, record)
+                                  trans_cur, int(thin), skip_z, record,
+                                  spatial._NNGP_DENSE_MAX)
             recs, state_cur, bad_cur, keys = fn(data, state_cur, keys, bad_cur)
             # pack now (async on device); fetch below.  Drop the original
             # record tree immediately — keeping it alive through the fetch

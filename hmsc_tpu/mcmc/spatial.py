@@ -35,8 +35,13 @@ __all__ = ["update_eta_spatial", "update_alpha", "vecchia_ops",
            "vecchia_cg_draw", "gpp_factor", "gpp_draw"]
 
 # above this many (units x factors) coefficients, NNGP Eta switches from the
-# dense joint cholesky to the matrix-free CG sampler
-_NNGP_DENSE_MAX = 4096
+# dense joint cholesky to the matrix-free CG sampler.  Overridable via
+# HMSC_TPU_NNGP_DENSE_MAX (read at import) so the crossover can be A/B'd on
+# hardware without an edit — at config-3b shape (np=1000, nf=2) both paths
+# are viable and the faster one is chip-dependent.
+import os as _os
+
+_NNGP_DENSE_MAX = int(_os.environ.get("HMSC_TPU_NNGP_DENSE_MAX", "4096"))
 
 
 # ---------------------------------------------------------------------------

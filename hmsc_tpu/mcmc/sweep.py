@@ -147,6 +147,14 @@ def make_sweep(spec: ModelSpec, updater: dict | None = None,
         if on("Z"):
             state = U.update_z(spec_x, data_x, state, ks[7], E=E_shared)
 
+        # opt-in ASIS flip of the probit augmentation on the intercept row
+        # (updaters.interweave_da_intercept) — placed after updateZ so the
+        # ancillary residual is built from the freshest Z; it changes Beta
+        # and Z jointly, and nothing after it consumes E_shared
+        if want("InterweaveDA") and on("Z") and on("BetaLambda"):
+            state = U.interweave_da_intercept(
+                spec, data, state, jax.random.fold_in(ks[7], 1))
+
         # factor-count adaptation during burn-in (iter <= adaptNf[r])
         for r in range(spec.nr):
             if adapt_nf[r] > 0 and on("Nf"):

@@ -14,7 +14,7 @@ import numpy as np
 from jax.scipy.linalg import solve_triangular
 
 from ..ops.linalg import chol_spd, sample_mvn_prec, sample_mvn_prec_batched
-from ..ops.rand import (polya_gamma, standard_gamma,
+from ..ops.rand import (polya_gamma, standard_gamma, truncated_normal,
                         truncated_normal_onesided, wishart)
 from .structs import GibbsState, LevelState, ModelData, ModelSpec
 
@@ -22,7 +22,8 @@ __all__ = ["linear_fixed", "level_loading", "update_z", "update_beta_lambda",
            "update_gamma_v", "gamma_given_beta", "update_rho",
            "update_lambda_priors", "update_eta_nonspatial",
            "update_inv_sigma", "update_nf", "eta_star", "lambda_effective",
-           "interweave_scale", "interweave_location", "location_gate"]
+           "interweave_scale", "interweave_location", "location_gate",
+           "interweave_da_intercept", "da_intercept_gate"]
 
 _NB_R = 1e3  # Poisson as the r->inf limit of NB (reference updateZ.R:68)
 
@@ -606,6 +607,76 @@ def interweave_location(spec: ModelSpec, data: ModelData, state: GibbsState,
         Beta = Beta.at[ii].add(-(c @ lam))
         new_levels.append(lv.replace(Eta=lv.Eta + c[None, :]))
     return state.replace(levels=tuple(new_levels), Beta=Beta)
+
+
+def da_intercept_gate(spec: ModelSpec, has_intercept: bool) -> str | None:
+    """Why :func:`interweave_da_intercept` cannot run on this model, or
+    ``None`` when eligible (same single-source contract as
+    :func:`location_gate`)."""
+    if not spec.any_probit:
+        return "no probit column — the move flips the probit augmentation"
+    if not has_intercept:
+        return "the design has no intercept column to shift"
+    if spec.x_is_list:
+        return "per-species design matrices"
+    if spec.ncsel > 0:
+        return ("variable selection's effective-Beta zeroing decouples the "
+                "intercept row from the recorded Beta")
+    if spec.nc_rrr > 0:
+        return "RRR appends state-dependent design columns"
+    if spec.has_phylo:
+        return ("the phylogenetic prior couples intercepts across species; "
+                "the per-species conditional no longer factorises over the "
+                "sign-interval box")
+    return None
+
+
+def interweave_da_intercept(spec: ModelSpec, data: ModelData,
+                            state: GibbsState, key) -> GibbsState:
+    """ASIS flip of the probit data augmentation for the intercept row:
+    redraw ``Beta[int, j]`` with the *residual* ``R = Z - Beta[int]`` held
+    fixed instead of ``Z`` itself (ancillary augmentation), then rebuild
+    ``Z = R + Beta[int]``.
+
+    Motivation (benchmarks/diag_mixing.py): the residual slow mode at
+    config-2 scale is probit-DA *saturation* — when ``|E|`` is large the
+    truncated-normal Z hugs E, so Z and the intercept take tiny coupled
+    steps in the sufficient parameterisation.  In the ancillary
+    parameterisation the sign constraints ``Y_ij = 1{R_ij + b0_j > 0}``
+    bind directly on ``b0_j``: its conditional is the Gaussian prior
+    conditional truncated to the interval
+    ``(max_{i: Y=1} -R_ij,  min_{i: Y=0} -R_ij)`` — an exact Gibbs step
+    (the (Z, b0) -> (R, b0) change of variables has unit Jacobian), one
+    whole-array reduction plus one truncated-normal draw per species.
+    Interweaving it with the standard sufficient-augmentation sweep is the
+    Yu & Meng (2011) ASIS recipe.  NA cells impose no constraint and their
+    imputed Z rides along with the shift; non-probit columns are left
+    untouched.  Structural eligibility lives in
+    :func:`da_intercept_gate`."""
+    ii = data.x_intercept_ind
+    fam = data.distr_family                           # (ns,)
+    prob = fam == 2
+    b0 = state.Beta[ii]                               # (ns,)
+    R = state.Z - b0[None, :]
+    negR = -R
+    if spec.has_na:
+        one = (data.Y > 0.5) & (data.Ymask > 0)
+        zero = (data.Y <= 0.5) & (data.Ymask > 0)
+    else:
+        one = data.Y > 0.5
+        zero = ~one
+    inf = jnp.asarray(jnp.inf, dtype=R.dtype)
+    lo = jnp.where(one, negR, -inf).max(axis=0)       # (ns,)
+    hi = jnp.where(zero, negR, inf).min(axis=0)
+    # Gaussian prior conditional of the intercept given the other rows of
+    # Beta_j (precision form): mean b0 - u / iV[ii,ii], var 1 / iV[ii,ii]
+    Mu = jnp.einsum("ct,jt->cj", state.Gamma, data.Tr)
+    u = state.iV[ii] @ (state.Beta - Mu)              # (ns,)
+    v00 = state.iV[ii, ii]
+    t = truncated_normal(key, lo, hi, mean=b0 - u / v00, std=v00 ** -0.5)
+    t = jnp.where(prob, t, b0)
+    Z = jnp.where(prob[None, :], R + t[None, :], state.Z)
+    return state.replace(Z=Z, Beta=state.Beta.at[ii].set(t))
 
 
 # ---------------------------------------------------------------------------

@@ -17,6 +17,7 @@ fixed nf, and — where applicable — the fitted model's rhopw/alphapw discrete
 grids passed to the NumPy engine's scans.
 """
 
+import os
 import pathlib
 import sys
 
@@ -38,8 +39,20 @@ pytestmark = pytest.mark.slow
 # z-score bounds over all compared entries: with correctly matched
 # posteriors z ~ N(0,1) entrywise (max over ~10-60 mildly dependent entries
 # stays below ~3.5; 5 leaves margin for ESS underestimation), while a prior
-# mismatch shows up as z in the tens
-Z_MAX, Z_MEAN = 5.0, 1.5
+# mismatch shows up as z in the tens.
+# Nightly tier: HMSC_TPU_PARITY_SCALE=k multiplies every draw count by k and
+# (for k >= 2) tightens the mean bound to 1.3.  The z-mean of a correctly
+# matched run does NOT shrink with draws (z is SE-normalised; the GPP
+# config's clean-run mean sits at ~1.1), so 1.0 would fail a correct
+# nightly run — but a fixed bias b grows like b/SE, so at 2x draws an
+# O(0.5*SE) bias the default 1.5 bound admits pushes the mean past 1.3.
+_SCALE = max(1, int(os.environ.get("HMSC_TPU_PARITY_SCALE", "1")))
+Z_MAX, Z_MEAN = 5.0, (1.3 if _SCALE >= 2 else 1.5)
+
+
+def _n(draws: int) -> int:
+    """Scale a draw count by the nightly-tier multiplier."""
+    return draws * _SCALE
 
 
 def _run_numpy(eng, transient, samples):
@@ -114,12 +127,12 @@ def test_parity_config1_probit():
     set_priors_random_level(rl, nf_max=nf, nf_min=nf)
     m = Hmsc(Y=Y, X=X, distr="probit", study_design=study,
              ran_levels={"sample": rl}, x_scale=False)
-    post = sample_mcmc(m, samples=1200, transient=400, n_chains=2, seed=1,
+    post = sample_mcmc(m, samples=_n(1200), transient=400, n_chains=2, seed=1,
                        nf_cap=nf, align_post=False)
 
     eng = ReferenceEngine(Y, X, np.full(ns, 2), nf,
                           np.random.default_rng(7))
-    nd = _run_numpy(eng, transient=400, samples=2400)
+    nd = _run_numpy(eng, transient=400, samples=_n(2400))
 
     zB = _z_scores(post["Beta"], nd["Beta"])
     zO = _z_scores(_jax_omega(post), nd["Omega"])
@@ -155,7 +168,7 @@ def test_parity_config3a_spatial_full():
     set_priors_random_level(rl, nf_max=nf, nf_min=nf)
     m = Hmsc(Y=Y, X=X, distr="normal", study_design=study,
              ran_levels={"plot": rl}, x_scale=False)
-    post = sample_mcmc(m, samples=1200, transient=400, n_chains=2, seed=2,
+    post = sample_mcmc(m, samples=_n(1200), transient=400, n_chains=2, seed=2,
                        nf_cap=nf, align_post=False)
 
     # the engine shares the model's alphapw grid (values + prior weights);
@@ -166,7 +179,7 @@ def test_parity_config3a_spatial_full():
                           np.random.default_rng(8), pi_row=unit_of,
                           spatial=("full", grids),
                           alpha_prior_w=np.asarray(rl.alphapw[:, 1]))
-    nd = _run_numpy(eng, transient=400, samples=2400)
+    nd = _run_numpy(eng, transient=400, samples=_n(2400))
 
     zB = _z_scores(post["Beta"], nd["Beta"])
     zO = _z_scores(_jax_omega(post), nd["Omega"])
@@ -204,7 +217,7 @@ def test_parity_config3b_nngp():
     set_priors_random_level(rl, nf_max=nf, nf_min=nf)
     m = Hmsc(Y=Y, X=X, distr="normal", study_design=study,
              ran_levels={"plot": rl}, x_scale=False)
-    post = sample_mcmc(m, samples=1200, transient=400, n_chains=2, seed=5,
+    post = sample_mcmc(m, samples=_n(1200), transient=400, n_chains=2, seed=5,
                        nf_cap=nf, align_post=False)
 
     # shared model spec: the alpha grid and the kNN-lower-index neighbour
@@ -219,7 +232,7 @@ def test_parity_config3b_nngp():
                           np.random.default_rng(12), pi_row=unit_of,
                           spatial=("nngp", grids),
                           alpha_prior_w=np.asarray(rl.alphapw[:, 1]))
-    nd = _run_numpy(eng, transient=400, samples=2400)
+    nd = _run_numpy(eng, transient=400, samples=_n(2400))
 
     zB = _z_scores(post["Beta"], nd["Beta"])
     zO = _z_scores(_jax_omega(post), nd["Omega"])
@@ -270,7 +283,7 @@ def test_parity_config_gpp():
     set_priors_random_level(rl, nf_max=nf, nf_min=nf)
     m = Hmsc(Y=Y, X=X, distr="normal", study_design=study,
              ran_levels={"plot": rl}, x_scale=False)
-    post = sample_mcmc(m, samples=2400, transient=600, n_chains=2, seed=6,
+    post = sample_mcmc(m, samples=_n(2400), transient=600, n_chains=2, seed=6,
                        nf_cap=nf, align_post=False)
 
     grids = gpp_grids(xy_all, knots, np.asarray(rl.alphapw[:, 0], float))
@@ -278,7 +291,7 @@ def test_parity_config_gpp():
                           np.random.default_rng(14), pi_row=unit_of,
                           spatial=("full", grids),
                           alpha_prior_w=np.asarray(rl.alphapw[:, 1]))
-    nd = _run_numpy(eng, transient=600, samples=4800)
+    nd = _run_numpy(eng, transient=600, samples=_n(4800))
 
     zB = _z_scores(post["Beta"], nd["Beta"])
     zO = _z_scores(_jax_omega(post), nd["Omega"])
@@ -305,13 +318,13 @@ def test_parity_config4_phylo_traits():
     set_priors_random_level(rl, nf_max=nf, nf_min=nf)
     m = Hmsc(Y=Y, X=X, distr="normal", study_design=study, C=C, Tr=Tr,
              ran_levels={"sample": rl}, x_scale=False, tr_scale=False)
-    post = sample_mcmc(m, samples=1200, transient=400, n_chains=2, seed=3,
+    post = sample_mcmc(m, samples=_n(1200), transient=400, n_chains=2, seed=3,
                        nf_cap=nf, align_post=False)
 
     eng = ReferenceEngine(Y, X, np.full(ns, 1), nf,
                           np.random.default_rng(9), C=C, Tr=Tr,
                           rho_prior_w=np.asarray(m.rhopw[:, 1]))
-    nd = _run_numpy(eng, transient=400, samples=2400)
+    nd = _run_numpy(eng, transient=400, samples=_n(2400))
 
     zB = _z_scores(post["Beta"], nd["Beta"])
     zO = _z_scores(_jax_omega(post), nd["Omega"])
@@ -348,13 +361,13 @@ def test_parity_config5_mixed_distr():
     m = Hmsc(Y=Y, X=X, distr=["normal", "normal", "probit", "probit",
                               "poisson", "poisson"],
              study_design=study, ran_levels={"sample": rl}, x_scale=False)
-    post = sample_mcmc(m, samples=1200, transient=400, n_chains=2, seed=4,
+    post = sample_mcmc(m, samples=_n(1200), transient=400, n_chains=2, seed=4,
                        nf_cap=nf, align_post=False)
 
     eng = ReferenceEngine(Y, X, fam, nf, np.random.default_rng(10),
                           pi_row=unit_of)
     eng.iSigma[fam == 3] = 100.0     # fixed sigma^2 = 1e-2 for Poisson
-    nd = _run_numpy(eng, transient=400, samples=2400)
+    nd = _run_numpy(eng, transient=400, samples=_n(2400))
 
     zB = _z_scores(post["Beta"], nd["Beta"])
     zO = _z_scores(_jax_omega(post), nd["Omega"])
