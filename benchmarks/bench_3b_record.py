@@ -46,7 +46,10 @@ def rate(m, kw, reps=3, **extra):
 def main():
     rng = np.random.default_rng(42)
     m, kw = config3_spatial_nngp(rng)
+    t0 = time.time()
     base = baseline_rate("3b", m, nf=kw.get("nf_cap", 2))
+    print(f"# baseline {base:.3f} sweeps/s ({time.time() - t0:.0f}s to "
+          f"measure)", file=sys.stderr, flush=True)
     no_eta = ("Beta", "Lambda", "Psi", "Delta", "Alpha", "sigma")
     variants = [
         ("full", {}),
@@ -61,11 +64,13 @@ def main():
         ("ablate_alpha_eta", {"updater": {"Alpha": False, "Eta": False}}),
     ]
     for name, extra in variants:
+        t0 = time.time()
         r_samp, r_sweep = rate(m, kw, **extra)
         print(json.dumps({
             "variant": name,
             "samples_per_s": round(r_samp, 1),
             "vs_baseline": round(r_sweep / base, 1),
+            "measure_s": round(time.time() - t0, 1),
         }), flush=True)
 
     # dense-vs-CG crossover A/B: at np=1000, nf=2 the dense path does a
@@ -77,11 +82,13 @@ def main():
     old = spatial._NNGP_DENSE_MAX
     try:
         spatial._NNGP_DENSE_MAX = 0
+        t0 = time.time()
         r_samp, r_sweep = rate(m, kw)
         print(json.dumps({
             "variant": "eta_cg_forced",
             "samples_per_s": round(r_samp, 1),
             "vs_baseline": round(r_sweep / base, 1),
+            "measure_s": round(time.time() - t0, 1),
         }), flush=True)
     finally:
         spatial._NNGP_DENSE_MAX = old

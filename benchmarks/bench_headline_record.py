@@ -1,0 +1,58 @@
+"""Headline (1000x1000 probit) record=/record_dtype A/B on the TPU.
+
+Round-4 left the record-selection effect on the driver headline unmeasured
+(the tunnel died).  This probe times the exact bench.py headline model under
+(a) full recording, (b) record= of the association-workflow blocks
+(Beta/Lambda/Delta/sigma — what computeAssociations/getPostEstimate/VP read),
+(c) b + bfloat16 record_dtype, and prints one JSON line each.
+
+Run on the TPU host: ``python benchmarks/bench_headline_record.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax.numpy as jnp
+
+from bench import _config
+from hmsc_tpu.mcmc.sampler import sample_mcmc
+
+
+def rate(m, reps=3, samples=200, transient=10, n_chains=4, nf=8, **extra):
+    sample_mcmc(m, samples=samples, transient=transient, n_chains=n_chains,
+                seed=0, align_post=False, nf_cap=nf, **extra)      # compile
+    t = np.inf
+    for rep in range(reps):
+        t0 = time.time()
+        post = sample_mcmc(m, samples=samples, transient=transient,
+                           n_chains=n_chains, seed=1 + rep, align_post=False,
+                           nf_cap=nf, **extra)
+        t = min(t, time.time() - t0)
+        assert np.isfinite(np.asarray(post["Beta"], dtype=np.float32)).all()
+    return n_chains * samples / t
+
+
+def main():
+    m, Y, X = _config(ny=1000, ns=1000, nf=8)
+    assoc = ("Beta", "Lambda", "Delta", "sigma")
+    variants = [
+        ("full", {}),
+        ("record_assoc", {"record": assoc}),
+        ("record_assoc_bf16", {"record": assoc, "record_dtype": jnp.bfloat16}),
+    ]
+    for name, extra in variants:
+        r = rate(m, **extra)
+        print(json.dumps({"variant": name,
+                          "samples_per_s": round(r, 1)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
