@@ -1,0 +1,88 @@
+"""Multi-seed A/B of an interweaving move at BENCHMARKS config 2.
+
+Round-5 finding: every earlier interweave A/B at this config ran with the
+move silently gated off (raw-matrix X has no *named* intercept; the gate
+now detects the all-ones column by value, structs._find_ones_column), so
+the recorded "gains"/"no gains" were cross-seed noise between two plain
+runs.  This harness hard-fails if the move is gated off, runs several
+independent seeds with the move off/on, and prints per-seed and aggregate
+min/median Beta ESS.
+
+Run: ``python benchmarks/ab_interweave_da.py [n_seeds] [move]`` with move
+in {InterweaveDA, InterweaveLocation} (CPU is fine — the comparison is ESS
+per sample, not wall-clock).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+# ESS-per-sample comparison: CPU is the right backend, and it must be
+# forced unconditionally — the ambient environment pins JAX_PLATFORMS=axon
+# (the TPU tunnel), and the config value must be set before first device
+# use (same dance as tests/conftest.py)
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from diag_mixing import config2
+from hmsc_tpu.mcmc.sampler import sample_mcmc
+from hmsc_tpu.post.diagnostics import effective_size
+
+
+def one(seed, move, off_move=None):
+    rng = np.random.default_rng(0)          # same data across seeds/arms
+    m, kw = config2(rng)
+    # the off arm must *explicitly* disable the tested move — default-on
+    # moves (InterweaveLocation) would otherwise run in both arms
+    upd = {move: True} if move else {off_move: False}
+    import io
+    import contextlib
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        post = sample_mcmc(m, samples=250, transient=125, thin=4, n_chains=4,
+                           seed=seed, updater=upd, align_post=False, **kw)
+    if move and f"{move}=FALSE" in buf.getvalue():
+        raise RuntimeError(
+            f"{move} was gated off — this A/B would be vacuous: "
+            + buf.getvalue().strip())
+    ess = np.asarray(effective_size(post["Beta"]))
+    return float(ess.min()), float(np.median(ess))
+
+
+def main():
+    n_seeds = int(sys.argv[1]) if len(sys.argv) > 1 else 5
+    move = sys.argv[2] if len(sys.argv) > 2 else "InterweaveDA"
+    rows = []
+    for seed in range(11, 11 + n_seeds):
+        off = one(seed, None, off_move=move)
+        on = one(seed, move)
+        rows.append((off, on))
+        print(json.dumps({"seed": seed,
+                          "off_min_med": [round(v, 1) for v in off],
+                          "on_min_med": [round(v, 1) for v in on]}),
+              flush=True)
+    off_min = np.mean([r[0][0] for r in rows])
+    on_min = np.mean([r[1][0] for r in rows])
+    off_med = np.mean([r[0][1] for r in rows])
+    on_med = np.mean([r[1][1] for r in rows])
+    print(json.dumps({
+        "aggregate": True, "move": move, "n_seeds": n_seeds,
+        "off_min_mean": round(off_min, 1), "on_min_mean": round(on_min, 1),
+        "off_med_mean": round(off_med, 1), "on_med_mean": round(on_med, 1),
+        "min_gain_pct": round(100 * (on_min / off_min - 1), 1),
+        "med_gain_pct": round(100 * (on_med / off_med - 1), 1),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

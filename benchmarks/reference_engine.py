@@ -133,7 +133,11 @@ def gpp_grids(coords, knots, alphas):
             W12 = np.exp(-d12 / a)
             iW22 = np.linalg.inv(np.exp(-d22 / a) + 1e-10 * np.eye(nK))
             Wt = W12 @ iW22 @ W12.T
-            W = Wt + np.diag(1.0 - np.diag(Wt))
+            # same conditional-variance nugget floor as the JAX engine's
+            # grids (precompute._GPP_DD_FLOOR): the two engines must define
+            # the identical model, incl. at knot-coincident units
+            from hmsc_tpu.precompute import _GPP_DD_FLOOR
+            W = Wt + np.diag(np.maximum(1.0 - np.diag(Wt), _GPP_DD_FLOOR))
         W = W + 1e-8 * np.eye(n)
         iW = np.linalg.inv(W)
         RiW = np.linalg.cholesky(iW)
@@ -149,11 +153,27 @@ class ReferenceEngine:
     """One chain of the reference's blocked Gibbs sweep in NumPy."""
 
     def __init__(self, Y, X, distr_fam, nf, rng, pi_row=None, C=None, Tr=None,
-                 spatial=None, alpha_prior_w=None, rho_prior_w=None):
+                 spatial=None, alpha_prior_w=None, rho_prior_w=None,
+                 xselect=None, xrrr=None, nc_rrr=0):
         ny, ns = Y.shape
-        self.Y, self.X, self.rng = Y, X, rng
+        self.Y, self.rng = Y, rng
         self.fam = distr_fam                    # (ns,) 1=normal 2=probit 3=pois
-        self.nc = X.shape[1]
+        # reduced-rank regression: X grows ncr derived columns XRRR @ wRRR'
+        # that are refreshed from the current wRRR at the top of each sweep
+        self.X1, self.XRRR, self.ncr = X, xrrr, nc_rrr
+        if nc_rrr:
+            self.nco = xrrr.shape[1]
+            self.wRRR = rng.standard_normal((nc_rrr, self.nco)) * 0.1
+            self.PsiRRR = np.ones((nc_rrr, self.nco))
+            self.DeltaRRR = np.ones(nc_rrr)
+            # reference defaults (setPriors.Hmsc): nuRRR=3, a1RRR=b1RRR=1,
+            # a2RRR=50, b2RRR=1
+            self.nuRRR, self.a1RRR, self.b1RRR = 3.0, 1.0, 1.0
+            self.a2RRR, self.b2RRR = 50.0, 1.0
+            self.X = np.concatenate([X, xrrr @ self.wRRR.T], axis=1)
+        else:
+            self.X = X
+        self.nc = self.X.shape[1]
         self.nf = nf
         self.pi_row = np.arange(ny) if pi_row is None else pi_row
         self.n_units = int(self.pi_row.max()) + 1
@@ -181,10 +201,36 @@ class ReferenceEngine:
         self.alpha_idx = np.zeros(nf, dtype=int)
         self.Z = np.where(Y > 0.5, 0.5, -0.5).astype(float)
         self.Z[:, self.fam == 1] = Y[:, self.fam == 1]
+        # spike-and-slab variable selection: list of
+        # (cov_group: int array, sp_group: (ns,) int array, q: (G,) array)
+        self.xsel = list(xselect) if xselect else []
+        assert not (self.xsel and C is not None), \
+            "engine: xselect not wired into the phylo joint BetaLambda system"
+        assert not (self.xsel and nc_rrr), \
+            "engine: update_w_rrr's residual ignores the selection mask"
+        self.BetaSel = [np.ones(len(q), dtype=bool)
+                        for (_, _, q) in self.xsel]
+
+    def _selmask(self):
+        """(nc, ns) 0/1 design mask implied by the current BetaSel switches
+        (reference updateBetaSel.R:31-41 zeroes covGroup columns of the
+        per-species X when the species group's switch is off)."""
+        ns = self.Y.shape[1]
+        mask = np.ones((self.nc, ns))
+        for (cov, spg, _), bs in zip(self.xsel, self.BetaSel):
+            off_sp = ~bs[spg]                       # (ns,) switched-off species
+            mask[np.ix_(cov, np.nonzero(off_sp)[0])] = 0.0
+        return mask
+
+    def _beta_eff(self):
+        """Beta with deselected entries zeroed: X_eff @ Beta == X @ beta_eff."""
+        if not self.xsel:
+            return self.Beta
+        return self.Beta * self._selmask()
 
     # -- updateZ (R/updateZ.R) ---------------------------------------------
     def update_z(self):
-        E = self.X @ self.Beta + self.Eta[self.pi_row] @ self.Lambda
+        E = self.X @ self._beta_eff() + self.Eta[self.pi_row] @ self.Lambda
         rng = self.rng
         fam = self.fam
         if np.any(fam == 2):
@@ -245,13 +291,22 @@ class ReferenceEngine:
         else:
             BL = np.empty((P, ns))
             XtZ = XE.T @ self.Z
+            mask = self._selmask() if self.xsel else None
             for j in range(ns):          # the reference's per-species loop
                 prior_prec = np.zeros((P, P))
                 prior_prec[:self.nc, :self.nc] = self.iV
                 prior_prec[self.nc:, self.nc:] = np.diag(self.Psi[:, j] * tau)
-                Pj = prior_prec + self.iSigma[j] * G
+                if mask is not None:
+                    # per-species design with deselected columns zeroed
+                    XEj = np.concatenate(
+                        [self.X * mask[:, j][None], self.Eta[self.pi_row]],
+                        axis=1)
+                    Gj, rhs_l = XEj.T @ XEj, XEj.T @ self.Z[:, j]
+                else:
+                    Gj, rhs_l = G, XtZ[:, j]
+                Pj = prior_prec + self.iSigma[j] * Gj
                 L = np.linalg.cholesky(Pj)
-                rhs = prior_prec @ mu0[:, j] + self.iSigma[j] * XtZ[:, j]
+                rhs = prior_prec @ mu0[:, j] + self.iSigma[j] * rhs_l
                 mean = np.linalg.solve(L.T, np.linalg.solve(L, rhs))
                 BL[:, j] = mean + np.linalg.solve(L.T, rng.standard_normal(P))
         self.Beta, self.Lambda = BL[:self.nc], BL[self.nc:]
@@ -307,7 +362,7 @@ class ReferenceEngine:
     # -- updateEta + updateAlpha (R/updateEta.R, R/updateAlpha.R) ----------
     def update_eta_alpha(self):
         rng = self.rng
-        S = self.Z - self.X @ self.Beta
+        S = self.Z - self.X @ self._beta_eff()
         G = (self.Lambda * self.iSigma[None]) @ self.Lambda.T
         PtS = np.zeros((self.n_units, self.Lambda.shape[1]))
         np.add.at(PtS, self.pi_row, S)
@@ -393,9 +448,84 @@ class ReferenceEngine:
         b = 5.0 + 0.5 * (resid ** 2).sum(0)
         self.iSigma[est] = self.rng.gamma(a, 1.0 / b)
 
+    # -- updateBetaSel (independent restatement of the masked-design MH
+    #    flip; acceptance uses the Gaussian density of the augmented Z, the
+    #    full conditional of the switches under the DA model — the same
+    #    target the JAX engine samples, hmsc_tpu/mcmc/updaters_sel.py:12) --
+    def update_beta_sel(self):
+        rng = self.rng
+        E = self.X @ self._beta_eff() + self.Eta[self.pi_row] @ self.Lambda
+        std = self.iSigma ** -0.5
+
+        def ll_sp(Ecur, sp):
+            r = (self.Z[:, sp] - Ecur[:, sp]) / std[None, sp]
+            return float(np.sum(-0.5 * r * r - np.log(std[None, sp])))
+
+        for i, (cov, spg, q) in enumerate(self.xsel):
+            # this selection's own block under the *full* design (other
+            # selections' masks never touch these covariates: validation
+            # forbids overlapping cov groups, as the reference's X-list
+            # threading assumes)
+            Lg = self.X[:, cov] @ self.Beta[cov]         # (ny, ns)
+            for g in range(len(q)):
+                cur = self.BetaSel[i][g]
+                sp = np.nonzero(spg == g)[0]
+                Enew = E.copy()
+                Enew[:, sp] += (-1.0 if cur else 1.0) * Lg[:, sp]
+                lldif = ll_sp(Enew, sp) - ll_sp(E, sp)
+                pridif = (np.log1p(-q[g]) - np.log(q[g]) if cur
+                          else np.log(q[g]) - np.log1p(-q[g]))
+                if np.log(rng.uniform()) < lldif + pridif:
+                    self.BetaSel[i][g] = not cur
+                    E = Enew
+
+    # -- updatewRRR + updatewRRRPriors (independent restatement of the GLS
+    #    draw of the projection weights, R/updatewRRR.R:7-80, with the
+    #    column-major vec layout on the (ncr, nco) matrix, and the
+    #    multiplicative-gamma shrinkage of R/updatewRRRPriors.R) -----------
+    def update_w_rrr(self):
+        rng = self.rng
+        ncn = self.X1.shape[1]
+        BetaN, BetaR = self.Beta[:ncn], self.Beta[ncn:]
+        S = self.Z - self.X1 @ BetaN - self.Eta[self.pi_row] @ self.Lambda
+        A1 = (BetaR * self.iSigma[None]) @ BetaR.T        # (ncr, ncr)
+        A2 = self.XRRR.T @ self.XRRR                      # (nco, nco)
+        tau = np.cumprod(self.DeltaRRR)
+        prior = (self.PsiRRR * tau[:, None]).T.reshape(-1)
+        iU = np.kron(A2, A1) + np.diag(prior)
+        mu1 = ((BetaR * self.iSigma[None]) @ S.T @ self.XRRR).T.reshape(-1)
+        L = np.linalg.cholesky(iU)
+        mean = np.linalg.solve(L.T, np.linalg.solve(L, mu1))
+        we = mean + np.linalg.solve(L.T, rng.standard_normal(iU.shape[0]))
+        self.wRRR = we.reshape(self.nco, self.ncr).T
+        self.X = np.concatenate([self.X1, self.XRRR @ self.wRRR.T], axis=1)
+
+        # shrinkage priors
+        lam2 = self.wRRR ** 2
+        tau = np.cumprod(self.DeltaRRR)
+        self.PsiRRR = rng.gamma(
+            self.nuRRR / 2 + 0.5,
+            1.0 / (self.nuRRR / 2 + 0.5 * lam2 * tau[:, None]))
+        M = self.PsiRRR * lam2
+        Msum = M.sum(axis=1)
+        for h in range(self.ncr):
+            tau = np.cumprod(self.DeltaRRR)
+            if h == 0:
+                a = self.a1RRR + 0.5 * self.nco * self.ncr
+                b0 = self.b1RRR
+            else:
+                a = self.a2RRR + 0.5 * self.nco * (self.ncr - h)
+                b0 = self.b2RRR
+            b = b0 + 0.5 * (tau[h:] * Msum[h:]).sum() / self.DeltaRRR[h]
+            self.DeltaRRR[h] = rng.gamma(a, 1.0 / b)
+
     def sweep(self):
         E = self.update_z()
         self.update_beta_lambda()
+        if self.ncr:
+            self.update_w_rrr()
+        if self.xsel:
+            self.update_beta_sel()
         self.update_gamma_v_rho()
         self.update_lambda_priors()
         self.update_eta_alpha()

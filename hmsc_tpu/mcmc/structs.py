@@ -147,6 +147,12 @@ class ModelData(struct.PyTreeNode):
     xrrr_scale_par: Any = None   # (2, nc_orrr)
     x_intercept_ind: Any = None  # () int32 or None
     tr_intercept_ind: Any = None
+    # first all-ones column of the *scaled* design (the named intercept when
+    # present, else detected by value): the column the interweaving moves can
+    # shift.  Detection by name alone (x_intercept_ind) silently no-ops the
+    # moves for raw-matrix designs whose first column is ones — measured in
+    # round 5: every prior interweave A/B had the move gated off.
+    x_ones_ind: Any = None       # () int32 or None
 
 
 class LevelState(struct.PyTreeNode):
@@ -217,6 +223,20 @@ def build_spec(hM: Hmsc, nf_cap: int = DEFAULT_NF_CAP) -> ModelSpec:
     )
 
 
+def _find_ones_column(hM) -> Any:
+    """First all-ones column of the scaled design the sampler runs on (the
+    shiftable direction the interweaving moves need).  Prefers the named
+    intercept; otherwise detects by value.  None for per-species X lists
+    (the moves are gated off there anyway)."""
+    if hM.x_intercept_ind is not None:
+        return jnp.asarray(hM.x_intercept_ind, dtype=jnp.int32)
+    Xs = np.asarray(hM.XScaled)
+    if Xs.ndim != 2:
+        return None
+    ones = np.nonzero(np.all(Xs == 1.0, axis=0))[0]
+    return jnp.asarray(ones[0], dtype=jnp.int32) if ones.size else None
+
+
 def build_model_data(hM: Hmsc, data_par: DataParams, spec: ModelSpec,
                      dtype=jnp.float32) -> ModelData:
     """Assemble the HBM-resident constant arrays from the host spec."""
@@ -281,6 +301,7 @@ def build_model_data(hM: Hmsc, data_par: DataParams, spec: ModelSpec,
                          else jnp.asarray(hM.x_intercept_ind, dtype=jnp.int32)),
         tr_intercept_ind=(None if hM.tr_intercept_ind is None
                           else jnp.asarray(hM.tr_intercept_ind, dtype=jnp.int32)),
+        x_ones_ind=_find_ones_column(hM),
     )
     if hM.nc_rrr > 0:
         kw["xrrr_scale_par"] = f(hM.xrrr_scale_par)

@@ -127,18 +127,21 @@ def make_sweep(spec: ModelSpec, updater: dict | None = None,
                     state = state.replace(levels=tuple(levels))
 
         # beyond-reference: per-factor (Eta, Lambda) scale interweaving
-        # (default on; measured 2x ESS on association scales) and the
-        # opt-in (Eta, Beta_intercept) location move (no measured gain at
-        # config-2 scale — see updaters.interweave_location).  Both leave
-        # the linear predictor invariant, so E_shared stays valid.  Gated on
-        # the updaters they perturb: a frozen Eta/BetaLambda run (debugging,
-        # conditional sampling) must not see drifting Eta/Lambda/Beta
+        # (measured 2x ESS on association scales) and the per-factor
+        # (Eta, Beta_intercept) location move (measured +10% min / +20%
+        # median Beta ESS at config 2 once the round-5 gate fix made it
+        # actually run — benchmarks/ab_interweave_da.py).  Both default on,
+        # both leave the linear predictor invariant, so E_shared stays
+        # valid.  interweave_location self-gates (location_gate) on models
+        # where its invariance breaks.  Gated on the updaters they perturb:
+        # a frozen Eta/BetaLambda run (debugging, conditional sampling)
+        # must not see drifting Eta/Lambda/Beta
         iw_ok = spec.nr > 0 and on("Eta") and on("BetaLambda")
-        if iw_ok and (on("Interweave") or want("InterweaveLocation")):
+        if iw_ok and (on("Interweave") or on("InterweaveLocation")):
             kI1, kI2 = jax.random.split(ks[12])
             if on("Interweave"):
                 state = U.interweave_scale(spec, data, state, kI1)
-            if want("InterweaveLocation"):
+            if on("InterweaveLocation"):
                 state = U.interweave_location(spec, data, state, kI2)
 
         if on("InvSigma"):

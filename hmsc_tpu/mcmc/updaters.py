@@ -552,14 +552,14 @@ def interweave_location(spec: ModelSpec, data: ModelData, state: GibbsState,
     leading-factor loadings (min-ESS vs head-loading correlation -0.51 /
     -0.57; tail loadings uncorrelated at config-2 scale), i.e. the classic
     mean-split ridge between X_int Beta_int and the factor term — not the
-    shrinkage tail.  **Measured outcome**: at config-2 scale the move does
-    NOT improve min/median Beta ESS (A/B: 43.8/212 on vs 52.2/248 off,
-    within run-to-run noise) — with np=400 units the Eta prior pins the
-    translation orbit tightly (conditional sd ~ (1' iW 1)^{-1/2}), so the
-    orbit is not the bottleneck; the residual slow mode is consistent with
-    probit data-augmentation saturation at large |E|.  Hence **opt-in**
-    (``updater={"InterweaveLocation": True}``), kept because it is exact,
-    Geweke-validated, and may pay off on weakly-pinned spatial orbits.
+    shrinkage tail.  **Measured outcome** (round 5, after the gate fix that
+    made the move actually run — every earlier A/B had it silently disabled
+    because raw-matrix designs carry no *named* intercept): a 5-seed
+    engaged A/B at config 2 gives min/median Beta ESS 53.8/192.6 off ->
+    59.1/232.2 on (**+10% min, +20% median**,
+    ``benchmarks/ab_interweave_da.py``) at a handful of reductions per
+    sweep.  Hence **default on**; disable with
+    ``updater={"InterweaveLocation": False}``.
     The joint nf-dim Gaussian for c has precision
     ``P = diag(1' iW_h 1) + iV_int,int Lam iQ Lam'`` and linear term
     ``Lam iQ (R' iV e_int) - 1' iW_h eta_h`` with R = Beta - Gamma Tr'
@@ -568,9 +568,9 @@ def interweave_location(spec: ModelSpec, data: ModelData, state: GibbsState,
     gather.  Structural eligibility lives in :func:`location_gate` (shared
     with the sampler's opt-in gate message); covariate-dependent levels are
     left untouched (their factor term is not row-constant)."""
-    if location_gate(spec, has_intercept=data.x_intercept_ind is not None):
+    if location_gate(spec, has_intercept=data.x_ones_ind is not None):
         return state
-    ii = data.x_intercept_ind
+    ii = data.x_ones_ind
     Beta = state.Beta
     Mu = jnp.einsum("ct,jt->cj", state.Gamma, data.Tr)
     iV = state.iV
@@ -653,7 +653,7 @@ def interweave_da_intercept(spec: ModelSpec, data: ModelData,
     imputed Z rides along with the shift; non-probit columns are left
     untouched.  Structural eligibility lives in
     :func:`da_intercept_gate`."""
-    ii = data.x_intercept_ind
+    ii = data.x_ones_ind
     fam = data.distr_family                           # (ns,)
     prob = fam == 2
     b0 = state.Beta[ii]                               # (ns,)

@@ -171,6 +171,13 @@ def _nngp_grids(s: np.ndarray, k: int, alphas: np.ndarray) -> LevelParams:
                        detWg=detWg, s=s)
 
 
+# conditional-variance floor for the GPP grids (see the comment at its use;
+# module-level so the knot-coincidence regression test can probe values).
+# 1e-3 of the unit marginal variance: measured stable over 4 chains at the
+# knot-coincident regression config (1e-4 still diverged in f32)
+_GPP_DD_FLOOR = 1e-3
+
+
 def _gpp_grids(s: np.ndarray, knots: np.ndarray, alphas: np.ndarray) -> LevelParams:
     """Knot-based predictive-process grids (reference
     computeDataParameters.R:138-194): per alpha the diagonal residual
@@ -195,6 +202,15 @@ def _gpp_grids(s: np.ndarray, knots: np.ndarray, alphas: np.ndarray) -> LevelPar
             W12 = np.exp(-d12 / a)
         iW22 = np.linalg.inv(W22 + 1e-10 * np.eye(nK))
         dD = 1.0 - np.einsum("ik,kl,il->i", W12, iW22, W12)
+        # nugget floor: a unit placed AT (or within float distance of) a
+        # knot has conditional variance dD -> 0, so idD = 1/dD explodes and
+        # the f32 double-Woodbury Eta solve cancels catastrophically
+        # (measured: knots taken from the data locations give idD ~ 1e10
+        # and the chain diverges at sweep 1).  The floor is far below any
+        # realistic residual scale and keeps the on-device algebra within
+        # f32 range.  (The reference divides by dD with no guard and would
+        # produce Inf on exact coincidence, computeDataParameters.R:138-194.)
+        dD = np.maximum(dD, _GPP_DD_FLOOR)
         idD = 1.0 / dD
         idDW12 = idD[:, None] * W12
         F = W22 + W12.T @ idDW12

@@ -373,3 +373,118 @@ def test_parity_config5_mixed_distr():
     zO = _z_scores(_jax_omega(post), nd["Omega"])
     zS = _z_scores(post["sigma"], nd["sigma"])
     _assert_parity([zB, zO, zS], "config5")
+
+
+def test_parity_config_xselect():
+    """Spike-and-slab variable selection (XSelect / updateBetaSel).
+
+    Covariate 2 is selectable per species group: group 0 carries a real
+    effect (decisive evidence — both engines must include it essentially
+    always), group 1 is null (interior inclusion probability — compared by
+    z-score, with ESS-based SEs absorbing the sticky switch chain).  The
+    recorded Beta is the masked spike-and-slab mixture in both engines
+    (reference combineParameters.R:45-53), so its parity jointly tests the
+    MH acceptance algebra and the masked BetaLambda draw."""
+    rng = np.random.default_rng(66)
+    ny, ns, nf = 60, 8, 2
+    X = np.column_stack([np.ones(ny), rng.standard_normal(ny),
+                         rng.standard_normal(ny)])
+    beta = rng.standard_normal((3, ns)) * 0.5
+    beta[2, :4] = 0.25
+    beta[2, 4:] = 0.0
+    Y = ((X @ beta + rng.standard_normal((ny, ns))) > 0).astype(float)
+    spg = np.array([0] * 4 + [1] * 4)
+    study = pd.DataFrame({"sample": [f"s{i:03d}" for i in range(ny)]})
+    rl = HmscRandomLevel(units=study["sample"])
+    set_priors_random_level(rl, nf_max=nf, nf_min=nf)
+
+    from hmsc_tpu.model import XSelect
+    m = Hmsc(Y=Y, X=X, distr="probit", study_design=study,
+             ran_levels={"sample": rl}, x_scale=False,
+             x_select=[XSelect(cov_group=[2], sp_group=spg, q=[0.5, 0.5])])
+    post = sample_mcmc(m, samples=_n(1200), transient=400, n_chains=2,
+                       seed=21, nf_cap=nf, align_post=False)
+
+    eng = ReferenceEngine(Y, X, np.full(ns, 2), nf,
+                          np.random.default_rng(9),
+                          xselect=[(np.array([2]), spg,
+                                    np.array([0.5, 0.5]))])
+    betas, omegas, incl = [], [], []
+    for _ in range(400):
+        eng.sweep()
+    for _ in range(_n(2400)):
+        eng.sweep()
+        betas.append(eng.Beta * eng._selmask())
+        omegas.append(eng.Lambda.T @ eng.Lambda)
+        incl.append(eng.BetaSel[0].copy())
+    betas, omegas = np.asarray(betas), np.asarray(omegas)
+    incl = np.asarray(incl, float)
+
+    zB = _z_scores(post["Beta"], betas)
+    zO = _z_scores(_jax_omega(post), omegas)
+
+    # inclusion indicators derived from the masked Beta (exact zeros):
+    # group 0 must saturate on both sides; group 1 is interior -> z-test
+    jB = np.asarray(post["Beta"])                      # (c, n, nc, ns)
+    j_incl = (jB[:, :, 2, :] != 0.0).astype(float)     # (c, n, ns)
+    j_g1 = j_incl[:, :, spg == 1].mean(axis=-1)        # (c, n)
+    n_g1 = incl[:, 1]                                  # (n,)
+    assert j_incl[:, :, spg == 0].mean() > 0.95
+    assert incl[:, 0].mean() > 0.95
+    zI = _z_scores(j_g1[:, :, None], n_g1[:, None])
+    _assert_parity([zB, zO, zI], "config_xselect")
+
+
+def test_parity_config_rrr():
+    """Reduced-rank regression (XRRR / updatewRRR / updatewRRRPriors).
+
+    The raw (wRRR, Beta_RRR) pair is sign/rotation ambiguous, so the parity
+    targets are the identified quantities: the induced full-rank coefficient
+    block P = wRRR' Beta_RRR (nco, ns), the non-RRR Beta rows, the non-RRR
+    block of V, Omega and sigma.  V's RRR rows/cols are excluded: the
+    likelihood-invariant scale ridge (c*wRRR, Beta_RRR/c) leaves the
+    Beta_RRR scale identified only through the two shrinkage priors, and the
+    resulting near-unit-root V entries defeat finite-run ESS-based SEs (the
+    same mixing-not-discrepancy situation as config 5's note)."""
+    rng = np.random.default_rng(12)
+    ny, ns, nf, nco, ncr = 150, 10, 2, 6, 2
+    X1 = np.column_stack([np.ones(ny), rng.standard_normal(ny)])
+    XR = rng.standard_normal((ny, nco))
+    w_true = rng.standard_normal((ncr, nco)) * 0.6
+    br_true = rng.standard_normal((ncr, ns)) * 0.6
+    Y = (X1 @ (rng.standard_normal((2, ns)) * 0.5)
+         + XR @ w_true.T @ br_true + rng.standard_normal((ny, ns)))
+    study = pd.DataFrame({"sample": [f"s{i:03d}" for i in range(ny)]})
+    rl = HmscRandomLevel(units=study["sample"])
+    set_priors_random_level(rl, nf_max=nf, nf_min=nf)
+    m = Hmsc(Y=Y, X=X1, XRRR=XR, nc_rrr=ncr, distr="normal",
+             study_design=study, ran_levels={"sample": rl},
+             x_scale=False, xrrr_scale=False)
+    post = sample_mcmc(m, samples=_n(1200), transient=400, n_chains=2,
+                       seed=31, nf_cap=nf, align_post=False)
+
+    eng = ReferenceEngine(Y, X1, np.full(ns, 1), nf,
+                          np.random.default_rng(13),
+                          xrrr=XR, nc_rrr=ncr)
+    betasN, prods, omegas, vs, sigs = [], [], [], [], []
+    for _ in range(400):
+        eng.sweep()
+    for _ in range(_n(2400)):
+        eng.sweep()
+        betasN.append(eng.Beta[:2].copy())
+        prods.append(eng.wRRR.T @ eng.Beta[2:])
+        omegas.append(eng.Lambda.T @ eng.Lambda)
+        vs.append(np.linalg.inv(eng.iV))
+        sigs.append(1.0 / eng.iSigma.copy())
+
+    jB = np.asarray(post["Beta"])                       # (c, n, nc, ns)
+    jW = np.asarray(post["wRRR"])                       # (c, n, ncr, nco)
+    jP = np.einsum("cnrk,cnrj->cnkj", jW, jB[:, :, 2:])
+
+    zBn = _z_scores(jB[:, :, :2], np.asarray(betasN))
+    zP = _z_scores(jP, np.asarray(prods))
+    zO = _z_scores(_jax_omega(post), np.asarray(omegas))
+    zV = _z_scores(np.asarray(post["V"])[:, :, :2, :2],
+                   np.asarray(vs)[:, :2, :2])
+    zS = _z_scores(post["sigma"], np.asarray(sigs))
+    _assert_parity([zBn, zP, zO, zV, zS], "config_rrr")

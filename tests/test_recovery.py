@@ -347,13 +347,18 @@ def test_interweave_preserves_stationary_distribution():
     assert abs(res["plain"][1] - res["iw"][1]) < 0.05 * res["plain"][1], res
 
 
-def test_interweave_location_preserves_stationary_distribution():
+def test_interweave_location_preserves_stationary_distribution(capsys):
     """The opt-in (Eta, Beta_intercept) location move
     (updaters.interweave_location) is exact Gibbs along the
     likelihood-invariant translation orbit, so the posterior must be
     IDENTICAL with and without it: compare long-run means of the intercept
     Beta row and the Eta column mean on a model where the mean split is well
-    identified (shared units pin Eta)."""
+    identified (shared units pin Eta).  The run must also prove the move
+    actually engaged: X here is a raw ones-column matrix with no named
+    intercept, which silently gated the move off until round 5 (the gate now
+    detects the shiftable ones column by value, structs._find_ones_column) —
+    a vacuous identical-arms comparison must never pass as validation
+    again."""
     rng = np.random.default_rng(9)
     n_units, per, ns = 25, 5, 8
     ny = n_units * per
@@ -367,14 +372,22 @@ def test_interweave_location_preserves_stationary_distribution():
     m = Hmsc(Y=Y, X=np.ones((ny, 1)), distr="normal", study_design=study,
              ran_levels={"u": rl}, x_scale=False)
     res = {}
-    for tag, upd in [("plain", None), ("loc", {"InterweaveLocation": True})]:
+    for tag, upd in [("plain", {"InterweaveLocation": False}),
+                     ("loc", {"InterweaveLocation": True})]:
+        capsys.readouterr()
         post = sample_mcmc(m, samples=1500, transient=500, n_chains=2,
                            seed=13, nf_cap=1, updater=upd, align_post=False)
+        if tag == "loc":
+            assert "InterweaveLocation=FALSE" not in capsys.readouterr().out, \
+                "gate declined the move — the A/B below would be vacuous"
         b0 = post.pooled("Beta")[:, 0, :].mean()
         em = post.pooled("Eta_0")[:, :, 0].mean()
         res[tag] = (b0, em)
     assert abs(res["plain"][0] - res["loc"][0]) < 0.04, res
     assert abs(res["plain"][1] - res["loc"][1]) < 0.04, res
+    # the two arms run different draw streams: identical pooled means to
+    # f32-exactness would mean the move never executed
+    assert res["plain"] != res["loc"]
 
 
 def test_interweave_da_preserves_stationary_distribution(capsys):
@@ -399,10 +412,16 @@ def test_interweave_da_preserves_stationary_distribution(capsys):
              ran_levels={"u": rl}, x_scale=False)
     res = {}
     for tag, upd in [("plain", None), ("da", {"InterweaveDA": True})]:
+        capsys.readouterr()
         post = sample_mcmc(m, samples=1500, transient=500, n_chains=2,
                            seed=21, nf_cap=1, updater=upd, align_post=False)
+        if tag == "da":
+            assert "InterweaveDA=FALSE" not in capsys.readouterr().out, \
+                "gate declined the move — the A/B below would be vacuous"
         res[tag] = post.pooled("Beta")[:, 0, :].mean()
     assert abs(res["plain"] - res["da"]) < 0.06, res
+    # identical means to f32-exactness would mean the move never executed
+    assert res["plain"] != res["da"]
 
     # structural gate: normal-only model -> announced auto-disable
     m2 = Hmsc(Y=L + rng.standard_normal((ny, ns)), X=np.ones((ny, 1)),

@@ -147,3 +147,35 @@ def test_full_sweep_and_record(cfg):
     rec = record_sample(spec, data, state)
     assert _finite(rec)
     assert rec["Beta"].shape == (spec.nc, spec.ns)
+
+
+def test_gpp_knots_at_data_locations_stay_finite():
+    """Knots placed exactly at observed locations give conditional variance
+    dD -> 0; without the nugget floor (precompute._gpp_grids) idD = 1/dD
+    reaches ~1e10 and the f32 double-Woodbury Eta draw cancels to NaN at
+    the first sweep (round-5 regression, caught by the GPP multichip
+    dry-run)."""
+    import pandas as pd
+    from hmsc_tpu.model import Hmsc
+    from hmsc_tpu.random_level import HmscRandomLevel, set_priors_random_level
+    from hmsc_tpu.mcmc.sampler import sample_mcmc
+
+    rng = np.random.default_rng(11)
+    ny, plots, ns = 40, 20, 6
+    units = [f"p{i:02d}" for i in range(plots)]
+    xy = pd.DataFrame(rng.uniform(size=(plots, 2)), index=units,
+                      columns=["x", "y"])
+    X = np.column_stack([np.ones(ny), rng.standard_normal(ny)])
+    Y = ((X @ rng.standard_normal((2, ns))
+          + rng.standard_normal((ny, ns))) > 0).astype(float)
+    study = pd.DataFrame({"plot": [units[u] for u in
+                                   rng.integers(0, plots, ny)]})
+    rl = HmscRandomLevel(s_data=xy, s_method="GPP",
+                         s_knot=xy.values[::4])        # knots ⊂ data
+    set_priors_random_level(rl, nf_max=2, nf_min=2)
+    m = Hmsc(Y=Y, X=X, distr="probit", study_design=study,
+             ran_levels={"plot": rl}, x_scale=False)
+    post = sample_mcmc(m, samples=5, transient=5, n_chains=2, seed=0,
+                       align_post=False)
+    assert np.isfinite(np.asarray(post["Beta"])).all()
+    assert post.chain_health["good_chains"].all()
