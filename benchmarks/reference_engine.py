@@ -31,6 +31,8 @@ sampling phase, so it is omitted here.
 from __future__ import annotations
 
 import numpy as np
+
+from hmsc_tpu.precompute import _GP_DD_FLOOR
 from scipy.stats import truncnorm as sp_truncnorm
 
 
@@ -106,7 +108,8 @@ def nngp_grids(coords, n_neighbours=10, n_grid=101, alphas=None,
                                   ).sum(-1)) / a) + 1e-8 * np.eye(len(nb))
             ks = np.exp(-np.sqrt(((coords[nb] - coords[i]) ** 2).sum(-1)) / a)
             w = np.linalg.solve(Ks, ks)
-            dvec[i] = 1.0 - ks @ w
+            # same conditional-variance floor as the JAX engine's grids
+            dvec[i] = max(1.0 - ks @ w, _GP_DD_FLOOR)
             rows.extend([i] * len(nb)); cols.extend(nb); vals.extend(-w)
         A = sp.csr_matrix((vals, (rows, cols)), shape=(n, n))
         RiW = sp.diags(dvec ** -0.5) @ (sp.eye(n) + A)
@@ -134,10 +137,9 @@ def gpp_grids(coords, knots, alphas):
             iW22 = np.linalg.inv(np.exp(-d22 / a) + 1e-10 * np.eye(nK))
             Wt = W12 @ iW22 @ W12.T
             # same conditional-variance nugget floor as the JAX engine's
-            # grids (precompute._GPP_DD_FLOOR): the two engines must define
+            # grids (precompute._GP_DD_FLOOR): the two engines must define
             # the identical model, incl. at knot-coincident units
-            from hmsc_tpu.precompute import _GPP_DD_FLOOR
-            W = Wt + np.diag(np.maximum(1.0 - np.diag(Wt), _GPP_DD_FLOOR))
+            W = Wt + np.diag(np.maximum(1.0 - np.diag(Wt), _GP_DD_FLOOR))
         W = W + 1e-8 * np.eye(n)
         iW = np.linalg.inv(W)
         RiW = np.linalg.cholesky(iW)
@@ -439,10 +441,14 @@ class ReferenceEngine:
                 self.alpha_idx[h] = rng.choice(len(p), p=p)
 
     # -- updateInvSigma (R/updateInvSigma.R) -------------------------------
-    def update_inv_sigma(self, E):
+    def update_inv_sigma(self):
         est = self.fam == 1                      # estimated-dispersion species
         if not np.any(est):
             return
+        # E recomputed from the CURRENT state (reference updateInvSigma.R
+        # conditions on this sweep's Beta/Lambda/Eta/wRRR, and self.X itself
+        # moves when RRR is active) — a stale E biases the sigma draw
+        E = self.X @ self._beta_eff() + self.Eta[self.pi_row] @ self.Lambda
         resid = self.Z[:, est] - E[:, est]
         a = 1.0 + 0.5 * self.Y.shape[0]
         b = 5.0 + 0.5 * (resid ** 2).sum(0)
@@ -520,7 +526,7 @@ class ReferenceEngine:
             self.DeltaRRR[h] = rng.gamma(a, 1.0 / b)
 
     def sweep(self):
-        E = self.update_z()
+        self.update_z()
         self.update_beta_lambda()
         if self.ncr:
             self.update_w_rrr()
@@ -529,4 +535,4 @@ class ReferenceEngine:
         self.update_gamma_v_rho()
         self.update_lambda_priors()
         self.update_eta_alpha()
-        self.update_inv_sigma(E)
+        self.update_inv_sigma()

@@ -1,0 +1,49 @@
+#!/bin/bash
+# Round-5 TPU measurement orchestrator: probes the tunnel-attached chip and,
+# once reachable, captures everything the round is waiting on, in priority
+# order.  Each probe result is appended to /tmp/tpu_session/; safe to re-run.
+set -u
+OUT=/tmp/tpu_session
+mkdir -p "$OUT"
+
+probe() {
+  timeout 240 python -c "
+import jax
+d = jax.devices()
+import jax.numpy as jnp
+(jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
+print(d[0].platform)" > /dev/null 2>&1
+}
+
+for attempt in $(seq 1 200); do
+  if probe; then
+    echo "$(date -u +%H:%M:%S) attempt $attempt: chip reachable" >> "$OUT/log"
+    # completeness = all 6 variant lines, not mere non-emptiness (a tunnel
+    # drop mid-probe must trigger a re-run, not satisfy the guard)
+    if [ "$(grep -c '"variant"' "$OUT/bench_3b.json" 2>/dev/null)" != 6 ]; then
+      timeout 3000 python -u benchmarks/bench_3b_record.py \
+        > "$OUT/bench_3b.raw" 2>&1
+      grep '"variant"' "$OUT/bench_3b.raw" > "$OUT/bench_3b.json" || true
+    fi
+    if [ ! -s "$OUT/bench_headline.json" ]; then
+      timeout 1800 python -u bench.py > "$OUT/bench_headline.raw" 2>&1
+      grep '"metric"' "$OUT/bench_headline.raw" > "$OUT/bench_headline.json" || true
+    fi
+    if [ ! -s "$OUT/five_configs.done" ] \
+       && [ "$(grep -c '"variant"' "$OUT/bench_3b.json" 2>/dev/null)" = 6 ]; then
+      timeout 5400 python -u benchmarks/run_benchmarks.py \
+        > "$OUT/five_configs.raw" 2>&1 \
+        && grep -q '"config"' "$OUT/five_configs.raw" \
+        && touch "$OUT/five_configs.done"
+    fi
+    if [ "$(grep -c '"variant"' "$OUT/bench_3b.json" 2>/dev/null)" = 6 ] \
+       && [ -s "$OUT/bench_headline.json" ] \
+       && [ -s "$OUT/five_configs.done" ]; then
+      echo "$(date -u +%H:%M:%S) all captures complete" >> "$OUT/log"
+      exit 0
+    fi
+  else
+    echo "$(date -u +%H:%M:%S) attempt $attempt: unreachable" >> "$OUT/log"
+  fi
+  sleep 420
+done

@@ -163,6 +163,10 @@ def _nngp_grids(s: np.ndarray, k: int, alphas: np.ndarray) -> LevelParams:
         v = np.linalg.solve(Knn + 1e-10 * np.eye(k)[None], kin[..., None])[..., 0]
         v = np.where(pad_mask, v, 0.0)
         Dg = 1.0 - (kin * v).sum(-1)
+        # same coincidence hazard as the GPP grids: duplicate unit
+        # coordinates give conditional variance 0, so 1/D and log(D) blow
+        # up in the f32 quadratics / CG scalings
+        Dg = np.maximum(Dg, _GP_DD_FLOOR)
         Dg[0] = 1.0
         coef[g] = v
         D[g] = Dg
@@ -171,11 +175,12 @@ def _nngp_grids(s: np.ndarray, k: int, alphas: np.ndarray) -> LevelParams:
                        detWg=detWg, s=s)
 
 
-# conditional-variance floor for the GPP grids (see the comment at its use;
-# module-level so the knot-coincidence regression test can probe values).
-# 1e-3 of the unit marginal variance: measured stable over 4 chains at the
-# knot-coincident regression config (1e-4 still diverged in f32)
-_GPP_DD_FLOOR = 1e-3
+# conditional-variance floor for the GPP and NNGP grids (see the comments at
+# the use sites; module-level so the coincidence regression tests can probe
+# values).  1e-3 of the unit marginal variance: measured stable over 4
+# chains at the knot-coincident GPP regression config (1e-4 still diverged
+# in f32)
+_GP_DD_FLOOR = 1e-3
 
 
 def _gpp_grids(s: np.ndarray, knots: np.ndarray, alphas: np.ndarray) -> LevelParams:
@@ -210,7 +215,7 @@ def _gpp_grids(s: np.ndarray, knots: np.ndarray, alphas: np.ndarray) -> LevelPar
         # realistic residual scale and keeps the on-device algebra within
         # f32 range.  (The reference divides by dD with no guard and would
         # produce Inf on exact coincidence, computeDataParameters.R:138-194.)
-        dD = np.maximum(dD, _GPP_DD_FLOOR)
+        dD = np.maximum(dD, _GP_DD_FLOOR)
         idD = 1.0 / dD
         idDW12 = idD[:, None] * W12
         F = W22 + W12.T @ idDW12

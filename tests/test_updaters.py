@@ -179,3 +179,35 @@ def test_gpp_knots_at_data_locations_stay_finite():
                        align_post=False)
     assert np.isfinite(np.asarray(post["Beta"])).all()
     assert post.chain_health["good_chains"].all()
+
+
+def test_nngp_duplicate_coordinates_stay_finite():
+    """Two units at the same location give Vecchia conditional variance
+    D -> 0 (the NNGP analogue of the GPP knot-coincidence hazard); the
+    shared _GP_DD_FLOOR keeps 1/D, sqrt(D) and log(D) finite through the
+    f32 alpha-grid quadratics and the Eta draw."""
+    import pandas as pd
+    from hmsc_tpu.model import Hmsc
+    from hmsc_tpu.random_level import HmscRandomLevel, set_priors_random_level
+    from hmsc_tpu.mcmc.sampler import sample_mcmc
+
+    rng = np.random.default_rng(13)
+    ny, plots, ns = 40, 20, 6
+    units = [f"p{i:02d}" for i in range(plots)]
+    coords = rng.uniform(size=(plots, 2))
+    coords[1] = coords[0]                      # exact duplicate location
+    coords[11] = coords[10]
+    xy = pd.DataFrame(coords, index=units, columns=["x", "y"])
+    X = np.column_stack([np.ones(ny), rng.standard_normal(ny)])
+    Y = ((X @ rng.standard_normal((2, ns))
+          + rng.standard_normal((ny, ns))) > 0).astype(float)
+    study = pd.DataFrame({"plot": [units[u] for u in
+                                   rng.integers(0, plots, ny)]})
+    rl = HmscRandomLevel(s_data=xy, s_method="NNGP", n_neighbours=5)
+    set_priors_random_level(rl, nf_max=2, nf_min=2)
+    m = Hmsc(Y=Y, X=X, distr="probit", study_design=study,
+             ran_levels={"plot": rl}, x_scale=False)
+    post = sample_mcmc(m, samples=5, transient=5, n_chains=2, seed=0,
+                       align_post=False)
+    assert np.isfinite(np.asarray(post["Beta"])).all()
+    assert post.chain_health["good_chains"].all()
