@@ -30,6 +30,11 @@ from hmsc_tpu.mcmc.sampler import sample_mcmc
 
 
 def rate(m, kw, reps=3, **extra):
+    # grids once, outside the timed windows (symmetric with baseline_rate;
+    # reference equivalent: sampleMcmc(dataParList=))
+    if "data_par" not in extra and "data_par" not in kw:
+        from hmsc_tpu.precompute import compute_data_parameters
+        extra["data_par"] = compute_data_parameters(m)
     sample_mcmc(m, samples=SAMPLES, transient=TRANSIENT, n_chains=CHAINS,
                 seed=0, align_post=False, **kw, **extra)     # compile
     t = np.inf
@@ -52,6 +57,8 @@ def rate(m, kw, reps=3, **extra):
 def main():
     rng = np.random.default_rng(42)
     m, kw = config3_spatial_nngp(rng)
+    from hmsc_tpu.precompute import compute_data_parameters
+    kw = dict(kw, data_par=compute_data_parameters(m))   # grids once, shared
     t0 = time.time()
     base = baseline_rate("3b", m, nf=kw.get("nf_cap", 2))
     print(f"# baseline {base:.3f} sweeps/s ({time.time() - t0:.0f}s to "

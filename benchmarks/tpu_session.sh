@@ -3,16 +3,21 @@
 # once reachable, captures everything the round is waiting on, in priority
 # order.  Each probe result is appended to /tmp/tpu_session/; safe to re-run.
 set -u
+cd "$(dirname "$0")/.."
 OUT=/tmp/tpu_session
 mkdir -p "$OUT"
 
 probe() {
-  timeout 240 python -c "
+  # must print a non-cpu platform: a failed TPU init can fall back to the
+  # CPU backend, and single-core rates must never be recorded as per-chip
+  local plat
+  plat=$(timeout 240 python -c "
 import jax
 d = jax.devices()
 import jax.numpy as jnp
 (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
-print(d[0].platform)" > /dev/null 2>&1
+print(d[0].platform)" 2>/dev/null | tail -1)
+  [ -n "$plat" ] && [ "$plat" != "cpu" ]
 }
 
 for attempt in $(seq 1 200); do
@@ -29,16 +34,16 @@ for attempt in $(seq 1 200); do
       timeout 1800 python -u bench.py > "$OUT/bench_headline.raw" 2>&1
       grep '"metric"' "$OUT/bench_headline.raw" > "$OUT/bench_headline.json" || true
     fi
-    if [ ! -s "$OUT/five_configs.done" ] \
+    if [ ! -f "$OUT/five_configs.done" ] \
        && [ "$(grep -c '"variant"' "$OUT/bench_3b.json" 2>/dev/null)" = 6 ]; then
       timeout 5400 python -u benchmarks/run_benchmarks.py \
         > "$OUT/five_configs.raw" 2>&1 \
         && grep -q '"config"' "$OUT/five_configs.raw" \
-        && touch "$OUT/five_configs.done"
+        && echo done > "$OUT/five_configs.done"
     fi
     if [ "$(grep -c '"variant"' "$OUT/bench_3b.json" 2>/dev/null)" = 6 ] \
        && [ -s "$OUT/bench_headline.json" ] \
-       && [ -s "$OUT/five_configs.done" ]; then
+       && [ -f "$OUT/five_configs.done" ]; then
       echo "$(date -u +%H:%M:%S) all captures complete" >> "$OUT/log"
       exit 0
     fi
@@ -47,3 +52,5 @@ for attempt in $(seq 1 200); do
   fi
   sleep 420
 done
+echo "$(date -u +%H:%M:%S) attempts exhausted without complete captures" >> "$OUT/log"
+exit 1

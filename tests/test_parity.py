@@ -46,6 +46,15 @@ pytestmark = pytest.mark.slow
 # config's clean-run mean sits at ~1.1), so 1.0 would fail a correct
 # nightly run — but a fixed bias b grows like b/SE, so at 2x draws an
 # O(0.5*SE) bias the default 1.5 bound admits pushes the mean past 1.3.
+# The JAX side runs its default move set (scale + location interweaves on).
+# Measured round 5 on the GPP config: with the location move disabled the
+# JAX finite window is BADLY biased (sigma z ~ 18 at 2400 draws — the
+# spatial intercept/Eta-mean ridge at 2 rows/unit is genuinely slow without
+# it), so the move materially improves finite-window correctness and parity
+# runs keep it.  The asymmetric cost is on the NumPy engine, which has no
+# interweaves: its window converges along those ridges only with burn-in,
+# so ridge-sensitive configs give the ENGINE extra transient (config_gpp)
+# rather than loosening the z bounds.
 _SCALE = max(1, int(os.environ.get("HMSC_TPU_PARITY_SCALE", "1")))
 Z_MAX, Z_MEAN = 5.0, (1.3 if _SCALE >= 2 else 1.5)
 
@@ -88,7 +97,17 @@ def _jax_alpha(post, rl):
 
 def _z_scores(jax_draws, np_draws):
     """Entrywise two-sample z between (chains, n, ...) and (n, ...) draws.
-    Constant entries (fixed sigma) are required to match exactly instead."""
+    Constant entries (fixed sigma) are required to match exactly instead.
+
+    The JAX-side SE is the LARGER of the ESS-based and the between-chain
+    estimate.  Geyer's initial-monotone truncation under-resolves the
+    autocorrelation tail of entries posterior-coupled to slow modes (the
+    window-mean then wanders ~3x more than the ESS-SE claims — measured on
+    the GPP config's slopes: cross-seed window means scatter 0.018 against
+    a claimed SE of 0.003, while a seed-stability check shows no actual
+    bias).  The between-chain estimator var(chain means)/nchains is
+    unbiased under arbitrary within-chain autocorrelation; taking the max
+    keeps the sharper ESS bound wherever chains agree by luck."""
     A, B = np.asarray(jax_draws), np.asarray(np_draws)[None]
     mA, mB = A.mean(axis=(0, 1)), B.mean(axis=(0, 1))
     sA, sB = A.std(axis=(0, 1)), B.std(axis=(0, 1))
@@ -96,6 +115,9 @@ def _z_scores(jax_draws, np_draws):
     np.testing.assert_allclose(np.where(live, 0, mA), np.where(live, 0, mB),
                                atol=1e-6)
     seA = sA / np.sqrt(np.maximum(effective_size(A), 1.0))
+    if A.shape[0] >= 2:
+        between = A.mean(axis=1).std(axis=0, ddof=1) / np.sqrt(A.shape[0])
+        seA = np.maximum(seA, between)
     seB = sB / np.sqrt(np.maximum(effective_size(B), 1.0))
     z = np.abs(mA - mB) / np.sqrt(seA**2 + seB**2 + 1e-30)
     return z[live]
@@ -291,7 +313,9 @@ def test_parity_config_gpp():
                           np.random.default_rng(14), pi_row=unit_of,
                           spatial=("full", grids),
                           alpha_prior_w=np.asarray(rl.alphapw[:, 1]))
-    nd = _run_numpy(eng, transient=600, samples=_n(4800))
+    # engine-side burn-in is the lever for its un-interwoven translation
+    # ridge (see the module note): 4x the JAX transient
+    nd = _run_numpy(eng, transient=2400, samples=_n(4800))
 
     zB = _z_scores(post["Beta"], nd["Beta"])
     zO = _z_scores(_jax_omega(post), nd["Omega"])
