@@ -37,11 +37,18 @@ __all__ = ["update_eta_spatial", "update_alpha", "vecchia_ops",
 # above this many (units x factors) coefficients, NNGP Eta switches from the
 # dense joint cholesky to the matrix-free CG sampler.  Overridable via
 # HMSC_TPU_NNGP_DENSE_MAX (read at import) so the crossover can be A/B'd on
-# hardware without an edit — at config-3b shape (np=1000, nf=2) both paths
-# are viable and the faster one is chip-dependent.
+# hardware without an edit.  Default set from a measured sweep on the v5
+# chip (whole-sweep samples/s at config-3b shape, nf=2, best-of-3):
+#   coeff   250: dense 1321/s  cg 1150/s   (dense 1.15x)
+#   coeff   500: dense  503/s  cg  943/s   (cg 1.87x)
+#   coeff  1000: dense  492/s  cg  851/s   (cg 1.73x)
+#   coeff  2000: dense  145/s  cg  531/s   (cg 3.65x)  <- config 3b
+# so dense only pays below ~256 coefficients, where the (coeff x coeff)
+# cholesky is a trivially small kernel and CG's fixed iteration count costs
+# more dispatches than it saves FLOPs.
 import os as _os
 
-_NNGP_DENSE_MAX = int(_os.environ.get("HMSC_TPU_NNGP_DENSE_MAX", "4096"))
+_NNGP_DENSE_MAX = int(_os.environ.get("HMSC_TPU_NNGP_DENSE_MAX", "256"))
 
 
 # ---------------------------------------------------------------------------

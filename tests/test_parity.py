@@ -95,6 +95,11 @@ def _jax_alpha(post, rl):
     return vals.reshape((int(good.sum()), -1) + vals.shape[1:])
 
 
+# parametrized tests whose NumPy-engine side is parameter-invariant park it
+# here so the slow reference sweep runs once per module, not once per param
+_ENGINE_CACHE = {}
+
+
 def _z_scores(jax_draws, np_draws):
     """Entrywise two-sample z between (chains, n, ...) and (n, ...) draws.
     Constant entries (fixed sigma) are required to match exactly instead.
@@ -210,16 +215,27 @@ def test_parity_config3a_spatial_full():
     _assert_parity([zB, zO, zS, zA], "config3a")
 
 
-def test_parity_config3b_nngp():
+@pytest.mark.parametrize("eta_path", ["dense", "cg"])
+def test_parity_config3b_nngp(eta_path, monkeypatch):
     """Config 3b: NNGP spatial level — the Vecchia-factor machinery (dense
     neighbour arrays / matrix-free draw on the JAX side,
     ``mcmc/spatial.py:75-90``; sparse factors + splu here) plus the
     updateAlpha grid scan (``R/updateEta.R:110-147``, ``R/updateAlpha.R``).
 
+    Parametrized over both Eta draw paths: at this size (96 coefficients)
+    the dense joint cholesky is the production default, but the matrix-free
+    Vecchia-CG sampler is what config 3b runs at np=1000 (the measured
+    crossover put ``_NNGP_DENSE_MAX`` at 256), so the CG draw gets the same
+    independent cross-engine check — not just the within-engine moments and
+    Geweke tiers.
+
     The neighbour graph is part of the model specification (each point's
     Vecchia prior conditions on a fixed set of lower-index points), so the
     engine is given the same kNN-lower-index graph the model builds; the
     factor algebra on top of it is computed independently by each engine."""
+    if eta_path == "cg":
+        from hmsc_tpu.mcmc import spatial as _sp
+        monkeypatch.setattr(_sp, "_NNGP_DENSE_MAX", 0)
     rng = np.random.default_rng(11)
     npu, ny_per, ns, nf, k = 48, 2, 6, 2, 6
     units = [f"u{i:02d}" for i in range(npu)]
@@ -248,13 +264,18 @@ def test_parity_config3b_nngp():
     _, idx = cKDTree(xy_all).query(xy_all, k=k + 1)
     nn = np.sort(idx[:, 1:], axis=1)
     nbrs = [nn[i][nn[i] < i] for i in range(npu)]
-    grids = nngp_grids(xy_all, alphas=np.asarray(rl.alphapw[:, 0], float),
-                       neighbours=nbrs)
-    eng = ReferenceEngine(Y, X, np.full(ns, 1), nf,
-                          np.random.default_rng(12), pi_row=unit_of,
-                          spatial=("nngp", grids),
-                          alpha_prior_w=np.asarray(rl.alphapw[:, 1]))
-    nd = _run_numpy(eng, transient=400, samples=_n(2400))
+    # the NumPy side is identical for both eta_path params (the monkeypatch
+    # only touches the JAX engine), so its slow sweep runs once per module
+    if "config3b" not in _ENGINE_CACHE:
+        grids = nngp_grids(xy_all, alphas=np.asarray(rl.alphapw[:, 0], float),
+                           neighbours=nbrs)
+        eng = ReferenceEngine(Y, X, np.full(ns, 1), nf,
+                              np.random.default_rng(12), pi_row=unit_of,
+                              spatial=("nngp", grids),
+                              alpha_prior_w=np.asarray(rl.alphapw[:, 1]))
+        _ENGINE_CACHE["config3b"] = _run_numpy(eng, transient=400,
+                                               samples=_n(2400))
+    nd = _ENGINE_CACHE["config3b"]
 
     zB = _z_scores(post["Beta"], nd["Beta"])
     zO = _z_scores(_jax_omega(post), nd["Omega"])
