@@ -172,12 +172,32 @@ def _probe_device(timeout_s: int = 180):
 
 def main():
     import sys
+    import time as _time
 
-    try:
-        plat = _probe_device()
-    except Exception as e:                      # noqa: BLE001
+    # the tunnel to the remote-attached chip drops and returns on
+    # minute-scales (observed rounds 4-5); a few spaced probes before giving
+    # up make the difference between a recorded measurement and an rc=2
+    # round artifact, while still bounding total failure time to ~15 min.
+    # Only tunnel-shaped failures are worth waiting out — a broken
+    # environment (e.g. import error in the probe subprocess) fails the
+    # same way every time and aborts on the first attempt.
+    _transient = ("timed out", "connection", "unavailable", "deadline")
+    plat, last = None, None
+    for attempt in range(3):
+        if attempt:
+            _time.sleep(180)
+        try:
+            plat = _probe_device()
+            break
+        except Exception as e:                  # noqa: BLE001
+            last = e
+            print(f"bench.py: device probe attempt {attempt + 1}/3 failed "
+                  f"({e})", file=sys.stderr)
+            if not any(s in str(e).lower() for s in _transient):
+                break                           # same-every-time failure
+    if plat is None:
         print(f"bench.py: accelerator unreachable, aborting before the "
-              f"timed runs ({e})", file=sys.stderr)
+              f"timed runs ({last})", file=sys.stderr)
         raise SystemExit(2)
     if plat == "cpu":
         # a failed TPU init falls back to the CPU backend with a warning; a
