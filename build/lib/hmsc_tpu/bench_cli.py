@@ -1,0 +1,72 @@
+"""Installed-package throughput probe (``hmsc-tpu-bench`` console script).
+
+Measures steady-state posterior samples/sec of the blocked-Gibbs engine on
+whatever accelerator JAX finds (compile excluded, best-of-3 windows) and
+prints one JSON line.  The repo-level ``bench.py`` harness additionally runs
+the reference-style NumPy baseline for a measured ``vs_baseline`` ratio; from
+an installed wheel only the package itself is available, so the ratio is
+reported as ``null`` here.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _model(ny, ns, nf, seed=66):
+    import pandas as pd
+
+    from .model import Hmsc
+    from .random_level import HmscRandomLevel, set_priors_random_level
+
+    rng = np.random.default_rng(seed)
+    X = np.column_stack([np.ones(ny), rng.standard_normal(ny)])
+    Y = ((X @ (rng.standard_normal((2, ns)) * 0.5)
+          + rng.standard_normal((ny, 2)) @ (rng.standard_normal((2, ns)) * 0.7)
+          + rng.standard_normal((ny, ns))) > 0).astype(float)
+    study = pd.DataFrame({"sample": [f"s{i:04d}" for i in range(ny)]})
+    rL = HmscRandomLevel(units=study["sample"])
+    set_priors_random_level(rL, nf_max=nf, nf_min=2)
+    return Hmsc(Y=Y, X=X, study_design=study, ran_levels={"sample": rL},
+                distr="probit", x_scale=False)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="hmsc-tpu sampling-throughput probe")
+    parser.add_argument("--ny", type=int, default=200)
+    parser.add_argument("--ns", type=int, default=100)
+    parser.add_argument("--nf", type=int, default=4)
+    parser.add_argument("--samples", type=int, default=200)
+    parser.add_argument("--chains", type=int, default=4)
+    args = parser.parse_args(argv)
+
+    import jax
+
+    from .mcmc.sampler import sample_mcmc
+
+    hM = _model(args.ny, args.ns, args.nf)
+    kw = dict(samples=args.samples, transient=10, n_chains=args.chains,
+              align_post=False, nf_cap=args.nf)
+    sample_mcmc(hM, seed=0, **kw)               # warm-up: compile
+    t = np.inf
+    for rep in range(3):
+        t0 = time.time()
+        post = sample_mcmc(hM, seed=1 + rep, **kw)
+        t = min(t, time.time() - t0)
+        assert np.all(np.isfinite(post["Beta"]))
+    print(json.dumps({
+        "metric": f"posterior samples/sec ({args.ns}-species probit JSDM, "
+                  f"{args.chains} chains, {jax.devices()[0].platform})",
+        "value": round(args.chains * args.samples / t, 2),
+        "unit": "samples/sec",
+        "vs_baseline": None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from .td import make_td, random_coalescent_corr, simulate_jsdm
+
+__all__ = ["make_td", "random_coalescent_corr", "simulate_jsdm"]

@@ -1,0 +1,6 @@
+from .structs import (GibbsState, LevelSpec, LevelState, ModelData, ModelSpec,
+                      build_model_data, build_state, LevelData)
+from .sampler import sample_mcmc
+
+__all__ = ["GibbsState", "LevelSpec", "LevelState", "ModelData", "ModelSpec",
+           "LevelData", "build_model_data", "build_state", "sample_mcmc"]

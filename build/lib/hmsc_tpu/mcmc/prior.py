@@ -1,0 +1,192 @@
+"""Direct prior sampling (reference ``R/samplePrior.R:15-145``), used by
+``sample_mcmc(from_prior=True)`` and the Geweke prior<->posterior consistency
+tests (SURVEY.md §4).  Host-side numpy; spatial Eta draws use the exact GP
+covariance W(alpha) rebuilt from the stored distance structures."""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy import stats as sps
+
+__all__ = ["sample_prior", "sample_prior_chains"]
+
+
+def _spatial_prior_eta(hM, lp, r, alpha_idx, np_r, nf, rng):
+    rL = hM.ranLevels[r]
+    alphas = rL.alphapw[:, 0]
+    eta = rng.standard_normal((np_r, nf))
+    if lp is None:
+        return eta
+    if lp.kind == "Full":
+        dist = lp.distance
+        for h in range(nf):
+            a = alphas[alpha_idx[h]]
+            W = np.eye(np_r) if a == 0 else np.exp(-dist / a)
+            L = np.linalg.cholesky(W + 1e-8 * np.eye(np_r))
+            eta[:, h] = L @ rng.standard_normal(np_r)
+        return eta
+    if lp.kind == "NNGP":
+        # sequential Vecchia draw from the *approximate* process the posterior
+        # sampler targets (same nn_coef/nn_D factors), not the exact kernel —
+        # keeps prior<->posterior Geweke checks consistent
+        for h in range(nf):
+            g = alpha_idx[h]
+            if alphas[g] == 0:
+                continue  # W = I: keep the standard-normal column
+            coef, D = lp.nn_coef[g], lp.nn_D[g]
+            # padded neighbour slots are safe because precompute zeroes their
+            # nn_coef entries (precompute.py pad_mask), not because of init order
+            col = np.zeros(np_r)
+            eps = rng.standard_normal(np_r)
+            for i in range(np_r):
+                col[i] = coef[i] @ col[lp.nn_idx[i]] + np.sqrt(D[i]) * eps[i]
+            eta[:, h] = col
+        return eta
+    # GPP: covariance of the predictive process = W12 iW22 W21 + diag(dD),
+    # reconstructed from the stored grids so prior == posterior target
+    for h in range(nf):
+        g = alpha_idx[h]
+        if alphas[g] == 0:
+            continue
+        dD = 1.0 / lp.idDg[g]
+        W12 = lp.idDW12g[g] * dD[:, None]
+        W22 = lp.Fg[g] - W12.T @ (lp.idDg[g][:, None] * W12)
+        cov = W12 @ np.linalg.solve(W22 + 1e-8 * np.eye(W22.shape[0]), W12.T)
+        cov += np.diag(dD)
+        L = np.linalg.cholesky(cov + 1e-8 * np.eye(np_r))
+        eta[:, h] = L @ rng.standard_normal(np_r)
+    return eta
+
+
+def sample_prior(hM, spec, data_par, rng: np.random.Generator) -> dict:
+    """One draw of all parameters from the prior, in the recorded-sample
+    (combineParameters) schema with factor arrays padded to nf_max."""
+    from ..model import FIXED_SIGMA2
+
+    nc, nt, ns = hM.nc, hM.nt, hM.ns
+    # column-major vec(Gamma), matching update_gamma_v's convention
+    Gamma = rng.multivariate_normal(hM.mGamma, hM.UGamma).reshape(
+        (nc, nt), order="F")
+    V = np.atleast_2d(sps.invwishart.rvs(df=hM.f0, scale=hM.V0, random_state=rng))
+
+    est = hM.distr[:, 1] == 1
+    sigma = np.array([FIXED_SIGMA2[int(f)] for f in hM.distr[:, 0]], dtype=float)
+    # prior: iSigma ~ Gamma(aSigma, rate bSigma) — the law updateInvSigma's
+    # conjugate draw implies.  The reference's samplePrior.R:34 instead draws
+    # *sigma* from that gamma, contradicting its own updater (updateInvSigma.R
+    # shape aSigma + n/2 on iSigma); the successive-conditional Geweke tier
+    # exposes that inconsistency, so we follow the updater.
+    sigma[est] = 1.0 / rng.gamma(hM.aSigma[est], 1.0 / hM.bSigma[est])
+
+    if hM.C is None:
+        rho_idx = 0
+    else:
+        rho_idx = rng.choice(hM.rhopw.shape[0], p=hM.rhopw[:, 1] / hM.rhopw[:, 1].sum())
+
+    rec = {}
+    Mu = Gamma @ hM.TrScaled.T
+    if hM.C is None:
+        Beta = Mu + np.linalg.cholesky(V) @ rng.standard_normal((nc, ns))
+    else:
+        e = data_par.Qeig[rho_idx]
+        # Beta ~ MN(Mu, V, Q): Mu + chol(V) @ N(0,1) @ sqrtQ'
+        sqQ = data_par.U * np.sqrt(e)[None, :]
+        Beta = Mu + np.linalg.cholesky(V) @ rng.standard_normal((nc, ns)) @ sqQ.T
+
+    for r in range(spec.nr):
+        rL = hM.ranLevels[r]
+        ls = spec.levels[r]
+        nf_max, ncr, np_r = ls.nf_max, ls.ncr, ls.n_units
+        Delta = np.ones((nf_max, ncr))
+        Delta[0] = rng.gamma(rL.a1, 1 / rL.b1)
+        if nf_max > 1:
+            Delta[1:] = rng.gamma(np.broadcast_to(rL.a2, (nf_max - 1, ncr)),
+                                  1 / np.broadcast_to(rL.b2, (nf_max - 1, ncr)))
+        Psi = rng.gamma(rL.nu / 2, 2 / rL.nu, (nf_max, ns, ncr))
+        tau = np.cumprod(Delta, axis=0)
+        Lambda = rng.standard_normal((nf_max, ns, ncr)) / np.sqrt(Psi * tau[:, None, :])
+        if ls.spatial is None:
+            alpha_idx = np.zeros(nf_max, dtype=np.int32)
+            Eta = rng.standard_normal((np_r, nf_max))
+        else:
+            w = rL.alphapw[:, 1] / rL.alphapw[:, 1].sum()
+            alpha_idx = rng.choice(len(w), size=nf_max, p=w).astype(np.int32)
+            lp = data_par.rL_par[r]
+            Eta = _spatial_prior_eta(hM, lp, r, alpha_idx, np_r, nf_max, rng)
+        rec[f"Eta_{r}"] = Eta
+        rec[f"Lambda_{r}"] = Lambda
+        rec[f"Psi_{r}"] = Psi
+        rec[f"Delta_{r}"] = Delta
+        rec[f"Alpha_{r}"] = alpha_idx
+        rec[f"nfMask_{r}"] = np.ones(nf_max)
+
+    # selection: the recorded-prior Beta carries the same Bernoulli(q)
+    # zero-mass per block that record_sample's masking induces
+    for sel in hM.x_select:
+        on = rng.uniform(size=len(sel.q)) < sel.q
+        off_species = ~on[sel.sp_group]
+        Beta[np.ix_(sel.cov_group, off_species)] = 0.0
+
+    wRRR_raw = None
+    if hM.nc_rrr > 0:
+        DeltaRRR = np.concatenate([rng.gamma(hM.a1RRR, 1 / hM.b1RRR, 1),
+                                   rng.gamma(hM.a2RRR, 1 / hM.b2RRR,
+                                             hM.nc_rrr - 1)])
+        PsiRRR = rng.gamma(hM.nuRRR / 2, 2 / hM.nuRRR,
+                           (hM.nc_rrr, hM.nc_orrr))
+        tau = np.cumprod(DeltaRRR)
+        wRRR_raw = rng.standard_normal((hM.nc_rrr, hM.nc_orrr)) \
+            / np.sqrt(PsiRRR * tau[:, None])
+        rs = hM.xrrr_scale_par[1]
+        rec.update(wRRR=wRRR_raw / rs[None, :], PsiRRR=PsiRRR,
+                   DeltaRRR=DeltaRRR)
+
+    # back-transform to original scale (combineParameters), numpy mirror
+    Beta_t, Gamma_t, V_t = _combine_np(hM, Beta, Gamma, V)
+    if wRRR_raw is not None and hM.x_intercept_ind is not None:
+        # absorb the XRRR centering constant into the intercept, matching
+        # record_sample's invariant (raw XRRR reproduces the scaled design)
+        rm, rs = hM.xrrr_scale_par
+        cK = (wRRR_raw * (rm / rs)[None, :]).sum(axis=1)     # (nc_rrr,)
+        ncn = hM.nc_nrrr
+        ii = hM.x_intercept_ind
+        Beta_t[ii] -= (cK[:, None] * Beta_t[ncn:]).sum(axis=0)
+        Gamma_t[ii] -= (cK[:, None] * Gamma_t[ncn:]).sum(axis=0)
+    rec.update(Beta=Beta_t, Gamma=Gamma_t, V=V_t, sigma=sigma,
+               rho=hM.rhopw[rho_idx, 0] if hM.C is not None else 0.0)
+    return rec
+
+
+def _combine_np(hM, Beta, Gamma, V):
+    Beta, Gamma = Beta.copy(), Gamma.copy()
+    iV = np.linalg.inv(V)
+    tm, ts = hM.tr_scale_par
+    Gamma = Gamma / ts[None, :]
+    if hM.tr_intercept_ind is not None:
+        ii = hM.tr_intercept_ind
+        corr = (tm[None, :] * Gamma).sum(axis=1) - tm[ii] * Gamma[:, ii]
+        Gamma[:, ii] -= corr
+    xm, xs = hM.x_scale_par
+    ncn = hM.nc_nrrr
+    Beta[:ncn] = Beta[:ncn] / xs[:, None]
+    Gamma[:ncn] = Gamma[:ncn] / xs[:, None]
+    if hM.x_intercept_ind is not None:
+        ii = hM.x_intercept_ind
+        corrB = (xm[:, None] * Beta[:ncn]).sum(axis=0) - xm[ii] * Beta[ii]
+        corrG = (xm[:, None] * Gamma[:ncn]).sum(axis=0) - xm[ii] * Gamma[ii]
+        Beta[ii] -= corrB
+        Gamma[ii] -= corrG
+    iV[:ncn, :] = iV[:ncn, :] * xs[:, None]
+    iV[:, :ncn] = iV[:, :ncn] * xs[None, :]
+    return Beta, Gamma, np.linalg.inv(iV)
+
+
+def sample_prior_chains(hM, spec, data_par, samples: int, n_chains: int, rng):
+    recs = []
+    for _ in range(n_chains):
+        chain = [sample_prior(hM, spec, data_par, rng) for _ in range(samples)]
+        recs.append(chain)
+    # stack into (chains, samples, ...)
+    keys = recs[0][0].keys()
+    return {k: np.stack([[np.asarray(r[k]) for r in chain] for chain in recs])
+            for k in keys}

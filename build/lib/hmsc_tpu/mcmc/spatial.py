@@ -1,0 +1,356 @@
+"""Spatially structured latent factors: Eta draws and the GP-range (alpha)
+grid sampler (reference ``R/updateEta.R:110-196``, ``R/updateAlpha.R:3-86``).
+
+Three methods, as in the reference:
+
+- ``Full``  — exact GP; the (np*nf) coupled precision (block-diagonal iW(alpha_h)
+  plus the factor coupling) is assembled dense and factorised once.
+- ``NNGP``  — Vecchia sparse precision stored as neighbour-index/coefficient
+  grids.  Below ``_NNGP_DENSE_MAX`` coefficients the precision is densified
+  on the fly from gathers (a dense np x np build beats sparse scatter on TPU
+  for moderate np); above it, a **matrix-free CG sampler** takes over: the
+  Vecchia factor is only ever *applied* (gathers + one segment_sum per
+  matvec), the draw is exact-by-construction via perturbation optimisation
+  (rhs perturbed with RiW' eps for the prior term and per-cell
+  sqrt(iSigma)-weighted noise for the likelihood term, so the CG solution
+  has exactly the full-conditional law up to CG tolerance), and the current
+  Eta warm-starts the solve.  This is the regime the reference recommends
+  NNGP for (>1000 units, vignette_4_spatial.Rmd:171-175) but cannot reach
+  with its own dense (np*nf)^2 cholesky.
+- ``GPP``   — knot-based predictive process: Woodbury identity with per-site
+  nf x nf batched blocks and an (nf*nK) knot correction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.linalg import solve_triangular
+
+from ..ops.linalg import chol_spd, sample_mvn_prec
+from .structs import GibbsState, LevelState, ModelData, ModelSpec
+from .updaters import _masked_level_gram, lambda_effective
+
+__all__ = ["update_eta_spatial", "update_alpha", "vecchia_ops",
+           "vecchia_cg_draw", "gpp_factor", "gpp_draw"]
+
+# above this many (units x factors) coefficients, NNGP Eta switches from the
+# dense joint cholesky to the matrix-free CG sampler.  Overridable via
+# HMSC_TPU_NNGP_DENSE_MAX (read at import) so the crossover can be A/B'd on
+# hardware without an edit.  Default set from a measured sweep on the v5
+# chip (whole-sweep samples/s at config-3b shape, nf=2, best-of-3):
+#   coeff   250: dense 1321/s  cg 1150/s   (dense 1.15x)
+#   coeff   500: dense  503/s  cg  943/s   (cg 1.87x)
+#   coeff  1000: dense  492/s  cg  851/s   (cg 1.73x)
+#   coeff  2000: dense  145/s  cg  531/s   (cg 3.65x)  <- config 3b
+# so dense only pays below ~256 coefficients, where the (coeff x coeff)
+# cholesky is a trivially small kernel and CG's fixed iteration count costs
+# more dispatches than it saves FLOPs.
+import os as _os
+
+_NNGP_DENSE_MAX = int(_os.environ.get("HMSC_TPU_NNGP_DENSE_MAX", "256"))
+
+
+# ---------------------------------------------------------------------------
+# shared NNGP / GPP precision algebra — one source for the training-side
+# updaters below AND the conditional-prediction refresh
+# (predict/predict._conditional_mcmc), so a numerics fix lands in both
+# ---------------------------------------------------------------------------
+
+def vecchia_ops(nn, coef, sqD, LiSL):
+    """Matrix-free apply closures for the NNGP full-conditional precision
+    ``P = blkdiag_f(RiW_f' RiW_f) + unitdiag(LiSL_u)``.
+
+    ``nn`` (np, k) neighbour indices; ``coef`` (nf, np, k) autoregressive
+    coefficients and ``sqD`` (nf, np) sqrt conditional variances at each
+    factor's alpha; ``LiSL`` (np, nf, nf) per-unit likelihood gram.
+    Returns ``(riw_t, pmv)``: RiW' u and the full P x, both (np, nf)."""
+    npr, k_nb = nn.shape
+    nf = LiSL.shape[-1]
+
+    def riw_t(u):
+        t = u / sqD.T
+        contrib = -jnp.einsum("fik,if->ikf", coef, t)   # (np, k, nf)
+        return t + jax.ops.segment_sum(
+            contrib.reshape(npr * k_nb, nf), nn.reshape(-1),
+            num_segments=npr)
+
+    def pmv(x):
+        xg = x[nn]                                      # (np, k, nf)
+        red = jnp.einsum("fik,ikf->if", coef, xg)
+        Rx = (x - red) / sqD.T
+        return riw_t(Rx) + jnp.einsum("ufg,ug->uf", LiSL, x)
+
+    return riw_t, pmv
+
+
+def vecchia_cg_draw(riw_t, pmv, F, b_like, eps1, x0, tol=1e-5, maxiter=500):
+    """Perturbation-optimisation draw x ~ N(P^{-1}(F), P^{-1}) via CG.
+
+    ``b_like`` must be noise with covariance equal to the likelihood part of
+    P (sum of lam sqrt(iSigma)-weighted normals per unit); ``eps1`` (np, nf)
+    standard normals feed the prior part through RiW'.  Returns the iterate
+    and its relative residual — the caller decides the stall policy (the
+    sweep poisons to NaN for divergence containment; conditional prediction
+    keeps the iterate and warns)."""
+    b = F + riw_t(eps1) + b_like
+    x, _ = jax.scipy.sparse.linalg.cg(pmv, b, x0=x0, tol=tol,
+                                      maxiter=maxiter)
+    res = jnp.linalg.norm(pmv(x) - b) / jnp.maximum(jnp.linalg.norm(b),
+                                                    1e-30)
+    return x, res
+
+
+def gpp_factor(LiSL, idD, M1, Fm):
+    """Step-invariant factorisation of the GPP full-conditional
+    ``P = A - M F_blk^{-1} M'`` with ``A = LiSL + unitdiag(idD)`` (reference
+    updateEta.R:148-196).  ``idD`` (nf, np), ``M1`` (nf, np, nK), ``Fm``
+    (nf, nK, nK); returns the payload consumed by :func:`gpp_draw`."""
+    npr, nf = LiSL.shape[0], LiSL.shape[-1]
+    nK = M1.shape[2]
+    A = LiSL + jnp.eye(nf, dtype=idD.dtype)[None] * idD.T[:, :, None]
+    LA = chol_spd(A)
+    iA = jax.vmap(lambda Lc: solve_triangular(
+        Lc.T, solve_triangular(Lc, jnp.eye(nf, dtype=idD.dtype), lower=True),
+        lower=False))(LA)                               # (np, nf, nf)
+    # H = blockdiag(F_h) - M' iA M   over the (nf*nK) knot space
+    MtAM = jnp.einsum("hum,uhg,gun->hmgn", M1, iA, M1)
+    H = -MtAM
+    fi = jnp.arange(nf)
+    H = H.at[fi, :, fi, :].add(Fm)
+    LH = chol_spd(H.reshape(nf * nK, nf * nK))
+    LiA = jnp.linalg.cholesky(iA)
+    return M1, iA, LiA, LH, nK
+
+
+def gpp_draw(payload, F, eps1, eps2):
+    """Exact draw eta ~ N(P^{-1} F, P^{-1}) from a :func:`gpp_factor`
+    payload: mean via double Woodbury, noise as LiA eps1 + iA M LH^{-T} eps2
+    (covariance exactly P^{-1})."""
+    M1, iA, LiA, LH, nK = payload
+    nf = iA.shape[-1]
+    iA_rhs = jnp.einsum("uhg,ug->uh", iA, F)
+    Mt_iA_rhs = jnp.einsum("hum,uh->hm", M1, iA_rhs).reshape(-1)
+    corr = solve_triangular(
+        LH.T, solve_triangular(LH, Mt_iA_rhs, lower=True),
+        lower=False).reshape(nf, nK)
+    Mx = jnp.einsum("hum,hm->uh", M1, corr)
+    mean = iA_rhs + jnp.einsum("uhg,ug->uh", iA, Mx)
+    noise1 = jnp.einsum("uhg,ug->uh", LiA, eps1)
+    w = solve_triangular(LH.T, eps2, lower=False).reshape(nf, nK)
+    Mw = jnp.einsum("hum,hm->uh", M1, w)
+    return mean + noise1 + jnp.einsum("uhg,ug->uh", iA, Mw)
+
+
+def _gather_iW(lvd, alpha_idx):
+    """(nf, np, np) dense precisions iW(alpha_h) per factor."""
+    return lvd.iWg[alpha_idx]
+
+
+def _nngp_dense_iW(lvd, alpha_idx, npr):
+    """Densify the Vecchia precision iW = RiW' RiW for each factor's alpha.
+
+    RiW rows: (e_i - sum_k A[i,k] e_{nn[i,k]}) / sqrt(D_i); built by scattering
+    the neighbour coefficients into an (np, np) matrix per factor.
+    """
+    coef = lvd.nn_coef[alpha_idx]                 # (nf, np, k)
+    D = lvd.nn_D[alpha_idx]                       # (nf, np)
+    nf, _, k = coef.shape
+    rows = jnp.broadcast_to(jnp.arange(npr)[None, :, None], (nf, npr, k))
+    RiW = jnp.zeros((nf, npr, npr), dtype=coef.dtype)
+    RiW = RiW.at[jnp.arange(nf)[:, None, None], rows,
+                 jnp.broadcast_to(lvd.nn_idx[None], (nf, npr, k))].add(-coef)
+    RiW = RiW + jnp.eye(npr, dtype=coef.dtype)[None]
+    RiW = RiW / jnp.sqrt(D)[:, :, None]
+    return jnp.einsum("fij,fik->fjk", RiW, RiW)
+
+
+def update_eta_spatial(spec: ModelSpec, data: ModelData, state: GibbsState,
+                       r: int, key, S) -> LevelState:
+    lvd, lv, ls = data.levels[r], state.levels[r], spec.levels[r]
+    if ls.spatial == "GPP":
+        return _eta_gpp(spec, data, state, r, key, S)
+    npr, nf = ls.n_units, ls.nf_max
+    if (ls.spatial == "NNGP" and ls.x_dim == 0
+            and npr * nf > _NNGP_DENSE_MAX):
+        return _eta_nngp_cg(spec, data, state, r, key, S)
+    LiSL, F = _masked_level_gram(spec, data, lvd, ls, lv, state.iSigma, S)
+
+    if ls.spatial == "Full":
+        iW = _gather_iW(lvd, lv.alpha_idx)        # (nf, np, np)
+    else:  # NNGP
+        iW = _nngp_dense_iW(lvd, lv.alpha_idx, npr)
+
+    # big precision (nf*np)^2, factor-major: blockdiag(iW_h) + unit-diagonal
+    # factor coupling LiSL_u scattered at (h*np+u, g*np+u)
+    big = jnp.zeros((nf, npr, nf, npr), dtype=F.dtype)
+    fi = jnp.arange(nf)
+    big = big.at[fi, :, fi, :].add(iW)
+    # advanced-index axes move to the front: the indexed view is (np, nf, nf),
+    # exactly LiSL's layout
+    ui = jnp.arange(npr)
+    big = big.at[:, ui, :, ui].add(LiSL)
+    big = big.reshape(nf * npr, nf * npr)
+    rhs = F.T.reshape(-1)                         # factor-major vec
+    L = chol_spd(big)
+    eps = jax.random.normal(key, rhs.shape, dtype=rhs.dtype)
+    eta = sample_mvn_prec(L, rhs, eps).reshape(nf, npr).T
+    return lv.replace(Eta=eta)
+
+
+def _eta_nngp_cg(spec, data, state, r, key, S, tol: float = 1e-5,
+                 maxiter: int = 500):
+    """Matrix-free NNGP Eta draw for large np (see module docstring).
+
+    The full-conditional precision is ``P = blkdiag_f(RiW_f' RiW_f) +
+    unitdiag(LiSL_u)``.  A draw x ~ N(P^{-1} b, P^{-1}) is obtained by
+    perturbation optimisation: solve ``P x = b~`` with
+    ``b~ = F + RiW' eps1 + sum_rows lam sqrt(iSigma) xi`` — the two
+    perturbations have covariances exactly equal to the prior and likelihood
+    precision terms, so Cov(x) = P^{-1} (P) P^{-1} = P^{-1} exactly; CG only
+    ever applies the sparse Vecchia factor via gathers + one segment_sum.
+    """
+    lvd, lv, ls = data.levels[r], state.levels[r], spec.levels[r]
+    npr, nf = ls.n_units, ls.nf_max
+    LiSL, F = _masked_level_gram(spec, data, lvd, ls, lv, state.iSigma, S)
+    lam = lambda_effective(lv)[:, :, 0]               # (nf, ns)
+    coef = lvd.nn_coef[lv.alpha_idx]                  # (nf, np, k)
+    sqD = jnp.sqrt(lvd.nn_D[lv.alpha_idx])            # (nf, np)
+    riw_t, pmv = vecchia_ops(lvd.nn_idx, coef, sqD, LiSL)
+
+    k1, k2 = jax.random.split(key)
+    eps1 = jax.random.normal(k1, (npr, nf), dtype=F.dtype)
+    xi = jax.random.normal(k2, S.shape, dtype=F.dtype)
+    w = xi * jnp.sqrt(state.iSigma)[None, :]
+    if spec.has_na:
+        w = w * data.Ymask
+    b_like = jax.ops.segment_sum(w @ lam.T, lvd.pi_row, num_segments=npr)
+    eta, res = vecchia_cg_draw(riw_t, pmv, F, b_like, eps1, x0=lv.Eta,
+                               tol=tol, maxiter=maxiter)
+    # cg returns its current iterate at maxiter with no signal; a stalled
+    # solve would silently bias the chain.  Check the relative residual and
+    # poison the draw to NaN instead — the sampler's divergence containment
+    # then reports the chain and first bad sweep loudly.
+    thresh = max(100.0 * tol, 1e-3)       # scales with the requested tol
+    eta = jnp.where(res < thresh, eta, jnp.nan)
+    return lv.replace(Eta=eta)
+
+
+def _eta_gpp(spec, data, state, r, key, S):
+    """GPP Eta via double Woodbury (reference updateEta.R:148-196):
+    precision P = A - M F_blk^{-1} M' with A = per-unit nf x nf blocks
+    (factor coupling + diag idD) and M the knot cross terms; sample as
+    LiA eps1 + (iA M R_H^{-1}) eps2 which has covariance exactly P^{-1}."""
+    lvd, lv, ls = data.levels[r], state.levels[r], spec.levels[r]
+    npr, nf, nK = ls.n_units, ls.nf_max, ls.n_knots
+    LiSL, F = _masked_level_gram(spec, data, lvd, ls, lv, state.iSigma, S)
+
+    idD = lvd.idDg[lv.alpha_idx]                  # (nf, np)
+    alpha0 = (lvd.alphapw[lv.alpha_idx, 0] == 0)  # alpha=0 slots: W=I
+    idD = jnp.where(alpha0[:, None], 1.0, idD)
+    M1 = lvd.idDW12g[lv.alpha_idx]                # (nf, np, nK)
+    M1 = jnp.where(alpha0[:, None, None], 0.0, M1)
+    Fm = lvd.Fg[lv.alpha_idx]                     # (nf, nK, nK)
+    payload = gpp_factor(LiSL, idD, M1, Fm)
+    k1, k2 = jax.random.split(key)
+    eps1 = jax.random.normal(k1, (npr, nf), dtype=F.dtype)
+    eps2 = jax.random.normal(k2, (nf * nK,), dtype=F.dtype)
+    eta = gpp_draw(payload, F, eps1, eps2)
+    return lv.replace(Eta=eta)
+
+
+# ---------------------------------------------------------------------------
+
+def eta_quad_grid(lvd, ls, eta):
+    """(v, ld): per-factor prior quadratics eta_h' iW_g eta_h, both (nf, G),
+    over the whole alpha grid.  Consumed by update_alpha; the interweaving
+    scale move uses the single-point :func:`eta_quad_at` instead."""
+    if ls.spatial == "Full":
+        v = jnp.einsum("hu,guv,hv->hg", eta.T, lvd.iWg, eta.T)
+        ld = lvd.detWg[None, :]
+    elif ls.spatial == "NNGP":
+        eta_nn = eta[lvd.nn_idx]                    # (np, k, nf)
+        pred = jnp.einsum("gik,ikh->hgi", lvd.nn_coef, eta_nn)  # (nf, G, np)
+        res = eta.T[:, None, :] - pred                          # (nf, G, np)
+        v = (res**2 / lvd.nn_D[None]).sum(axis=2)               # (nf, G)
+        ld = lvd.detWg[None, :]
+    else:  # GPP
+        q_full = jnp.einsum("uh,uh->h", eta, eta)
+        t1 = jnp.einsum("gu,uh->hg", lvd.idDg, eta**2)
+        Et = jnp.einsum("uh,gum->hgm", eta, lvd.idDW12g)        # (nf, G, nK)
+        t2 = jnp.einsum("hgm,gmn,hgn->hg", Et, lvd.iFg, Et)
+        v = jnp.where(lvd.alphapw[None, :, 0] == 0, q_full[:, None], t1 - t2)
+        ld = lvd.detDg[None, :]
+    return v, ld
+
+
+def eta_quad_at(lvd, ls, eta, alpha_idx):
+    """(nf,) prior quadratic eta_h' iW(alpha_h) eta_h at each factor's
+    *current* alpha only — same algebra as :func:`eta_quad_grid` with the
+    grid axis gathered away up front (the interweaving move needs one point
+    per factor; evaluating the whole 101-point grid for it roughly doubled
+    the update_alpha-scale prior cost per sweep)."""
+    if ls.spatial == "Full":
+        iW = lvd.iWg[alpha_idx]                               # (nf, np, np)
+        return jnp.einsum("hu,huv,hv->h", eta.T, iW, eta.T)
+    if ls.spatial == "NNGP":
+        coef = lvd.nn_coef[alpha_idx]                         # (nf, np, k)
+        D = lvd.nn_D[alpha_idx]                               # (nf, np)
+        eta_nn = eta[lvd.nn_idx]                              # (np, k, nf)
+        pred = jnp.einsum("hik,ikh->hi", coef, eta_nn)        # (nf, np)
+        res = eta.T - pred
+        return (res**2 / D).sum(axis=1)
+    # GPP
+    idD = lvd.idDg[alpha_idx]                                 # (nf, np)
+    W12 = lvd.idDW12g[alpha_idx]                              # (nf, np, nK)
+    iF = lvd.iFg[alpha_idx]                                   # (nf, nK, nK)
+    t1 = jnp.einsum("hu,uh->h", idD, eta**2)
+    Et = jnp.einsum("uh,hum->hm", eta, W12)                   # (nf, nK)
+    t2 = jnp.einsum("hm,hmn,hn->h", Et, iF, Et)
+    q_full = jnp.einsum("uh,uh->h", eta, eta)
+    return jnp.where(lvd.alphapw[alpha_idx, 0] == 0, q_full, t1 - t2)
+
+
+def eta_ones_forms_at(lvd, ls, eta, alpha_idx):
+    """``(1' iW_h 1, 1' iW_h eta_h)`` per factor at each factor's current
+    alpha, with ONE gather of the level's prior structures (the location
+    interweave needs both; three :func:`eta_quad_at` polarization calls
+    would triple the prior-quadratic cost)."""
+    npr = eta.shape[0]
+    if ls.spatial == "Full":
+        iW = lvd.iWg[alpha_idx]                               # (nf, np, np)
+        w = iW.sum(axis=2)                                    # iW_h @ 1
+        return w.sum(axis=1), jnp.einsum("hu,uh->h", w, eta)
+    if ls.spatial == "NNGP":
+        coef = lvd.nn_coef[alpha_idx]                         # (nf, np, k)
+        D = lvd.nn_D[alpha_idx]                               # (nf, np)
+        # RiW x rows: (x_i - sum_k A[i,k] x_nn[i,k]) / sqrt(D_i)
+        sqD = jnp.sqrt(D)
+        r1 = (1.0 - coef.sum(axis=2)) / sqD                   # RiW @ 1
+        pred = jnp.einsum("hik,ikh->hi", coef, eta[lvd.nn_idx])
+        re = (eta.T - pred) / sqD                             # RiW @ eta
+        return (r1**2).sum(axis=1), (r1 * re).sum(axis=1)
+    # GPP: x' iW y = sum_u idD x y - (x' M1) iF (M1' y); alpha=0 -> I
+    idD = lvd.idDg[alpha_idx]                                 # (nf, np)
+    W12 = lvd.idDW12g[alpha_idx]                              # (nf, np, nK)
+    iF = lvd.iFg[alpha_idx]                                   # (nf, nK, nK)
+    E1 = W12.sum(axis=1)                                      # 1' idDW12
+    Ee = jnp.einsum("uh,hum->hm", eta, W12)
+    q1 = idD.sum(axis=1) - jnp.einsum("hm,hmn,hn->h", E1, iF, E1)
+    s = jnp.einsum("hu,uh->h", idD, eta) \
+        - jnp.einsum("hm,hmn,hn->h", E1, iF, Ee)
+    zero = lvd.alphapw[alpha_idx, 0] == 0
+    return (jnp.where(zero, float(npr), q1),
+            jnp.where(zero, eta.sum(axis=0), s))
+
+
+def update_alpha(spec: ModelSpec, data: ModelData, state: GibbsState, r: int,
+                 key) -> LevelState:
+    """Per-factor categorical draw of the GP range on the alphapw grid:
+    log p_g  =  log prior_g - 0.5 log|W_g| - 0.5 eta' iW_g eta."""
+    lvd, lv, ls = data.levels[r], state.levels[r], spec.levels[r]
+    v, ld = eta_quad_grid(lvd, ls, lv.Eta)
+    loglike = jnp.log(lvd.alphapw[None, :, 1]) - 0.5 * ld - 0.5 * v
+    idx = jax.random.categorical(key, loglike, axis=-1).astype(jnp.int32)
+    idx = jnp.where(lv.nf_mask > 0, idx, 0)
+    return lv.replace(alpha_idx=idx)

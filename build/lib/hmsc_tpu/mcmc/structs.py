@@ -1,0 +1,377 @@
+"""Core pytrees of the Gibbs engine.
+
+Split cleanly into:
+
+- ``ModelSpec`` / ``LevelSpec``: *static*, hashable metadata (shapes, flags,
+  methods).  Closed over by the jitted sweep; changing it triggers a recompile.
+- ``ModelData`` / ``LevelData``: HBM-resident constant arrays (data, priors,
+  precomputed grids).
+- ``GibbsState`` / ``LevelState``: the Markov-chain state pytree carried
+  through ``lax.scan``.  Factor blocks are allocated at the static ``nf_max``
+  with an active-factor mask; "adapting the number of factors" is mask/permute
+  arithmetic inside jit (SURVEY.md §7 point 1).
+
+All shapes are static; chains add a leading batch axis via vmap.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..model import FIXED_SIGMA2, Hmsc
+from ..precompute import DataParams, compute_initial_parameters
+
+__all__ = ["LevelSpec", "ModelSpec", "LevelData", "ModelData", "LevelState",
+           "GibbsState", "build_model_data", "build_state", "DEFAULT_NF_CAP"]
+
+# static cap on latent factors per level (reference grows nf up to ns,
+# updateNf.R:26; static XLA shapes need a concrete bound)
+DEFAULT_NF_CAP = 16
+
+
+@dataclasses.dataclass(frozen=True)
+class LevelSpec:
+    name: str
+    n_units: int
+    nf_max: int
+    nf_min: int
+    ncr: int                      # max(x_dim, 1)
+    x_dim: int
+    spatial: str | None           # None | 'Full' | 'NNGP' | 'GPP'
+    n_alpha: int                  # alpha-grid size (0 if non-spatial)
+    n_neighbours: int = 0
+    n_knots: int = 0
+    # True when nf_max was cut below the user's prior bound min(rL.nf_max,
+    # ns) by the static nf_cap — only then is blocked factor growth a cap
+    # artifact worth warning about (a deliberate nf_min=nf_max freeze is not)
+    nf_capped: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    ny: int
+    ns: int
+    nc: int
+    nt: int
+    nr: int
+    n_rho: int
+    has_phylo: bool
+    has_na: bool
+    x_is_list: bool
+    any_normal: bool
+    any_probit: bool
+    any_poisson: bool
+    any_estimated_sigma: bool
+    # all residual variances fixed to one common value (e.g. all-probit):
+    # enables the matrix-normal fast path for the phylogenetic Beta draw
+    homoskedastic_fixed: bool
+    f0: float
+    ncsel: int
+    nc_rrr: int
+    nc_orrr: int
+    nc_nrrr: int
+    levels: tuple[LevelSpec, ...]
+
+    @property
+    def nf_total(self) -> int:
+        """Total stacked factor columns across levels: sum nf_max * ncr."""
+        return sum(l.nf_max * l.ncr for l in self.levels)
+
+
+class LevelData(struct.PyTreeNode):
+    pi_row: Any                  # (ny,) int32 unit index per row
+    unit_count: Any              # (np,) rows per unit
+    x_row: Any                   # (ny, ncr) covariate value per row (ones if x_dim=0)
+    x_unit: Any                  # (np, ncr)
+    nu: Any                      # (ncr,) shrinkage hyperparams
+    a1: Any
+    b1: Any
+    a2: Any
+    b2: Any
+    alphapw: Any = None          # (G, 2)
+    # spatial 'Full'
+    iWg: Any = None              # (G, np, np)
+    detWg: Any = None            # (G,) log det W
+    # spatial 'NNGP'
+    nn_idx: Any = None           # (np, k) int32
+    nn_coef: Any = None          # (G, np, k)
+    nn_D: Any = None             # (G, np)
+    # spatial 'GPP'
+    idDg: Any = None             # (G, np)
+    idDW12g: Any = None          # (G, np, nK)
+    Fg: Any = None               # (G, nK, nK)
+    iFg: Any = None              # (G, nK, nK)
+    detDg: Any = None            # (G,)
+
+
+class ModelData(struct.PyTreeNode):
+    Y: Any                       # (ny, ns) NaNs replaced by 0
+    Ymask: Any                   # (ny, ns) 1.0 observed / 0.0 missing
+    X: Any                       # (ny, nc) or (ns, ny, nc)
+    Tr: Any                      # (ns, nt)
+    distr_family: Any            # (ns,) int32
+    distr_estsig: Any            # (ns,) 1.0 where dispersion estimated
+    sigma_fixed: Any             # (ns,) fixed sigma^2 values for the rest
+    mGamma: Any                  # (nc*nt,)
+    iUGamma: Any                 # (nc*nt, nc*nt)
+    UGamma: Any                  # (nc*nt, nc*nt) (collapsed updaters)
+    V0: Any                      # (nc, nc)
+    aSigma: Any                  # (ns,)
+    bSigma: Any                  # (ns,)
+    rhopw: Any = None            # (G_rho, 2)
+    Qeig: Any = None             # (G_rho, ns) eigenvalues of Q(rho_g)
+    logdetQ: Any = None          # (G_rho,)
+    U: Any = None                # (ns, ns) eigenvectors of C
+    UTr: Any = None              # (ns, nt) U' Tr
+    levels: tuple = ()
+    # reduced-rank regression: scaled XRRR covariates
+    XRRRs: Any = None            # (ny, nc_orrr)
+    nuRRR: Any = None            # () shrinkage hyperparams for wRRR
+    a1RRR: Any = None
+    b1RRR: Any = None
+    a2RRR: Any = None
+    b2RRR: Any = None
+    # spike-and-slab variable selection groups (one entry per XSelect)
+    sel_cov: tuple = ()          # ((nc,) 1.0-where-switched masks)
+    sel_spg: tuple = ()          # ((ns,) int32 species-group index)
+    sel_q: tuple = ()            # ((n_groups,) prior inclusion probs)
+    # back-transform parameters (combineParameters at record time)
+    x_scale_par: Any = None      # (2, nc_nrrr)
+    tr_scale_par: Any = None     # (2, nt)
+    y_scale_par: Any = None      # (2, ns)
+    xrrr_scale_par: Any = None   # (2, nc_orrr)
+    x_intercept_ind: Any = None  # () int32 or None
+    tr_intercept_ind: Any = None
+    # first all-ones column of the *scaled* design (the named intercept when
+    # present, else detected by value): the column the interweaving moves can
+    # shift.  Detection by name alone (x_intercept_ind) silently no-ops the
+    # moves for raw-matrix designs whose first column is ones — measured in
+    # round 5: every prior interweave A/B had the move gated off.
+    x_ones_ind: Any = None       # () int32 or None
+
+
+class LevelState(struct.PyTreeNode):
+    Eta: Any                     # (np, nf_max)
+    Lambda: Any                  # (nf_max, ns, ncr)
+    Psi: Any                     # (nf_max, ns, ncr)
+    Delta: Any                   # (nf_max, ncr); 1.0 on inactive slots
+    alpha_idx: Any               # (nf_max,) int32
+    nf_mask: Any                 # (nf_max,) 1.0 active
+    # () int32: adaptation events that wanted to ADD a factor but were
+    # blocked by the static nf_max cap (factor-cap observability; the
+    # reference grows unbounded to nfMax=ns, updateNf.R:26)
+    nf_sat: Any = 0
+
+
+class GibbsState(struct.PyTreeNode):
+    Z: Any                       # (ny, ns) latent response
+    Beta: Any                    # (nc, ns)
+    Gamma: Any                   # (nc, nt)
+    iV: Any                      # (nc, nc)
+    rho_idx: Any                 # () int32
+    iSigma: Any                  # (ns,) residual precisions
+    levels: tuple                # tuple[LevelState]
+    it: Any                      # () int32 sweep counter (1-based like the reference)
+    # extras (variable selection / reduced-rank regression); None-free pytree
+    BetaSel: tuple = ()          # tuple of (n_groups,) bool arrays
+    wRRR: Any = 0.0              # (nc_rrr, nc_orrr)
+    PsiRRR: Any = 0.0
+    DeltaRRR: Any = 0.0
+
+
+# ---------------------------------------------------------------------------
+
+def build_spec(hM: Hmsc, nf_cap: int = DEFAULT_NF_CAP) -> ModelSpec:
+    level_specs = []
+    for r in range(hM.nr):
+        rL = hM.ranLevels[r]
+        nf_max = int(min(rL.nf_max, hM.ns, nf_cap))
+        nf_min = int(min(rL.nf_min, nf_max))
+        spatial = rL.spatial_method if rL.s_dim != 0 else None
+        level_specs.append(LevelSpec(
+            name=hM.rl_names[r], n_units=int(hM.np_[r]), nf_max=nf_max,
+            nf_min=nf_min, ncr=max(rL.x_dim, 1), x_dim=rL.x_dim,
+            nf_capped=nf_max < min(rL.nf_max, hM.ns),
+            spatial=spatial,
+            n_alpha=0 if spatial is None else rL.alphapw.shape[0],
+            n_neighbours=int(rL.n_neighbours or 10) if spatial == "NNGP" else 0,
+            n_knots=0 if rL.s_knot is None else int(np.asarray(rL.s_knot).shape[0]),
+        ))
+    est = hM.distr[:, 1] == 1
+    fixed_vals = np.array([FIXED_SIGMA2[int(f)] for f in hM.distr[:, 0]])
+    homo = (not est.any()) and bool(np.all(fixed_vals == fixed_vals[0]))
+    return ModelSpec(
+        ny=hM.ny, ns=hM.ns, nc=hM.nc, nt=hM.nt, nr=hM.nr,
+        n_rho=0 if hM.C is None else hM.rhopw.shape[0],
+        has_phylo=hM.C is not None,
+        has_na=bool(np.isnan(hM.Y).any()),
+        x_is_list=hM.x_is_list,
+        any_normal=bool((hM.distr[:, 0] == 1).any()),
+        any_probit=bool((hM.distr[:, 0] == 2).any()),
+        any_poisson=bool((hM.distr[:, 0] == 3).any()),
+        any_estimated_sigma=bool(est.any()),
+        homoskedastic_fixed=homo,
+        f0=float(hM.f0),
+        ncsel=hM.ncsel, nc_rrr=hM.nc_rrr, nc_orrr=hM.nc_orrr,
+        nc_nrrr=hM.nc_nrrr,
+        levels=tuple(level_specs),
+    )
+
+
+def _find_ones_column(hM) -> Any:
+    """First all-ones column of the scaled design the sampler runs on (the
+    shiftable direction the interweaving moves need).  Prefers the named
+    intercept; otherwise detects by value.  None for per-species X lists
+    (the moves are gated off there anyway)."""
+    if hM.x_intercept_ind is not None:
+        return jnp.asarray(hM.x_intercept_ind, dtype=jnp.int32)
+    Xs = np.asarray(hM.XScaled)
+    if Xs.ndim != 2:
+        return None
+    ones = np.nonzero(np.all(Xs == 1.0, axis=0))[0]
+    return jnp.asarray(ones[0], dtype=jnp.int32) if ones.size else None
+
+
+def build_model_data(hM: Hmsc, data_par: DataParams, spec: ModelSpec,
+                     dtype=jnp.float32) -> ModelData:
+    """Assemble the HBM-resident constant arrays from the host spec."""
+    f = lambda a: jnp.asarray(np.asarray(a), dtype=dtype)
+    Y = np.asarray(hM.YScaled, dtype=float)
+    mask = (~np.isnan(Y)).astype(float)
+    Y0 = np.nan_to_num(Y, nan=0.0)
+
+    levels = []
+    for r in range(hM.nr):
+        rL = hM.ranLevels[r]
+        ls = spec.levels[r]
+        pi = hM.Pi[:, r]
+        counts = np.bincount(pi, minlength=ls.n_units).astype(float)
+        if rL.x_dim > 0:
+            x_unit = rL.x_for(hM.pi_names[r])
+            x_row = x_unit[pi]
+        else:
+            x_unit = np.ones((ls.n_units, 1))
+            x_row = np.ones((hM.ny, 1))
+        kw = dict(
+            pi_row=jnp.asarray(pi, dtype=jnp.int32),
+            unit_count=f(counts), x_row=f(x_row), x_unit=f(x_unit),
+            nu=f(rL.nu), a1=f(rL.a1), b1=f(rL.b1), a2=f(rL.a2), b2=f(rL.b2),
+        )
+        lp = data_par.rL_par[r] if data_par.rL_par else None
+        if ls.spatial is not None:
+            kw["alphapw"] = f(rL.alphapw)
+            if ls.spatial == "Full":
+                kw["iWg"] = f(lp.iWg)
+                kw["detWg"] = f(lp.detWg)
+            elif ls.spatial == "NNGP":
+                kw["nn_idx"] = jnp.asarray(lp.nn_idx, dtype=jnp.int32)
+                kw["nn_coef"] = f(lp.nn_coef)
+                kw["nn_D"] = f(lp.nn_D)
+                kw["detWg"] = f(lp.detWg)
+            elif ls.spatial == "GPP":
+                kw["idDg"] = f(lp.idDg)
+                kw["idDW12g"] = f(lp.idDW12g)
+                kw["Fg"] = f(lp.Fg)
+                kw["iFg"] = f(lp.iFg)
+                kw["detDg"] = f(lp.detDg)
+        levels.append(LevelData(**kw))
+
+    est = (hM.distr[:, 1] == 1).astype(float)
+    fixed_vals = np.array([FIXED_SIGMA2[int(fam)] for fam in hM.distr[:, 0]])
+    iUGamma = np.linalg.inv(hM.UGamma)
+
+    kw = dict(
+        Y=f(Y0), Ymask=f(mask),
+        X=f(hM.XScaled), Tr=f(hM.TrScaled),
+        distr_family=jnp.asarray(hM.distr[:, 0], dtype=jnp.int32),
+        distr_estsig=f(est), sigma_fixed=f(fixed_vals),
+        mGamma=f(hM.mGamma), iUGamma=f(iUGamma), UGamma=f(hM.UGamma),
+        V0=f(hM.V0),
+        aSigma=f(hM.aSigma), bSigma=f(hM.bSigma),
+        levels=tuple(levels),
+        x_scale_par=f(hM.x_scale_par),
+        tr_scale_par=f(hM.tr_scale_par),
+        y_scale_par=f(hM.y_scale_par),
+        x_intercept_ind=(None if hM.x_intercept_ind is None
+                         else jnp.asarray(hM.x_intercept_ind, dtype=jnp.int32)),
+        tr_intercept_ind=(None if hM.tr_intercept_ind is None
+                          else jnp.asarray(hM.tr_intercept_ind, dtype=jnp.int32)),
+        x_ones_ind=_find_ones_column(hM),
+    )
+    if hM.nc_rrr > 0:
+        kw["xrrr_scale_par"] = f(hM.xrrr_scale_par)
+        kw["XRRRs"] = f(hM.XRRRScaled)
+        kw.update(nuRRR=f(hM.nuRRR), a1RRR=f(hM.a1RRR), b1RRR=f(hM.b1RRR),
+                  a2RRR=f(hM.a2RRR), b2RRR=f(hM.b2RRR))
+    if hM.ncsel > 0:
+        sel_cov, sel_spg, sel_q = [], [], []
+        for sel in hM.x_select:
+            cov = np.zeros(hM.nc)
+            cov[sel.cov_group] = 1.0
+            sel_cov.append(f(cov))
+            sel_spg.append(jnp.asarray(sel.sp_group, dtype=jnp.int32))
+            sel_q.append(f(sel.q))
+        kw.update(sel_cov=tuple(sel_cov), sel_spg=tuple(sel_spg),
+                  sel_q=tuple(sel_q))
+    if spec.has_phylo:
+        kw.update(rhopw=f(hM.rhopw), Qeig=f(data_par.Qeig),
+                  logdetQ=f(data_par.logdetQ), U=f(data_par.U),
+                  UTr=f(data_par.U.T @ hM.TrScaled))
+    return ModelData(**kw)
+
+
+def build_state(hM: Hmsc, spec: ModelSpec, seed: int,
+                init_par=None, dtype=jnp.float32) -> GibbsState:
+    """One chain's initial GibbsState (Z starts at the linear predictor; the
+    sampler immediately runs update_z once, like the reference's init)."""
+    rng = np.random.default_rng(seed)
+    nf_max = [ls.nf_max for ls in spec.levels]
+    p = compute_initial_parameters(hM, nf_max, rng, init_par)
+    f = lambda a: jnp.asarray(np.asarray(a, dtype=float), dtype=dtype)
+
+    levels = tuple(
+        LevelState(Eta=f(lv["Eta"]), Lambda=f(lv["Lambda"]), Psi=f(lv["Psi"]),
+                   Delta=f(lv["Delta"]),
+                   alpha_idx=jnp.asarray(lv["alpha_idx"], dtype=jnp.int32),
+                   nf_mask=f(lv["nf_mask"]),
+                   nf_sat=jnp.asarray(0, dtype=jnp.int32))
+        for lv in p["levels"])
+
+    # linear predictor as the Z starting point (RRR columns appended from the
+    # initial wRRR draw, like the reference's X = [X1A, XRRR wRRR'])
+    Beta = np.asarray(p["Beta"], dtype=float)
+    Xs = np.asarray(hM.XScaled)
+    if hM.nc_rrr > 0:
+        XB = np.asarray(hM.XRRRScaled) @ np.asarray(p["wRRR"]).T
+        Xs = (np.concatenate([Xs, np.broadcast_to(XB, (hM.ns,) + XB.shape)], axis=2)
+              if spec.x_is_list else np.concatenate([Xs, XB], axis=1))
+    if spec.x_is_list:
+        L = np.einsum("jyc,cj->yj", Xs, Beta)
+    else:
+        L = Xs @ Beta
+    for r in range(spec.nr):
+        lv = p["levels"][r]
+        lam = lv["Lambda"] * lv["nf_mask"][:, None, None]
+        eta_rows = lv["Eta"][hM.Pi[:, r]]
+        x_row = (hM.ranLevels[r].x_for(hM.pi_names[r])[hM.Pi[:, r]]
+                 if hM.ranLevels[r].x_dim > 0 else np.ones((hM.ny, 1)))
+        L = L + np.einsum("yf,yk,fjk->yj", eta_rows, x_row, lam)
+
+    iSigma = 1.0 / np.asarray(p["sigma"], dtype=float)
+    state = GibbsState(
+        Z=f(L), Beta=f(Beta), Gamma=f(p["Gamma"]),
+        iV=f(np.linalg.inv(p["V"])),
+        rho_idx=jnp.asarray(p["rho_idx"], dtype=jnp.int32),
+        iSigma=f(iSigma), levels=levels,
+        it=jnp.asarray(0, dtype=jnp.int32),
+        BetaSel=tuple(jnp.asarray(b) for b in p["BetaSel"]),
+        wRRR=0.0 if p["wRRR"] is None else f(p["wRRR"]),
+        PsiRRR=0.0 if p["PsiRRR"] is None else f(p["PsiRRR"]),
+        DeltaRRR=0.0 if p["DeltaRRR"] is None else f(p["DeltaRRR"]),
+    )
+    return state

@@ -1,0 +1,260 @@
+"""One Gibbs sweep in the reference's fixed update order
+(``R/sampleMcmc.R:219-306``), assembled at trace time from static flags.
+
+The sweep is a pure function ``(data, state, key) -> state`` suitable for
+``lax.scan`` and ``vmap`` over chains.  Updaters can be disabled via the
+``updater`` toggle dict exactly like the reference (``updater$Eta=FALSE`` ->
+``updater={"Eta": False}``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from . import updaters as U
+from . import updaters_sel as USel
+from .spatial import update_alpha, update_eta_spatial
+from .structs import GibbsState, ModelData, ModelSpec
+
+__all__ = ["make_sweep", "record_sample", "effective_spec_data"]
+
+
+def effective_spec_data(spec: ModelSpec, data: ModelData, state: GibbsState):
+    """(spec, data) with the state-dependent effective design in force —
+    RRR columns appended, selection zeroing applied (no-op otherwise)."""
+    if spec.nc_rrr == 0 and spec.ncsel == 0:
+        return spec, data
+    Xeff, per_species = USel.effective_design(spec, data, state)
+    spec_x = (dataclasses.replace(spec, x_is_list=True)
+              if per_species and not spec.x_is_list else spec)
+    return spec_x, data.replace(X=Xeff)
+
+
+def make_sweep(spec: ModelSpec, updater: dict | None = None,
+               adapt_nf: tuple | None = None):
+    updater = updater or {}
+    on = lambda name: updater.get(name, True) is not False
+    adapt_nf = adapt_nf or tuple(0 for _ in range(spec.nr))
+    # RRR appends columns and selection zeroes per-species blocks: both make
+    # the in-force design state-dependent, so downstream updaters see a
+    # per-sweep effective X (and the per-species design path when selecting)
+    has_dynamic_x = spec.nc_rrr > 0 or spec.ncsel > 0
+    spec_x = (dataclasses.replace(spec, x_is_list=True)
+              if spec.ncsel > 0 and not spec.x_is_list else spec)
+
+    def with_eff_x(data, state):
+        if not has_dynamic_x:
+            return data
+        Xeff, _ = USel.effective_design(spec, data, state)
+        return data.replace(X=Xeff)
+
+    # collapsed updaters are opt-in (see updaters_marginal module docstring);
+    # the sampler validates their structural gates before enabling
+    want = lambda name: updater.get(name, False) is True
+
+    def sweep(data: ModelData, state: GibbsState, key) -> GibbsState:
+        state = state.replace(it=state.it + 1)
+        ks = jax.random.split(key, 13)
+        data_x = with_eff_x(data, state)
+
+        if want("Gamma2"):
+            from .updaters_marginal import update_gamma2
+            state = update_gamma2(spec_x, data_x, state, ks[10])
+        if want("GammaEta"):
+            from .updaters_marginal import update_gamma_eta
+            for r in range(spec.nr):
+                state = update_gamma_eta(spec_x, data_x, state, r,
+                                         jax.random.fold_in(ks[11], r))
+        if on("BetaLambda"):
+            state = U.update_beta_lambda(spec_x, data_x, state, ks[0])
+        if has_dynamic_x and spec.nr > 0:
+            LRan_total = sum(U.level_loading(data.levels[r], state.levels[r])
+                             for r in range(spec.nr))
+        elif has_dynamic_x:
+            LRan_total = jnp.zeros_like(state.Z)
+        if spec.nc_rrr > 0 and on("wRRR"):
+            state = USel.update_w_rrr(spec, data, state, ks[8], LRan_total)
+            data_x = with_eff_x(data, state)
+        if spec.ncsel > 0 and on("BetaSel"):
+            state = USel.update_beta_sel(spec, data, state, ks[9], LRan_total)
+            data_x = with_eff_x(data, state)
+        if on("GammaV"):
+            state = U.update_gamma_v(spec, data, state, ks[1])
+        if spec.has_phylo and on("Rho"):
+            state = U.update_rho(spec, data, state, ks[2])
+        if on("LambdaPriors"):
+            state = U.update_lambda_priors(spec, data, state, ks[3])
+        if spec.nc_rrr > 0 and on("wRRRPriors"):
+            state = USel.update_w_rrr_priors(spec, data, state,
+                                             jax.random.fold_in(ks[8], 1))
+
+        # E_shared: the current linear predictor, threaded through the sweep
+        # tail (Eta -> InvSigma -> Z) so total_loading's padding-bound small-K
+        # matmuls run once instead of three times per sweep
+        E_shared = None
+        if on("Eta") and spec.nr > 0:
+            LFix = U.linear_fixed(spec_x, data_x, state.Beta)
+            LRan = [U.level_loading(data.levels[r], state.levels[r])
+                    for r in range(spec.nr)]
+            for r in range(spec.nr):
+                S = state.Z - LFix
+                for q in range(spec.nr):
+                    if q != r:
+                        S = S - LRan[q]
+                kr = jax.random.fold_in(ks[4], r)
+                if spec.levels[r].spatial is None:
+                    lv = U.update_eta_nonspatial(spec, data, state, r, kr, S)
+                else:
+                    lv = update_eta_spatial(spec, data, state, r, kr, S)
+                levels = list(state.levels)
+                levels[r] = lv
+                state = state.replace(levels=tuple(levels))
+                LRan[r] = U.level_loading(data.levels[r], state.levels[r])
+            E_shared = LFix
+            for r in range(spec.nr):
+                E_shared = E_shared + LRan[r]
+
+        if on("Alpha"):
+            for r in range(spec.nr):
+                if spec.levels[r].spatial is not None:
+                    lv = update_alpha(spec, data, state, r,
+                                      jax.random.fold_in(ks[5], r))
+                    levels = list(state.levels)
+                    levels[r] = lv
+                    state = state.replace(levels=tuple(levels))
+
+        # beyond-reference: per-factor (Eta, Lambda) scale interweaving
+        # (measured 2x ESS on association scales) and the per-factor
+        # (Eta, Beta_intercept) location move (measured +10% min / +20%
+        # median Beta ESS at config 2 once the round-5 gate fix made it
+        # actually run — benchmarks/ab_interweave_da.py).  Both default on,
+        # both leave the linear predictor invariant, so E_shared stays
+        # valid.  interweave_location self-gates (location_gate) on models
+        # where its invariance breaks.  Gated on the updaters they perturb:
+        # a frozen Eta/BetaLambda run (debugging, conditional sampling)
+        # must not see drifting Eta/Lambda/Beta
+        iw_ok = spec.nr > 0 and on("Eta") and on("BetaLambda")
+        if iw_ok and (on("Interweave") or on("InterweaveLocation")):
+            kI1, kI2 = jax.random.split(ks[12])
+            if on("Interweave"):
+                state = U.interweave_scale(spec, data, state, kI1)
+            if on("InterweaveLocation"):
+                state = U.interweave_location(spec, data, state, kI2)
+
+        if on("InvSigma"):
+            state = U.update_inv_sigma(spec_x, data_x, state, ks[6],
+                                       E=E_shared)
+        if on("Z"):
+            state = U.update_z(spec_x, data_x, state, ks[7], E=E_shared)
+
+        # opt-in ASIS flip of the probit augmentation on the intercept row
+        # (updaters.interweave_da_intercept) — placed after updateZ so the
+        # ancillary residual is built from the freshest Z; it changes Beta
+        # and Z jointly, and nothing after it consumes E_shared
+        if want("InterweaveDA") and on("Z") and on("BetaLambda"):
+            state = U.interweave_da_intercept(
+                spec, data, state, jax.random.fold_in(ks[7], 1))
+
+        # factor-count adaptation during burn-in (iter <= adaptNf[r])
+        for r in range(spec.nr):
+            if adapt_nf[r] > 0 and on("Nf"):
+                kr = jax.random.fold_in(ks[5], 1000 + r)
+                lv_new = U.update_nf(spec, data, state, r, kr)
+                gate = (state.it <= adapt_nf[r])
+                lv_old = state.levels[r]
+                lv = jax.tree.map(
+                    lambda a, b: jnp.where(gate, a, b), lv_new, lv_old)
+                levels = list(state.levels)
+                levels[r] = lv
+                state = state.replace(levels=tuple(levels))
+        return state
+
+    return sweep
+
+
+# ---------------------------------------------------------------------------
+# combineParameters at record time (reference R/combineParameters.R:1-58)
+# ---------------------------------------------------------------------------
+
+def record_sample(spec: ModelSpec, data: ModelData, state: GibbsState) -> dict:
+    """Back-transform the current state to the original X/Tr scale and return
+    the posterior-sample pytree (the postList schema, SURVEY.md §2.2)."""
+    Beta = state.Beta
+    Gamma = state.Gamma
+    iV = state.iV
+
+    # selection: zero the switched-off covariate blocks FIRST, so the
+    # centering/intercept corrections below operate on the effective Beta
+    # (the reference zeroes after back-transform, combineParameters.R:45-53,
+    # which mis-absorbs off-block slab coefficients into the intercept when
+    # X is centered)
+    if spec.ncsel > 0:
+        from .updaters_sel import selection_mask
+        Beta = Beta * selection_mask(spec, data, state.BetaSel).T
+
+    # traits: Gamma columns back to raw-trait scale
+    tm, ts = data.tr_scale_par[0], data.tr_scale_par[1]
+    Gamma = Gamma / ts[None, :]
+    if data.tr_intercept_ind is not None:
+        corr = (tm[None, :] * Gamma).sum(axis=1) - tm[data.tr_intercept_ind] * Gamma[:, data.tr_intercept_ind]
+        Gamma = Gamma.at[:, data.tr_intercept_ind].add(-corr)
+
+    # covariates: Beta/Gamma rows and iV rows+cols
+    xm = data.x_scale_par[0], data.x_scale_par[1]
+    xmean, xs = xm
+    ncn = spec.nc_nrrr
+    scale_rows = jnp.concatenate(
+        [xs, jnp.ones(spec.nc - ncn, dtype=xs.dtype)]) if spec.nc > ncn else xs
+    mean_rows = jnp.concatenate(
+        [xmean, jnp.zeros(spec.nc - ncn, dtype=xmean.dtype)]) if spec.nc > ncn else xmean
+    Beta = Beta / scale_rows[:, None]
+    Gamma = Gamma / scale_rows[:, None]
+    if data.x_intercept_ind is not None:
+        ii = data.x_intercept_ind
+        corrB = (mean_rows[:, None] * Beta).sum(axis=0) - mean_rows[ii] * Beta[ii]
+        corrG = (mean_rows[:, None] * Gamma).sum(axis=0) - mean_rows[ii] * Gamma[ii]
+        Beta = Beta.at[ii].add(-corrB)
+        Gamma = Gamma.at[ii].add(-corrG)
+    iV_t = iV * scale_rows[:, None] * scale_rows[None, :]
+    V = jnp.linalg.inv(iV_t)
+
+    # RRR: back-transform wRRR so raw XRRR reproduces the scaled design
+    # (XB_raw @ wRRR_rec' == XRRRScaled @ wRRR'), with the centering constant
+    # absorbed into the intercept row of Beta/Gamma.  The reference instead
+    # divides Beta's RRR rows by XRRRScalePar[,k] (combineParameters.R:30-43),
+    # which mixes per-original-covariate scales into per-component rows; the
+    # invariant above is the one predict()/WAIC rely on.
+    wRRR = state.wRRR
+    if spec.nc_rrr > 0 and data.xrrr_scale_par is not None:
+        rm, rs = data.xrrr_scale_par[0], data.xrrr_scale_par[1]
+        wRRR = state.wRRR / rs[None, :]
+        if data.x_intercept_ind is not None:
+            ii = data.x_intercept_ind
+            cK = (state.wRRR * (rm / rs)[None, :]).sum(axis=1)  # (nc_rrr,)
+            Beta = Beta.at[ii].add(-(cK[:, None] * Beta[ncn:]).sum(axis=0))
+            Gamma = Gamma.at[ii].add(-(cK[:, None] * Gamma[ncn:]).sum(axis=0))
+
+    rec = {
+        "Beta": Beta,
+        "Gamma": Gamma,
+        "V": V,
+        "sigma": 1.0 / state.iSigma,
+        "rho": (data.rhopw[state.rho_idx, 0] if spec.has_phylo
+                else jnp.zeros((), dtype=Beta.dtype)),
+    }
+    for r in range(spec.nr):
+        lv = state.levels[r]
+        rec[f"Eta_{r}"] = lv.Eta
+        rec[f"Lambda_{r}"] = U.lambda_effective(lv)
+        rec[f"Psi_{r}"] = lv.Psi
+        rec[f"Delta_{r}"] = lv.Delta
+        rec[f"Alpha_{r}"] = lv.alpha_idx
+        rec[f"nfMask_{r}"] = lv.nf_mask
+    if spec.nc_rrr > 0:
+        rec["wRRR"] = wRRR
+        rec["PsiRRR"] = state.PsiRRR
+        rec["DeltaRRR"] = state.DeltaRRR
+    return rec

@@ -1,0 +1,255 @@
+"""Marginalized ("collapsed") updaters: ``update_gamma2`` and
+``update_gamma_eta`` (reference ``R/updateGamma2.R:6-60``,
+``R/updateGammaEta.R:7-206``).
+
+Both accelerate mixing of the Beta–Gamma–Eta hierarchy by integrating
+parameters out of a conditional draw.  They are exact Gibbs moves and fully
+optional: the TPU sweep's batched joint BetaLambda update already removes the
+per-species bottleneck that motivates them in the reference, so they default
+OFF here and are enabled with ``updater={"Gamma2": True, "GammaEta": True}``
+(the reference enables them by default whenever its structural gates pass,
+``sampleMcmc.R:123-152,206-216``).
+
+The default was **measured, not assumed** (round 3, TPU v5e, probit + one
+unstructured level, 4 chains; see BENCHMARKS.md): enabling GammaEta loses on
+throughput and min ESS/s at every scale tried, and on median ESS/s at all
+but the largest (where it is within noise, 11.3 -> 11.5) —
+TD-scale (50x4): 2174 -> 1490 samples/s, median ESS/s 723 -> 409;
+mid (400x250): 1080 -> 364 samples/s, ESS/s 174 -> 91;
+headline (1000x1000): 198 -> 48 samples/s, min ESS/s 4.1 -> 1.5.
+The collapsed move pays its dense algebra without buying mixing this engine
+does not already get from the batched joint (Beta, Lambda) draw, so
+reference-default parity here would be a regression.
+
+Design notes (TPU-first restatement, not a translation):
+
+- ``update_gamma2`` draws Gamma | Z with **Beta marginalized**.  The
+  reference implements only the C=NULL, iSigma==1, X-matrix corner
+  (``updateGamma2.R:35-58``); here the species-marginal covariances
+  X V X' + sigma_j^2 I are handled per species by a batched Woodbury
+  identity, so any iSigma, NA masks, and general mGamma/UGamma work.
+  Still requires no phylogeny (independence across species) and a shared X.
+
+- ``update_gamma_eta`` performs the reference's partially-collapsed move as
+  one uniform scheme for *every* level kind: (1) draw Beta | Z with Gamma
+  AND the level's Eta both marginalized, (2) draw Gamma | Beta, (3) draw
+  Eta | Beta, Z via the standard Eta updater.  Given (Z, Beta), Gamma and
+  Eta are conditionally independent, so this sequential draw equals the
+  reference's joint (Gamma,Eta) draw — and because step (3) reuses the
+  engine's Eta updaters it extends to NNGP/GPP levels where the reference
+  stops (``updateGammaEta.R:153-158``).  Unlike the reference (which
+  discards its auxiliary Beta draw), the collapsed Beta is kept: the triple
+  (Beta, Gamma, Eta_r) is then one exact joint draw from
+  p(Beta, Gamma, Eta_r | Z, rest), which only improves mixing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.linalg import cho_solve
+
+from ..ops.linalg import chol_spd, sample_mvn_prec
+from .structs import GibbsState, ModelData, ModelSpec
+from . import updaters as U
+
+__all__ = ["update_gamma2", "update_gamma_eta", "gamma_eta_gates"]
+
+
+def gamma_eta_gates(spec: ModelSpec, mGamma=None) -> dict:
+    """Why each collapsed updater cannot run for this model, as a dict of
+    reasons (empty value = can run).  Mirrors the reference's auto-gating
+    (``sampleMcmc.R:123-152``); Gamma2 additionally supports NA masks (its
+    Woodbury path is per species), while GammaEta's Eta-marginal algebra
+    assumes fully observed rows and gates NA off."""
+    import numpy as np
+
+    g2, ge = [], []
+    if spec.has_phylo:
+        g2.append("phylogeny couples species in the Beta-marginal likelihood")
+    if spec.x_is_list or spec.ncsel > 0:
+        g2.append("per-species design matrix")
+        ge.append("per-species design matrix")
+    if mGamma is not None and np.any(np.abs(np.asarray(mGamma)) > 1e-6):
+        ge.append("non-zero mGamma")
+    if spec.nr == 0:
+        ge.append("no random levels")
+    if spec.has_na:
+        ge.append("NA-masked likelihood not marginalizable in closed form")
+    return {"Gamma2": "; ".join(g2), "GammaEta": "; ".join(ge)}
+
+
+# ---------------------------------------------------------------------------
+# updateGamma2: Gamma | Z, Beta marginalized (reference updateGamma2.R)
+# ---------------------------------------------------------------------------
+
+def update_gamma2(spec: ModelSpec, data: ModelData, state: GibbsState,
+                  key) -> GibbsState:
+    """Per species j (no phylogeny): z_j | Gamma ~ N(X Gamma Tr_j',
+    X V X' + sigma_j^2 I).  Woodbury gives the information contribution
+    W_j = iSig_j (XX_j - iSig_j XX_j (iV + iSig_j XX_j)^{-1} XX_j) batched
+    over species; the Gamma full conditional is then one (nc*nt) Gaussian
+    with precision iUGamma + sum_j kron(Tr_j Tr_j', W_j)."""
+    nc, nt, ns = spec.nc, spec.nt, spec.ns
+    S = state.Z
+    for r in range(spec.nr):
+        S = S - U.level_loading(data.levels[r], state.levels[r])
+
+    V = cho_solve((chol_spd(state.iV), True), jnp.eye(nc, dtype=S.dtype))
+    if spec.has_na:
+        XX = jnp.einsum("ip,ij,iq->jpq", data.X, data.Ymask, data.X)
+        XtS = jnp.einsum("ip,ij,ij->jp", data.X, data.Ymask, S)  # (ns, nc)
+    else:
+        XX0 = data.X.T @ data.X
+        XX = jnp.broadcast_to(XX0, (ns, nc, nc))
+        XtS = (data.X.T @ S).T
+    isig = state.iSigma                                   # (ns,)
+    iP = state.iV[None] + isig[:, None, None] * XX        # (ns, nc, nc)
+    LiP = chol_spd(iP)
+    XXiPXX = jnp.einsum("jpq,jqr->jpr", XX,
+                        cho_solve((LiP, True), XX))
+    W = isig[:, None, None] * (XX - isig[:, None, None] * XXiPXX)
+    # X' Sigma_j^{-1} z_j = iSig_j (X'z_j - iSig_j XX iP^{-1} X'z_j)
+    XiSz = isig[:, None] * (XtS - isig[:, None] * jnp.einsum(
+        "jpq,jq->jp", XX, cho_solve((LiP, True), XtS[..., None])[..., 0]))
+
+    # column-major vec(Gamma) (t-major blocks of nc), as in update_gamma_v
+    prec = data.iUGamma + jnp.einsum("jt,ju,jpq->tpuq", data.Tr, data.Tr,
+                                     W).reshape(nt * nc, nt * nc)
+    rhs = data.iUGamma @ data.mGamma + jnp.einsum(
+        "jt,jp->tp", data.Tr, XiSz).reshape(-1)
+    L = chol_spd(prec)
+    eps = jax.random.normal(key, rhs.shape, dtype=rhs.dtype)
+    gvec = sample_mvn_prec(L, rhs, eps)
+    return state.replace(Gamma=gvec.reshape(nt, nc).T)
+
+
+# ---------------------------------------------------------------------------
+# updateGammaEta (reference updateGammaEta.R, restructured; see module doc)
+# ---------------------------------------------------------------------------
+
+def _factor_prior_precision(ls, lvd, lv):
+    """Dense per-factor prior precision blocks iK_f (nf, np, np) for the
+    level's factor prior (identity when unstructured), from the stored
+    spatial grids."""
+    nf, npr = ls.nf_max, ls.n_units
+    if ls.spatial is None:
+        return jnp.broadcast_to(jnp.eye(npr), (nf, npr, npr))
+    if ls.spatial == "Full":
+        return lvd.iWg[lv.alpha_idx]                     # (nf, np, np)
+    if ls.spatial == "NNGP":
+        # Vecchia factors: B = I - A, iK = B' D^{-1} B
+        coef = lvd.nn_coef[lv.alpha_idx]                 # (nf, np, k)
+        D = lvd.nn_D[lv.alpha_idx]                       # (nf, np)
+        k = coef.shape[-1]
+        A = jnp.zeros((nf, npr, npr))
+        rows = jnp.broadcast_to(jnp.arange(npr)[None, :, None], (nf, npr, k))
+        cols = jnp.broadcast_to(lvd.nn_idx[None], (nf, npr, k))
+        A = A.at[jnp.arange(nf)[:, None, None], rows, cols].add(coef)
+        B = jnp.eye(npr)[None] - A
+        return jnp.einsum("fqp,fq,fqr->fpr", B, 1.0 / D, B)
+    # GPP: K = W12 iW22 W21 + diag(dD); Woodbury with stored F = W22 + W21 idD W12
+    idD = lvd.idDg[lv.alpha_idx]                         # (nf, np)
+    idDW12 = lvd.idDW12g[lv.alpha_idx]                   # (nf, np, nK)
+    iF = lvd.iFg[lv.alpha_idx]                           # (nf, nK, nK)
+    corr = jnp.einsum("fpk,fkl,fql->fpq", idDW12, iF, idDW12)
+    return jnp.eye(npr)[None] * idD[:, :, None] - corr
+
+
+def _w_solve_blocks(G, counts, V):
+    """Solve W x = v for non-spatial W = blockdiag_p(I + count_p G) with
+    factor-major vec ordering [f*np + p]; V is (np*nf, m)."""
+    npr = counts.shape[0]
+    nf = G.shape[0]
+    W = jnp.eye(nf)[None] + counts[:, None, None] * G[None]   # (np, nf, nf)
+    L = chol_spd(W)
+    Vr = V.reshape(nf, npr, -1).transpose(1, 0, 2)            # (np, nf, m)
+    X = cho_solve((L, True), Vr)
+    return X.transpose(1, 0, 2).reshape(nf * npr, -1)
+
+
+def update_gamma_eta(spec: ModelSpec, data: ModelData, state: GibbsState,
+                     r: int, key) -> GibbsState:
+    """One partially-collapsed draw for level ``r`` (x_dim==0 only):
+    Beta | Z (Gamma, Eta_r marginal) -> Gamma | Beta -> Eta_r | Beta, Z."""
+    ls, lvd, lv = spec.levels[r], data.levels[r], state.levels[r]
+    if ls.x_dim > 0:
+        return state                                     # reference skips too
+    nc, ns, nt = spec.nc, spec.ns, spec.nt
+    npr, nf = ls.n_units, ls.nf_max
+    kb, kg, ke = jax.random.split(key, 3)
+
+    # residual without this level's loading (Beta NOT subtracted)
+    S = state.Z
+    for q in range(spec.nr):
+        if q != r:
+            S = S - U.level_loading(data.levels[q], state.levels[q])
+
+    id_ = state.iSigma                                   # (ns,)
+    lam = U.lambda_effective(lv)[:, :, 0]                # (nf, ns)
+    LamiD = lam * id_[None, :]
+    G = LamiD @ lam.T                                    # Lam iD Lam' (nf, nf)
+    XtX = data.X.T @ data.X
+    XtS = data.X.T @ S                                   # (nc, ns)
+    counts = lvd.unit_count                              # (np,)
+
+    # T = kron(LamiD, PtX): rows [f*np+p], cols [j*nc+c] (species-major vec)
+    PtX = jax.ops.segment_sum(data.X, lvd.pi_row, num_segments=npr)  # (np, nc)
+    T = jnp.einsum("fj,pc->fpjc", LamiD, PtX).reshape(nf * npr, ns * nc)
+    PtS = jax.ops.segment_sum(S, lvd.pi_row, num_segments=npr)       # (np, ns)
+    u = (PtS @ LamiD.T).T.reshape(-1)                    # [f*np+p] ordering
+
+    spatial = ls.spatial is not None
+    if spatial:
+        iK = _factor_prior_precision(ls, lvd, lv)        # (nf, np, np)
+        Wd = jnp.zeros((nf, npr, nf, npr))
+        fr = jnp.arange(nf)
+        Wd = Wd.at[fr, :, fr, :].add(iK)
+        Wd = Wd + jnp.einsum("fg,p,pq->fpgq", G, counts,
+                             jnp.eye(npr))
+        Lw = chol_spd(Wd.reshape(nf * npr, nf * npr))
+        iWT = cho_solve((Lw, True), T)
+        iWu = cho_solve((Lw, True), u)
+    else:
+        iWT = _w_solve_blocks(G, counts, T)
+        iWu = _w_solve_blocks(G, counts, u[:, None])[:, 0]
+
+    # Eta-marginal likelihood precision and rhs on vec(Beta)
+    jr = jnp.arange(ns)
+    blk = jnp.zeros((ns, nc, ns, nc), dtype=S.dtype)
+    blk = blk.at[jr, :, jr, :].set(id_[:, None, None] * XtX[None])
+    tmp1 = blk.reshape(ns * nc, ns * nc) - T.T @ iWT
+    rhs = (XtS * id_[None, :]).T.reshape(-1) - T.T @ iWu
+
+    # Gamma-marginal prior covariance A = (Tr x I) U_G (Tr x I)' + kron(Q, V)
+    V = cho_solve((chol_spd(state.iV), True), jnp.eye(nc, dtype=S.dtype))
+    UG = data.UGamma.reshape(nt, nc, nt, nc)
+    A = jnp.einsum("jt,tcud,Ju->jcJd", data.Tr, UG, data.Tr)
+    if spec.has_phylo:
+        e = data.Qeig[state.rho_idx]
+        Q = (data.U * e[None, :]) @ data.U.T
+    else:
+        Q = jnp.eye(ns, dtype=S.dtype)
+    A = (A + jnp.einsum("jJ,cd->jcJd", Q, V)).reshape(ns * nc, ns * nc)
+    iA = cho_solve((chol_spd(A), True), jnp.eye(ns * nc, dtype=S.dtype))
+
+    M = iA + tmp1
+    Lm = chol_spd(M)
+    eps = jax.random.normal(kb, rhs.shape, dtype=rhs.dtype)
+    Beta = sample_mvn_prec(Lm, rhs, eps).reshape(ns, nc).T
+    state = state.replace(Beta=Beta)
+
+    # Gamma | Beta (same full conditional as update_gamma_v's Gamma block)
+    state = U.gamma_given_beta(spec, data, state, kg)
+
+    # Eta_r | Beta, Z via the standard Eta updater
+    LFix = U.linear_fixed(spec, data, state.Beta)
+    S_eta = S - LFix
+    if spatial:
+        from .spatial import update_eta_spatial
+        lv_new = update_eta_spatial(spec, data, state, r, ke, S_eta)
+    else:
+        lv_new = U.update_eta_nonspatial(spec, data, state, r, ke, S_eta)
+    levels = list(state.levels)
+    levels[r] = lv_new
+    return state.replace(levels=tuple(levels))

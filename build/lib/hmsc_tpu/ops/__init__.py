@@ -1,0 +1,7 @@
+from .rand import (truncated_normal, truncated_normal_onesided, polya_gamma,
+                   wishart, mvn_from_prec_chol, categorical_logits)
+from .linalg import chol_spd, solve_from_chol
+
+__all__ = ["truncated_normal", "truncated_normal_onesided", "polya_gamma",
+           "wishart", "mvn_from_prec_chol", "categorical_logits", "chol_spd",
+           "solve_from_chol"]

@@ -1,0 +1,103 @@
+"""Dense linear-algebra helpers for the Gibbs engine.
+
+All solvers are batched-friendly (leading batch axes via vmap) and keep
+everything on the MXU: cholesky + triangular solves, no explicit inverses
+(the reference's ``chol2inv``/``backsolve`` pattern, e.g.
+``R/updateBetaLambda.R:100-103``, maps to ``cho_solve``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.scipy.linalg import cho_solve, solve_triangular
+
+__all__ = ["chol_spd", "solve_from_chol", "sample_mvn_prec",
+           "sample_mvn_prec_batched"]
+
+# Relative jitter added to diagonals before cholesky; f32 MCMC insurance
+# (design choice documented in SURVEY.md §7 point 6).
+_JITTER = 1e-6
+
+
+def chol_spd(A: jnp.ndarray, jitter: float = _JITTER) -> jnp.ndarray:
+    """Cholesky of a symmetric PD matrix with relative diagonal jitter."""
+    n = A.shape[-1]
+    scale = jnp.mean(jnp.diagonal(A, axis1=-2, axis2=-1), axis=-1)
+    eye = jnp.eye(n, dtype=A.dtype)
+    A = A + (jitter * scale)[..., None, None] * eye
+    return jnp.linalg.cholesky(A)
+
+
+def solve_from_chol(L: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Solve A x = b given L = chol(A) (lower)."""
+    return cho_solve((L, True), b)
+
+
+def sample_mvn_prec(L: jnp.ndarray, rhs: jnp.ndarray, eps: jnp.ndarray) -> jnp.ndarray:
+    """Draw from N(P^{-1} rhs, P^{-1}) given L = chol(P) and eps ~ N(0, I).
+
+    mean = P^{-1} rhs; noise = L^{-T} eps  (cov L^{-T} L^{-1} = P^{-1}).
+    """
+    mean = cho_solve((L, True), rhs)
+    noise = solve_triangular(jnp.swapaxes(L, -1, -2), eps, lower=False)
+    return mean + noise
+
+
+# Above this matrix size the unrolled code (~P^3/6 vector ops) stops paying
+# for itself and the generic batched LAPACK-style path takes over.
+_SMALL_P_MAX = 16
+
+
+def sample_mvn_prec_batched(prec: jnp.ndarray, rhs: jnp.ndarray,
+                            eps: jnp.ndarray,
+                            jitter: float = _JITTER) -> jnp.ndarray:
+    """Fused chol + N(P^{-1} rhs, P^{-1}) draw for a batch of small SPD
+    precisions — the Gibbs sweep's hottest linear algebra (per-species
+    (nc+K)^2 systems in updateBetaLambda, per-unit nf^2 systems in updateEta;
+    reference R/updateBetaLambda.R:76-122, R/updateEta.R:44-92).
+
+    For P <= ``_SMALL_P_MAX`` the factorisation is fully unrolled over the
+    static P with the batch as the vector dimension: XLA's batched
+    ``cholesky`` keeps the (P, P) minor dims in lane/sublane position, so a
+    10x10 factorisation uses 10 of 128 lanes and serialises sublane steps —
+    measured ~20x slower than this formulation at (4000, 10, 10) on TPU v5e.
+    Semantics (incl. the relative diagonal jitter and NaN propagation on
+    indefinite input — relied on by divergence containment) match
+    ``chol_spd`` + ``sample_mvn_prec``.
+    """
+    P = prec.shape[-1]
+    if P > _SMALL_P_MAX:
+        return sample_mvn_prec(chol_spd(prec, jitter), rhs, eps)
+
+    A = [[prec[..., i, j] for j in range(P)] for i in range(P)]
+    scale = A[0][0]
+    for j in range(1, P):
+        scale = scale + A[j][j]
+    bump = (jitter / P) * scale
+    L = [[None] * P for _ in range(P)]
+    inv = [None] * P
+    for j in range(P):
+        s = A[j][j] + bump
+        for k in range(j):
+            s = s - L[j][k] * L[j][k]
+        d = jnp.sqrt(s)                       # NaN if indefinite, like chol
+        inv[j] = 1.0 / d
+        L[j][j] = d
+        for i in range(j + 1, P):
+            s2 = A[i][j]
+            for k in range(j):
+                s2 = s2 - L[i][k] * L[j][k]
+            L[i][j] = s2 * inv[j]
+    # forward solve L y = rhs, then back solve L' x = y + eps
+    y = [None] * P
+    for i in range(P):
+        s = rhs[..., i]
+        for k in range(i):
+            s = s - L[i][k] * y[k]
+        y[i] = s * inv[i]
+    x = [None] * P
+    for i in reversed(range(P)):
+        s = y[i] + eps[..., i]
+        for k in range(i + 1, P):
+            s = s - L[k][i] * x[k]
+        x[i] = s * inv[i]
+    return jnp.stack(x, axis=-1)

@@ -1,0 +1,274 @@
+"""Visualization layer (L6, reference ``R/plotBeta.R:59-264``,
+``R/plotGamma.R:50-180``, ``R/plotGradient.R:63-210``,
+``R/plotVariancePartitioning.R:21-41``, ``R/biPlot.R:26-59``).
+
+Matplotlib-level presentation over the L4/L5 outputs; pure host-side.  Each
+function returns the matplotlib ``Axes`` so callers can restyle or save.
+``plot_beta``/``plot_gamma`` support the reference's three display modes:
+posterior mean, support (P(>0)), and sign-thresholded mean.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["plot_beta", "plot_gamma", "plot_gradient",
+           "plot_variance_partitioning", "bi_plot"]
+
+
+def _ax(ax):
+    if ax is not None:
+        return ax
+    import matplotlib.pyplot as plt
+    _, ax = plt.subplots()
+    return ax
+
+
+def _mode_matrix(est, plot_type, support_level):
+    """The displayed matrix for the reference's three plot modes."""
+    mean = est["mean"]
+    if plot_type == "Mean":
+        return mean
+    if plot_type == "Support":
+        return np.where(est["support"] > support_level, est["support"],
+                        np.where(est["supportNeg"] > support_level,
+                                 -est["supportNeg"], 0.0))
+    if plot_type == "Sign":
+        sig = (est["support"] > support_level) \
+            | (est["supportNeg"] > support_level)
+        return np.where(sig, np.sign(mean), 0.0)
+    raise ValueError("plotType must be 'Mean', 'Support' or 'Sign'")
+
+
+def _support_plot(est, row_names, col_names, plot_type, support_level, ax,
+                  title):
+    ax = _ax(ax)
+    M = _mode_matrix(est, plot_type, support_level)
+    vmax = np.max(np.abs(M)) or 1.0
+    im = ax.imshow(M, cmap="RdBu_r", vmin=-vmax, vmax=vmax, aspect="auto")
+    ax.set_xticks(range(len(col_names)))
+    ax.set_xticklabels(col_names, rotation=90, fontsize=7)
+    ax.set_yticks(range(len(row_names)))
+    ax.set_yticklabels(row_names, fontsize=7)
+    ax.set_title(title)
+    ax.figure.colorbar(im, ax=ax, shrink=0.8)
+    return ax
+
+
+def _draw_c_dendrogram(ax_t, C):
+    """UPGMA dendrogram of the phylogenetic correlation matrix; returns the
+    bottom-to-top species order with leaf h at y = 5 + 10 h."""
+    from scipy.cluster import hierarchy
+    from scipy.spatial.distance import squareform
+
+    D = 1.0 - np.asarray(C, dtype=float)
+    D = np.clip((D + D.T) / 2.0, 0.0, None)
+    np.fill_diagonal(D, 0.0)
+    Z = hierarchy.linkage(squareform(D, checks=False), method="average")
+    dn = hierarchy.dendrogram(Z, orientation="left", ax=ax_t, no_labels=True,
+                              color_threshold=0,
+                              above_threshold_color="#555555")
+    return dn["leaves"]
+
+
+def _draw_phylogram(ax_t, newick, sp_names):
+    """The supplied tree itself, as the reference's ``ape::plot.phylo`` panel
+    (``plotBeta.R:59-264``): x = root-to-node distance (real branch lengths),
+    leaf h at y = 5 + 10 h (the shared row coordinate), internal nodes at the
+    mean of their children.  Trees covering more species than the model are
+    pruned to the modeled set.  Returns the bottom-to-top species order."""
+    from .utils.phylo import parse_newick, prune_parsed
+
+    sp = [str(s) for s in sp_names]
+    children, lengths, names = prune_parsed(*parse_newick(newick), sp)
+    n = len(children)
+    depth = np.zeros(n)
+    for v in range(n):                       # parents precede children
+        for c in children[v]:
+            depth[c] = depth[v] + lengths[c]
+    # leaf order: DFS in Newick child order, bottom-to-top
+    leaves, stack = [], [0]
+    while stack:
+        v = stack.pop()
+        if not children[v]:
+            leaves.append(v)
+        else:
+            stack.extend(reversed(children[v]))
+    y = np.zeros(n)
+    for i, v in enumerate(leaves):
+        y[v] = 5.0 + 10.0 * i
+    for v in range(n - 1, -1, -1):           # children before parents
+        if children[v]:
+            y[v] = np.mean([y[c] for c in children[v]])
+    for v in range(n):
+        for c in children[v]:
+            ax_t.plot([depth[v], depth[c]], [y[c], y[c]],
+                      color="#555555", lw=1.0)
+        if children[v]:
+            ys = [y[c] for c in children[v]]
+            ax_t.plot([depth[v], depth[v]], [min(ys), max(ys)],
+                      color="#555555", lw=1.0)
+    ax_t.set_xlim(-0.02 * max(depth.max(), 1e-12), depth.max() * 1.02)
+    pos = {name: i for i, name in enumerate(sp)}
+    return [pos[names[v]] for v in leaves]
+
+
+def plot_beta(post, plot_type: str = "Support", support_level: float = 0.89,
+              ax=None, *, plot_tree: bool = False):
+    """Heatmap of species' environmental responses Beta (covariates x
+    species), reference ``plotBeta.R``.
+
+    ``plot_tree=True`` draws the phylogeny side panel (reference
+    ``plotBeta.R:59-264``, which renders the ``ape`` tree): species move to
+    the y-axis with the tree drawn left of the heatmap, leaves aligned to
+    the rows.  A model built with ``phylo_tree=`` draws the actual supplied
+    topology and branch lengths (pruned to the modeled species); a model
+    built with only ``C`` falls back to an average-linkage dendrogram of
+    the correlation matrix (distance ``1 - C``) — a reconstruction that is
+    exact for ultrametric trees only.
+    """
+    hM = post.hM
+    est = post.get_post_estimate("Beta")
+    if not plot_tree:
+        return _support_plot(est, hM.cov_names, hM.sp_names, plot_type,
+                             support_level, ax, "Beta")
+    if hM.C is None:
+        raise ValueError(
+            "Hmsc.plotBeta: plot_tree requires a model with a phylogenetic "
+            "correlation matrix C")
+    if ax is not None:
+        raise ValueError(
+            "Hmsc.plotBeta: plot_tree draws its own two-panel figure; "
+            "the ax argument cannot be combined with it")
+    import matplotlib.pyplot as plt
+
+    fig, (ax_t, ax_h) = plt.subplots(
+        1, 2, figsize=(9, max(4, 0.3 * hM.ns + 2)),
+        gridspec_kw={"width_ratios": [1, 3], "wspace": 0.02})
+    if getattr(hM, "phylo_tree", None) is not None:
+        order = _draw_phylogram(ax_t, hM.phylo_tree, hM.sp_names)
+    else:
+        order = _draw_c_dendrogram(ax_t, hM.C)
+    M = _mode_matrix(est, plot_type, support_level)[:, order].T  # (ns, nc)
+    vmax = np.max(np.abs(M)) or 1.0
+    # dendrogram leaf h sits at y = 5 + 10 h; the extent puts heatmap row h
+    # exactly there so the panels align
+    im = ax_h.imshow(M, cmap="RdBu_r", vmin=-vmax, vmax=vmax, aspect="auto",
+                     origin="lower", extent=(-0.5, M.shape[1] - 0.5,
+                                             0, 10 * hM.ns))
+    ax_t.set_ylim(0, 10 * hM.ns)
+    ax_t.set_axis_off()
+    ax_h.set_yticks(5 + 10 * np.arange(hM.ns))
+    ax_h.set_yticklabels([hM.sp_names[j] for j in order], fontsize=7)
+    ax_h.set_xticks(range(len(hM.cov_names)))
+    ax_h.set_xticklabels(hM.cov_names, rotation=90, fontsize=7)
+    ax_h.set_title("Beta")
+    fig.colorbar(im, ax=ax_h, shrink=0.8)
+    return ax_h
+
+
+def plot_gamma(post, plot_type: str = "Support", support_level: float = 0.89,
+               ax=None):
+    """Heatmap of trait effects Gamma (covariates x traits), reference
+    ``plotGamma.R``."""
+    hM = post.hM
+    est = post.get_post_estimate("Gamma")
+    return _support_plot(est, hM.cov_names, hM.tr_names, plot_type,
+                         support_level, ax, "Gamma")
+
+
+def plot_gradient(post, gradient, pred=None, measure: str = "S", index: int = 0,
+                  q=(0.25, 0.5, 0.75), show_data: bool = True, ax=None,
+                  seed: int = 0):
+    """Prediction along an environmental gradient with credible ribbons
+    (reference ``plotGradient.R``): ``measure``='S' species richness, 'Y'
+    one species (``index``), 'T' community-weighted mean trait (``index``)."""
+    from .predict import predict as _predict
+
+    hM = post.hM
+    if pred is None:
+        pred = _predict(post, gradient=gradient, expected=True, seed=seed)
+    xx = np.asarray(gradient["XDataNew"].iloc[:, 0], dtype=float)
+    if measure == "S":
+        stat = pred.sum(axis=2)                      # (n, ngrid)
+        label = "Summed response (richness)"
+    elif measure == "Y":
+        stat = pred[:, :, index]
+        label = f"{hM.sp_names[index]}"
+    elif measure == "T":
+        tw = pred @ hM.Tr[:, index]
+        stat = tw / np.maximum(pred.sum(axis=2), 1e-12)
+        label = f"CWM {hM.tr_names[index]}"
+    else:
+        raise ValueError("measure must be 'S', 'Y' or 'T'")
+    lo, med, hi = np.quantile(stat, q, axis=0)
+    ax = _ax(ax)
+    ax.fill_between(xx, lo, hi, alpha=0.3, color="#4477aa", lw=0)
+    ax.plot(xx, med, color="#4477aa")
+    ax.set_xlabel(str(gradient["XDataNew"].columns[0]))
+    ax.set_ylabel(label)
+    if show_data and measure == "S" and hM.x_data is not None:
+        try:
+            v = np.asarray(hM.x_data[gradient["XDataNew"].columns[0]], float)
+            ax.plot(v, np.nansum(hM.Y, axis=1), ".", color="#666666",
+                    markersize=3)
+        except Exception:
+            pass
+    return ax
+
+
+def plot_variance_partitioning(post, vp=None, ax=None, cmap: str = "tab20"):
+    """Stacked per-species bars of the variance shares (reference
+    ``plotVariancePartitioning.R``)."""
+    from .post.metrics import compute_variance_partitioning
+
+    hM = post.hM
+    if vp is None:
+        vp = compute_variance_partitioning(post)
+    vals = vp["vals"]
+    ax = _ax(ax)
+    import matplotlib.pyplot as plt
+
+    colors = plt.get_cmap(cmap)(np.linspace(0, 1, vals.shape[0]))
+    bottom = np.zeros(vals.shape[1])
+    xs = np.arange(vals.shape[1])
+    means = vals.mean(axis=1)
+    for i in range(vals.shape[0]):
+        ax.bar(xs, vals[i], bottom=bottom, color=colors[i],
+               label=f"{vp['names'][i]} (mean = {means[i]:.2f})")
+        bottom += vals[i]
+    ax.set_xticks(xs)
+    ax.set_xticklabels(hM.sp_names, rotation=90, fontsize=7)
+    ax.set_ylabel("Variance proportion")
+    ax.legend(fontsize=6, loc="upper right")
+    return ax
+
+
+def bi_plot(post, r: int = 0, factors=(0, 1), color_var=None, ax=None):
+    """Ordination of sites (posterior-mean Eta) against species loadings
+    (posterior-mean Lambda) for one random level (reference ``biPlot.R``)."""
+    hM = post.hM
+    eta = post.get_post_estimate("Eta", r=r)["mean"]       # (np, nf)
+    lam = post.get_post_estimate("Lambda", r=r)["mean"]    # (nf, ns[, ncr])
+    lam = lam[..., 0] if lam.ndim == 3 else lam
+    f1, f2 = factors
+    ax = _ax(ax)
+    c = None
+    if color_var is not None and hM.x_data is not None:
+        v = np.asarray(hM.x_data[color_var], dtype=float)
+        if len(v) == eta.shape[0]:           # one row per unit already
+            c = v
+        elif len(v) == hM.ny:                # map rows -> first row per unit
+            first_row = np.zeros(eta.shape[0], dtype=int)
+            first_row[hM.Pi[::-1, r]] = np.arange(hM.ny - 1, -1, -1)
+            c = v[first_row]
+    kw = {"c": c, "cmap": "viridis"} if c is not None else {}
+    ax.scatter(eta[:, f1], eta[:, f2], s=12, label="sites", **kw)
+    scale = (np.abs(eta[:, [f1, f2]]).max() /
+             max(np.abs(lam[[f1, f2]]).max(), 1e-12))
+    for j in range(hM.ns):
+        ax.annotate(hM.sp_names[j], (lam[f1, j] * scale, lam[f2, j] * scale),
+                    color="#bb3333", fontsize=8)
+    ax.set_xlabel(f"Latent factor {f1 + 1}")
+    ax.set_ylabel(f"Latent factor {f2 + 1}")
+    return ax

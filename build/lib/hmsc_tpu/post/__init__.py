@@ -1,0 +1,11 @@
+from .posterior import Posterior, pool_mcmc_chains
+from .diagnostics import effective_size, gelman_rhat, convert_to_coda_object
+from .associations import compute_associations
+from .align import align_posterior
+from .metrics import (evaluate_model_fit, compute_waic,
+                      compute_variance_partitioning)
+
+__all__ = ["Posterior", "pool_mcmc_chains", "effective_size", "gelman_rhat",
+           "convert_to_coda_object", "compute_associations", "align_posterior",
+           "evaluate_model_fit", "compute_waic",
+           "compute_variance_partitioning"]

@@ -1,0 +1,29 @@
+"""Residual species-association summaries (reference
+``R/computeAssociations.R:19-39``): per random level the posterior mean of
+cov2cor(Lambda' Lambda) and the support P(omega > 0), as one batched einsum
+over the whole posterior."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["compute_associations"]
+
+
+def compute_associations(post, start: int = 0, thin: int = 1):
+    # per-chain windowing like the reference's poolMcmcChains(start, thin)
+    # (slicing the pooled chain-concatenated axis would thin across chain
+    # boundaries)
+    post = post.subset(start, thin)
+    out = []
+    for r in range(post.spec.nr):
+        lam = post.pooled(f"Lambda_{r}")                  # (n, nf, ns[, ncr])
+        lam = lam[..., 0] if lam.ndim == 4 else lam
+        om = np.einsum("nfj,nfk->njk", lam, lam)
+        d = np.sqrt(np.maximum(np.einsum("njj->nj", om), 1e-30))
+        cor = om / d[:, :, None] / d[:, None, :]
+        out.append({
+            "mean": cor.mean(axis=0),
+            "support": (om > 0).mean(axis=0),
+        })
+    return out

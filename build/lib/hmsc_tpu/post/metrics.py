@@ -1,0 +1,358 @@
+"""Model-assessment metrics (L4): ``evaluate_model_fit``, ``compute_waic``,
+``compute_variance_partitioning`` (reference ``R/evaluateModelFit.R:53-169``,
+``R/computeWAIC.R:25-131``, ``R/computeVariancePartitioning.R:37-205``).
+
+All three recompute per-draw quantities the reference obtains by interpreted
+per-sample R loops; here the whole pooled posterior is one stacked batch and
+every reduction is a vectorised einsum / elementwise pass (SURVEY.md §3.5).
+AUC is the rank-based Mann-Whitney statistic (equals the reference's
+``pROC::auc``); Poisson WAIC terms use Gauss-Hermite quadrature over the
+lognormal mixing exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["evaluate_model_fit", "compute_waic",
+           "compute_variance_partitioning"]
+
+
+# ---------------------------------------------------------------------------
+# shared: linear predictor over the whole pooled posterior
+# ---------------------------------------------------------------------------
+
+def posterior_linear_predictor(post) -> np.ndarray:
+    """(n_draws, ny, ns) linear predictor at the training design from the
+    recorded (back-transformed) posterior: L = X B + sum_r Eta_r[Pi_r] Lam_r.
+    Delegates to the prediction layer's batched assembly so the two stay in
+    lockstep."""
+    from ..predict.predict import _lin_pred
+
+    hM, spec = post.hM, post.spec
+    eta_pred = [post.pooled(f"Eta_{r}") for r in range(hM.nr)]
+    pi = [hM.Pi[:, r] for r in range(hM.nr)]
+    x_row = [hM.ranLevels[r].x_for(hM.pi_names[r])[hM.Pi[:, r]]
+             if hM.ranLevels[r].x_dim > 0 else np.ones((hM.ny, 1))
+             for r in range(hM.nr)]
+    return _lin_pred(hM, spec, hM.X, hM.x_is_list,
+                     hM.XRRR if hM.nc_rrr > 0 else None, post,
+                     post.pooled("Beta"), eta_pred, pi, x_row)
+
+
+# ---------------------------------------------------------------------------
+# evaluateModelFit
+# ---------------------------------------------------------------------------
+
+def _rmse(Y, P):
+    return np.sqrt(np.nanmean((Y - P) ** 2, axis=0))
+
+
+def _pearson_r2(Y, P):
+    out = np.full(Y.shape[1], np.nan)
+    for j in range(Y.shape[1]):
+        m = ~np.isnan(Y[:, j]) & ~np.isnan(P[:, j])
+        if m.sum() > 1 and np.std(Y[m, j]) > 0 and np.std(P[m, j]) > 0:
+            co = np.corrcoef(Y[m, j], P[m, j])[0, 1]
+            out[j] = np.sign(co) * co**2
+    return out
+
+
+def _rank(x):
+    order = np.argsort(x, kind="mergesort")
+    ranks = np.empty(len(x))
+    ranks[order] = np.arange(1, len(x) + 1)
+    # midranks for ties
+    sx = x[order]
+    i = 0
+    while i < len(x):
+        j = i
+        while j + 1 < len(x) and sx[j + 1] == sx[i]:
+            j += 1
+        if j > i:
+            ranks[order[i:j + 1]] = (i + j) / 2 + 1
+        i = j + 1
+    return ranks
+
+
+def _spearman_r2(Y, P):
+    out = np.full(Y.shape[1], np.nan)
+    for j in range(Y.shape[1]):
+        m = ~np.isnan(Y[:, j]) & ~np.isnan(P[:, j])
+        if m.sum() > 1:
+            ry, rp = _rank(Y[m, j]), _rank(P[m, j])
+            if np.std(ry) > 0 and np.std(rp) > 0:
+                co = np.corrcoef(ry, rp)[0, 1]
+                out[j] = np.sign(co) * co**2
+    return out
+
+
+def _auc(Y, P):
+    """Mann-Whitney AUC per species (== pROC::auc with direction '<')."""
+    out = np.full(Y.shape[1], np.nan)
+    for j in range(Y.shape[1]):
+        m = ~np.isnan(Y[:, j]) & ~np.isnan(P[:, j])
+        y = (Y[m, j] > 0).astype(int)
+        n1, n0 = y.sum(), (1 - y).sum()
+        if n1 == 0 or n0 == 0:
+            continue
+        r = _rank(P[m, j])
+        out[j] = (r[y == 1].sum() - n1 * (n1 + 1) / 2) / (n1 * n0)
+    return out
+
+
+def _tjur_r2(Y, P):
+    out = np.full(Y.shape[1], np.nan)
+    for j in range(Y.shape[1]):
+        m = ~np.isnan(Y[:, j])
+        y, p = Y[m, j], P[m, j]
+        if (y == 1).any() and (y == 0).any():
+            out[j] = np.nanmean(p[y == 1]) - np.nanmean(p[y == 0])
+    return out
+
+
+def evaluate_model_fit(hM, predY: np.ndarray) -> dict:
+    """Per-species fit metrics from a (n_draws, ny, ns) prediction array
+    (reference ``evaluateModelFit.R:53-169``): RMSE always; normal -> signed
+    pearson R2; probit -> AUC + Tjur R2; Poisson -> spearman pseudo-R2 plus
+    occurrence-truncated (O.*) and conditional-on-presence (C.*) variants."""
+    predY = np.asarray(predY)
+    fam = hM.distr[:, 0]
+    mPredY = np.empty((hM.ny, hM.ns))
+    sel_p = fam == 3
+    if sel_p.any():
+        mPredY[:, sel_p] = np.nanmedian(predY[:, :, sel_p], axis=0)
+    if (~sel_p).any():
+        mPredY[:, ~sel_p] = np.nanmean(predY[:, :, ~sel_p], axis=0)
+
+    MF = {"RMSE": _rmse(hM.Y, mPredY)}
+    sel = fam == 1
+    if sel.any():
+        R2 = np.full(hM.ns, np.nan)
+        R2[sel] = _pearson_r2(hM.Y[:, sel], mPredY[:, sel])
+        MF["R2"] = R2
+    sel = fam == 2
+    if sel.any():
+        AUC = np.full(hM.ns, np.nan)
+        Tjur = np.full(hM.ns, np.nan)
+        AUC[sel] = _auc(hM.Y[:, sel], mPredY[:, sel])
+        Tjur[sel] = _tjur_r2(hM.Y[:, sel], mPredY[:, sel])
+        MF["AUC"] = AUC
+        MF["TjurR2"] = Tjur
+    sel = fam == 3
+    if sel.any():
+        SR2 = np.full(hM.ns, np.nan)
+        SR2[sel] = _spearman_r2(hM.Y[:, sel], mPredY[:, sel])
+        MF["SR2"] = SR2
+        predO = (predY[:, :, sel] > 0).astype(float)
+        mPredO = np.nanmean(predO, axis=0)
+        YO = (hM.Y[:, sel] > 0).astype(float)
+        YO[np.isnan(hM.Y[:, sel])] = np.nan
+        for name, arr in (("O.AUC", _auc(YO, mPredO)),
+                          ("O.TjurR2", _tjur_r2(YO, mPredO)),
+                          ("O.RMSE", _rmse(YO, mPredO))):
+            full = np.full(hM.ns, np.nan)
+            full[sel] = arr
+            MF[name] = full
+        with np.errstate(divide="ignore", invalid="ignore"):
+            mPredC = mPredY[:, sel] / mPredO
+        CY = hM.Y[:, sel].copy()
+        CY[CY == 0] = np.nan
+        for name, arr in (("C.SR2", _spearman_r2(CY, mPredC)),
+                          ("C.RMSE", _rmse(CY, mPredC))):
+            full = np.full(hM.ns, np.nan)
+            full[sel] = arr
+            MF[name] = full
+    return MF
+
+
+# ---------------------------------------------------------------------------
+# computeWAIC
+# ---------------------------------------------------------------------------
+
+def compute_waic(post, ghN: int = 11) -> float:
+    """WAIC from pointwise log-likelihoods over the pooled posterior
+    (reference ``computeWAIC.R:25-131``): exact for normal & probit, Poisson
+    via ``ghN``-point Gauss-Hermite quadrature over the lognormal mixing.
+    The lppd term uses a stable log-mean-exp over draws."""
+    from scipy.special import log_ndtr
+
+    hM = post.hM
+    E = posterior_linear_predictor(post)             # (n, ny, ns)
+    sigma = post.pooled("sigma")                     # (n, ns)
+    fam = hM.distr[:, 0]
+    Y = hM.Y
+    na = np.isnan(Y)
+    n_draws = E.shape[0]
+
+    L = np.zeros((n_draws, hM.ny))
+    sel = fam == 1
+    if sel.any():
+        sd = np.sqrt(sigma[:, None, sel])
+        t = (-0.5 * np.log(2 * np.pi) - np.log(sd)
+             - 0.5 * ((Y[None, :, sel] - E[:, :, sel]) / sd) ** 2)
+        t[:, na[:, sel]] = 0.0
+        L += t.sum(axis=2)
+    sel = fam == 2
+    if sel.any():
+        # unit-sd probit log-lik, like the reference (computeWAIC.R:97-99);
+        # Y is 0/1 so select between the two tails rather than multiplying
+        # two (n, ny, ns)-sized products
+        Ey = E[:, :, sel]
+        t = np.where(Y[None, :, sel] > 0.5, log_ndtr(Ey), log_ndtr(-Ey))
+        t[:, na[:, sel]] = 0.0
+        L += t.sum(axis=2)
+    sel = fam == 3
+    if sel.any():
+        from scipy.special import gammaln
+
+        gx, gw = np.polynomial.hermite.hermgauss(ghN)
+        std = np.sqrt(sigma[:, None, sel])
+        gX = (E[:, :, sel, None]
+              + np.sqrt(2.0) * gx[None, None, None, :] * std[..., None])
+        lam = np.exp(np.clip(gX, None, 30.0))
+        yv = Y[None, :, sel, None]
+        log_pois = yv * gX - lam - gammaln(np.where(na[:, sel], 0, Y[:, sel])[None, :, :, None] + 1.0)
+        # integrate exp(log_pois) against the GH weights, in log space
+        mx = log_pois.max(axis=-1, keepdims=True)
+        integral = np.log((np.exp(log_pois - mx) * gw[None, None, None, :]
+                           ).sum(axis=-1)) + mx[..., 0] - 0.5 * np.log(np.pi)
+        integral[:, na[:, sel]] = 0.0
+        L += integral.sum(axis=2)
+
+    # WAIC = mean over units of (-log mean_n lik) + var_n(log lik)
+    mx = L.max(axis=0, keepdims=True)
+    lppd_neg = -(np.log(np.exp(L - mx).mean(axis=0)) + mx[0])
+    V = L.var(axis=0, ddof=1)
+    return float(np.mean(lppd_neg + V))
+
+
+# ---------------------------------------------------------------------------
+# computeVariancePartitioning
+# ---------------------------------------------------------------------------
+
+def compute_variance_partitioning(post, group=None, group_names=None,
+                                  start: int = 0,
+                                  na_ignore: bool = False) -> dict:
+    """Per-species variance shares of each covariate group and random level,
+    plus trait-explained R2 (reference ``computeVariancePartitioning.R``).
+    All per-draw quantities are batched einsums over the pooled posterior."""
+    hM = post.hM
+    ns, nc, nr = hM.ns, hM.nc, hM.nr
+    if group is None:
+        if nc > 1:
+            group = np.concatenate([[1], np.arange(1, nc)])
+            group_names = list(hM.cov_names[1:nc])
+        else:
+            group = np.array([1])
+            group_names = list(hM.cov_names[:1])
+    group = np.asarray(group, dtype=int)
+    if group.size != nc:
+        raise ValueError(
+            f"computeVariancePartitioning: group must assign one of ngroups "
+            f"to each of the nc={nc} covariates")
+    if group.min() < 1:
+        raise ValueError(
+            "computeVariancePartitioning: group labels are 1-indexed "
+            "(reference convention); got a label < 1")
+    ngroups = int(group.max())
+    missing = set(range(1, ngroups + 1)) - set(group.tolist())
+    if missing:
+        raise ValueError(
+            "computeVariancePartitioning: group labels must be contiguous "
+            f"1..{ngroups}; no covariate is assigned to group(s) "
+            f"{sorted(missing)}")
+    if group_names is not None and len(group_names) != ngroups:
+        raise ValueError(
+            f"computeVariancePartitioning: groupnames has "
+            f"{len(group_names)} entries but group defines {ngroups} groups")
+
+    # per-chain windowing like the reference's poolMcmcChains(start)
+    post = post.subset(start)
+    Beta = post.pooled("Beta")                       # (n, nc, ns)
+    Gamma = post.pooled("Gamma")                     # (n, nc, nt)
+    n_draws = Beta.shape[0]
+
+    X2 = hM.X if not hM.x_is_list else None
+    if na_ignore or hM.x_is_list:
+        # per-species covariance of X over that species' informative rows
+        cM = np.empty((ns, nc, nc))
+        for j in range(ns):
+            Xj = hM.X[j] if hM.x_is_list else hM.X
+            rows = ~np.isnan(hM.Y[:, j]) if na_ignore else np.ones(hM.ny, bool)
+            cM[j] = np.cov(Xj[rows], rowvar=False)
+    else:
+        cM = np.broadcast_to(np.cov(X2, rowvar=False).reshape(1, nc, nc),
+                             (ns, nc, nc))
+
+    # fixed-effect variance per species, total and per covariate group
+    fixed1 = np.einsum("ncj,jcd,ndj->nj", Beta, cM, Beta)       # (n, ns)
+    fixedsplit1 = np.empty((n_draws, ns, ngroups))
+    for k in range(ngroups):
+        s = group == k + 1
+        fixedsplit1[:, :, k] = np.einsum("ncj,jcd,ndj->nj", Beta[:, s],
+                                         cM[np.ix_(range(ns), s, s)],
+                                         Beta[:, s])
+    # random-level variance per species: sum_h lambda_h^2.  For a
+    # covariate-dependent level the per-unit variance is (lambda_h' x_u)^2,
+    # so average over units: lambda_h' E[x x'] lambda_h.  (The reference's
+    # own xDim>0 line `t(Lambda[factor,])*Lambda[factor,]` is shape-invalid
+    # R, computeVariancePartitioning.R:159 — this is the intended quantity.)
+    random1 = np.empty((n_draws, ns, nr))
+    for r in range(nr):
+        lam = post.pooled(f"Lambda_{r}")
+        if lam.ndim == 4 and lam.shape[-1] > 1:
+            xu = hM.ranLevels[r].x_for(hM.pi_names[r])
+            M2 = xu.T @ xu / xu.shape[0]                   # (ncr, ncr)
+            random1[:, :, r] = np.einsum("nhjk,kl,nhjl->nj", lam, M2, lam)
+        else:
+            lam = lam[..., 0] if lam.ndim == 4 else lam
+            random1[:, :, r] = (lam**2).sum(axis=1)
+
+    if nr > 0:
+        tot = fixed1 + random1.sum(axis=2)
+        fixed = (fixed1 / tot).mean(axis=0)
+        random = (random1 / tot[:, :, None]).mean(axis=0)
+    else:
+        fixed = np.ones(ns)
+        random = np.zeros((ns, 0))
+    denom = fixedsplit1.sum(axis=2, keepdims=True)
+    fixedsplit = (fixedsplit1 / np.where(denom > 0, denom, 1.0)).mean(axis=0)
+
+    # trait R2: correlation between Beta and its trait-implied mean
+    # Tr (ns, nt), Gamma (n, nc, nt) -> Mu (n, nc, ns)
+    Mu = np.einsum("jt,nct->ncj", hM.Tr, Gamma)
+    R2T_Beta = np.zeros(nc)
+    for k in range(nc):
+        b, m = Beta[:, k, :], Mu[:, k, :]
+        bc = b - b.mean(axis=1, keepdims=True)
+        mc = m - m.mean(axis=1, keepdims=True)
+        num = (bc * mc).sum(axis=1)
+        den = np.sqrt((bc**2).sum(axis=1) * (mc**2).sum(axis=1))
+        co = np.where(den > 0, num / np.where(den > 0, den, 1.0), 0.0)
+        R2T_Beta[k] = float(np.mean(co**2))
+
+    # trait R2 for Y: per draw, across-species covariance of the fitted
+    # linear predictors vs the trait-implied ones (computeVariancePartitioning.R:125-143)
+    if hM.x_is_list:
+        f = np.einsum("jyc,ncj->nyj", hM.X, Beta)
+        a = np.einsum("jyc,ncj->nyj", hM.X, Mu)
+    else:
+        f = np.einsum("yc,ncj->nyj", hM.X, Beta)
+        a = np.einsum("yc,ncj->nyj", hM.X, Mu)
+    a = a - a.mean(axis=2, keepdims=True)
+    f = f - f.mean(axis=2, keepdims=True)
+    res1 = (((a * f).sum(axis=2) / (ns - 1)) ** 2).sum(axis=1)
+    res2 = (((a * a).sum(axis=2) / (ns - 1))
+            * ((f * f).sum(axis=2) / (ns - 1))).sum(axis=1)
+    R2T_Y = float(np.mean(res1 / np.where(res2 > 0, res2, 1.0)))
+
+    vals = np.zeros((ngroups + nr, ns))
+    for k in range(ngroups):
+        vals[k] = fixed * fixedsplit[:, k]
+    for r in range(nr):
+        vals[ngroups + r] = random[:, r]
+    leg = list(group_names or [f"group{k+1}" for k in range(ngroups)])
+    leg += [f"Random: {hM.rl_names[r]}" for r in range(nr)]
+    return {"vals": vals, "R2T": {"Beta": R2T_Beta, "Y": R2T_Y},
+            "group": group, "groupnames": leg[:ngroups], "names": leg}

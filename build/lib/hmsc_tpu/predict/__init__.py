@@ -1,0 +1,16 @@
+"""Prediction & cross-validation layer (L5): posterior-predictive draws at
+new covariates/units, latent-factor kriging (Full/NNGP/GPP), conditional
+prediction, k-fold CV with per-fold refits, and gradient construction.
+
+Reference surface: ``R/predict.R``, ``R/predictLatentFactor.R``,
+``R/computePredictedValues.R``, ``R/createPartition.R``,
+``R/constructGradient.R``, ``R/prepareGradient.R``.
+"""
+
+from .latent import predict_latent_factor
+from .predict import predict
+from .cv import compute_predicted_values, create_partition
+from .gradient import construct_gradient, prepare_gradient
+
+__all__ = ["predict", "predict_latent_factor", "compute_predicted_values",
+           "create_partition", "construct_gradient", "prepare_gradient"]

@@ -1,0 +1,172 @@
+"""Cross-validation layer (reference ``R/computePredictedValues.R:52-145``,
+``R/createPartition.R:16-37``).
+
+The fold refits are full ``sample_mcmc`` runs — already one jitted,
+chain-vmapped program each — so k-fold CV is k compiled executions, the
+embarrassingly parallel workload SURVEY.md §3.4 highlights.  The per-fold
+model rebuild copies the parent's scaling parameters exactly like the
+reference (``computePredictedValues.R:95-116``).  One reference bug is fixed
+rather than replicated: ``computePredictedValues.R:94`` passes ``hM$rhowp``
+(a typo, always NULL) so the reference's CV refits silently lose a custom
+rho prior — we pass the parent's ``rhopw``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+__all__ = ["create_partition", "compute_predicted_values"]
+
+
+def create_partition(hM, nfolds: int = 10, column=None,
+                     rng: np.random.Generator | None = None) -> np.ndarray:
+    """Random fold assignment per sampling unit, optionally grouping rows by
+    a study-design column so a unit's rows share a fold."""
+    rng = rng or np.random.default_rng()
+    if column is not None:
+        if hM.nr == 0 and not hasattr(hM, "study_design"):
+            raise ValueError("HMSC.createPartition: nfolds cannot exceed the number of units in the specified random level")
+        r = column if isinstance(column, int) else hM.rl_names.index(column)
+        labels = np.asarray(hM.df_pi[r])
+        units = sorted(set(labels))
+        if len(units) < nfolds:
+            raise ValueError("HMSC.createPartition: nfolds cannot exceed the number of units in the specified random level")
+        tags = np.resize(np.arange(1, nfolds + 1), len(units))
+        rng.shuffle(tags)
+        lut = dict(zip(units, tags))
+        return np.array([lut[v] for v in labels], dtype=int)
+    if hM.ny < nfolds:
+        raise ValueError("HMSC.createPartition: nfolds cannot exceed the number of sampling units")
+    tags = np.resize(np.arange(1, nfolds + 1), hM.ny)
+    rng.shuffle(tags)
+    return tags
+
+
+def _fold_model(hM, train: np.ndarray):
+    """Rebuild the model on the training rows, copying the parent's scaling
+    parameters and priors (reference ``computePredictedValues.R:92-116``)."""
+    from ..model import Hmsc, set_priors
+
+    X_train = hM.X[:, train, :] if hM.x_is_list else hM.X[train]
+    sd = None
+    if hM.nr > 0:
+        sd = pd.DataFrame({name: np.asarray(hM.df_pi[r])[train]
+                           for r, name in enumerate(hM.rl_names)})
+    hM1 = Hmsc(
+        Y=hM.Y[train], X=list(X_train) if hM.x_is_list else X_train,
+        x_scale=False, y_scale=False, tr_scale=False,
+        XRRR=None if hM.nc_rrr == 0 else hM.XRRR[train],
+        nc_rrr=hM.nc_rrr, xrrr_scale=False,
+        x_select=hM.x_select or None,
+        Tr=hM.Tr, C=hM.C, distr=hM.distr,
+        study_design=sd,
+        ran_levels={n: rl for n, rl in zip(hM.rl_names, hM.ranLevels)})
+    set_priors(hM1, V0=hM.V0, f0=hM.f0, mGamma=hM.mGamma, UGamma=hM.UGamma,
+               aSigma=hM.aSigma, bSigma=hM.bSigma,
+               rhopw=hM.rhopw if hM.C is not None else None)
+    # copy the parent's scaling state verbatim
+    hM1.x_scale_par = hM.x_scale_par
+    hM1.x_intercept_ind = hM.x_intercept_ind
+    xs = (hM.XScaled[:, train, :] if hM.x_is_list else hM.XScaled[train])
+    hM1.XScaled = xs
+    hM1.tr_scale_par = hM.tr_scale_par
+    hM1.tr_intercept_ind = hM.tr_intercept_ind
+    hM1.TrScaled = hM.TrScaled
+    hM1.y_scale_par = hM.y_scale_par
+    hM1.YScaled = hM.YScaled[train]
+    if hM.nc_rrr > 0:
+        hM1.xrrr_scale_par = hM.xrrr_scale_par
+        hM1.XRRRScaled = hM.XRRRScaled[train]
+    hM1.sp_names = hM.sp_names
+    hM1.cov_names = hM.cov_names
+    return hM1
+
+
+def compute_predicted_values(post, partition=None, partition_sp=None,
+                             start: int = 0, thin: int = 1, Yc=None,
+                             mcmc_step: int = 1, expected: bool = True,
+                             init_par=None, n_chains: int | None = None,
+                             updater: dict | None = None,
+                             nf_cap: int | None = None,
+                             seed: int | None = None,
+                             verbose: bool = True) -> np.ndarray:
+    """Posterior-predictive values; (n_draws, ny, ns).
+
+    Without ``partition``: predictions on the training data.  With a
+    partition vector (from :func:`create_partition`): k-fold CV with a full
+    refit per fold; ``partition_sp`` additionally predicts each species fold
+    conditional on the remaining species (``Yc`` machinery).
+    """
+    from ..mcmc.sampler import sample_mcmc
+    from ..mcmc.structs import DEFAULT_NF_CAP
+    from .predict import predict
+
+    hM = post.hM
+    rng = np.random.default_rng(seed)
+    post = post.subset(start, thin)
+    if partition is None:
+        return predict(post, Yc=Yc, mcmc_step=mcmc_step, expected=expected,
+                       seed=None if seed is None else int(rng.integers(2**31)))
+
+    partition = np.asarray(partition)
+    if partition.size != hM.ny:
+        raise ValueError("HMSC.computePredictedValues: partition parameter must be a vector of length ny")
+    folds = np.unique(partition)
+    n_chains = n_chains or post.n_chains
+    post_n = post.samples * n_chains
+    pred_array = np.full((post_n, hM.ny, hM.ns), np.nan)
+
+    def _fill_rows(pred):
+        """Pad a fold's posterior-predictive draws back to post_n rows when a
+        refit chain diverged (pooled() excludes it): cycle the healthy draws
+        so the fold's Monte-Carlo estimate stays valid and the shared
+        pred_array keeps one fixed draw axis."""
+        if pred.shape[0] == post_n:
+            return pred
+        return pred[np.resize(np.arange(pred.shape[0]), post_n)]
+
+    for ki, k in enumerate(folds):
+        if verbose:
+            print(f"Cross-validation, fold {ki + 1} out of {len(folds)}")
+        train = partition != k
+        val = partition == k
+        hM1 = _fold_model(hM, train)
+        post1 = sample_mcmc(
+            hM1, samples=post.samples, thin=post.thin,
+            transient=post.transient, n_chains=n_chains, init_par=init_par,
+            updater=updater, nf_cap=nf_cap or DEFAULT_NF_CAP,
+            seed=int(rng.integers(2**31)))
+        if not post1.chain_health["good_chains"].any():
+            # good_chain_mask() falls back to "exclude nothing" when every
+            # chain diverged, so this must be caught here, loudly, before
+            # NaN draws flow into the shared pred_array
+            raise RuntimeError(
+                f"cross-validation fold {ki + 1}: every refit chain "
+                "diverged; no finite draws to predict from")
+        sd_val = (pd.DataFrame({name: np.asarray(hM.df_pi[r])[val]
+                                for r, name in enumerate(hM.rl_names)})
+                  if hM.nr > 0 else None)
+        X_val = (list(hM.X[:, val, :]) if hM.x_is_list else hM.X[val])
+        XRRR_val = None if hM.nc_rrr == 0 else hM.XRRR[val]
+        if partition_sp is None:
+            pred = _fill_rows(predict(
+                post1, X=X_val, XRRR=XRRR_val, study_design=sd_val,
+                Yc=None if Yc is None else Yc[val],
+                mcmc_step=mcmc_step, expected=expected,
+                seed=int(rng.integers(2**31))))
+        else:
+            partition_sp = np.asarray(partition_sp)
+            pred = np.full((post_n, int(val.sum()), hM.ns), np.nan)
+            for i in np.unique(partition_sp):
+                val_sp = partition_sp == i
+                Yc_i = np.full((int(val.sum()), hM.ns), np.nan)
+                Yc_i[:, ~val_sp] = hM.Y[np.ix_(val, ~val_sp)]
+                pred2 = _fill_rows(predict(
+                    post1, X=X_val, XRRR=XRRR_val,
+                    study_design=sd_val, Yc=Yc_i,
+                    mcmc_step=mcmc_step, expected=expected,
+                    seed=int(rng.integers(2**31))))
+                pred[:, :, val_sp] = pred2[:, :, val_sp]
+        pred_array[:, val, :] = pred
+    return pred_array

@@ -1,0 +1,593 @@
+"""Posterior-predictive distribution at new covariates / units (reference
+``R/predict.R:55-232``).
+
+TPU-first restructuring: the reference loops over posterior samples, building
+one ny x ns linear predictor per R iteration.  Here the whole posterior is one
+stacked (n_draws, ...) batch — the linear predictor, link transform and
+response sampling are single batched einsums / elementwise ops over all draws
+at once, and the conditional-prediction MCMC refinement (``Yc`` +
+``mcmc_step``, reference ``predict.R:181-198``) is a jitted
+``lax.scan`` vmapped over draws instead of an interpreted per-sample loop.
+
+Deviations from the reference (latent bugs there):
+
+- conditional prediction on *spatial* levels: the reference passes
+  ``rLPar=object$rLPar`` which is never populated (``predict.R:185``), so its
+  spatial conditional updates crash.  Here the conditional Eta refresh uses
+  the level's *actual* GP prior, per spatial method and at any scale:
+
+  * ``NNGP`` — Vecchia neighbour structures built over the prediction units
+    at the alpha grid values visited by the posterior, applied matrix-free
+    inside a CG sampler (same perturbation-optimisation draw as the
+    training-side ``mcmc/spatial._eta_nngp_cg``) — the >1000-unit regime the
+    reference recommends NNGP for works at prediction time too;
+  * ``GPP`` — knot-based double-Woodbury draw over the prediction units
+    (the training-side ``_eta_gpp`` structure);
+  * ``Full`` (and any spatial level with covariate-dependent loadings) —
+    exact exponential-kernel precision per draw, joint (np x nf) system,
+    processed in draw chunks sized to memory up to
+    ``_SPATIAL_COND_DENSE_MAX`` coefficients.
+
+  Only a dense level beyond ``_SPATIAL_COND_DENSE_MAX`` falls back to the
+  unstructured N(0,1) prior, and that downgrade emits a ``RuntimeWarning``.
+  Non-spatial levels use the N(0,1) prior (exact for them).
+- ``predict.R:174,192`` uses ``object$ny`` where the new-data row count
+  belongs; we use the new row count.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils.formula import design_matrix
+from .latent import predict_latent_factor
+
+__all__ = ["predict"]
+
+# above this many (units x factors) coefficients, a *dense* spatial level
+# (Full, or covariate-dependent NNGP/GPP) falls back to the unstructured
+# prior with a RuntimeWarning; NNGP/GPP levels with unit loadings use their
+# own sparse structure and have no cap
+_SPATIAL_COND_DENSE_MAX = 20000
+# device-memory budget (bytes) for the per-chunk joint dense precisions in
+# the conditional refresh; sets how many posterior draws vmap together
+_COND_DENSE_MEM_BUDGET = 2.5e9
+
+
+def _new_design(hM, x_data, X):
+    """Resolve the prediction design matrix like the reference's
+    model.matrix-with-pinned-xlev step (``predict.R:76-90``)."""
+    if x_data is not None and X is not None:
+        raise ValueError("Hmsc.predict: only one of XData and X arguments can be specified")
+    if x_data is not None:
+        if isinstance(x_data, (list, tuple)):
+            mats = [design_matrix(hM.x_formula, df)[0] for df in x_data]
+            return np.stack(mats, axis=0), True
+        M, _ = design_matrix(hM.x_formula, x_data)
+        return M, False
+    if X is not None:
+        X = np.asarray(X, dtype=float)
+        return X, X.ndim == 3
+    return hM.X, hM.x_is_list
+
+
+def predict(post, x_data=None, X=None, xrrr_data=None, XRRR=None,
+            study_design=None, ran_levels=None, gradient=None, Yc=None,
+            mcmc_step: int = 1, expected: bool = False,
+            predict_eta_mean: bool = False, predict_eta_mean_field: bool = False,
+            seed: int | None = None) -> np.ndarray:
+    """Posterior-predictive draws; returns (n_draws, ny_new, ns).
+
+    ``post`` is the :class:`~hmsc_tpu.post.Posterior` from ``sample_mcmc``
+    (all pooled draws are used).  With ``expected=True`` the location
+    parameter of each observation model is returned instead of sampled
+    responses; ``Yc`` enables conditional prediction refined by ``mcmc_step``
+    extra MCMC iterations of the latent factors.
+    """
+    hM, spec = post.hM, post.spec
+    rng = np.random.default_rng(seed)
+
+    if gradient is not None:
+        x_data = gradient["XDataNew"]
+        study_design = gradient["studyDesignNew"]
+        ran_levels = gradient["rLNew"]
+    if xrrr_data is not None and XRRR is not None:
+        raise ValueError("Hmsc.predict: only one of XRRRData and XRRR arguments can be specified")
+    if predict_eta_mean and predict_eta_mean_field:
+        raise ValueError("Hmsc.predict: predictEtaMean and predictEtaMeanField arguments cannot be TRUE simultanuisly")
+
+    Xn, x_is_list = _new_design(hM, x_data, X)
+    ny_new = Xn.shape[1] if x_is_list else Xn.shape[0]
+    if hM.nc_rrr > 0:
+        if xrrr_data is not None:
+            XRRR, _ = design_matrix(hM.xrrr_formula if hasattr(hM, "xrrr_formula") else "~.-1", xrrr_data)
+        if XRRR is None:
+            XRRR = hM.XRRR
+        XRRR = np.asarray(XRRR, dtype=float)
+
+    if Yc is not None:
+        Yc = np.asarray(Yc, dtype=float)
+        if Yc.shape[1] != hM.ns:
+            raise ValueError("hMsc.predict: number of columns in Yc must be equal to ns")
+        if Yc.shape[0] != ny_new:
+            raise ValueError("hMsc.predict: number of rows in Yc and X must be equal")
+
+    # ---- study design -> per-level unit labels and row indices -----------
+    if ran_levels is None:
+        ran_levels = {hM.rl_names[r]: hM.ranLevels[r] for r in range(hM.nr)}
+    if study_design is None:
+        labels = hM.df_pi                               # training labels
+    else:
+        cols = ([str(c) for c in study_design.columns]
+                if hasattr(study_design, "columns") else None)
+        if cols is not None and any(n not in cols for n in hM.rl_names):
+            raise ValueError("hMsc.predict: dfPiNew does not contain all the necessary named columns")
+        labels = []
+        for r, name in enumerate(hM.rl_names):
+            col = (study_design[name] if cols is not None
+                   else np.asarray(study_design)[:, r])
+            labels.append([str(v) for v in np.asarray(col)])
+    if any(n not in ran_levels for n in hM.rl_names):
+        raise ValueError("hMsc.predict: rL does not contain all the necessary named levels")
+
+    Beta = post.pooled("Beta")                          # (n, nc, ns)
+    sigma = post.pooled("sigma")                        # (n, ns)
+
+    # ---- latent factors at prediction units ------------------------------
+    will_condition = Yc is not None and not np.all(np.isnan(Yc))
+    eta_pred, pi_new, x_row_new, spatial_prior = [], [], [], []
+    for r in range(hM.nr):
+        rL = ran_levels[hM.rl_names[r]]
+        units_pred = sorted(set(labels[r]))
+        post_eta = post.pooled(f"Eta_{r}")              # (n, np, nf)
+        post_alpha = post.pooled(f"Alpha_{r}")          # (n, nf) grid indices
+        ep = predict_latent_factor(units_pred, hM.pi_names[r], post_eta,
+                                   post_alpha, rL,
+                                   predict_mean=predict_eta_mean,
+                                   predict_mean_field=predict_eta_mean_field,
+                                   rng=rng)
+        lut = {u: i for i, u in enumerate(units_pred)}
+        eta_pred.append(ep)
+        pi_new.append(np.array([lut[v] for v in labels[r]], dtype=np.int32))
+        if spec.levels[r].x_dim > 0:
+            x_row_new.append(rL.x_for(labels[r]))
+        else:
+            x_row_new.append(np.ones((ny_new, 1)))
+
+        # spatial levels: per-method prior structures over the units_pred
+        # ordering, at the alpha grid values the posterior actually visits
+        # (see module docstring and _spatial_cond_info)
+        spatial_prior.append(_spatial_cond_info(
+            hM, spec, rL, r, units_pred, post_alpha, will_condition))
+
+    L = _lin_pred(hM, spec, Xn, x_is_list, XRRR, post, Beta, eta_pred, pi_new,
+                  x_row_new)
+
+    # ---- conditional prediction: refine Eta with extra MCMC steps --------
+    if will_condition:
+        eta_pred = _conditional_mcmc(hM, spec, post, Xn, x_is_list, XRRR, Beta,
+                                     sigma, Yc, eta_pred, pi_new, x_row_new, L,
+                                     mcmc_step, rng, spatial_prior)
+        L = _lin_pred(hM, spec, Xn, x_is_list, XRRR, post, Beta, eta_pred,
+                      pi_new, x_row_new)
+
+    # ---- observation model: link + response sampling ---------------------
+    # (keep everything in the posterior's f32: the (n, ny, ns) block is
+    # ~1 GB at the 1000-species scale and the f64 upcasts scipy/np.random
+    # default to double both memory traffic and wall-clock)
+    if expected:
+        Z = L
+    else:
+        eps = rng.standard_normal(L.shape, dtype=L.dtype) \
+            if np.issubdtype(L.dtype, np.floating) else rng.standard_normal(L.shape)
+        Z = L + np.sqrt(sigma)[:, None, :] * eps
+    fam = hM.distr[:, 0][None, None, :]
+    out = Z.copy()
+    probit = fam == 2
+    if probit.any():
+        if expected:
+            from scipy.special import ndtr
+            out = np.where(probit, ndtr(Z).astype(Z.dtype, copy=False), out)
+        else:
+            out = np.where(probit, (Z > 0).astype(Z.dtype), out)
+    pois = fam == 3
+    if pois.any():
+        lam = np.exp(np.clip(Z, None, 30.0))
+        if expected:
+            out = np.where(pois, np.exp(Z + sigma[:, None, :] / 2), out)
+        else:
+            out = np.where(pois, rng.poisson(lam).astype(Z.dtype), out)
+    # Y back-scaling (predict.R:222-228)
+    m, s = hM.y_scale_par
+    out = out * s[None, None, :] + m[None, None, :]
+    return out
+
+
+def _lin_pred(hM, spec, Xn, x_is_list, XRRR, post, Beta, eta_pred, pi_new,
+              x_row_new) -> np.ndarray:
+    """(n_draws, ny_new, ns) linear predictor, one batched einsum per term."""
+    import jax.numpy as jnp
+
+    if hM.nc_rrr > 0:
+        wRRR = post.pooled("wRRR")                      # (n, nc_rrr, nc_orrr)
+        XB = jnp.einsum("yo,nro->nyr", XRRR, wRRR)      # (n, ny, nc_rrr)
+        if x_is_list:
+            base = jnp.einsum("jyc,ncj->nyj", Xn, Beta[:, :hM.nc_nrrr])
+            L = base + jnp.einsum("nyr,nrj->nyj", XB, Beta[:, hM.nc_nrrr:])
+        else:
+            L = (jnp.einsum("yc,ncj->nyj", Xn, Beta[:, :hM.nc_nrrr])
+                 + jnp.einsum("nyr,nrj->nyj", XB, Beta[:, hM.nc_nrrr:]))
+    elif x_is_list:
+        L = jnp.einsum("jyc,ncj->nyj", Xn, Beta)
+    else:
+        L = jnp.einsum("yc,ncj->nyj", Xn, Beta)
+
+    for r in range(hM.nr):
+        lam = post.pooled(f"Lambda_{r}")                # (n, nf, ns[, ncr])
+        rows = eta_pred[r][:, pi_new[r], :]             # (n, ny, nf)
+        if lam.ndim == 3:
+            L = L + jnp.einsum("nyf,nfj->nyj", rows, lam)
+        else:
+            L = L + jnp.einsum("nyf,yk,nfjk->nyj", rows,
+                               jnp.asarray(x_row_new[r]), lam)
+    return np.asarray(L)
+
+
+def _spatial_cond_info(hM, spec, rL, r, units_pred, post_alpha,
+                       will_condition):
+    """Per-level prior descriptor for the conditional Eta refresh.
+
+    Returns ``None`` (unstructured N(0,1) prior — exact for non-spatial
+    levels, loudly-warned fallback otherwise), or one of
+
+    - ``("dense", D, alpha_vals)`` — exact exponential-kernel precision per
+      draw (Full method, or spatial levels with covariate-dependent
+      loadings), bounded by ``_SPATIAL_COND_DENSE_MAX``;
+    - ``("nngp", lp, idx)`` — Vecchia neighbour structures over the
+      prediction units at the alpha grid values the posterior visits
+      (``precompute._nngp_grids``), ``idx`` (n_draws, nf) indices into them;
+    - ``("gpp", lp, idx)`` — knot-based grids over the prediction units
+      (``precompute._gpp_grids``), same indexing.
+    """
+    if not will_condition or spec.levels[r].spatial is None:
+        return None
+    import warnings
+
+    from ..precompute import _gpp_grids, _nngp_grids
+
+    method = rL.spatial_method
+    post_alpha = np.asarray(post_alpha)
+    n_coef = len(units_pred) * post_alpha.shape[1]
+    x0 = spec.levels[r].x_dim == 0
+    if method in ("NNGP", "GPP") and x0:
+        uniq, inv = np.unique(post_alpha, return_inverse=True)
+        alphas = np.asarray(rL.alphapw, dtype=float)[uniq, 0]
+        idx = inv.reshape(post_alpha.shape).astype(np.int32)
+        s = rL.coords_for(units_pred)
+        if method == "NNGP":
+            lp = _nngp_grids(s, rL.n_neighbours or 10, alphas)
+        else:
+            lp = _gpp_grids(s, np.asarray(rL.s_knot, dtype=float), alphas)
+        return (method.lower(), lp, idx)
+    if n_coef <= _SPATIAL_COND_DENSE_MAX:
+        if rL.dist_mat is not None:
+            D = rL.dist_for(units_pred)
+        else:
+            xy = rL.coords_for(units_pred)
+            D = np.linalg.norm(xy[:, None, :] - xy[None, :, :], axis=-1)
+        alpha_vals = np.asarray(rL.alphapw, dtype=float)[:, 0][post_alpha]
+        return ("dense", D, alpha_vals)
+    warnings.warn(
+        f"conditional prediction: spatial level '{hM.rl_names[r]}' "
+        f"({method}{'' if x0 else ', covariate-dependent loadings'}) has "
+        f"{n_coef} unit x factor coefficients, beyond the dense-path cap "
+        f"{_SPATIAL_COND_DENSE_MAX}; its conditional Eta refresh falls back "
+        "to the unstructured N(0,1) prior, so conditional predictions will "
+        "be less well calibrated than the training-side spatial model",
+        RuntimeWarning, stacklevel=3)
+    return None
+
+
+def _conditional_mcmc(hM, spec, post, Xn, x_is_list, XRRR, Beta, sigma, Yc,
+                      eta_pred, pi_new, x_row_new, L, mcmc_step, rng,
+                      spatial_prior=None):
+    """``mcmc_step`` iterations of (updateEta, updateZ) per posterior draw,
+    conditioning on the observed cells of Yc — vmapped over draws and run as
+    one jitted scan (reference ``predict.R:181-198``).
+
+    ``spatial_prior[r]`` is a :func:`_spatial_cond_info` descriptor — the Eta
+    refresh uses the level's actual GP prior per spatial method (the
+    capability the reference intends but crashes on, ``predict.R:185``);
+    ``None`` falls back to the unstructured N(0,1) prior.  Draws are
+    processed in memory-sized chunks when a dense spatial level is present.
+    """
+    import warnings
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.rand import truncated_normal_onesided
+
+    # scale Yc for y-scaled normal species so it lives on the Z scale
+    m, s = hM.y_scale_par
+    Ycs = (Yc - m[None, :]) / s[None, :]
+    mask = jnp.asarray((~np.isnan(Ycs)).astype(np.float32))
+    Yc0 = jnp.asarray(np.nan_to_num(Ycs, nan=0.0), dtype=jnp.float32)
+    fam = jnp.asarray(hM.distr[:, 0], dtype=jnp.int32)[None, :]
+    any_probit = bool((hM.distr[:, 0] == 2).any())
+    any_normal = bool((hM.distr[:, 0] == 1).any())
+    any_poisson = bool((hM.distr[:, 0] == 3).any())
+
+    n_draws = Beta.shape[0]
+    nf_r = [post.pooled(f"Lambda_{r}").shape[1] for r in range(hM.nr)]
+    # padded Lambda is (n, nf, ns, ncr); squeeze the trivial ncr axis for
+    # unstructured levels so the shared-precision path applies
+    lam_r = []
+    for r in range(hM.nr):
+        lam = post.pooled(f"Lambda_{r}")
+        if lam.ndim == 4 and spec.levels[r].x_dim == 0:
+            lam = lam[..., 0]
+        lam_r.append(jnp.asarray(lam, dtype=jnp.float32))
+    # per-unit covariate values for covariate-dependent levels
+    x_unit_r = []
+    for r in range(hM.nr):
+        npr = eta_pred[r].shape[1]
+        xu = np.ones((npr, x_row_new[r].shape[1]))
+        xu[pi_new[r]] = x_row_new[r]
+        x_unit_r.append(jnp.asarray(xu, dtype=jnp.float32))
+    eta_r = [jnp.asarray(eta_pred[r], dtype=jnp.float32) for r in range(hM.nr)]
+    pi_r = [jnp.asarray(pi_new[r]) for r in range(hM.nr)]
+    xrow_r = [jnp.asarray(x_row_new[r], dtype=jnp.float32) for r in range(hM.nr)]
+    np_r = [eta_pred[r].shape[1] for r in range(hM.nr)]
+    if spatial_prior is None:
+        spatial_prior = [None] * hM.nr
+    # prior structures are draw-invariant closures; the per-draw vmapped
+    # input is either the alpha *values* (dense: kernel built per draw) or
+    # grid *indices* into the precomputed pred-unit structures (nngp/gpp)
+    mode_r = [None if sp is None else sp[0] for sp in spatial_prior]
+    D_r, nngp_r, gpp_r, alpha_in = [], [], [], []
+    for r in range(hM.nr):
+        sp = spatial_prior[r]
+        D_r.append(None)
+        nngp_r.append(None)
+        gpp_r.append(None)
+        if sp is None:
+            alpha_in.append(jnp.zeros((n_draws, nf_r[r]), dtype=jnp.float32))
+        elif sp[0] == "dense":
+            D_r[r] = jnp.asarray(sp[1], dtype=jnp.float32)
+            alpha_in.append(jnp.asarray(sp[2], dtype=jnp.float32))
+        elif sp[0] == "nngp":
+            lp = sp[1]
+            nngp_r[r] = (jnp.asarray(lp.nn_idx, dtype=jnp.int32),
+                         jnp.asarray(lp.nn_coef, dtype=jnp.float32),
+                         jnp.asarray(lp.nn_D, dtype=jnp.float32))
+            alpha_in.append(jnp.asarray(sp[2], dtype=jnp.int32))
+        else:  # gpp
+            lp = sp[1]
+            gpp_r[r] = (jnp.asarray(lp.idDg, dtype=jnp.float32),
+                        jnp.asarray(lp.idDW12g, dtype=jnp.float32),
+                        jnp.asarray(lp.Fg, dtype=jnp.float32))
+            alpha_in.append(jnp.asarray(sp[2], dtype=jnp.int32))
+    alpha_r = tuple(alpha_in)
+    iSig = jnp.asarray(1.0 / np.asarray(sigma), dtype=jnp.float32)  # (n, ns)
+    LFix0 = jnp.asarray(L, dtype=jnp.float32) - sum(
+        _loading_np(eta_r[r], pi_r[r], xrow_r[r], lam_r[r])
+        for r in range(hM.nr)) if hM.nr else jnp.asarray(L, dtype=jnp.float32)
+
+    def loading(eta, lam, pi, xrow):
+        rows = eta[pi]                                  # (ny, nf)
+        if lam.ndim == 2:
+            return rows @ lam
+        return jnp.einsum("yf,yk,fjk->yj", rows, xrow, lam)
+
+    def z_given_yc(E, z_prev, isig, key):
+        """One updateZ pass against the observed Yc cells — one key per draw
+        site, so families stay independent even if the disjoint-cell layout
+        ever changes."""
+        k_base, k_probit, k_pg, k_poisz = jax.random.split(key, 4)
+        std = isig[None, :] ** -0.5
+        z = E + std * jax.random.normal(k_base, E.shape, dtype=E.dtype)
+        if any_normal:
+            z = jnp.where((fam == 1) & (mask > 0), Yc0, z)
+        if any_probit:
+            # one-sided truncation, same specialisation as the sweep's updateZ
+            ztn = truncated_normal_onesided(k_probit, 0.0, Yc0 > 0.5, E, std)
+            z = jnp.where((fam == 2) & (mask > 0), ztn, z)
+        if any_poisson:
+            from ..ops.rand import polya_gamma
+            logr = jnp.log(1e3)
+            w = polya_gamma(k_pg, Yc0 + 1e3, z_prev - logr)
+            prec_z = isig[None, :]
+            s2 = 1.0 / (prec_z + w)
+            mu = s2 * ((Yc0 - 1e3) / 2.0 + prec_z * (E - logr)) + logr
+            zp = mu + jnp.sqrt(s2) * jax.random.normal(k_poisz, mu.shape,
+                                                       dtype=mu.dtype)
+            z = jnp.where((fam == 3) & (mask > 0), zp, z)
+        return z
+
+    def one_draw(LFix, lams, etas, isig, alphas, key):
+        from jax.scipy.linalg import cho_solve, solve_triangular
+
+        # step-invariant per level: the likelihood gram LiSL (lam/isig/mask
+        # only) and the factorisation / closures of the full-conditional
+        # precision — dense spatial: joint blkdiag_f(iW(alpha_f)) + unit
+        # blocks (the training-side spatial updateEta structure, reference
+        # updateEta.R:110-135); nngp: Vecchia factor gathered at each
+        # factor's alpha (applied matrix-free, as mcmc/spatial._eta_nngp_cg);
+        # gpp: double-Woodbury blocks (as mcmc/spatial._eta_gpp);
+        # unstructured: per-unit nf x nf.  Only the rhs changes across the
+        # mcmc_step scan, so factorise once per posterior draw.
+        lam2_r, solver_r = [], []
+        for r in range(hM.nr):
+            lam = lams[r]
+            lam2 = lam if lam.ndim == 2 else jnp.einsum(
+                "fjk,uk->ufj", lam, x_unit_r[r])
+            if lam.ndim == 2:
+                rows = jnp.einsum("fj,gj,j,ij->ifg", lam, lam, isig, mask)
+                LiSL = jax.ops.segment_sum(rows, pi_r[r],
+                                           num_segments=np_r[r])
+            else:
+                Mu_cnt = jax.ops.segment_sum(mask, pi_r[r],
+                                             num_segments=np_r[r])
+                LiSL = jnp.einsum("ufj,ugj,j,uj->ufg", lam2, lam2, isig,
+                                  Mu_cnt)
+            lam2_r.append(lam2)
+            npr, nf = np_r[r], nf_r[r]
+            if mode_r[r] == "dense":
+                D = D_r[r]
+                eyeu = jnp.eye(npr, dtype=D.dtype)
+
+                def iW_of(a):
+                    safe = jnp.maximum(a, 1e-6)
+                    W = jnp.where(a > 0, jnp.exp(-D / safe), eyeu)
+                    W = W + 1e-5 * eyeu       # f32 far-range conditioning
+                    Lw = jnp.linalg.cholesky(W)
+                    return cho_solve((Lw, True), eyeu)
+
+                iW = jax.vmap(iW_of)(alphas[r])       # (nf, np, np)
+                P4 = jnp.einsum("fuv,fg->ufvg", iW,
+                                jnp.eye(nf, dtype=D.dtype))
+                u_idx = jnp.arange(npr)
+                P4 = P4.at[u_idx, :, u_idx, :].add(LiSL)
+                solver_r.append(("dense", jnp.linalg.cholesky(
+                    P4.reshape(npr * nf, npr * nf))))
+            elif mode_r[r] == "nngp":
+                from ..mcmc.spatial import vecchia_ops
+                nn, coef_g, Dg = nngp_r[r]
+                coef = coef_g[alphas[r]]              # (nf, np, k)
+                sqD = jnp.sqrt(Dg[alphas[r]])         # (nf, np)
+                solver_r.append(("nngp", vecchia_ops(nn, coef, sqD, LiSL)))
+            elif mode_r[r] == "gpp":
+                from ..mcmc.spatial import gpp_factor
+                idDg, M1g, Fg = gpp_r[r]
+                # pred-unit grids degrade to the identity prior naturally at
+                # alpha=0 (W12=0, dD=1 in precompute._gpp_grids) — no guard
+                solver_r.append(("gpp", gpp_factor(
+                    LiSL, idDg[alphas[r]], M1g[alphas[r]], Fg[alphas[r]])))
+            else:
+                solver_r.append(("none", jnp.linalg.cholesky(
+                    LiSL + jnp.eye(nf, dtype=LiSL.dtype)[None])))
+
+        def step(carry, k):
+            z, etas, fail = carry
+            kz = jax.random.fold_in(k, 0)
+            # Eta update per level (the level's GP prior where available,
+            # N(0,1) otherwise; see module docstring)
+            for r in range(hM.nr):
+                others = sum(loading(etas[q], lams[q], pi_r[q], xrow_r[q])
+                             for q in range(hM.nr) if q != r)
+                S = z - LFix - (others if hM.nr > 1 else 0.0)
+                lam = lams[r]
+                if lam.ndim == 2:
+                    # NA-aware rhs (Yc cells outside the mask carry no
+                    # likelihood weight)
+                    Fr = jax.ops.segment_sum((S * isig[None, :] * mask) @ lam.T,
+                                             pi_r[r], num_segments=np_r[r])
+                else:
+                    T = jax.ops.segment_sum(S * isig[None, :] * mask, pi_r[r],
+                                            num_segments=np_r[r])
+                    Fr = jnp.einsum("uj,ufj->uf", T, lam2_r[r])
+                npr, nf = np_r[r], nf_r[r]
+                mode, payload = solver_r[r]
+                kr = jax.random.fold_in(k, 1 + r)
+                if mode == "dense":
+                    Lc = payload
+                    rhs = Fr.reshape(npr * nf)
+                    mean = cho_solve((Lc, True), rhs)
+                    eps = jax.random.normal(kr, rhs.shape, dtype=rhs.dtype)
+                    noise = solve_triangular(Lc.T, eps, lower=False)
+                    eta_new = (mean + noise).reshape(npr, nf)
+                elif mode == "nngp":
+                    from ..mcmc.spatial import vecchia_cg_draw
+                    riw_t, pmv = payload
+                    ka, kb = jax.random.split(kr)
+                    eps1 = jax.random.normal(ka, (npr, nf), dtype=Fr.dtype)
+                    xi = jax.random.normal(kb, mask.shape, dtype=Fr.dtype)
+                    b_like = jax.ops.segment_sum(
+                        (xi * jnp.sqrt(isig)[None, :] * mask) @ lam.T,
+                        pi_r[r], num_segments=npr)
+                    eta_new, res = vecchia_cg_draw(riw_t, pmv, Fr, b_like,
+                                                   eps1, x0=etas[r])
+                    # count stalled solves; the maxiter iterate is kept (an
+                    # approximate draw) and the host warns post-run
+                    fail = fail + (res >= 1e-3).astype(jnp.int32)
+                elif mode == "gpp":
+                    from ..mcmc.spatial import gpp_draw
+                    nK = payload[-1]
+                    ka, kb = jax.random.split(kr)
+                    eps1 = jax.random.normal(ka, (npr, nf), dtype=Fr.dtype)
+                    eps2 = jax.random.normal(kb, (nf * nK,), dtype=Fr.dtype)
+                    eta_new = gpp_draw(payload, Fr, eps1, eps2)
+                else:
+                    Lc = payload
+                    mean = cho_solve((Lc, True), Fr[..., None])[..., 0]
+                    eps = jax.random.normal(kr, mean.shape, dtype=mean.dtype)
+                    noise = solve_triangular(jnp.swapaxes(Lc, -1, -2),
+                                             eps[..., None], lower=False)[..., 0]
+                    eta_new = mean + noise
+                etas = etas[:r] + (eta_new,) + etas[r + 1:]
+            # Z update against Yc
+            E = LFix + sum(loading(etas[r], lams[r], pi_r[r], xrow_r[r])
+                           for r in range(hM.nr))
+            z = z_given_yc(E, z, isig, kz)
+            return (z, etas, fail), None
+
+        # initial Z draw against Yc before the refinement loop, mirroring
+        # the reference's Z = updateZ(...) at predict.R:183 — so even
+        # mcmc_step=1 refines Eta against Yc-informed Z
+        E0 = LFix + sum(loading(etas[r], lams[r], pi_r[r], xrow_r[r])
+                        for r in range(hM.nr))
+        key, k0 = jax.random.split(key)
+        z0 = z_given_yc(E0, E0, isig, k0)
+        keys = jax.random.split(key, mcmc_step)
+        fail0 = jnp.zeros((), dtype=jnp.int32)
+        (z, etas, fail), _ = jax.lax.scan(step, (z0, etas, fail0), keys)
+        return etas, fail
+
+    keys = jax.vmap(jax.random.PRNGKey)(
+        jnp.asarray(rng.integers(0, 2**31 - 1, size=n_draws)))
+    etas0 = tuple(eta_r)
+    run = jax.jit(jax.vmap(one_draw, in_axes=(0, 0, 0, 0, 0, 0)))
+    args = (LFix0, tuple(lam_r), etas0, iSig, alpha_r, keys)
+
+    # dense spatial levels hold a (np*nf)^2 joint precision per draw; chunk
+    # the draw axis so the vmapped working set stays inside the budget
+    dense_bytes = sum((np_r[r] * nf_r[r]) ** 2 * 4
+                      for r in range(hM.nr) if mode_r[r] == "dense")
+    chunk = n_draws if not dense_bytes else max(
+        1, min(n_draws, int(_COND_DENSE_MEM_BUDGET // (dense_bytes * 3))))
+    if chunk >= n_draws:
+        etas_out, fails = run(*args)
+        n_fail = int(np.asarray(fails).sum())
+        etas_list = [np.asarray(e) for e in etas_out]
+    else:
+        # pad to a whole number of chunks: one compiled shape, drop the tail
+        n_pad = -(-n_draws // chunk) * chunk
+        sel = jnp.asarray(np.r_[np.arange(n_draws),
+                                np.full(n_pad - n_draws, n_draws - 1)])
+        args = jax.tree.map(lambda a: a[sel], args)
+        outs, n_fail = [], 0
+        for c0 in range(0, n_pad, chunk):
+            eo, fl = run(*jax.tree.map(lambda a: a[c0:c0 + chunk], args))
+            outs.append([np.asarray(e) for e in eo])
+            # padded duplicates re-run real draws; don't double-count their
+            # stalls
+            real = (c0 + np.arange(chunk)) < n_draws
+            n_fail += int(np.asarray(fl)[real].sum())
+        etas_list = [np.concatenate([o[r] for o in outs], axis=0)[:n_draws]
+                     for r in range(hM.nr)]
+    if n_fail:
+        warnings.warn(
+            f"conditional prediction: the NNGP Eta CG solve stalled in "
+            f"{n_fail} (draw, step, level) instances; those draws keep the "
+            "maxiter iterate (an approximate refresh)", RuntimeWarning,
+            stacklevel=3)
+    return etas_list
+
+
+def _loading_np(eta, pi, xrow, lam):
+    import jax.numpy as jnp
+    rows = eta[:, pi, :]
+    if lam.ndim == 3:
+        return jnp.einsum("nyf,nfj->nyj", rows, lam)
+    return jnp.einsum("nyf,yk,nfjk->nyj", rows, xrow, lam)

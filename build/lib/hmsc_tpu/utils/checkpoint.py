@@ -1,0 +1,78 @@
+"""Checkpoint / resume for long MCMC runs (SURVEY.md §5: the reference has no
+in-process checkpointing — its idiom is R serialization of the fitted object
+plus ``initPar`` warm starts; here (samples-so-far, carry-state) snapshots
+are first-class).
+
+Layout: one ``.npz`` holding the recorded posterior arrays (``post:<name>``),
+the chain carry-state pytree leaves (``state:<i>``) with a pickled treedef,
+and the run metadata.  ``load_checkpoint`` + ``sample_mcmc(init_state=...)``
+continues the chains bit-exactly where they left off (modulo the fresh RNG
+stream seeded for the continuation), and ``Posterior.concat`` splices the
+segments.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+__all__ = ["save_checkpoint", "load_checkpoint", "concat_posteriors"]
+
+
+def save_checkpoint(path: str, post, state) -> None:
+    """Write a resumable snapshot: the Posterior so far + the carry state
+    from ``sample_mcmc(..., return_state=True)``."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    payload = {f"post:{k}": v for k, v in post.arrays.items()}
+    payload.update({f"state:{i}": np.asarray(x) for i, x in enumerate(leaves)})
+    payload["meta"] = np.frombuffer(pickle.dumps({
+        "samples": post.samples, "transient": post.transient,
+        "thin": post.thin, "treedef": treedef}), dtype=np.uint8)
+    with open(path, "wb") as f:
+        np.savez_compressed(f, **payload)
+
+
+def load_checkpoint(path: str, hM):
+    """Returns (Posterior, carry_state) ready for
+    ``sample_mcmc(hM, ..., init_state=carry_state)``."""
+    import jax.numpy as jnp
+    from jax.tree_util import tree_unflatten
+
+    from ..mcmc.structs import build_spec
+    from ..post.posterior import Posterior
+
+    with np.load(path, allow_pickle=False) as z:
+        meta = pickle.loads(z["meta"].tobytes())
+        arrays = {k[5:]: z[k] for k in z.files if k.startswith("post:")}
+        n_state = sum(1 for k in z.files if k.startswith("state:"))
+        leaves = [jnp.asarray(z[f"state:{i}"]) for i in range(n_state)]
+    state = tree_unflatten(meta["treedef"], leaves)
+    spec = build_spec(hM)
+    post = Posterior(hM, spec, arrays, samples=meta["samples"],
+                     transient=meta["transient"], thin=meta["thin"])
+    return post, state
+
+
+def concat_posteriors(first, second):
+    """Splice two sampling segments of the same model (chains must match):
+    the recorded-sample axis is concatenated per parameter."""
+    if first.n_chains != second.n_chains:
+        raise ValueError("concat_posteriors: chain counts differ")
+    arrays = {k: np.concatenate([first.arrays[k], second.arrays[k]], axis=1)
+              for k in first.arrays}
+    from ..post.posterior import Posterior
+
+    out = Posterior(first.hM, first.spec, arrays,
+                    samples=first.samples + second.samples,
+                    transient=first.transient, thin=first.thin)
+    # segments may have been sign-aligned against their own posterior-mean
+    # Lambda; re-align per (chain, sample) over the spliced window so factor
+    # signs are consistent across segments
+    if first.spec.nr > 0:
+        from ..post.align import align_posterior
+        for _ in range(5):
+            align_posterior(out)
+    return out

@@ -1,0 +1,62 @@
+"""Device-mesh construction for chain / species parallelism.
+
+The reference's only parallelism is a SOCK cluster fanning chains over OS
+processes (``R/sampleMcmc.R:329-345``).  Here the equivalent is a
+``jax.sharding.Mesh``: chains are the data-parallel axis (no collectives
+during sampling — chains are independent), and an optional second axis
+shards the species dimension of every site x species array model-parallel,
+with XLA inserting the cross-species collectives over ICI.
+
+Multi-host: under ``jax.distributed``, ``jax.devices()`` returns the global
+device list, so the same helper lays the mesh over all hosts; chains ride
+DCN-free (pure replication) and only the species axis communicates — place
+it within a host (the default device order does this) so its collectives
+stay on ICI.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["make_mesh"]
+
+
+def make_mesh(n_chains: int | None = None, species_shards: int = 1,
+              devices=None, chain_axis: str = "chains",
+              species_axis: str = "species"):
+    """Build a 1-D ``(chains,)`` or 2-D ``(chains, species)`` Mesh.
+
+    ``n_chains = None`` uses every available device on the chain axis (after
+    dividing out ``species_shards``).  Raises if the device count cannot be
+    factored as requested.  Pass the result as ``sample_mcmc(mesh=...)``;
+    chains need not equal the mesh's chain extent (they are laid out over
+    it), but the species extent must divide ``ns`` to engage model
+    parallelism (the sampler warns and replicates otherwise).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n = len(devices)
+    if species_shards < 1:
+        raise ValueError(f"species_shards={species_shards} must be >= 1")
+    if n_chains is None:
+        # derive the chain extent from the device count; needs divisibility
+        if n % species_shards:
+            raise ValueError(
+                f"species_shards={species_shards} must divide the device "
+                f"count {n} (or pass n_chains explicitly)")
+        n_chain_devs = n // species_shards
+    else:
+        n_chain_devs = int(n_chains)
+        if n_chain_devs < 1:
+            raise ValueError(f"n_chains={n_chains} must be >= 1")
+    if n_chain_devs * species_shards > n:
+        raise ValueError(
+            f"{n_chain_devs} chain-devices x {species_shards} species shards "
+            f"> {n} devices")
+    grid = np.array(devices[:n_chain_devs * species_shards]).reshape(
+        n_chain_devs, species_shards)
+    if species_shards == 1:
+        return Mesh(grid[:, 0], axis_names=(chain_axis,))
+    return Mesh(grid, axis_names=(chain_axis, species_axis))

@@ -1,0 +1,270 @@
+"""Phylogenetic-tree ingestion: Newick parsing and the Brownian-motion
+correlation matrix (the reference accepts ``phyloTree`` and converts it via
+``ape::vcv.phylo(model="Brownian", corr=TRUE)``, ``R/Hmsc.R:504-509``; here
+the tree arrives as a Newick string — the lingua franca outside R)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["parse_newick", "phylo_corr", "prune_parsed", "vcv_from_newick"]
+
+
+def _clean(newick: str) -> str:
+    """Strip [...] comments and whitespace outside quoted labels.
+
+    Inside a quoted label the Newick escape ``''`` (doubled apostrophe)
+    stands for a literal apostrophe and does not terminate the quote.
+    """
+    out, depth, quoted = [], 0, False
+    i, n = 0, len(newick)
+    while i < n:
+        ch = newick[i]
+        if quoted:
+            out.append(ch)
+            if ch == "'":
+                if i + 1 < n and newick[i + 1] == "'":
+                    out.append("'")       # escaped quote: keep both, stay quoted
+                    i += 1
+                else:
+                    quoted = False
+        elif ch == "[":
+            depth += 1
+        elif ch == "]":
+            depth = max(0, depth - 1)
+        elif depth == 0:
+            if ch == "'":
+                quoted = True
+                out.append(ch)
+            elif not ch.isspace():
+                out.append(ch)
+        i += 1
+    return "".join(out)
+
+
+def parse_newick(newick: str):
+    """Parse a Newick string into ``(children, lengths, names)``.
+
+    - ``children``: list per node of child node ids (empty for leaves);
+    - ``lengths``: branch length from each node to its parent (root: 0.0);
+      every non-root edge must carry an explicit ``:length`` — like the
+      ``ape::vcv.phylo`` path this mirrors, a topology-only tree is an
+      error, not a fabricated unit-length tree;
+    - ``names``: node labels ('' for unnamed internals).
+
+    Node ids are topologically ordered (every parent precedes its
+    children); node 0 is the root.  Quoted labels ('...'), whitespace and
+    ``[...]`` comments are handled.  The parser and the vcv accumulation
+    are iterative, so deep (pectinate) trees of any size parse without
+    hitting the recursion limit.
+    """
+    s = _clean(newick)
+    if s.endswith(";"):
+        s = s[:-1]
+    if not s:
+        raise ValueError("Hmsc.parse_newick: empty tree string")
+
+    children: list[list[int]] = []
+    lengths: list[float | None] = []
+    names: list[str] = []
+
+    def new_node(parent):
+        children.append([])
+        lengths.append(None)
+        names.append("")
+        node = len(children) - 1
+        if parent is not None:
+            children[parent].append(node)
+        return node
+
+    def read_label(i, node):
+        """Optional name[:length] attached to ``node``; returns new i."""
+        if i < len(s) and s[i] == "'":
+            # '' inside the label is the Newick escape for a literal quote
+            j, buf = i + 1, []
+            while j < len(s):
+                if s[j] == "'":
+                    if j + 1 < len(s) and s[j + 1] == "'":
+                        buf.append("'")
+                        j += 2
+                        continue
+                    break
+                buf.append(s[j])
+                j += 1
+            if j >= len(s):
+                raise ValueError("Hmsc.parse_newick: unterminated quoted label")
+            names[node] = "".join(buf)
+            i = j + 1
+        else:
+            j = i
+            while j < len(s) and s[j] not in ",():;":
+                j += 1
+            names[node] = s[i:j]
+            i = j
+        if i < len(s) and s[i] == ":":
+            j = i + 1
+            while j < len(s) and s[j] not in ",()":
+                j += 1
+            try:
+                lengths[node] = float(s[i + 1:j])
+            except ValueError:
+                raise ValueError(
+                    f"Hmsc.parse_newick: bad branch length {s[i + 1:j]!r}")
+            i = j
+        return i
+
+    stack: list[int] = []
+    root = None
+    i = 0
+    expect_node = True            # position where a subtree/leaf may start
+    while i < len(s):
+        ch = s[i]
+        if ch == "(":
+            if not expect_node:
+                raise ValueError(
+                    f"Hmsc.parse_newick: unexpected '(' at {i}")
+            node = new_node(stack[-1] if stack else None)
+            if root is None:
+                root = node
+            stack.append(node)
+            i += 1
+        elif ch == ",":
+            if not stack:
+                raise ValueError(
+                    f"Hmsc.parse_newick: ',' outside any group at {i}")
+            expect_node = True
+            i += 1
+        elif ch == ")":
+            if not stack:
+                raise ValueError("Hmsc.parse_newick: unbalanced ')'")
+            node = stack.pop()
+            i = read_label(i + 1, node)
+            expect_node = False
+        else:                     # a leaf (or the bare single-node tree)
+            if not expect_node:
+                raise ValueError(
+                    f"Hmsc.parse_newick: unexpected {ch!r} at {i}")
+            node = new_node(stack[-1] if stack else None)
+            if root is None:
+                root = node
+            i = read_label(i, node)
+            expect_node = False
+    if stack:
+        raise ValueError("Hmsc.parse_newick: unbalanced tree string")
+
+    lengths[root] = 0.0
+    missing = [names[v] or f"node {v}" for v in range(len(lengths))
+               if lengths[v] is None]
+    if missing:
+        raise ValueError(
+            "Hmsc.parse_newick: the tree must have branch lengths on every "
+            f"edge (missing on {missing[:5]}{'...' if len(missing) > 5 else ''})")
+    # parents were created before their children, so ids are topological;
+    # root relabelled to 0 for the documented contract
+    if root != 0:  # pragma: no cover — current construction always has root 0
+        order = [root] + [v for v in range(len(children)) if v != root]
+        inv = {v: k for k, v in enumerate(order)}
+        children = [[inv[c] for c in children[v]] for v in order]
+        lengths = [lengths[v] for v in order]
+        names = [names[v] for v in order]
+    return children, lengths, names
+
+
+def prune_parsed(children, lengths, names, keep_leaves):
+    """Restrict a parsed tree to the leaves in ``keep_leaves`` (the
+    ``ape::keep.tip`` operation plotBeta needs when the supplied tree covers
+    more species than the model): dropped subtrees are removed and unary
+    chains are collapsed with branch lengths summed.  Returns a new
+    ``(children, lengths, names)`` triple with the same id contract as
+    :func:`parse_newick` (parents precede children, root is 0)."""
+    keep = set(map(str, keep_leaves))
+    n = len(children)
+    sub = [None] * n
+    for v in range(n - 1, -1, -1):           # children before parents
+        if not children[v]:
+            if names[v] in keep:
+                sub[v] = {"len": lengths[v], "ch": [], "name": names[v]}
+        else:
+            ch = [sub[c] for c in children[v] if sub[c] is not None]
+            if not ch:
+                continue
+            if len(ch) == 1:                 # collapse the unary chain
+                c = ch[0]
+                sub[v] = {"len": lengths[v] + c["len"], "ch": c["ch"],
+                          "name": c["name"]}
+            else:
+                sub[v] = {"len": lengths[v], "ch": ch, "name": names[v]}
+    root = sub[0]
+    if root is None:
+        raise ValueError(
+            "Hmsc.prune_parsed: no requested leaf is present in the tree")
+    root = dict(root, len=0.0)               # root carries no branch
+    out_ch, out_len, out_nm = [], [], []
+    stack = [(root, None)]
+    while stack:                             # parent-before-child ids
+        node, parent = stack.pop()
+        out_ch.append([])
+        out_len.append(node["len"])
+        out_nm.append(node["name"])
+        vid = len(out_ch) - 1
+        if parent is not None:
+            out_ch[parent].append(vid)
+        for c in reversed(node["ch"]):
+            stack.append((c, vid))
+    return out_ch, out_len, out_nm
+
+
+def vcv_from_newick(newick: str):
+    """Brownian-motion phylogenetic covariance over the leaves:
+    ``cov[i, j]`` = summed branch length shared by the root-to-leaf paths
+    (``ape::vcv.phylo(model="Brownian")``).  Returns ``(V, leaf_names)``."""
+    children, lengths, names = parse_newick(newick)
+    n_nodes = len(children)
+    leaves = [v for v in range(n_nodes) if not children[v]]
+    if any(not names[v] for v in leaves):
+        raise ValueError("Hmsc.vcv_from_newick: every leaf must be named")
+    leaf_names = [names[v] for v in leaves]
+    if len(set(leaf_names)) != len(leaf_names):
+        dup = sorted({n for n in leaf_names if leaf_names.count(n) > 1})
+        raise ValueError(
+            f"Hmsc.vcv_from_newick: duplicated leaf names {dup[:5]} — tip "
+            "labels must be unique (ape::vcv.phylo errors here too)")
+    leaf_ix = {v: k for k, v in enumerate(leaves)}
+    n = len(leaves)
+    V = np.zeros((n, n))
+    # bottom-up leaf sets without recursion: ids are parent-before-child
+    leafset: list[list[int] | None] = [None] * n_nodes
+    for v in range(n_nodes - 1, -1, -1):
+        if not children[v]:
+            leafset[v] = [leaf_ix[v]]
+        else:
+            acc = []
+            for c in children[v]:
+                acc.extend(leafset[c])
+                leafset[c] = None          # free as we go
+            leafset[v] = acc
+        ia = np.asarray(leafset[v])
+        V[np.ix_(ia, ia)] += lengths[v]
+    return V, [names[v] for v in leaves]
+
+
+def phylo_corr(newick: str, sp_names=None):
+    """Brownian correlation matrix over species, ordered like ``sp_names``
+    (the reference's ``corM[spNames, spNames]`` reindex, ``Hmsc.R:505-506``).
+    With ``sp_names=None`` the tree's own leaf order is kept."""
+    V, leaves = vcv_from_newick(newick)
+    d = np.sqrt(np.diag(V))
+    if np.any(d <= 0):
+        raise ValueError(
+            "Hmsc.phylo_corr: zero root-to-leaf distance; the tree needs "
+            "positive branch lengths")
+    C = V / d[:, None] / d[None, :]
+    if sp_names is None:
+        return C, leaves
+    pos = {name: k for k, name in enumerate(leaves)}
+    missing = [s for s in map(str, sp_names) if s not in pos]
+    if missing:
+        raise ValueError(
+            f"Hmsc.setData: phylogenetic tree is missing species {missing}")
+    ix = np.asarray([pos[str(s)] for s in sp_names])
+    return C[np.ix_(ix, ix)], [leaves[k] for k in ix]
