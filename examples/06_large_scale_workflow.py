@@ -1,0 +1,104 @@
+"""The large-dataset workflow: NNGP at thousands of spatial units, recording
+only what the analysis reads, quantised draws for bandwidth-starved hosts,
+and checkpoint/resume across sessions.
+
+The reference's guidance for >1000 spatial units is NNGP
+(vignettes/vignette_4_spatial.Rmd:171-175); its engine then still
+materialises every posterior block in memory and offers no way to resume an
+interrupted run (a worker error in the SOCK cluster aborts the fit,
+R/sampleMcmc.R:33-36).  This example shows the counterparts built for that
+regime here:
+
+- the NNGP Eta draw runs matrix-free (Vecchia-factor gathers + CG) above
+  ~256 unit*factor coefficients — the measured TPU crossover, BENCHMARKS.md;
+- ``record=`` keeps only the blocks the downstream workflow touches
+  (association analyses never read Eta — at np=2000 that is most of the
+  posterior's bytes);
+- ``record_dtype=bfloat16`` halves the device->host transfer again, at
+  ~3-significant-digit draws (errors far below Monte-Carlo noise for
+  summary use);
+- ``save_checkpoint``/``load_checkpoint``/``concat_posteriors`` make long
+  fits restartable mid-stream.
+
+Run:  python examples/06_large_scale_workflow.py     (CPU is fine)
+"""
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import jax.numpy as jnp
+
+import hmsc_tpu as hm
+
+# smoke-test mode (tests/test_examples.py): tiny sizes, recovery asserts off
+TOY = os.environ.get("HMSC_TPU_EXAMPLES_TOY") == "1"
+
+# ---- simulate a large spatial community ------------------------------------
+rng = np.random.default_rng(11)
+n_units, ns = (150, 8) if TOY else (2000, 40)
+units = [f"site_{i:04d}" for i in range(n_units)]
+xy = rng.uniform(size=(n_units, 2)) * 10
+X = np.column_stack([np.ones(n_units), rng.standard_normal(n_units)])
+# build the latent field with a cheap local smoother instead of the dense
+# (n_units x n_units) cholesky the full simulation would need
+eta_u = rng.standard_normal(n_units)
+for _ in range(3):                      # crude smoother: local averaging
+    order = np.argsort(xy[:, 0])
+    eta_u[order] = 0.5 * eta_u[order] + 0.25 * (
+        np.roll(eta_u[order], 1) + np.roll(eta_u[order], -1))
+lam = rng.standard_normal(ns) * 1.2
+L = X @ (rng.standard_normal((2, ns)) * 0.5) + np.outer(eta_u, lam)
+Y = (L + rng.standard_normal((n_units, ns)) > 0).astype(float)
+
+study = pd.DataFrame({"site": units})
+rl = hm.HmscRandomLevel(
+    s_data=pd.DataFrame(xy, index=units, columns=["x", "y"]),
+    s_method="NNGP", n_neighbours=8)
+hm.set_priors_random_level(rl, nf_max=2, nf_min=2)
+m = hm.Hmsc(Y=Y, X=X, distr="probit", study_design=study,
+            ran_levels={"site": rl}, x_scale=False)
+
+# ---- first session: sample half the run, checkpoint, "crash" ---------------
+samples, transient = (20, 20) if TOY else (125, 250)
+dp = hm.compute_data_parameters(m)      # grids once, reusable across refits
+record = ("Beta", "Lambda", "Psi", "Delta", "Alpha", "sigma")   # no Eta
+post1, state = hm.sample_mcmc(
+    m, samples=samples, transient=transient, n_chains=2, seed=42,
+    nf_cap=2, data_par=dp, record=record,
+    record_dtype=jnp.bfloat16,          # quantised draws, f32 chain state
+    return_state=True)
+
+with tempfile.TemporaryDirectory() as tmpdir:
+    ckpt = Path(tmpdir) / "fit.npz"
+    hm.save_checkpoint(ckpt, post1, state)
+
+    # ---- second session: resume from the checkpoint and finish -------------
+    post_prev, state_prev = hm.load_checkpoint(ckpt, m)
+post2 = hm.sample_mcmc(
+    m, samples=samples, n_chains=2, seed=43, nf_cap=2, data_par=dp,
+    record=record, record_dtype=jnp.bfloat16, init_state=state_prev)
+post = hm.concat_posteriors(post_prev, post2)
+print(f"pooled draws: {post['Beta'].shape}  (2 chains x {2 * samples})")
+
+# ---- the association workflow the record= selection serves -----------------
+assoc = hm.compute_associations(post)
+omega = assoc[0]["mean"]
+off = omega[~np.eye(len(omega), dtype=bool)]
+print("mean |association|:", round(float(np.mean(np.abs(off))), 3))
+ess = hm.effective_size(post["Beta"])
+print("Beta ESS median:", float(np.median(ess)).__round__(1))
+
+if not TOY:
+    # the simulated loading direction must show up in the associations:
+    # species pairs with same-sign lambda should be positively associated
+    # (diagonal excluded — it is 1 by construction in a correlation matrix)
+    pair_sign = np.sign(np.outer(lam, lam))
+    offdiag = ~np.eye(len(omega), dtype=bool)
+    agree = np.mean(np.sign(omega)[offdiag & (pair_sign > 0)] > 0)
+    print("same-sign association agreement:", round(float(agree), 3))
+    assert agree > 0.8, agree
