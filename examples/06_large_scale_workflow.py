@@ -66,7 +66,9 @@ m = hm.Hmsc(Y=Y, X=X, distr="probit", study_design=study,
 # ---- first session: sample half the run, checkpoint, "crash" ---------------
 samples, transient = (20, 20) if TOY else (125, 250)
 dp = hm.compute_data_parameters(m)      # grids once, reusable across refits
-record = ("Beta", "Lambda", "Psi", "Delta", "Alpha", "sigma")   # no Eta
+# only what the association workflow reads (no Eta; sigma is a fixed
+# constant under the probit link, so recording it would be dead payload)
+record = ("Beta", "Lambda", "Psi", "Delta", "Alpha")
 post1, state = hm.sample_mcmc(
     m, samples=samples, transient=transient, n_chains=2, seed=42,
     nf_cap=2, data_par=dp, record=record,

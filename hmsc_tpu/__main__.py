@@ -1,0 +1,7 @@
+"""``python -m hmsc_tpu`` — the installed-package throughput probe
+(same entry as the ``hmsc-tpu-bench`` console script)."""
+
+from .bench_cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())
