@@ -264,6 +264,29 @@ def test_float64_mode_subprocess():
     assert "F64OK" in out.stdout, (out.stdout, out.stderr[-2000:])
 
 
+def test_nngp_dense_max_env_override_subprocess():
+    """README/BENCHMARKS document HMSC_TPU_NNGP_DENSE_MAX as the runtime
+    override for the measured dense/CG crossover; it is read at import, so
+    the guard has to live in a subprocess."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    _ROOT = Path(__file__).resolve().parent.parent
+
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from hmsc_tpu.mcmc import spatial\n"
+        "assert spatial._NNGP_DENSE_MAX == 7, spatial._NNGP_DENSE_MAX\n"
+        "print('ENVOK')\n"
+    ) % str(_ROOT)
+    env = dict(os.environ, HMSC_TPU_NNGP_DENSE_MAX="7", JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert "ENVOK" in out.stdout, (out.stdout, out.stderr[-2000:])
+
+
 def test_retry_diverged_restarts_chain():
     """retry_diverged=1 must re-run the poisoned chain and splice a healthy
     replacement into the posterior (VERDICT round-2 item 2: 'exclude or
