@@ -21,7 +21,10 @@ from .post import (Posterior, pool_mcmc_chains, compute_associations,
 from .predict import (predict, predict_latent_factor, compute_predicted_values,
                       create_partition, construct_gradient, prepare_gradient)
 from .utils.checkpoint import (save_checkpoint, load_checkpoint,
-                               concat_posteriors)
+                               load_checkpoint_full, concat_posteriors,
+                               resume_run, CheckpointError,
+                               CheckpointCorruptError,
+                               CheckpointSpecMismatchError, PreemptedRun)
 from .utils.mesh import make_mesh
 from .utils.phylo import parse_newick, phylo_corr, vcv_from_newick
 from .plots import (plot_beta, plot_gamma, plot_gradient,
@@ -65,7 +68,10 @@ __all__ = [
     "evaluate_model_fit", "compute_waic", "compute_variance_partitioning",
     "predict", "predict_latent_factor", "compute_predicted_values",
     "create_partition", "construct_gradient", "prepare_gradient",
-    "save_checkpoint", "load_checkpoint", "concat_posteriors", "make_mesh",
+    "save_checkpoint", "load_checkpoint", "load_checkpoint_full",
+    "concat_posteriors", "resume_run", "CheckpointError",
+    "CheckpointCorruptError", "CheckpointSpecMismatchError", "PreemptedRun",
+    "make_mesh",
     "parse_newick", "phylo_corr", "vcv_from_newick",
     "plot_beta", "plot_gamma", "plot_gradient",
     "plot_variance_partitioning", "bi_plot",

@@ -1,7 +1,25 @@
-"""``python -m hmsc_tpu`` — the installed-package throughput probe
-(same entry as the ``hmsc-tpu-bench`` console script)."""
+"""``python -m hmsc_tpu`` — installed-package CLI.
 
-from .bench_cli import main
+Subcommands: ``bench`` (default; the throughput probe, same entry as the
+``hmsc-tpu-bench`` console script) and ``run`` (checkpointed, preemption-safe
+long-run driver with ``--resume``).  Bare arguments keep the historical
+bench behaviour: ``python -m hmsc_tpu --ns 50`` still works.
+"""
+
+import sys
+
+from .bench_cli import main as bench_main
+from .bench_cli import run_main
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if argv[:1] == ["run"]:
+        return run_main(argv[1:])
+    if argv[:1] == ["bench"]:
+        argv = argv[1:]
+    return bench_main(argv)
+
 
 if __name__ == "__main__":
     raise SystemExit(main())

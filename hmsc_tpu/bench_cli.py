@@ -1,11 +1,19 @@
-"""Installed-package throughput probe (``hmsc-tpu-bench`` console script).
+"""Installed-package CLI entry points.
 
-Measures steady-state posterior samples/sec of the blocked-Gibbs engine on
-whatever accelerator JAX finds (compile excluded, best-of-3 windows) and
-prints one JSON line.  The repo-level ``bench.py`` harness additionally runs
-the reference-style NumPy baseline for a measured ``vs_baseline`` ratio; from
-an installed wheel only the package itself is available, so the ratio is
+``main`` (= ``hmsc-tpu-bench`` / ``python -m hmsc_tpu bench``) measures
+steady-state posterior samples/sec of the blocked-Gibbs engine on whatever
+accelerator JAX finds (compile excluded, best-of-3 windows) and prints one
+JSON line.  The repo-level ``bench.py`` harness additionally runs the
+reference-style NumPy baseline for a measured ``vs_baseline`` ratio; from an
+installed wheel only the package itself is available, so the ratio is
 reported as ``null`` here.
+
+``run_main`` (= ``python -m hmsc_tpu run``) drives a checkpointed sampling
+run of the same synthetic probit JSDM: auto-snapshots every
+``--checkpoint-every`` samples into ``--checkpoint-dir``, exits with code 75
+(EX_TEMPFAIL) when preempted by SIGTERM/SIGINT after writing a resumable
+snapshot, and ``--resume`` continues from the newest valid one (corrupt
+slots fall back to the previous rotation slot).
 """
 
 from __future__ import annotations
@@ -66,6 +74,77 @@ def main(argv=None):
         "unit": "samples/sec",
         "vs_baseline": None,
     }))
+
+
+def run_main(argv=None):
+    """``python -m hmsc_tpu run`` — fault-tolerant long-run driver."""
+    parser = argparse.ArgumentParser(
+        prog="python -m hmsc_tpu run",
+        description="checkpointed (preemption-safe, resumable) sampling run "
+                    "of the synthetic benchmark JSDM")
+    parser.add_argument("--ny", type=int, default=200)
+    parser.add_argument("--ns", type=int, default=100)
+    parser.add_argument("--nf", type=int, default=4)
+    parser.add_argument("--samples", type=int, default=200)
+    parser.add_argument("--transient", type=int, default=50)
+    parser.add_argument("--chains", type=int, default=4)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--verbose", type=int, default=0)
+    parser.add_argument("--checkpoint-dir", required=True,
+                        help="directory for the rotating ckpt-<n>.npz files")
+    parser.add_argument("--checkpoint-every", type=int, default=25,
+                        help="recorded samples between snapshots")
+    parser.add_argument("--keep", type=int, default=3,
+                        help="rotation depth (newest K snapshots kept)")
+    parser.add_argument("--resume", action="store_true",
+                        help="continue from the newest valid checkpoint "
+                             "instead of starting fresh")
+    args = parser.parse_args(argv)
+
+    import os
+
+    from .mcmc.sampler import sample_mcmc
+    from .utils.checkpoint import PreemptedRun, resume_run
+
+    # the spec fingerprint in every checkpoint rejects a resume against a
+    # different model, so the model args are persisted next to the snapshots
+    # and --resume rebuilds from them instead of trusting the CLI defaults
+    model_json = os.path.join(args.checkpoint_dir, "model.json")
+    if args.resume and os.path.exists(model_json):
+        with open(model_json) as f:
+            margs = json.load(f)
+    else:
+        margs = {"ny": args.ny, "ns": args.ns, "nf": args.nf}
+    hM = _model(margs["ny"], margs["ns"], margs["nf"], seed=66)
+    try:
+        if args.resume:
+            post = resume_run(hM, args.checkpoint_dir, verbose=args.verbose)
+        else:
+            os.makedirs(args.checkpoint_dir, exist_ok=True)
+            with open(model_json, "w") as f:
+                json.dump(margs, f)
+            post = sample_mcmc(
+                hM, samples=args.samples, transient=args.transient,
+                n_chains=args.chains, seed=args.seed, nf_cap=args.nf,
+                align_post=False, verbose=args.verbose,
+                checkpoint_every=args.checkpoint_every,
+                checkpoint_path=args.checkpoint_dir,
+                checkpoint_keep=args.keep)
+    except PreemptedRun as e:
+        print(json.dumps({
+            "preempted": True, "signal": e.signum,
+            "samples_done": e.samples_done, "checkpoint": e.checkpoint_path,
+            "resume": f"python -m hmsc_tpu run --resume --checkpoint-dir "
+                      f"{args.checkpoint_dir}",
+        }))
+        return 75                      # EX_TEMPFAIL: try again (resume)
+    print(json.dumps({
+        "preempted": False, "samples": int(post.samples),
+        "chains": int(post.n_chains),
+        "finite": bool(np.isfinite(post["Beta"]).all()),
+        "checkpoint_dir": args.checkpoint_dir,
+    }))
+    return 0
 
 
 if __name__ == "__main__":

@@ -232,7 +232,10 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 return_state: bool = False, verbose: int = 0,
                 init_state=None, profile_dir: str | None = None,
                 rng_impl: str | None = None, record_dtype=None,
-                retry_diverged: int = 0, record=None):
+                retry_diverged: int = 0, record=None,
+                checkpoint_every: int = 0, checkpoint_path: str | None = None,
+                checkpoint_keep: int = 3, init_keys=None,
+                progress_callback=None, _ckpt_base=None):
     """Run the blocked Gibbs sampler; returns a :class:`~hmsc_tpu.post.Posterior`.
 
     Arguments mirror the reference's ``sampleMcmc`` (samples/transient/thin/
@@ -291,6 +294,28 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
       sign-alignment references are force-included (Lambda whenever the
       corresponding Eta is recorded; wRRR on reduced-rank models).
       Un-recorded parameters raise a clear KeyError downstream.
+    - ``checkpoint_every=N`` with ``checkpoint_path=DIR`` writes a resumable
+      snapshot (recorded draws so far + carry state + carried RNG keys) every
+      N recorded samples, atomically (tmp + rename), rotating the newest
+      ``checkpoint_keep`` files as ``ckpt-<samples>.npz``.  Snapshots land on
+      host-segment boundaries — the same segmentation machinery ``verbose``
+      uses — so the key stream (and therefore every draw) is bit-identical
+      for any checkpointing cadence.  While active, SIGTERM/SIGINT is
+      intercepted: the in-flight segment finishes, a final snapshot is
+      written, and the run unwinds with
+      :class:`~hmsc_tpu.utils.checkpoint.PreemptedRun`.  Continue with
+      :func:`~hmsc_tpu.utils.checkpoint.resume_run` (or
+      ``python -m hmsc_tpu run --resume``), which restores the key stream so
+      kill → resume reproduces the uninterrupted run exactly.
+      ``checkpoint_path`` alone (no ``checkpoint_every``) writes a single
+      snapshot at completion.
+    - ``init_keys`` resumes the per-chain RNG key stream from a checkpoint
+      (requires ``init_state``); without it a resumed run draws a fresh
+      stream seeded from (seed, carried iteration).
+    - ``progress_callback(samples_done, samples_total)`` is invoked on the
+      host after every compiled segment (cumulative counts when continuing a
+      checkpointed run); exceptions propagate and abort the run — the
+      fault-injection harness uses this to simulate device loss.
     """
     import time
 
@@ -303,7 +328,12 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         adapt_nf = tuple(transient for _ in range(hM.nr))
     else:
         adapt_nf = tuple(int(a) for a in np.broadcast_to(adapt_nf, (hM.nr,)))
-    if any(a > transient for a in adapt_nf):
+    if any(a > transient for a in adapt_nf) and init_state is None:
+        # a resumed continuation legitimately carries the original run's
+        # adaptation window past its own transient=0: the adaptation gate
+        # compares against the carried absolute iteration counter, so the
+        # window is long since closed — and passing the original adapt_nf
+        # lets the continuation reuse the original run's compiled program
         raise ValueError("transient parameter should be no less than any element of adaptNf parameter")
 
     spec = build_spec(hM, nf_cap)
@@ -387,6 +417,13 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         states = [build_state(hM, spec, int(s), init_par, dtype=dtype)
                   for s in chain_seeds]
         state0 = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+    # canonicalise carry leaves to strong dtypes: the stacked fresh state
+    # otherwise carries weak-typed f32 scalars (the 0.0 RRR placeholders),
+    # and a checkpoint-loaded state (strong f32 from disk) would miss the
+    # compiled executable and pay a full recompile on every resume
+    state0 = jax.tree.map(
+        lambda x: jnp.asarray(x, dtype=x.dtype) if hasattr(x, "dtype") else x,
+        state0)
 
     # structural gates for the opt-in collapsed updaters (reference
     # auto-gating, sampleMcmc.R:123-152; see updaters_marginal)
@@ -454,27 +491,92 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         if sp is not None:
             data = _shard_species(data, mesh, spec, sp, lead=None)
 
-    # progress: verbose>0 splits the sample scan into host-level segments so
-    # the host prints between compiled chunks (the reference's per-iteration
-    # printout, sampleMcmc.R:317-324, at `verbose`-sweep granularity)
+    # progress printing and auto-checkpointing both split the sample scan
+    # into host-level segments (the reference's per-iteration printout,
+    # sampleMcmc.R:317-324, at `verbose`-sweep granularity; snapshots at
+    # `checkpoint_every`-sample granularity).  The carried key makes the
+    # draw stream identical for ANY segmentation, so the boundary set is
+    # simply the union of what either feature needs.  (Measured: on a
+    # remote-attached chip, device->host copies do not overlap device
+    # compute, so segmentation adds only per-segment round-trip latency —
+    # with both features off the scan stays one segment.)
+    ck_every = int(checkpoint_every or 0)
+    if ck_every < 0:
+        raise ValueError(f"checkpoint_every must be >= 0, got {ck_every}")
+    if ck_every and checkpoint_path is None:
+        raise ValueError("checkpoint_every requires checkpoint_path "
+                         "(a directory for the rotating snapshots)")
+    if checkpoint_path is not None and ck_every == 0:
+        ck_every = int(samples)       # single snapshot at completion
+    if int(samples) == 0:
+        ck_every = 0                  # nothing recordable to snapshot
+    marks = {int(samples)}
     if verbose:
         chunk = max(1, int(round(verbose / thin)))
-        seg_sizes = [chunk] * (int(samples) // chunk)
-        if int(samples) % chunk:
-            seg_sizes.append(int(samples) % chunk)
-    else:
-        # (measured: on the remote-attached chip, device->host copies do not
-        # overlap device compute, so splitting the scan to pipeline fetches
-        # only adds per-segment round-trip latency — keep one segment)
-        seg_sizes = [int(samples)]
+        marks.update(range(chunk, int(samples), chunk))
+    if ck_every:
+        marks.update(range(ck_every, int(samples), ck_every))
+    cuts = sorted(marks)
+    seg_sizes = [b - a for a, b in zip([0] + cuts[:-1], cuts)]
+    ck_marks = ({m for m in cuts if m % ck_every == 0} | {int(samples)}
+                if ck_every else set())
     total_it = it0 + int(transient) + int(samples) * int(thin)
+
+    base_post = _ckpt_base            # prior segments of a resumed run
+    base_samples = int(base_post.samples) if base_post is not None else 0
+    ck_dir = None
+    if ck_every:
+        import os
+        ck_dir = os.fspath(checkpoint_path)
+        os.makedirs(ck_dir, exist_ok=True)
+        if init_state is None and base_post is None:
+            # a FRESH run owns its snapshot directory: stale ckpt-*.npz from
+            # an earlier run would outnumber this run's early snapshots and
+            # resume_run would silently return the old run's posterior
+            from ..utils.checkpoint import checkpoint_files as _ck_files
+            stale = _ck_files(ck_dir)
+            if stale:
+                import warnings
+                warnings.warn(
+                    f"checkpoint_path {ck_dir!r} held {len(stale)} "
+                    "snapshot(s) from a previous run; removing them so "
+                    "resume_run cannot confuse the runs (use resume_run "
+                    "instead of a fresh call to continue the old one)",
+                    RuntimeWarning, stacklevel=2)
+                for p in stale:
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
+
+    # preemption-safe shutdown: while auto-checkpointing, SIGTERM/SIGINT set
+    # a flag that the segment loop checks after each compiled chunk — finish
+    # the segment, snapshot, unwind resumably.  A second signal escalates to
+    # an immediate KeyboardInterrupt (escape hatch for a stuck segment).
+    preempt = {"signum": None}
+    restore_handlers = []
+    if ck_every:
+        import signal as _signal
+        import threading as _threading
+        if _threading.current_thread() is _threading.main_thread():
+            def _on_signal(signum, frame):
+                if preempt["signum"] is not None:
+                    raise KeyboardInterrupt
+                preempt["signum"] = signum
+            for s in (_signal.SIGTERM, _signal.SIGINT):
+                try:
+                    restore_handlers.append((s, _signal.signal(s, _on_signal)))
+                except (ValueError, OSError):
+                    pass              # non-main thread / unsupported platform
 
     t1 = time.perf_counter()
     import contextlib
     ctx = (jax.profiler.trace(profile_dir) if profile_dir is not None
            else contextlib.nullcontext())
-    with ctx:
-        recs_segs = []
+    try:
+      with ctx:
+        pending = []                  # packed-but-unfetched segments
+        host_segs = []                # fetched host record trees, in order
         state_cur = state0
         trans_cur = int(transient)
         skip_z = init_state is not None
@@ -486,34 +588,141 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         # the per-chain key is threaded *through* the segments (the final
         # carry key of one segment seeds the next), so the draw stream is a
         # pure function of (seed, iteration) — identical for any `verbose`
-        # segmentation (round-2 verdict weak #4)
-        keys = jax.vmap(lambda s: jax.random.key(s, impl=rng_impl))(
-            jnp.asarray(chain_seeds))
+        # or `checkpoint_every` segmentation (round-2 verdict weak #4)
+        if init_keys is not None:
+            if init_state is None:
+                raise ValueError("init_keys requires init_state (both come "
+                                 "from the same checkpoint)")
+            keys = init_keys
+            if int(keys.shape[0]) != n_chains:
+                raise ValueError(
+                    f"init_keys carries {int(keys.shape[0])} chain keys, "
+                    f"n_chains={n_chains}")
+        else:
+            keys = jax.vmap(lambda s: jax.random.key(s, impl=rng_impl))(
+                jnp.asarray(chain_seeds))
         if sharding is not None:
             keys = jax.device_put(keys, sharding)
+
+        def _flush_pending():
+            while pending:
+                host_segs.append(_unpack_records(*pending.pop(0)))
+            if len(host_segs) > 1:    # fold so repeat snapshots stay linear
+                merged = jax.tree.map(
+                    lambda *xs: np.concatenate(xs, axis=1), *host_segs)
+                host_segs[:] = [merged]
+
+        def _write_ck(done_now, post_override=None, state_override=None):
+            """Snapshot draws-so-far (prepending a resumed run's base
+            segment) + carry state + carried keys; atomic write, rotate.
+            ``post_override``/``state_override`` re-write a slot from an
+            already-built posterior and spliced carry state (the
+            retry_diverged splice re-writes the final one)."""
+            import os
+
+            from ..post.posterior import Posterior as _P
+            from ..utils import checkpoint as _ck
+            if post_override is None:
+                _flush_pending()
+                arrays = {k: np.asarray(v) for k, v in host_segs[0].items()}
+                fb = np.asarray(bad_cur)
+            else:
+                arrays = {k: np.asarray(v)
+                          for k, v in post_override.arrays.items()}
+                fb = np.asarray(post_override.chain_health["first_bad_it"])
+            if base_post is not None:
+                if set(arrays) != set(base_post.arrays):
+                    raise _ck.CheckpointError(
+                        "continuation records different parameters than the "
+                        "checkpointed base segment — was record= changed?")
+                arrays = {k: np.concatenate([base_post.arrays[k], arrays[k]],
+                                            axis=1) for k in arrays}
+            partial = _P(hM, spec, arrays, samples=base_samples + done_now,
+                         transient=int(base_post.transient
+                                       if base_post is not None
+                                       else transient), thin=int(thin))
+            if base_post is not None:
+                fb0 = np.asarray(base_post.chain_health["first_bad_it"])
+                fb = np.where(fb0 >= 0, fb0, fb)
+            partial.set_chain_health(fb)
+            partial.nf_saturation = (
+                dict(post_override.nf_saturation) if post_override is not None
+                else {r: np.asarray(state_cur.levels[r].nf_sat).reshape(-1)
+                      for r in range(spec.nr)})
+            meta = {
+                "samples_total": base_samples + int(samples),
+                "samples_done": base_samples + done_now,
+                "transient": int(base_post.transient if base_post is not None
+                                 else transient),
+                "thin": int(thin), "n_chains": int(n_chains),
+                "seed": None if seed is None else int(seed),
+                "nf_cap": int(nf_cap), "rng_impl": rng_impl,
+                "adapt_nf": [int(a) for a in adapt_nf],
+                "dtype": np.dtype(dtype).name,
+                "record": list(record) if record is not None else None,
+                "record_dtype": (None if record_dtype is None
+                                 else np.dtype(record_dtype).name),
+                "updater": dict(updater) if updater else None,
+                "retry_diverged": int(retry_diverged),
+                "align_post": bool(align_post),
+                "checkpoint_every": ck_every,
+                "checkpoint_keep": int(checkpoint_keep),
+            }
+            path = os.path.join(
+                ck_dir, f"ckpt-{base_samples + done_now:08d}.npz")
+            _ck.save_checkpoint(
+                path, partial,
+                state_cur if state_override is None else state_override,
+                keys=keys, keys_impl=rng_impl, run_meta=meta)
+            _ck.rotate_checkpoints(ck_dir, int(checkpoint_keep))
+            return path
+
+        done = 0
         for si, seg in enumerate(seg_sizes):
             fn = _compiled_runner(spec, updater_items, adapt_nf, seg,
                                   trans_cur, int(thin), skip_z, record,
                                   spatial._NNGP_DENSE_MAX)
             recs, state_cur, bad_cur, keys = fn(data, state_cur, keys, bad_cur)
-            # pack now (async on device); fetch below.  Drop the original
-            # record tree immediately — keeping it alive through the fetch
-            # would double record HBM (the pack holds the only live copy)
-            recs_segs.append(_pack_records(recs, record_dtype))
+            # pack now (async on device); fetch at the next snapshot or at
+            # the end.  Drop the original record tree immediately — keeping
+            # it alive through the fetch would double record HBM (the pack
+            # holds the only live copy)
+            pending.append(_pack_records(recs, record_dtype))
             del recs
+            done += int(seg)
             trans_cur = 0
             skip_z = True
             if verbose:
                 it_now = int(np.asarray(state_cur.it).ravel()[0])
                 phase = "sampling" if it_now > it0 + transient else "transient"
                 print(f"iteration {it_now} of {total_it} ({phase})")
+            wrote = None
+            if ck_every and (done in ck_marks or preempt["signum"] is not None):
+                wrote = _write_ck(done)
+            if progress_callback is not None:
+                progress_callback(base_samples + done,
+                                  base_samples + int(samples))
+            if preempt["signum"] is not None:
+                if ck_every and wrote is None:
+                    wrote = _write_ck(done)
+                from ..utils.checkpoint import PreemptedRun
+                raise PreemptedRun(
+                    f"run preempted by signal {preempt['signum']} after "
+                    f"{base_samples + done} of {base_samples + int(samples)} "
+                    f"recorded samples; resumable checkpoint: {wrote} "
+                    "(continue with resume_run or "
+                    "`python -m hmsc_tpu run --resume`)",
+                    checkpoint_path=wrote,
+                    samples_done=base_samples + done,
+                    signum=preempt["signum"])
         final_state = state_cur
-        host_segs = [_unpack_records(*seg) for seg in recs_segs]
-        if len(host_segs) == 1:
-            recs = host_segs[0]
-        else:
-            recs = jax.tree.map(lambda *xs: np.concatenate(xs, axis=1),
-                                *host_segs)
+        _flush_pending()
+        recs = host_segs[0]
+    finally:
+        if restore_handlers:
+            import signal as _signal
+            for s, h in restore_handlers:
+                _signal.signal(s, h)
     t2 = time.perf_counter()
 
     post = Posterior(hM, spec, recs, samples=samples, transient=transient,
@@ -554,6 +763,11 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         sub_mesh = mesh
         if mesh is not None and len(bad) % int(mesh.shape[chain_axis]) != 0:
             sub_mesh = None
+        # the replacement carry state is needed whenever the caller asked for
+        # it OR a final checkpoint must be re-written: snapshotting the
+        # pre-splice state would hand a later resume_run(extra_samples=...)
+        # the NaN-poisoned carry of the very chain the retry just replaced
+        want_state = return_state or bool(ck_every)
         sub = sample_mcmc(hM, samples=samples,
                           transient=int(transient) + it0, thin=thin,
                           n_chains=len(bad), seed=int(rng.integers(2**31 - 1)),
@@ -564,8 +778,8 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                           species_axis=species_axis,
                           rng_impl=rng_impl, record_dtype=record_dtype,
                           retry_diverged=retry_diverged - 1,
-                          record=record, return_state=return_state)
-        if return_state:
+                          record=record, return_state=want_state)
+        if want_state:
             sub, sub_state = sub
 
             def _splice(a, b):
@@ -582,9 +796,27 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         first_bad = first_bad.copy()
         first_bad[bad] = sub.chain_health["first_bad_it"]
         post.set_chain_health(first_bad)
+        # surface the restart in the posterior's metadata (operations
+        # audit: a spliced chain is a different realisation than the one
+        # the seed alone would have produced)
+        post.retry_info = {
+            "retried_chains": tuple(int(c) for c in bad),
+            "healthy_after_retry": tuple(
+                bool(b < 0) for b in
+                np.asarray(sub.chain_health["first_bad_it"])),
+        }
         for r in range(spec.nr):          # replacement chains' counts
             nf_sat_counts[r] = nf_sat_counts[r].copy()
             nf_sat_counts[r][bad] = sub.nf_saturation[r]
+        if ck_every:
+            # the splice changed recorded draws AND the carry state AFTER
+            # the final snapshot was written inside the segment loop —
+            # re-write it so resume_run of the completed run returns the
+            # spliced (healthy) posterior and any extension continues from
+            # the replacement chains' healthy carry, not the poisoned one
+            post.nf_saturation = nf_sat_counts
+            _write_ck(int(samples), post_override=post,
+                      state_override=final_state)
 
     # factor-cap observability: warn when burn-in adaptation wanted to add
     # factors past the static nf_max cap — the residual associations may be
@@ -606,7 +838,8 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
     if align_post and spec.nr > 0:
         from ..post.align import align_posterior
         for _ in range(5):
-            align_posterior(post)
+            if align_posterior(post) == 0:     # converged: pass was a no-op
+                break
     if return_state:
         return post, final_state
     return post

@@ -18,7 +18,12 @@ import numpy as np
 __all__ = ["align_posterior"]
 
 
-def align_posterior(post) -> None:
+def align_posterior(post) -> int:
+    """One alignment pass.  Returns the number of (chain, sample, factor)
+    sign flips applied, so callers can iterate to a fixed point (the
+    cross-chain mean moves with each pass) instead of a blind repeat count:
+    0 means the pass was a no-op and the alignment has converged."""
+    flips = 0
     gmask = post.good_chain_mask()
     for r in range(post.spec.nr):
         if f"Lambda_{r}" not in post.arrays:      # record=-restricted run
@@ -29,6 +34,7 @@ def align_posterior(post) -> None:
         # per-sample correlation sign against the cross-chain mean
         num = np.einsum("csfj,fj->csf", lam2, mean_lam)
         sign = np.where(num < 0, -1.0, 1.0)       # (c, s, nf)
+        flips += int((sign < 0).sum())
         # arrays may be read-only views of JAX buffers; multiply out-of-place
         if lam.ndim == 5:
             lam = lam * sign[..., None, None]
@@ -48,6 +54,7 @@ def align_posterior(post) -> None:
         mc = mean_w - mean_w.mean(axis=-1, keepdims=True)
         num = np.einsum("cskj,kj->csk", wc, mc)
         sign = np.where(num < 0, -1.0, 1.0)       # (c, s, K)
+        flips += int((sign < 0).sum())
         ncn = spec.nc_nrrr
         post.arrays["wRRR"] = w * sign[..., None]
         B = np.array(post.arrays["Beta"])
@@ -62,3 +69,4 @@ def align_posterior(post) -> None:
             V[:, :, ncn:, :] = V[:, :, ncn:, :] * sign[..., None]
             V[:, :, :, ncn:] = V[:, :, :, ncn:] * sign[:, :, None, :]
             post.arrays["V"] = V
+    return flips

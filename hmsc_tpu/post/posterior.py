@@ -42,6 +42,10 @@ class Posterior:
         # set by sample_mcmc; poisoned chains are excluded from pooled()
         self.chain_health = {"first_bad_it": np.full(self.n_chains, -1),
                              "good_chains": np.ones(self.n_chains, bool)}
+        # retry_diverged bookkeeping, set by sample_mcmc when a diverged
+        # chain was re-run and spliced in: which chains were replaced and
+        # whether the replacement came back healthy
+        self.retry_info = {"retried_chains": (), "healthy_after_retry": ()}
 
     def set_chain_health(self, first_bad_it: np.ndarray) -> None:
         first_bad_it = np.asarray(first_bad_it)

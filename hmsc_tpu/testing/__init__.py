@@ -1,0 +1,10 @@
+"""Fault-injection harness for rehearsing long-run failure modes
+(see :mod:`hmsc_tpu.testing.faults`).  Ships with the wheel so operators can
+drill kill → resume recovery against their own models, not just the test
+suite's."""
+
+from .faults import (InjectedFault, InjectedDeviceLoss, device_loss_after,
+                     flip_bytes, inject_nan, sigterm_after)
+
+__all__ = ["InjectedFault", "InjectedDeviceLoss", "device_loss_after",
+           "flip_bytes", "inject_nan", "sigterm_after"]

@@ -1,0 +1,117 @@
+"""Fault injection for the fault-tolerance layer (tests/test_fault_tolerance).
+
+Long multi-chip MCMC runs fail in three characteristic ways (ROADMAP
+north-star; the round-5 outage probe in ``benchmarks/tpu_outage_r05.log``):
+a numerical blow-up inside one updater poisons a chain, the host or device
+is preempted mid-run, and checkpoint files rot on disk.  Each helper here
+injects exactly one of those, deterministically, so the recovery paths
+(divergence containment + ``retry_diverged``, auto-checkpoint +
+``resume_run``, checksum rejection + rotation fallback) can be proven
+end-to-end rather than assumed.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import signal
+
+import numpy as np
+
+__all__ = ["InjectedFault", "InjectedDeviceLoss", "inject_nan",
+           "device_loss_after", "sigterm_after", "flip_bytes"]
+
+
+class InjectedFault(RuntimeError):
+    """Base class for deliberately injected failures."""
+
+
+class InjectedDeviceLoss(InjectedFault):
+    """Simulated loss of the accelerator / host between compiled segments."""
+
+
+@contextlib.contextmanager
+def inject_nan(updater: str = "update_beta_lambda", at_iteration: int = 1,
+               field: str = "Beta"):
+    """Poison ``state.<field>`` with NaN at the exact sweep
+    ``state.it == at_iteration`` — *inside* the compiled scan, like a real
+    numerical blow-up (the gate is traced on the carried iteration counter,
+    so it fires mid-scan, not between host segments).
+
+    Monkeypatches ``mcmc.updaters.<updater>`` (the sweep resolves updaters
+    from the module at trace time) and clears the compiled-program cache on
+    entry and exit, so the poison is actually traced in and is fully gone
+    afterwards.  Affects every chain — chains are vmapped over one program.
+    """
+    import jax.numpy as jnp
+
+    from ..mcmc import sampler as sampler_mod
+    from ..mcmc import updaters as U
+
+    real = getattr(U, updater)
+
+    def poisoned(spec, data, state, key, **kw):
+        state = real(spec, data, state, key, **kw)
+        tgt = getattr(state, field)
+        hit = (state.it == at_iteration).astype(tgt.dtype)
+        return state.replace(**{field: tgt + hit * jnp.asarray(
+            jnp.nan, dtype=tgt.dtype)})
+
+    setattr(U, updater, poisoned)
+    sampler_mod._compiled_runner.cache_clear()
+    try:
+        yield
+    finally:
+        setattr(U, updater, real)
+        sampler_mod._compiled_runner.cache_clear()
+
+
+def device_loss_after(samples_done: int):
+    """Progress callback raising :class:`InjectedDeviceLoss` once the run
+    has recorded ``samples_done`` samples — simulating losing the device
+    between two compiled segments.  The auto-checkpoint for that boundary is
+    written *before* the callback fires, so ``resume_run`` recovers from it.
+    """
+    def cb(done, total):
+        if done >= samples_done:
+            raise InjectedDeviceLoss(
+                f"injected device loss at {done}/{total} recorded samples")
+    return cb
+
+
+def sigterm_after(samples_done: int):
+    """Progress callback delivering a real SIGTERM to this process once
+    ``samples_done`` samples are recorded — a preemption rehearsal: the
+    sampler's handler finishes the segment, snapshots, and unwinds with
+    :class:`~hmsc_tpu.utils.checkpoint.PreemptedRun`.  Fires once."""
+    fired = {"done": False}
+
+    def cb(done, total):
+        if not fired["done"] and done >= samples_done:
+            fired["done"] = True
+            os.kill(os.getpid(), signal.SIGTERM)
+    return cb
+
+
+def flip_bytes(path: str, n: int = 16, offset: int | None = None,
+               seed: int = 0) -> list[int]:
+    """Flip ``n`` bytes of a file in place (bit-rot simulation for
+    checkpoint-integrity tests).  With ``offset=None`` the positions are
+    drawn deterministically from the middle 80% of the file (the payload
+    region); returns the flipped offsets."""
+    with open(path, "rb") as f:
+        data = bytearray(f.read())
+    if not data:
+        raise ValueError(f"{path}: empty file, nothing to corrupt")
+    if offset is not None:
+        offs = list(range(offset, min(offset + n, len(data))))
+    else:
+        lo = int(len(data) * 0.1)
+        hi = max(int(len(data) * 0.9), lo + 1)
+        rng = np.random.default_rng(seed)
+        offs = sorted({int(x) for x in rng.integers(lo, hi, size=n)})
+    for o in offs:
+        data[o] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(data))
+    return offs

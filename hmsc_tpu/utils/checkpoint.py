@@ -1,66 +1,519 @@
 """Checkpoint / resume for long MCMC runs (SURVEY.md §5: the reference has no
-in-process checkpointing — its idiom is R serialization of the fitted object
-plus ``initPar`` warm starts; here (samples-so-far, carry-state) snapshots
-are first-class).
+in-process fault tolerance — a killed ``sampleMcmc`` loses everything; its
+idiom is R serialization of the fitted object plus ``initPar`` warm starts).
 
-Layout: one ``.npz`` holding the recorded posterior arrays (``post:<name>``),
-the chain carry-state pytree leaves (``state:<i>``) with a pickled treedef,
-and the run metadata.  ``load_checkpoint`` + ``sample_mcmc(init_state=...)``
-continues the chains bit-exactly where they left off (modulo the fresh RNG
-stream seeded for the continuation), and ``Posterior.concat`` splices the
-segments.
+Format v2 (this module): one ``.npz`` holding the recorded posterior arrays
+(``post:<name>``), the chain carry-state leaves keyed by *structural name*
+(``state:levels.0.Eta``), optionally the carried per-chain RNG keys, and a
+JSON header with per-payload crc32 checksums plus a model-spec fingerprint.
+Nothing is pickled: the state pytree structure is re-derived from
+``build_spec(hM)`` at load time, so a checkpoint survives any environment
+that can rebuild the model.  Writes are atomic (tmp + rename) and
+``sample_mcmc(checkpoint_every=..., checkpoint_path=...)`` rotates the last
+K snapshots, so a kill at any instant leaves a loadable file behind.
+
+``load_checkpoint`` + ``sample_mcmc(init_state=...)`` continues the chains
+bit-exactly where they left off; when the checkpoint also carries the RNG
+keys (auto-checkpoints always do), ``resume_run`` continues the *key stream*
+too, making kill → resume produce draws bit-identical to an uninterrupted
+run.  Corruption (flipped bytes, truncation) is detected via the checksums
+and rejected with :class:`CheckpointCorruptError`; ``resume_run`` then falls
+back to the previous rotation slot.  Legacy v1 files (pickled metadata) are
+readable only behind an explicit ``allow_legacy_pickle=True``.
 """
 
 from __future__ import annotations
 
-import pickle
+import dataclasses
+import hashlib
+import json
+import os
+import re
+import warnings
+import zipfile
+import zlib
+from typing import Any
 
 import numpy as np
 
-__all__ = ["save_checkpoint", "load_checkpoint", "concat_posteriors"]
+__all__ = [
+    "save_checkpoint", "load_checkpoint", "load_checkpoint_full",
+    "concat_posteriors", "resume_run", "checkpoint_files",
+    "rotate_checkpoints", "latest_valid_checkpoint", "spec_fingerprint",
+    "CheckpointError", "CheckpointCorruptError",
+    "CheckpointSpecMismatchError", "PreemptedRun", "LoadedCheckpoint",
+    "CKPT_VERSION",
+]
+
+CKPT_VERSION = 2
+_HEADER_KEY = "__hmsc_ckpt_header__"
+_CKPT_RE = re.compile(r"ckpt-(\d+)\.npz")
 
 
-def save_checkpoint(path: str, post, state) -> None:
-    """Write a resumable snapshot: the Posterior so far + the carry state
-    from ``sample_mcmc(..., return_state=True)``."""
+class CheckpointError(RuntimeError):
+    """Base class for checkpoint load/save failures."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """The file is unreadable or a payload failed its integrity checksum."""
+
+
+class CheckpointSpecMismatchError(CheckpointError):
+    """The checkpoint was written for a different model specification."""
+
+
+class PreemptedRun(RuntimeError):
+    """Raised by ``sample_mcmc`` when SIGTERM/SIGINT arrives during an
+    auto-checkpointing run: the in-flight segment is finished, a resumable
+    snapshot is written, and the run unwinds with this error.  Continue with
+    ``resume_run`` (or ``python -m hmsc_tpu run --resume``)."""
+
+    def __init__(self, msg, checkpoint_path=None, samples_done=0, signum=None):
+        super().__init__(msg)
+        self.checkpoint_path = checkpoint_path
+        self.samples_done = samples_done
+        self.signum = signum
+
+
+@dataclasses.dataclass
+class LoadedCheckpoint:
+    """Everything a checkpoint carries: the partial posterior, the chain
+    carry state, optionally the carried RNG keys, the sampler's run metadata
+    (empty for manual ``save_checkpoint`` files), and the parsed header."""
+    post: Any
+    state: Any
+    keys: Any
+    run_meta: dict
+    header: dict
+    path: str
+
+
+# ---------------------------------------------------------------------------
+# structural (pickle-free) state layout
+# ---------------------------------------------------------------------------
+
+def _state_skeleton(spec):
+    """(leaf names, treedef) of the carry state, derived purely from the
+    model spec: a GibbsState whose leaves are their own names has the same
+    pytree structure as the real state (every field is a leaf), so the
+    flatten order gives a stable name per saved array — no pickled treedef."""
     import jax
 
-    leaves, treedef = jax.tree_util.tree_flatten(state)
-    payload = {f"post:{k}": v for k, v in post.arrays.items()}
-    payload.update({f"state:{i}": np.asarray(x) for i, x in enumerate(leaves)})
-    payload["meta"] = np.frombuffer(pickle.dumps({
-        "samples": post.samples, "transient": post.transient,
-        "thin": post.thin, "treedef": treedef}), dtype=np.uint8)
-    with open(path, "wb") as f:
-        np.savez_compressed(f, **payload)
+    from ..mcmc.structs import GibbsState, LevelState
+
+    def lvl(r):
+        return LevelState(
+            Eta=f"levels.{r}.Eta", Lambda=f"levels.{r}.Lambda",
+            Psi=f"levels.{r}.Psi", Delta=f"levels.{r}.Delta",
+            alpha_idx=f"levels.{r}.alpha_idx", nf_mask=f"levels.{r}.nf_mask",
+            nf_sat=f"levels.{r}.nf_sat")
+
+    skel = GibbsState(
+        Z="Z", Beta="Beta", Gamma="Gamma", iV="iV", rho_idx="rho_idx",
+        iSigma="iSigma", levels=tuple(lvl(r) for r in range(spec.nr)),
+        it="it", BetaSel=tuple(f"BetaSel.{i}" for i in range(spec.ncsel)),
+        wRRR="wRRR", PsiRRR="PsiRRR", DeltaRRR="DeltaRRR")
+    names, treedef = jax.tree_util.tree_flatten(skel)
+    return list(names), treedef
 
 
-def load_checkpoint(path: str, hM):
-    """Returns (Posterior, carry_state) ready for
-    ``sample_mcmc(hM, ..., init_state=carry_state)``."""
+def _effective_nf_cap(spec) -> int:
+    """The smallest nf_cap that rebuilds this spec via ``build_spec``: every
+    level's nf_max is min(prior bound, ns, cap), so the max over levels
+    reconstructs each level exactly (a capped level stores the cap itself)."""
+    from ..mcmc.structs import DEFAULT_NF_CAP
+    return max((ls.nf_max for ls in spec.levels), default=DEFAULT_NF_CAP)
+
+
+def spec_fingerprint(spec) -> str:
+    """sha256 of the (frozen, primitive-valued) ModelSpec repr — changes
+    whenever the model structure or the package's spec layout changes."""
+    return hashlib.sha256(repr(spec).encode()).hexdigest()
+
+
+def _crc(a) -> str:
+    return f"{zlib.crc32(np.ascontiguousarray(a).tobytes()) & 0xFFFFFFFF:08x}"
+
+
+def _atomic_savez(path: str, payload: dict) -> None:
+    """tmp + fsync + rename so a kill mid-write never leaves a torn file
+    under the final name."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez_compressed(f, **payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# save / load
+# ---------------------------------------------------------------------------
+
+def save_checkpoint(path: str, post, state, *, keys=None, keys_impl=None,
+                    run_meta: dict | None = None) -> None:
+    """Write a resumable snapshot: the Posterior so far + the carry state
+    from ``sample_mcmc(..., return_state=True)``.
+
+    ``keys``/``keys_impl`` optionally persist the carried per-chain RNG keys
+    (``jax.random`` typed keys + their impl name) so a continuation replays
+    the exact key stream — auto-checkpoints always pass them.  ``run_meta``
+    is an arbitrary JSON-serializable dict stored in the header
+    (``resume_run`` reads the sampler's run configuration from it)."""
+    import jax
+
+    path = os.fspath(path)
+    names, skel_def = _state_skeleton(post.spec)
+    leaves, state_def = jax.tree_util.tree_flatten(state)
+    if state_def != skel_def:
+        raise CheckpointError(
+            "carry state structure does not match the layout derived from "
+            "the model spec (GibbsState fields changed without updating "
+            "checkpoint._state_skeleton?) — refusing to write an "
+            "unloadable checkpoint")
+
+    payload = {f"post:{k}": np.asarray(v) for k, v in post.arrays.items()}
+    payload.update({f"state:{n}": np.asarray(x)
+                    for n, x in zip(names, leaves)})
+    if keys is not None:
+        if keys_impl is None:
+            raise ValueError("save_checkpoint: keys requires keys_impl "
+                             "(the PRNG impl name, e.g. 'threefry2x32')")
+        payload["rngkeys"] = np.asarray(jax.random.key_data(keys))
+
+    import hmsc_tpu as _pkg
+    header = {
+        "format": "hmsc_tpu-checkpoint",
+        "version": CKPT_VERSION,
+        "package_version": _pkg.__version__,
+        "samples": int(post.samples),
+        "transient": int(post.transient),
+        "thin": int(post.thin),
+        "n_chains": int(post.n_chains),
+        "nf_cap": int(_effective_nf_cap(post.spec)),
+        "spec_sha256": spec_fingerprint(post.spec),
+        "keys_impl": keys_impl,
+        "first_bad_it": [int(x) for x in post.chain_health["first_bad_it"]],
+        "nf_saturation": {str(r): np.asarray(v).tolist()
+                          for r, v in post.nf_saturation.items()},
+        "checksums": {k: _crc(v) for k, v in payload.items()},
+        "run": run_meta or {},
+    }
+    payload[_HEADER_KEY] = np.frombuffer(
+        json.dumps(header).encode(), dtype=np.uint8)
+    _atomic_savez(path, payload)
+
+
+def load_checkpoint_full(path: str, hM, *,
+                         allow_legacy_pickle: bool = False) -> LoadedCheckpoint:
+    """Load a checkpoint with full metadata (see :class:`LoadedCheckpoint`).
+
+    Raises :class:`CheckpointCorruptError` on unreadable/byte-flipped files
+    (every payload is checksummed) and :class:`CheckpointSpecMismatchError`
+    when the file was written for a different model spec."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..mcmc.structs import build_spec
+    from ..post.posterior import Posterior
+
+    path = os.fspath(path)
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            files = set(z.files)
+            if _HEADER_KEY not in files:
+                if "meta" in files:
+                    return _load_legacy_v1(z, hM, path, allow_legacy_pickle)
+                raise CheckpointCorruptError(
+                    f"{path}: not an hmsc_tpu checkpoint (no v2 header and "
+                    "no legacy v1 metadata)")
+            header = json.loads(z[_HEADER_KEY].tobytes().decode())
+
+            # materialise each payload exactly once (NpzFile re-inflates the
+            # zip member on every access — verifying from z[k] and then
+            # loading z[k] again would decompress a multi-GB checkpoint
+            # twice), then verify against the header's checksums
+            data = {k: z[k] for k in files if k != _HEADER_KEY}
+            for k, want in header.get("checksums", {}).items():
+                if k not in data:
+                    raise CheckpointCorruptError(
+                        f"{path}: payload {k!r} is missing — the file is "
+                        "truncated or corrupt")
+                got = _crc(data[k])
+                if got != want:
+                    raise CheckpointCorruptError(
+                        f"{path}: payload {k!r} failed its integrity "
+                        f"checksum (crc32 {got} != {want}) — the file is "
+                        "corrupt; fall back to an earlier rotation slot")
+
+            spec = build_spec(hM, int(header["nf_cap"]))
+            got_fp = spec_fingerprint(spec)
+            if got_fp != header["spec_sha256"]:
+                raise CheckpointSpecMismatchError(
+                    f"{path}: model spec fingerprint mismatch "
+                    f"({got_fp[:12]}… != {header['spec_sha256'][:12]}…) — "
+                    "the checkpoint was written for a different model "
+                    "(data shapes, levels, priors) or a different "
+                    "hmsc_tpu spec layout; rebuild the matching Hmsc "
+                    "object to resume")
+
+            names, treedef = _state_skeleton(spec)
+            missing = [n for n in names if f"state:{n}" not in data]
+            if missing:
+                raise CheckpointCorruptError(
+                    f"{path}: carry-state leaves missing: {missing}")
+            state = jax.tree_util.tree_unflatten(
+                treedef, [jnp.asarray(data[f"state:{n}"]) for n in names])
+
+            arrays = {k[5:]: v for k, v in data.items()
+                      if k.startswith("post:")}
+            keys = None
+            if "rngkeys" in data and header.get("keys_impl"):
+                keys = jax.random.wrap_key_data(
+                    jnp.asarray(data["rngkeys"]), impl=header["keys_impl"])
+    except CheckpointError:
+        raise
+    except (zipfile.BadZipFile, zlib.error, OSError, ValueError, KeyError,
+            EOFError, json.JSONDecodeError) as e:
+        raise CheckpointCorruptError(
+            f"{path}: unreadable checkpoint ({type(e).__name__}: {e}) — "
+            "the file is corrupt or truncated") from e
+
+    post = Posterior(hM, spec, arrays, samples=int(header["samples"]),
+                     transient=int(header["transient"]),
+                     thin=int(header["thin"]))
+    if "first_bad_it" in header:
+        post.set_chain_health(np.asarray(header["first_bad_it"]))
+    post.nf_saturation = {int(r): np.asarray(v)
+                          for r, v in header.get("nf_saturation", {}).items()}
+    return LoadedCheckpoint(post=post, state=state, keys=keys,
+                            run_meta=dict(header.get("run", {})),
+                            header=header, path=path)
+
+
+def _load_legacy_v1(z, hM, path, allow_legacy_pickle) -> LoadedCheckpoint:
+    """Guarded read path for pre-v2 files: the run metadata is a python
+    pickle, so it is only decoded behind an explicit opt-in.  The state
+    structure itself is rebuilt from the spec (the v1 leaves ``state:<i>``
+    are in the same flatten order), so the pickled treedef is never used."""
+    if not allow_legacy_pickle:
+        raise CheckpointError(
+            f"{path}: legacy v1 checkpoint whose metadata is a python "
+            "pickle; refusing to unpickle by default.  Pass "
+            "allow_legacy_pickle=True only if you trust the file's origin "
+            "(or re-save it in the v2 format via save_checkpoint)")
+    import pickle
+
     import jax.numpy as jnp
     from jax.tree_util import tree_unflatten
 
     from ..mcmc.structs import build_spec
     from ..post.posterior import Posterior
 
-    with np.load(path, allow_pickle=False) as z:
-        meta = pickle.loads(z["meta"].tobytes())
-        arrays = {k[5:]: z[k] for k in z.files if k.startswith("post:")}
-        n_state = sum(1 for k in z.files if k.startswith("state:"))
-        leaves = [jnp.asarray(z[f"state:{i}"]) for i in range(n_state)]
-    state = tree_unflatten(meta["treedef"], leaves)
+    meta = pickle.loads(z["meta"].tobytes())
+    arrays = {k[5:]: z[k] for k in z.files if k.startswith("post:")}
+    n_state = sum(1 for k in z.files if k.startswith("state:"))
+    leaves = [jnp.asarray(z[f"state:{i}"]) for i in range(n_state)]
     spec = build_spec(hM)
+    names, treedef = _state_skeleton(spec)
+    if len(leaves) != len(names):
+        raise CheckpointCorruptError(
+            f"{path}: legacy checkpoint carries {len(leaves)} state leaves, "
+            f"the model spec implies {len(names)}")
+    state = tree_unflatten(treedef, leaves)
     post = Posterior(hM, spec, arrays, samples=meta["samples"],
                      transient=meta["transient"], thin=meta["thin"])
-    return post, state
+    return LoadedCheckpoint(post=post, state=state, keys=None, run_meta={},
+                            header={"version": 1}, path=path)
 
 
-def concat_posteriors(first, second):
-    """Splice two sampling segments of the same model (chains must match):
-    the recorded-sample axis is concatenated per parameter."""
+def load_checkpoint(path: str, hM, *, allow_legacy_pickle: bool = False):
+    """Returns (Posterior, carry_state) ready for
+    ``sample_mcmc(hM, ..., init_state=carry_state)`` — see
+    :func:`load_checkpoint_full` for the RNG keys and run metadata."""
+    ck = load_checkpoint_full(path, hM, allow_legacy_pickle=allow_legacy_pickle)
+    return ck.post, ck.state
+
+
+# ---------------------------------------------------------------------------
+# rotation / discovery
+# ---------------------------------------------------------------------------
+
+def checkpoint_files(path: str) -> list[str]:
+    """Auto-checkpoint files under a directory, newest (most samples) first.
+    A direct file path is returned as a single-element list."""
+    path = os.fspath(path)
+    if os.path.isfile(path):
+        return [path]
+    if not os.path.isdir(path):
+        return []          # no directory yet -> no checkpoints (callers
+                           # raise the documented CheckpointError on empty)
+    entries = []
+    for fn in os.listdir(path):
+        m = _CKPT_RE.fullmatch(fn)
+        if m:
+            entries.append((int(m.group(1)), os.path.join(path, fn)))
+    return [p for _, p in sorted(entries, reverse=True)]
+
+
+def rotate_checkpoints(path: str, keep: int) -> None:
+    """Delete all but the newest ``keep`` auto-checkpoints in a directory."""
+    if keep <= 0:
+        return
+    for p in checkpoint_files(path)[keep:]:
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+
+
+def latest_valid_checkpoint(path: str, hM, *,
+                            allow_legacy_pickle: bool = False) -> LoadedCheckpoint:
+    """Newest checkpoint that loads cleanly; corrupt slots are skipped with
+    a warning (falling back to the previous rotation slot).  A spec mismatch
+    is raised immediately — every slot would mismatch the same way."""
+    cands = checkpoint_files(path)
+    if not cands:
+        raise CheckpointError(f"no checkpoints found under {path!r}")
+    failures = []
+    for p in cands:
+        try:
+            return load_checkpoint_full(
+                p, hM, allow_legacy_pickle=allow_legacy_pickle)
+        except CheckpointCorruptError as e:
+            warnings.warn(
+                f"skipping corrupt checkpoint {p} ({e}); falling back to "
+                "the previous rotation slot", RuntimeWarning, stacklevel=2)
+            failures.append(f"{p}: {e}")
+    raise CheckpointError(
+        "every candidate checkpoint failed to load:\n  "
+        + "\n  ".join(failures))
+
+
+# ---------------------------------------------------------------------------
+# resume / concat
+# ---------------------------------------------------------------------------
+
+def _bounded_align(post, max_passes: int = 5) -> None:
+    from ..post.align import align_posterior
+    for _ in range(max_passes):
+        if align_posterior(post) == 0:
+            break
+
+
+def resume_run(hM, checkpoint_path: str, *, verbose: int = 0,
+               progress_callback=None, extra_samples: int = 0,
+               allow_legacy_pickle: bool = False, mesh=None,
+               chain_axis: str = "chains", species_axis: str = "species"):
+    """Continue an auto-checkpointed ``sample_mcmc`` run to completion.
+
+    Locates the newest valid checkpoint under ``checkpoint_path`` (corrupt
+    slots fall back to the previous rotation slot), restores the carry state
+    *and the carried RNG keys*, and samples the remaining draws with the
+    stored run configuration — so the concatenated posterior is bit-identical
+    to the uninterrupted run.  The continuation keeps auto-checkpointing into
+    the same directory, so repeated kill → resume cycles compose.  A run that
+    already completed returns its posterior without sampling;
+    ``extra_samples`` extends the target beyond the original total.  A device
+    ``mesh`` is not serializable, so a sharded run passes its (possibly
+    different) mesh back in via ``mesh=``/``chain_axis=``/``species_axis=``."""
+    import jax.numpy as jnp
+
+    ck = latest_valid_checkpoint(checkpoint_path, hM,
+                                 allow_legacy_pickle=allow_legacy_pickle)
+    meta = dict(ck.run_meta)
+    if not meta:
+        raise CheckpointError(
+            f"{ck.path}: no run metadata in this checkpoint (it was written "
+            "by save_checkpoint, not by sample_mcmc auto-checkpointing) — "
+            "continue it manually via sample_mcmc(init_state=...)")
+    total = int(meta["samples_total"]) + int(extra_samples)
+    done = int(meta["samples_done"])
+    align = bool(meta.get("align_post", True))
+    if total <= done:
+        out = ck.post
+        if align and out.spec.nr > 0:
+            _bounded_align(out)
+        return out
+
+    rd = meta.get("record_dtype")
+    record = meta.get("record")
+    ckdir = (os.fspath(checkpoint_path) if os.path.isdir(checkpoint_path)
+             else (os.path.dirname(ck.path) or "."))
+    from ..mcmc.sampler import sample_mcmc
+    cont = sample_mcmc(
+        hM, samples=total - done, transient=0, thin=int(meta["thin"]),
+        n_chains=ck.post.n_chains, seed=meta.get("seed"),
+        init_state=ck.state, init_keys=ck.keys,
+        # the original (resolved) adaptation window: its gate is on the
+        # carried iteration counter, so it is a no-op here — but matching it
+        # lets the continuation reuse the original run's compiled program
+        adapt_nf=meta.get("adapt_nf"),
+        nf_cap=int(meta["nf_cap"]), updater=meta.get("updater"),
+        # model data must be rebuilt at the original precision, or an f64
+        # run would continue against f32 data (init_par/data_par are not
+        # serializable and so not restored; they only affect retry restarts)
+        dtype=getattr(jnp, meta.get("dtype", "float32")),
+        record=tuple(record) if record else None,
+        record_dtype=None if rd is None else getattr(jnp, rd),
+        rng_impl=meta.get("rng_impl"),
+        retry_diverged=int(meta.get("retry_diverged", 0)),
+        align_post=False, verbose=verbose, mesh=mesh,
+        chain_axis=chain_axis, species_axis=species_axis,
+        progress_callback=progress_callback,
+        checkpoint_every=int(meta.get("checkpoint_every", 0)),
+        checkpoint_path=ckdir,
+        checkpoint_keep=int(meta.get("checkpoint_keep", 3)),
+        _ckpt_base=ck.post)
+    out = concat_posteriors(ck.post, cont, align=False)
+    if align and out.spec.nr > 0:
+        _bounded_align(out)
+    return out
+
+
+def concat_posteriors(first, second, *, align: bool = True,
+                      max_align_passes: int = 5):
+    """Splice two sampling segments of the same model: the recorded-sample
+    axis is concatenated per parameter.  Validates that the segments are
+    actually compatible — chain counts, parameter keys, per-parameter
+    shapes and the ``thin`` stride — naming the offending key on mismatch."""
     if first.n_chains != second.n_chains:
-        raise ValueError("concat_posteriors: chain counts differ")
+        raise ValueError(
+            f"concat_posteriors: chain counts differ "
+            f"({first.n_chains} vs {second.n_chains})")
+    only_a = sorted(set(first.arrays) - set(second.arrays))
+    only_b = sorted(set(second.arrays) - set(first.arrays))
+    if only_a or only_b:
+        raise ValueError(
+            "concat_posteriors: recorded parameter sets differ — "
+            f"only in first: {only_a}; only in second: {only_b} "
+            "(were the segments run with different record= selections?)")
+    for k, v in first.arrays.items():
+        w = second.arrays[k]
+        if v.shape[2:] != w.shape[2:]:
+            raise ValueError(
+                f"concat_posteriors: parameter {k!r} has incompatible "
+                f"shapes {v.shape} vs {w.shape} (differs beyond the "
+                "(chains, samples) axes) — the segments come from "
+                "different model configurations")
+    if first.thin != second.thin:
+        raise ValueError(
+            f"concat_posteriors: thin strides differ ({first.thin} vs "
+            f"{second.thin}) — the spliced sample axis would not be a "
+            "single MCMC stride")
+    if second.transient not in (0, first.transient):
+        raise ValueError(
+            f"concat_posteriors: second segment carries transient="
+            f"{second.transient}; expected 0 (a continuation) or "
+            f"{first.transient} (an independent replicate)")
+
     arrays = {k: np.concatenate([first.arrays[k], second.arrays[k]], axis=1)
               for k in first.arrays}
     from ..post.posterior import Posterior
@@ -68,11 +521,20 @@ def concat_posteriors(first, second):
     out = Posterior(first.hM, first.spec, arrays,
                     samples=first.samples + second.samples,
                     transient=first.transient, thin=first.thin)
+    fb1 = np.asarray(first.chain_health["first_bad_it"])
+    fb2 = np.asarray(second.chain_health["first_bad_it"])
+    out.set_chain_health(np.where(fb1 >= 0, fb1, fb2))
+    out.nf_saturation = {
+        r: np.maximum(np.asarray(first.nf_saturation[r]),
+                      np.asarray(second.nf_saturation[r]))
+        if r in first.nf_saturation and r in second.nf_saturation
+        else np.asarray(first.nf_saturation.get(r,
+                        second.nf_saturation.get(r)))
+        for r in set(first.nf_saturation) | set(second.nf_saturation)}
     # segments may have been sign-aligned against their own posterior-mean
     # Lambda; re-align per (chain, sample) over the spliced window so factor
-    # signs are consistent across segments
-    if first.spec.nr > 0:
-        from ..post.align import align_posterior
-        for _ in range(5):
-            align_posterior(out)
+    # signs are consistent across segments (bounded: stop once a pass makes
+    # no flips instead of the former blind 5 iterations)
+    if align and first.spec.nr > 0:
+        _bounded_align(out, max_align_passes)
     return out

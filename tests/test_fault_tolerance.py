@@ -1,0 +1,346 @@
+"""Fault-tolerance suite: in-run auto-checkpointing, preemption-safe
+shutdown, checkpoint integrity (format v2), and the fault-injection harness
+(``hmsc_tpu.testing``).  The acceptance bar: a run killed mid-sampling and
+resumed from its auto-checkpoint must reproduce the uninterrupted run's
+draws *bit-exactly*, and a byte-flipped checkpoint must be rejected with a
+clear error while resume falls back to the previous rotation slot.
+
+Deliberately fast (not ``slow``): checkpoint regressions must surface in the
+default ``pytest -m 'not slow'`` tier-1 run.  All tests share one tiny model
+config and exactly two compiled segment programs; only the
+NaN-injection/retry test and the plain-run comparison are ``slow``
+(inject_nan must clear the compile cache, and the plain single-segment
+reference is its own program — three fresh XLA compiles between them).
+"""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from hmsc_tpu import (PreemptedRun, concat_posteriors, load_checkpoint,
+                      resume_run, sample_mcmc, save_checkpoint)
+from hmsc_tpu.utils.checkpoint import (CheckpointCorruptError,
+                                       CheckpointError,
+                                       CheckpointSpecMismatchError,
+                                       checkpoint_files,
+                                       load_checkpoint_full)
+from hmsc_tpu.testing import (InjectedDeviceLoss, device_loss_after,
+                              flip_bytes, inject_nan, sigterm_after)
+
+from util import small_model
+
+pytestmark = pytest.mark.faults
+
+# one shared shape config: every sample_mcmc below reuses these static
+# dimensions so the compiled-program cache is shared across the module
+M_KW = dict(ny=24, ns=3, nc=2, distr="normal", n_units=5, seed=3)
+RUN_KW = dict(samples=8, transient=4, thin=1, n_chains=2, seed=7, nf_cap=2,
+              align_post=False)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return small_model(**M_KW)
+
+
+@pytest.fixture(scope="module")
+def full_post(model, tmp_path_factory):
+    """The uninterrupted reference run every recovery path must reproduce.
+    Checkpointing is enabled so the whole fast tier shares its two compiled
+    segment programs; equality with a plain (single-segment, no-checkpoint)
+    run is proven by test_checkpointing_does_not_change_draws below."""
+    d = os.fspath(tmp_path_factory.mktemp("ref") / "ck")
+    return sample_mcmc(model, **RUN_KW, checkpoint_every=4,
+                       checkpoint_path=d)
+
+
+@pytest.mark.slow
+def test_checkpointing_does_not_change_draws(model, full_post):
+    """Segmenting the scan at checkpoint boundaries must not change a single
+    recorded draw (the carried key makes the stream segmentation-invariant)."""
+    plain = sample_mcmc(model, **RUN_KW)
+    _assert_bit_identical(plain, full_post)
+
+
+def _assert_bit_identical(post, full_post):
+    assert set(post.arrays) == set(full_post.arrays)
+    for k in full_post.arrays:
+        np.testing.assert_array_equal(post.arrays[k], full_post.arrays[k],
+                                      err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# auto-checkpointing
+# ---------------------------------------------------------------------------
+
+def test_autocheckpoint_rotation_and_invariance(tmp_path, model, full_post):
+    """checkpoint_every rotates the newest K snapshots, writes atomically,
+    and reproduces the reference draws."""
+    d = os.fspath(tmp_path / "ck")
+    post = sample_mcmc(model, **RUN_KW, checkpoint_every=4, checkpoint_path=d,
+                       checkpoint_keep=1)
+    _assert_bit_identical(post, full_post)
+
+    files = checkpoint_files(d)
+    assert [os.path.basename(p) for p in files] == \
+        ["ckpt-00000008.npz"]                        # keep-last-1 of 4, 8
+    assert not [f for f in os.listdir(d) if ".tmp" in f]   # atomic writes
+
+    # the final snapshot is the completed run: loadable, draws identical
+    post2, state = load_checkpoint(files[0], model)
+    assert post2.samples == 8 and post2.n_chains == 2
+    _assert_bit_identical(post2, full_post)
+    # run metadata makes it resume_run-able; a completed run resumes to a
+    # no-op that returns the stored posterior without sampling
+    res = resume_run(model, d)
+    _assert_bit_identical(res, full_post)
+
+    # a FRESH run into the same directory owns it: stale snapshots from the
+    # previous run are cleared (resume_run must never mix the two runs)
+    with pytest.warns(RuntimeWarning, match="previous run"):
+        post3 = sample_mcmc(model, **RUN_KW, checkpoint_every=4,
+                            checkpoint_path=d, checkpoint_keep=1)
+    _assert_bit_identical(post3, full_post)
+    assert [os.path.basename(p) for p in checkpoint_files(d)] == \
+        ["ckpt-00000008.npz"]
+
+
+def test_kill_resume_bit_exact(tmp_path, model, full_post):
+    """Acceptance: killed mid-sampling via the fault harness, resumed from
+    the auto-checkpoint — draws bit-identical to the uninterrupted run (the
+    carried RNG keys are checkpointed, so the key stream continues)."""
+    d = os.fspath(tmp_path / "ck")
+    with pytest.raises(InjectedDeviceLoss):
+        sample_mcmc(model, **RUN_KW, checkpoint_every=4, checkpoint_path=d,
+                    progress_callback=device_loss_after(4))
+    assert os.path.basename(checkpoint_files(d)[0]) == "ckpt-00000004.npz"
+
+    res = resume_run(model, d)
+    assert res.samples == 8
+    assert res.chain_health["good_chains"].all()
+    _assert_bit_identical(res, full_post)
+
+
+def test_corrupt_checkpoint_rejected_and_fallback(tmp_path, model, full_post):
+    """Acceptance: flipped bytes are rejected with a clear error; resume
+    falls back to the previous rotation slot and still completes exactly."""
+    d = os.fspath(tmp_path / "ck")
+    with pytest.raises(InjectedDeviceLoss):
+        sample_mcmc(model, **RUN_KW, checkpoint_every=4, checkpoint_path=d,
+                    progress_callback=device_loss_after(8))
+    assert len(checkpoint_files(d)) == 2            # slots 4 and 8
+    newest = checkpoint_files(d)[0]
+    flip_bytes(newest)
+
+    with pytest.raises(CheckpointCorruptError):
+        load_checkpoint(newest, model)
+    with pytest.warns(RuntimeWarning, match="corrupt checkpoint"):
+        res = resume_run(model, d)                  # continues from ckpt-4
+    _assert_bit_identical(res, full_post)
+
+
+def test_payload_checksum_detects_silent_tamper(tmp_path, model):
+    """A tampered payload that still parses as a valid npz (no zip-level
+    damage) is caught by the per-payload crc32 and named in the error."""
+    d = os.fspath(tmp_path / "ck")
+    sample_mcmc(model, **RUN_KW, checkpoint_every=4, checkpoint_path=d)
+    path = checkpoint_files(d)[0]
+    with np.load(path, allow_pickle=False) as z:
+        payload = {k: z[k] for k in z.files}
+    beta = payload["post:Beta"].copy()
+    beta.flat[0] += 1.0
+    payload["post:Beta"] = beta
+    with open(path, "wb") as f:
+        np.savez_compressed(f, **payload)
+    with pytest.raises(CheckpointCorruptError, match="post:Beta"):
+        load_checkpoint(path, model)
+
+
+def test_spec_mismatch_rejected(tmp_path, model):
+    d = os.fspath(tmp_path / "ck")
+    sample_mcmc(model, **RUN_KW, checkpoint_every=4, checkpoint_path=d)
+    other = small_model(**{**M_KW, "ns": 4})
+    with pytest.raises(CheckpointSpecMismatchError,
+                       match="spec fingerprint mismatch"):
+        load_checkpoint(checkpoint_files(d)[0], other)
+
+
+# ---------------------------------------------------------------------------
+# preemption-safe shutdown
+# ---------------------------------------------------------------------------
+
+def test_sigterm_finishes_segment_checkpoints_and_unwinds(tmp_path, model,
+                                                          full_post):
+    """A real SIGTERM mid-run: the in-flight segment finishes, a resumable
+    snapshot is written, PreemptedRun unwinds, the previous handler is
+    restored — and resume reproduces the uninterrupted run exactly."""
+    d = os.fspath(tmp_path / "ck")
+    prev = signal.getsignal(signal.SIGTERM)
+    with pytest.raises(PreemptedRun) as ei:
+        sample_mcmc(model, **RUN_KW, checkpoint_every=4, checkpoint_path=d,
+                    progress_callback=sigterm_after(4))
+    assert signal.getsignal(signal.SIGTERM) is prev
+    assert ei.value.samples_done == 4
+    assert ei.value.signum == signal.SIGTERM
+    assert ei.value.checkpoint_path.endswith("ckpt-00000004.npz")
+    assert os.path.exists(ei.value.checkpoint_path)
+
+    res = resume_run(model, d)
+    _assert_bit_identical(res, full_post)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint format v2: roundtrip, legacy v1 guard, concat validation
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_roundtrip_fast(tmp_path, model):
+    """Fast tier-1 save → load → resume roundtrip (regressions must surface
+    in the default ``-m 'not slow'`` run, not only in the slow tier)."""
+    post1, state = sample_mcmc(model, samples=4, transient=4, n_chains=2,
+                               seed=1, nf_cap=2, align_post=False,
+                               return_state=True)
+    path = os.fspath(tmp_path / "ck.npz")
+    save_checkpoint(path, post1, state)
+
+    post1b, state_b = load_checkpoint(path, model)
+    assert (post1b.samples, post1b.transient, post1b.thin) == (4, 4, 1)
+    _assert_bit_identical(post1b, post1)
+    import jax
+    assert (jax.tree_util.tree_structure(state_b)
+            == jax.tree_util.tree_structure(state))
+    for a, b in zip(jax.tree_util.tree_leaves(state),
+                    jax.tree_util.tree_leaves(state_b)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # adapt_nf=4 matches the original run's resolved window (a no-op for
+    # the carried iteration counter) so the continuation reuses its program
+    post2 = sample_mcmc(model, samples=4, transient=0, adapt_nf=4, n_chains=2,
+                        seed=2, nf_cap=2, init_state=state_b, align_post=False)
+    both = concat_posteriors(post1b, post2)
+    assert both.samples == 8
+    assert np.isfinite(both.pooled("Beta")).all()
+
+
+def test_legacy_v1_read_is_guarded(tmp_path, model):
+    """v1 files (pickled metadata) load only behind allow_legacy_pickle=True
+    — and even then the state structure is re-derived, not unpickled."""
+    import pickle
+
+    import jax
+
+    post1, state = sample_mcmc(model, samples=4, transient=4, n_chains=2,
+                               seed=1, nf_cap=2, align_post=False,
+                               return_state=True)
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    payload = {f"post:{k}": v for k, v in post1.arrays.items()}
+    payload.update({f"state:{i}": np.asarray(x)
+                    for i, x in enumerate(leaves)})
+    payload["meta"] = np.frombuffer(pickle.dumps({
+        "samples": post1.samples, "transient": post1.transient,
+        "thin": post1.thin, "treedef": treedef}), dtype=np.uint8)
+    path = os.fspath(tmp_path / "v1.npz")
+    with open(path, "wb") as f:
+        np.savez_compressed(f, **payload)
+
+    with pytest.raises(CheckpointError, match="pickle"):
+        load_checkpoint(path, model)
+    post1b, state_b = load_checkpoint(path, model, allow_legacy_pickle=True)
+    _assert_bit_identical(post1b, post1)
+    assert (jax.tree_util.tree_structure(state_b)
+            == jax.tree_util.tree_structure(state))
+
+
+# ---------------------------------------------------------------------------
+# fault-injection harness: NaN poisoning + retry_diverged coverage
+# (last in the module: inject_nan clears the compiled-program cache, so
+# running it after the checkpoint tests preserves their compile reuse)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_injected_nan_divergence_then_retry_splices(model):
+    """inject_nan poisons the carry inside the compiled scan at an exact
+    sweep; divergence tracking reports it, and retry_diverged splices a
+    healthy replacement whose retry is reported in Posterior metadata."""
+    with inject_nan(updater="update_beta_lambda", at_iteration=10,
+                    field="Beta"):
+        with pytest.warns(RuntimeWarning, match="diverged"):
+            post, state = sample_mcmc(model, samples=8, transient=4,
+                                      n_chains=2, seed=7, nf_cap=2,
+                                      align_post=False, return_state=True)
+    # every chain is vmapped over the one poisoned program: first bad sweep
+    # is exactly the injection sweep
+    assert (post.chain_health["first_bad_it"] == 10).all()
+    assert post.retry_info["retried_chains"] == ()      # no retry requested
+
+    # outside the injection context the updater is restored (the retry
+    # sub-run below re-traces it: its burn-in passes sweep 10 again, so a
+    # leaked poison would leave the replacement chains unhealthy too) — a
+    # retrying run seeded from the poisoned carry replaces both chains
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        with pytest.warns(RuntimeWarning, match="diverged"):
+            post2 = sample_mcmc(model, samples=8, transient=0, n_chains=2,
+                                seed=9, nf_cap=2, align_post=False,
+                                init_state=state, retry_diverged=1,
+                                checkpoint_every=8, checkpoint_path=d)
+        assert post2.retry_info["retried_chains"] == (0, 1)
+        assert post2.retry_info["healthy_after_retry"] == (True, True)
+        assert post2.chain_health["good_chains"].all()
+        assert np.isfinite(post2["Beta"]).all()
+        assert post2.pooled("Beta").shape[0] == 16
+
+        # the splice happens after the final in-loop snapshot: the slot must
+        # have been re-written so a resume returns the spliced draws, not
+        # the diverged ones
+        res = resume_run(model, d)
+        assert res.chain_health["good_chains"].all()
+        _assert_bit_identical(res, post2)
+
+        # ...and the stored carry state is the spliced one: an extension of
+        # the completed run must not restart from the poisoned carry
+        import jax
+        ck = load_checkpoint_full(checkpoint_files(d)[0], model)
+        for leaf in jax.tree_util.tree_leaves(ck.state):
+            assert np.isfinite(np.asarray(leaf, dtype=np.float64)).all()
+
+
+def test_concat_validation_names_the_mismatch(model):
+    from hmsc_tpu.mcmc.structs import build_spec
+    from hmsc_tpu.post.posterior import Posterior
+
+    spec = build_spec(model, 2)
+    mk = lambda arrays, thin=1, transient=0: Posterior(
+        model, spec, arrays,
+        samples=next(iter(arrays.values())).shape[1],
+        transient=transient, thin=thin)
+    a = mk({"Beta": np.zeros((2, 3, 2, 3))})
+
+    with pytest.raises(ValueError, match="chain counts"):
+        concat_posteriors(a, mk({"Beta": np.zeros((3, 3, 2, 3))}))
+    with pytest.raises(ValueError, match="Gamma"):
+        concat_posteriors(a, mk({"Gamma": np.zeros((2, 3, 2, 3))}))
+    with pytest.raises(ValueError, match="'Beta' has incompatible shapes"):
+        concat_posteriors(a, mk({"Beta": np.zeros((2, 3, 2, 4))}))
+    with pytest.raises(ValueError, match="thin strides differ"):
+        concat_posteriors(a, mk({"Beta": np.zeros((2, 3, 2, 3))}, thin=2))
+    with pytest.raises(ValueError, match="transient"):
+        concat_posteriors(a, mk({"Beta": np.zeros((2, 3, 2, 3))},
+                                transient=99))
+
+    out = concat_posteriors(a, mk({"Beta": np.ones((2, 4, 2, 3))}))
+    assert out.samples == 7 and out["Beta"].shape == (2, 7, 2, 3)
+
+
+def test_align_reports_convergence(full_post):
+    """align_posterior returns its flip count so the repeat loops are
+    bounded by convergence: once a pass makes no flips, the next pass (same
+    arrays, same cross-chain mean) cannot flip either."""
+    from hmsc_tpu.post.align import align_posterior
+
+    post = full_post.subset(chain_index=[0, 1])     # writable copies
+    for _ in range(10):
+        if align_posterior(post) == 0:
+            break
+    assert align_posterior(post) == 0
