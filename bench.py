@@ -149,13 +149,13 @@ def _tpu_rate(hM, samples, transient, n_chains, nf, **extra):
     return n_chains * samples / t, n_chains * (samples + transient) / t
 
 
-def _probe_device(timeout_s: int = 180):
+def _probe_device(timeout_s: int):
     """Fail fast and loudly if the accelerator is unreachable.
 
     `jax.devices()` blocks forever when the remote-attached chip's tunnel is
     down (observed: a multi-hour outage mid-round-4); probing in a killable
-    subprocess turns an indefinite hang into a clear nonzero exit the driver
-    can record."""
+    subprocess turns an indefinite hang into a clear, classifiable failure
+    the driver can record."""
     import subprocess
     import sys
 
@@ -170,41 +170,76 @@ def _probe_device(timeout_s: int = 180):
     return r.stdout.strip()
 
 
+def _skip(reason: str):
+    """Emit a parseable skip record instead of a bare nonzero exit: the
+    bench trajectory must distinguish "chip unreachable this round" from "a
+    regression made the run fail" (round 5 burned 9 minutes of probe
+    timeouts and recorded only rc=2).  The driver contract keys stay
+    present with value null."""
+    print(json.dumps({
+        "metric": "posterior samples/sec/chip, 1000-species probit JSDM",
+        "value": None,
+        "unit": "samples/sec",
+        "vs_baseline": None,
+        "skipped": True,
+        "reason": reason,
+    }))
+    raise SystemExit(0)
+
+
 def main():
+    import os
     import sys
     import time as _time
 
     # the tunnel to the remote-attached chip drops and returns on
     # minute-scales (observed rounds 4-5); a few spaced probes before giving
-    # up make the difference between a recorded measurement and an rc=2
-    # round artifact, while still bounding total failure time to ~15 min.
-    # Only tunnel-shaped failures are worth waiting out — a broken
-    # environment (e.g. import error in the probe subprocess) fails the
-    # same way every time and aborts on the first attempt.
+    # up make the difference between a recorded measurement and a skipped
+    # round.  All knobs are env-configurable so a CI lane that knows the
+    # chip is flaky (or knows it is local) can fail fast instead of burning
+    # the default ~9 minutes.  Only tunnel-shaped failures are worth
+    # waiting out — a broken environment (e.g. import error in the probe
+    # subprocess) fails the same way every time and aborts on the first
+    # attempt.
+    probe_timeout = int(os.environ.get("HMSC_BENCH_PROBE_TIMEOUT_S", "180"))
+    probe_retries = int(os.environ.get("HMSC_BENCH_PROBE_RETRIES", "3"))
+    probe_wait = float(os.environ.get("HMSC_BENCH_PROBE_WAIT_S", "180"))
     _transient = ("timed out", "connection", "unavailable", "deadline")
-    plat, last = None, None
-    for attempt in range(3):
+    plat, last, last_transient = None, None, False
+    for attempt in range(max(1, probe_retries)):
         if attempt:
-            _time.sleep(180)
+            _time.sleep(probe_wait)
         try:
-            plat = _probe_device()
+            plat = _probe_device(probe_timeout)
             break
         except Exception as e:                  # noqa: BLE001
             last = e
-            print(f"bench.py: device probe attempt {attempt + 1}/3 failed "
-                  f"({e})", file=sys.stderr)
-            if not any(s in str(e).lower() for s in _transient):
+            last_transient = any(s in str(e).lower() for s in _transient)
+            print(f"bench.py: device probe attempt {attempt + 1}/"
+                  f"{probe_retries} failed ({e})", file=sys.stderr)
+            if not last_transient:
                 break                           # same-every-time failure
     if plat is None:
-        print(f"bench.py: accelerator unreachable, aborting before the "
-              f"timed runs ({last})", file=sys.stderr)
+        if last_transient:
+            # tunnel-shaped: the chip is unreachable THIS round — a skip
+            # record, not a regression
+            print(f"bench.py: accelerator unreachable, skipping the timed "
+                  f"runs ({last})", file=sys.stderr)
+            _skip(f"accelerator unreachable: {last}")
+        # same-every-time failure (import error, broken env): this IS a
+        # regression and must stay a hard failure, or the bench trajectory
+        # would record it as a clean skip
+        print(f"bench.py: device probe failed non-transiently — a broken "
+              f"environment, not an outage; aborting ({last})",
+              file=sys.stderr)
         raise SystemExit(2)
     if plat == "cpu":
         # a failed TPU init falls back to the CPU backend with a warning; a
         # single-core run must never be recorded as a per-chip measurement
         print("bench.py: JAX fell back to the CPU backend — refusing to "
               "record a CPU run as samples/sec/chip", file=sys.stderr)
-        raise SystemExit(2)
+        _skip("JAX fell back to the CPU backend (TPU init failed); a CPU "
+              "run must not be recorded as samples/sec/chip")
     print(f"bench.py: device probe ok ({plat})", file=sys.stderr)
 
     n_chains = 4

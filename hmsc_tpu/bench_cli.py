@@ -10,10 +10,14 @@ reported as ``null`` here.
 
 ``run_main`` (= ``python -m hmsc_tpu run``) drives a checkpointed sampling
 run of the same synthetic probit JSDM: auto-snapshots every
-``--checkpoint-every`` samples into ``--checkpoint-dir``, exits with code 75
-(EX_TEMPFAIL) when preempted by SIGTERM/SIGINT after writing a resumable
-snapshot, and ``--resume`` continues from the newest valid one (corrupt
-slots fall back to the previous rotation slot).
+``--checkpoint-every`` samples into ``--checkpoint-dir`` (pipelined host
+loop: fetches + writes overlap the next segment's compute; ``--no-pipeline``
+serialises for A/B), exits with code 75 (EX_TEMPFAIL) when preempted by
+SIGTERM/SIGINT after writing a resumable snapshot, and ``--resume``
+continues from the newest valid one (corrupt slots fall back to the
+previous rotation slot; ``--verbose`` / ``--checkpoint-every`` act as
+draw-invariant overrides).  Rotation: ``--keep`` newest, ``--keep-age-s``
+age policy, ``--archive-every`` Nth snapshot archived.
 """
 
 from __future__ import annotations
@@ -92,13 +96,30 @@ def run_main(argv=None):
     parser.add_argument("--verbose", type=int, default=0)
     parser.add_argument("--checkpoint-dir", required=True,
                         help="directory for the rotating ckpt-<n>.npz files")
-    parser.add_argument("--checkpoint-every", type=int, default=25,
-                        help="recorded samples between snapshots")
-    parser.add_argument("--keep", type=int, default=3,
-                        help="rotation depth (newest K snapshots kept)")
+    parser.add_argument("--checkpoint-every", type=int, default=None,
+                        help="recorded samples between snapshots "
+                             "(default 25; on --resume the stored cadence "
+                             "is kept unless this is given explicitly — "
+                             "cadence only re-segments the host loop, so "
+                             "the draws are unchanged)")
+    parser.add_argument("--keep", type=int, default=None,
+                        help="rotation depth (newest K snapshots kept; "
+                             "default 3, stored cadence kept on --resume "
+                             "unless given explicitly)")
+    parser.add_argument("--keep-age-s", type=float, default=None,
+                        help="additionally delete kept snapshots older than "
+                             "this many seconds (newest always survives)")
+    parser.add_argument("--archive-every", type=int, default=0,
+                        help="hard-link every Nth snapshot into "
+                             "<checkpoint-dir>/archive/, exempt from "
+                             "rotation (post-hoc divergence debugging)")
+    parser.add_argument("--no-pipeline", action="store_true",
+                        help="disable the background writer / donated-carry "
+                             "pipeline (serialised host loop, for A/B)")
     parser.add_argument("--resume", action="store_true",
                         help="continue from the newest valid checkpoint "
-                             "instead of starting fresh")
+                             "instead of starting fresh; --verbose and "
+                             "--checkpoint-every act as overrides")
     args = parser.parse_args(argv)
 
     import os
@@ -118,7 +139,27 @@ def run_main(argv=None):
     hM = _model(margs["ny"], margs["ns"], margs["nf"], seed=66)
     try:
         if args.resume:
-            post = resume_run(hM, args.checkpoint_dir, verbose=args.verbose)
+            # the run configuration (samples/transient/chains/seed) always
+            # comes from the checkpoint — passing different values with
+            # --resume would otherwise be silently ignored
+            import sys
+            ignored = [f for f, v, d in (
+                ("--samples", args.samples, 200),
+                ("--transient", args.transient, 50),
+                ("--chains", args.chains, 4),
+                ("--seed", args.seed, 0)) if v != d]
+            if ignored:
+                print(f"run --resume: {', '.join(ignored)} ignored — the "
+                      "run configuration comes from the checkpoint "
+                      "(overridable: --verbose, --checkpoint-every, --keep, "
+                      "--keep-age-s, --archive-every)", file=sys.stderr)
+            post = resume_run(hM, args.checkpoint_dir, verbose=args.verbose,
+                              checkpoint_every=args.checkpoint_every,
+                              checkpoint_keep=args.keep,
+                              checkpoint_max_age_s=args.keep_age_s,
+                              checkpoint_archive_every=(
+                                  args.archive_every or None),
+                              pipeline=not args.no_pipeline)
         else:
             os.makedirs(args.checkpoint_dir, exist_ok=True)
             with open(model_json, "w") as f:
@@ -127,9 +168,13 @@ def run_main(argv=None):
                 hM, samples=args.samples, transient=args.transient,
                 n_chains=args.chains, seed=args.seed, nf_cap=args.nf,
                 align_post=False, verbose=args.verbose,
-                checkpoint_every=args.checkpoint_every,
+                checkpoint_every=(25 if args.checkpoint_every is None
+                                  else args.checkpoint_every),
                 checkpoint_path=args.checkpoint_dir,
-                checkpoint_keep=args.keep)
+                checkpoint_keep=3 if args.keep is None else args.keep,
+                checkpoint_max_age_s=args.keep_age_s,
+                checkpoint_archive_every=args.archive_every,
+                pipeline=not args.no_pipeline)
     except PreemptedRun as e:
         print(json.dumps({
             "preempted": True, "signal": e.signum,

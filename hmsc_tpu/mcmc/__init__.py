@@ -1,6 +1,8 @@
 from .structs import (GibbsState, LevelSpec, LevelState, ModelData, ModelSpec,
-                      build_model_data, build_state, LevelData)
+                      build_model_data, build_state, LevelData,
+                      state_nbytes)
 from .sampler import sample_mcmc
 
 __all__ = ["GibbsState", "LevelSpec", "LevelState", "ModelData", "ModelSpec",
-           "LevelData", "build_model_data", "build_state", "sample_mcmc"]
+           "LevelData", "build_model_data", "build_state", "state_nbytes",
+           "sample_mcmc"]

@@ -31,6 +31,106 @@ from . import updaters as U
 __all__ = ["sample_mcmc"]
 
 
+class _InlineWriter:
+    """Synchronous stand-in for :class:`_SegmentWriter` (``pipeline=False``):
+    every submitted item runs immediately on the caller's thread, restoring
+    the pre-pipeline serialised behaviour for A/B and bit-identity tests."""
+
+    def __init__(self):
+        self.max_depth_seen = 0
+        self.busy_s = 0.0
+
+    def submit(self, fn):
+        import time
+        t0 = time.perf_counter()
+        fn()
+        self.busy_s += time.perf_counter() - t0
+
+    def barrier(self):
+        pass
+
+    def shutdown(self):
+        pass
+
+
+class _SegmentWriter:
+    """Background collector/writer for the pipelined sampling loop.
+
+    One FIFO worker thread consumes submitted callables in order: segment
+    fetches (``np.asarray`` of the packed device buffer — the device→host
+    copy) and checkpoint serialisation + atomic rename both run here, off
+    the segment loop's critical path, overlapping the next segment's device
+    compute.  The queue is *bounded* (``depth``): when a slow disk or link
+    falls behind, ``submit`` blocks — explicit backpressure, so pending
+    host buffers can never grow without bound.
+
+    An exception inside any item is captured and re-raised on the driver
+    thread at the next ``submit``/``barrier`` (FIFO order is preserved:
+    items submitted after a failure are skipped until the error is
+    delivered).  ``barrier`` drains all in-flight work — the durability
+    point before :class:`~hmsc_tpu.utils.checkpoint.PreemptedRun` unwinds
+    and before the run returns."""
+
+    def __init__(self, depth: int = 2):
+        import queue
+        import threading
+        if depth < 1:
+            raise ValueError(f"pipeline_depth must be >= 1, got {depth}")
+        self._q = queue.Queue(maxsize=int(depth))
+        self._err = None
+        self.max_depth_seen = 0
+        self.busy_s = 0.0
+        self._thread = threading.Thread(
+            target=self._run, name="hmsc-segment-writer", daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        import time
+        while True:
+            item = self._q.get()
+            try:
+                if item is None:
+                    return
+                if self._err is None:      # skip work after a failure
+                    t0 = time.perf_counter()
+                    item()
+                    self.busy_s += time.perf_counter() - t0
+            except BaseException as e:     # noqa: BLE001 — delivered to driver
+                self._err = e
+            finally:
+                self._q.task_done()
+
+    def _check(self):
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+
+    def submit(self, fn):
+        self._check()
+        self._q.put(fn)                    # blocks when full: backpressure
+        # at least the just-submitted item was in flight; qsize() may
+        # already read 0 when the worker drains instantly
+        self.max_depth_seen = max(self.max_depth_seen, self._q.qsize(), 1)
+        self._check()
+
+    def barrier(self):
+        """Wait for every submitted item to finish; raise any captured
+        failure.  The fsync inside ``_atomic_savez`` has completed for all
+        checkpoint items once this returns."""
+        self._q.join()
+        self._check()
+
+    def shutdown(self):
+        """Drain remaining items (best effort — later failures are
+        swallowed; call ``barrier`` first when errors must propagate) and
+        join the worker.  Safe to call twice."""
+        if self._thread is None:
+            return
+        self._q.put(None)
+        self._thread.join()
+        self._thread = None
+
+
 @functools.lru_cache(maxsize=16)
 def _packer(n_leaves, cast=None):
     """Jitted raveled-concat: one contiguous device buffer per fetch."""
@@ -173,7 +273,17 @@ def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin,
     ``nngp_dense_max`` carries the current NNGP dense/CG crossover into the
     key: the sweep reads it at trace time from the ``spatial`` module
     global, so an A/B that mutates it must not be handed the stale cached
-    program."""
+    program.
+
+    The carry arguments (state, keys, divergence tracker — argnums 1..3) are
+    **donated**: each output carry aliases its input buffer, so the segment
+    loop updates the chain state in place instead of holding two copies of
+    the carry pytree in HBM per step.  Callers must treat the carry they
+    passed in as consumed (``sample_mcmc`` copies caller-provided
+    ``init_state``/``init_keys`` before the first donated call, and
+    snapshots the carry on-device before a checkpoint boundary).  A
+    ``samples=0`` config is a pure burn-in segment: the sample scan has
+    length 0 and the recorded tree comes back empty along the sample axis."""
     updater = dict(updater_items) if updater_items else None
     sweep = make_sweep(spec, updater, adapt_nf)
 
@@ -219,7 +329,8 @@ def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin,
         carry, recs = jax.lax.scan(sample_step, carry, None, length=samples)
         return recs, carry[0], carry[2], carry[1]
 
-    return jax.jit(jax.vmap(run_chain, in_axes=(None, 0, 0, 0)))
+    return jax.jit(jax.vmap(run_chain, in_axes=(None, 0, 0, 0)),
+                   donate_argnums=(1, 2, 3))
 
 
 def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
@@ -234,8 +345,13 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 rng_impl: str | None = None, record_dtype=None,
                 retry_diverged: int = 0, record=None,
                 checkpoint_every: int = 0, checkpoint_path: str | None = None,
-                checkpoint_keep: int = 3, init_keys=None,
-                progress_callback=None, _ckpt_base=None):
+                checkpoint_keep: int = 3,
+                checkpoint_max_age_s: float | None = None,
+                checkpoint_archive_every: int = 0,
+                pipeline: bool = True, pipeline_depth: int = 2,
+                init_keys=None,
+                progress_callback=None, _ckpt_base=None,
+                _transient_base: int = 0):
     """Run the blocked Gibbs sampler; returns a :class:`~hmsc_tpu.post.Posterior`.
 
     Arguments mirror the reference's ``sampleMcmc`` (samples/transient/thin/
@@ -308,14 +424,43 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
       ``python -m hmsc_tpu run --resume``), which restores the key stream so
       kill → resume reproduces the uninterrupted run exactly.
       ``checkpoint_path`` alone (no ``checkpoint_every``) writes a single
-      snapshot at completion.
+      snapshot at completion.  While checkpointing (or ``verbose``) is on,
+      the *transient* scan is segmented too: burn-in reports progress and
+      writes resumable state-only snapshots (``ckpt-t<sweep>.npz`` — carry
+      state + RNG keys, no draws), so a kill during a long burn-in no
+      longer loses it.
+    - ``checkpoint_keep`` rotates the newest K snapshots;
+      ``checkpoint_max_age_s`` additionally deletes kept snapshots older
+      than the given age (the newest always survives), and
+      ``checkpoint_archive_every=N`` hard-links every Nth written snapshot
+      into ``<checkpoint_path>/archive/`` exempt from rotation (post-hoc
+      divergence debugging: old snapshots stay inspectable after the
+      rotation window has moved on).
+    - ``pipeline`` (default on) runs the host loop as a pipeline: the
+      jitted segment runner *donates* its carry buffers (the scan carry is
+      updated in place — one copy of the state pytree in HBM instead of
+      two), the device→host fetch of each packed sample segment is consumed
+      by a background writer thread while the next segment computes, and
+      checkpoint serialisation + atomic rename happen on that same thread.
+      The queue between the loop and the writer is bounded
+      (``pipeline_depth`` segments) with blocking backpressure, so a slow
+      disk cannot grow host memory without bound; writer failures propagate
+      to the caller, and an in-flight/fsync barrier runs at preemption and
+      at run end so the durability and bit-identical-resume guarantees are
+      unchanged.  The draw stream is device-side only, so draws are
+      bit-identical with the pipeline on or off; ``pipeline=False`` keeps
+      the fully serialised loop.  Per-run host-loop counters land in
+      ``Posterior.io_stats``.
     - ``init_keys`` resumes the per-chain RNG key stream from a checkpoint
       (requires ``init_state``); without it a resumed run draws a fresh
       stream seeded from (seed, carried iteration).
     - ``progress_callback(samples_done, samples_total)`` is invoked on the
       host after every compiled segment (cumulative counts when continuing a
-      checkpointed run); exceptions propagate and abort the run — the
-      fault-injection harness uses this to simulate device loss.
+      checkpointed run; burn-in segments report ``samples_done`` still at
+      its pre-sampling value); exceptions propagate and abort the run — the
+      fault-injection harness uses this to simulate device loss.  Any
+      checkpoint already submitted for the boundary is drained to disk
+      before the error escapes.
     """
     import time
 
@@ -424,6 +569,12 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
     state0 = jax.tree.map(
         lambda x: jnp.asarray(x, dtype=x.dtype) if hasattr(x, "dtype") else x,
         state0)
+    if init_state is not None:
+        # the compiled runner donates its carry: the first segment would
+        # consume (invalidate) the caller's init_state arrays — hand the
+        # runner a private copy instead
+        state0 = jax.tree.map(
+            lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, state0)
 
     # structural gates for the opt-in collapsed updaters (reference
     # auto-gating, sampleMcmc.R:123-152; see updaters_marginal)
@@ -506,6 +657,10 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
     if ck_every and checkpoint_path is None:
         raise ValueError("checkpoint_every requires checkpoint_path "
                          "(a directory for the rotating snapshots)")
+    archive_every = int(checkpoint_archive_every or 0)
+    if archive_every < 0:
+        raise ValueError("checkpoint_archive_every must be >= 0, "
+                         f"got {archive_every}")
     if checkpoint_path is not None and ck_every == 0:
         ck_every = int(samples)       # single snapshot at completion
     if int(samples) == 0:
@@ -520,6 +675,30 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
     seg_sizes = [b - a for a, b in zip([0] + cuts[:-1], cuts)]
     ck_marks = ({m for m in cuts if m % ck_every == 0} | {int(samples)}
                 if ck_every else set())
+    # burn-in segmentation (ROADMAP: a kill during a long transient used to
+    # lose all of it): whenever a host boundary exists at all, the transient
+    # scan is segmented on the same cadences — `verbose` sweeps for progress,
+    # `checkpoint_every * thin` sweeps for state-only burn-in snapshots.  The
+    # carried key makes this segmentation draw-invariant too.  With neither
+    # feature on, the transient stays fused into the first sampling program.
+    t_cuts, t_ck_marks = [], set()
+    if int(transient) > 0 and (ck_every or verbose):
+        t_marks = {int(transient)}
+        if verbose:
+            t_marks.update(range(int(verbose), int(transient), int(verbose)))
+        if ck_every:
+            t_step = max(1, ck_every * int(thin))
+            t_marks.update(range(t_step, int(transient), t_step))
+            t_ck_marks = {m for m in t_marks if m % t_step == 0}
+        t_cuts = sorted(t_marks)
+    # the segment plan: (transient sweeps, recorded samples) per compiled
+    # chunk.  Pure burn-in segments record nothing (samples=0); the first
+    # sampling segment carries any unsegmented transient remainder.
+    plan = [(t, 0) for t in
+            (b - a for a, b in zip([0] + t_cuts[:-1], t_cuts))]
+    rem_transient = 0 if t_cuts else int(transient)
+    plan += [(rem_transient if i == 0 else 0, s)
+             for i, s in enumerate(seg_sizes)]
     total_it = it0 + int(transient) + int(samples) * int(thin)
 
     base_post = _ckpt_base            # prior segments of a resumed run
@@ -575,10 +754,10 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
            else contextlib.nullcontext())
     try:
       with ctx:
-        pending = []                  # packed-but-unfetched segments
+        import os
+
         host_segs = []                # fetched host record trees, in order
         state_cur = state0
-        trans_cur = int(transient)
         skip_z = init_state is not None
         bad_cur = jnp.full((n_chains,), -1, dtype=jnp.int32)
         if rng_impl is None:
@@ -593,39 +772,102 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
             if init_state is None:
                 raise ValueError("init_keys requires init_state (both come "
                                  "from the same checkpoint)")
-            keys = init_keys
-            if int(keys.shape[0]) != n_chains:
+            if int(init_keys.shape[0]) != n_chains:
                 raise ValueError(
-                    f"init_keys carries {int(keys.shape[0])} chain keys, "
-                    f"n_chains={n_chains}")
+                    f"init_keys carries {int(init_keys.shape[0])} chain "
+                    f"keys, n_chains={n_chains}")
+            # private copy: the donated carry must not consume the caller's
+            keys = jnp.copy(init_keys)
         else:
             keys = jax.vmap(lambda s: jax.random.key(s, impl=rng_impl))(
                 jnp.asarray(chain_seeds))
         if sharding is not None:
             keys = jax.device_put(keys, sharding)
 
-        def _flush_pending():
-            while pending:
-                host_segs.append(_unpack_records(*pending.pop(0)))
+        # the bounded background writer: segment fetches and checkpoint
+        # serialisation run here while the next segment computes on-device
+        writer = (_SegmentWriter(int(pipeline_depth)) if pipeline
+                  else _InlineWriter())
+        n_ck_writes = 0               # snapshot ordinal (archive cadence)
+
+        def _collect(packed):
+            host_segs.append(_unpack_records(*packed))
+
+        def _merge_segs():
             if len(host_segs) > 1:    # fold so repeat snapshots stay linear
                 merged = jax.tree.map(
                     lambda *xs: np.concatenate(xs, axis=1), *host_segs)
                 host_segs[:] = [merged]
 
-        def _write_ck(done_now, post_override=None, state_override=None):
+        def _snap_carry():
+            """On-device copies of the carry for an in-flight checkpoint:
+            the next segment DONATES (invalidates) the live carry buffers,
+            so the writer thread must fetch from copies dispatched before
+            that.  Keys are snapshotted as raw uint32 key data."""
+            st = jax.tree.map(
+                lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x,
+                state_cur)
+            kd = jnp.array(jax.random.key_data(keys))
+            return st, kd, jnp.copy(bad_cur)
+
+        def _run_meta(done_now):
+            return {
+                "samples_total": base_samples + int(samples),
+                "samples_done": base_samples + done_now,
+                "transient": int(base_post.transient if base_post is not None
+                                 else _transient_base + int(transient)),
+                "thin": int(thin), "n_chains": int(n_chains),
+                "seed": None if seed is None else int(seed),
+                "nf_cap": int(nf_cap), "rng_impl": rng_impl,
+                "adapt_nf": [int(a) for a in adapt_nf],
+                "dtype": np.dtype(dtype).name,
+                "record": list(record) if record is not None else None,
+                "record_dtype": (None if record_dtype is None
+                                 else np.dtype(record_dtype).name),
+                "updater": dict(updater) if updater else None,
+                "retry_diverged": int(retry_diverged),
+                "align_post": bool(align_post),
+                "checkpoint_every": ck_every,
+                "checkpoint_keep": int(checkpoint_keep),
+                "checkpoint_max_age_s": checkpoint_max_age_s,
+                "checkpoint_archive_every": archive_every,
+            }
+
+        def _finish_ck(path, partial, state_arg, keys_arg, meta, ordinal):
+            from ..utils import checkpoint as _ck
+            _ck.save_checkpoint(path, partial, state_arg, keys=keys_arg,
+                                keys_impl=rng_impl, run_meta=meta)
+            _ck.rotate_checkpoints(ck_dir, int(checkpoint_keep),
+                                   max_age_s=checkpoint_max_age_s)
+            if archive_every and ordinal % archive_every == 0:
+                # hard-link (copy fallback) into archive/, exempt from
+                # rotation — post-hoc divergence debugging
+                adir = os.path.join(ck_dir, "archive")
+                os.makedirs(adir, exist_ok=True)
+                apath = os.path.join(adir, os.path.basename(path))
+                try:
+                    if os.path.exists(apath):
+                        os.unlink(apath)
+                    os.link(path, apath)
+                except OSError:
+                    import shutil
+                    shutil.copy2(path, apath)
+
+        def _write_ck(done_now, state_snap, keys_snap, bad_snap, ordinal,
+                      post_override=None, state_override=None):
             """Snapshot draws-so-far (prepending a resumed run's base
             segment) + carry state + carried keys; atomic write, rotate.
+            Runs on the writer thread (FIFO after all prior segment
+            collects) from on-device carry snapshots.
             ``post_override``/``state_override`` re-write a slot from an
             already-built posterior and spliced carry state (the
             retry_diverged splice re-writes the final one)."""
-            import os
-
             from ..post.posterior import Posterior as _P
             from ..utils import checkpoint as _ck
             if post_override is None:
-                _flush_pending()
+                _merge_segs()
                 arrays = {k: np.asarray(v) for k, v in host_segs[0].items()}
-                fb = np.asarray(bad_cur)
+                fb = np.asarray(bad_snap)
             else:
                 arrays = {k: np.asarray(v)
                           for k, v in post_override.arrays.items()}
@@ -640,94 +882,135 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
             partial = _P(hM, spec, arrays, samples=base_samples + done_now,
                          transient=int(base_post.transient
                                        if base_post is not None
-                                       else transient), thin=int(thin))
+                                       else _transient_base + int(transient)),
+                         thin=int(thin))
             if base_post is not None:
                 fb0 = np.asarray(base_post.chain_health["first_bad_it"])
                 fb = np.where(fb0 >= 0, fb0, fb)
             partial.set_chain_health(fb)
             partial.nf_saturation = (
                 dict(post_override.nf_saturation) if post_override is not None
-                else {r: np.asarray(state_cur.levels[r].nf_sat).reshape(-1)
+                else {r: np.asarray(state_snap.levels[r].nf_sat).reshape(-1)
                       for r in range(spec.nr)})
-            meta = {
-                "samples_total": base_samples + int(samples),
-                "samples_done": base_samples + done_now,
-                "transient": int(base_post.transient if base_post is not None
-                                 else transient),
-                "thin": int(thin), "n_chains": int(n_chains),
-                "seed": None if seed is None else int(seed),
-                "nf_cap": int(nf_cap), "rng_impl": rng_impl,
-                "adapt_nf": [int(a) for a in adapt_nf],
-                "dtype": np.dtype(dtype).name,
-                "record": list(record) if record is not None else None,
-                "record_dtype": (None if record_dtype is None
-                                 else np.dtype(record_dtype).name),
-                "updater": dict(updater) if updater else None,
-                "retry_diverged": int(retry_diverged),
-                "align_post": bool(align_post),
-                "checkpoint_every": ck_every,
-                "checkpoint_keep": int(checkpoint_keep),
-            }
             path = os.path.join(
                 ck_dir, f"ckpt-{base_samples + done_now:08d}.npz")
-            _ck.save_checkpoint(
-                path, partial,
-                state_cur if state_override is None else state_override,
-                keys=keys, keys_impl=rng_impl, run_meta=meta)
-            _ck.rotate_checkpoints(ck_dir, int(checkpoint_keep))
+            _finish_ck(path, partial,
+                       state_snap if state_override is None else state_override,
+                       keys_snap, _run_meta(done_now), ordinal)
+            return path
+
+        def _write_burnin_ck(it_now, state_snap, keys_snap, bad_snap,
+                             ordinal):
+            """State-only burn-in snapshot (carry + keys, no draws): a kill
+            during a long transient resumes from here instead of restarting
+            burn-in from scratch."""
+            from ..post.posterior import Posterior as _P
+            partial = _P(hM, spec, {}, samples=0,
+                         transient=_transient_base + int(transient),
+                         thin=int(thin))
+            partial.n_chains = int(n_chains)
+            partial.set_chain_health(np.asarray(bad_snap))
+            partial.nf_saturation = {
+                r: np.asarray(state_snap.levels[r].nf_sat).reshape(-1)
+                for r in range(spec.nr)}
+            meta = _run_meta(0)
+            meta["transient_done"] = int(it_now)
+            path = os.path.join(ck_dir, f"ckpt-t{it_now:08d}.npz")
+            _finish_ck(path, partial, state_snap, keys_snap, meta, ordinal)
+            return path
+
+        def _submit_ck(in_burnin, done_now, it_now):
+            nonlocal n_ck_writes
+            n_ck_writes += 1
+            st, kd, bd = _snap_carry()
+            if in_burnin:
+                path = os.path.join(ck_dir, f"ckpt-t{it_now:08d}.npz")
+                writer.submit(functools.partial(
+                    _write_burnin_ck, it_now, st, kd, bd, n_ck_writes))
+            else:
+                path = os.path.join(
+                    ck_dir, f"ckpt-{base_samples + done_now:08d}.npz")
+                writer.submit(functools.partial(
+                    _write_ck, done_now, st, kd, bd, n_ck_writes))
             return path
 
         done = 0
-        for si, seg in enumerate(seg_sizes):
+        sweeps_done = 0
+        n_burn = len(t_cuts)          # leading plan entries are pure burn-in
+        for si, (trans_seg, seg) in enumerate(plan):
+            in_burnin = si < n_burn
             fn = _compiled_runner(spec, updater_items, adapt_nf, seg,
-                                  trans_cur, int(thin), skip_z, record,
+                                  trans_seg, int(thin), skip_z, record,
                                   spatial._NNGP_DENSE_MAX)
-            recs, state_cur, bad_cur, keys = fn(data, state_cur, keys, bad_cur)
-            # pack now (async on device); fetch at the next snapshot or at
-            # the end.  Drop the original record tree immediately — keeping
-            # it alive through the fetch would double record HBM (the pack
-            # holds the only live copy)
-            pending.append(_pack_records(recs, record_dtype))
-            del recs
-            done += int(seg)
-            trans_cur = 0
+            recs, state_cur, bad_cur, keys = fn(data, state_cur, keys,
+                                                bad_cur)
             skip_z = True
+            sweeps_done += trans_seg + int(seg) * int(thin)
+            if not in_burnin:
+                # pack now (async on device); the writer thread forces the
+                # device→host fetch while the next segment computes.  Drop
+                # the original record tree immediately — keeping it alive
+                # through the fetch would double record HBM (the pack holds
+                # the only live copy)
+                writer.submit(functools.partial(
+                    _collect, _pack_records(recs, record_dtype)))
+                del recs
+                done += int(seg)
             if verbose:
-                it_now = int(np.asarray(state_cur.it).ravel()[0])
-                phase = "sampling" if it_now > it0 + transient else "transient"
+                it_now = it0 + sweeps_done
+                phase = ("sampling" if it_now > it0 + int(transient)
+                         else "transient")
                 print(f"iteration {it_now} of {total_it} ({phase})")
             wrote = None
-            if ck_every and (done in ck_marks or preempt["signum"] is not None):
-                wrote = _write_ck(done)
+            at_mark = (sweeps_done in t_ck_marks if in_burnin
+                       else done in ck_marks)
+            if ck_every and (at_mark or preempt["signum"] is not None):
+                wrote = _submit_ck(in_burnin, done, it0 + sweeps_done)
             if progress_callback is not None:
                 progress_callback(base_samples + done,
                                   base_samples + int(samples))
             if preempt["signum"] is not None:
                 if ck_every and wrote is None:
-                    wrote = _write_ck(done)
+                    wrote = _submit_ck(in_burnin, done, it0 + sweeps_done)
+                # durability barrier: the snapshot (and every pending write)
+                # is fsync-complete before the preemption unwinds
+                writer.barrier()
                 from ..utils.checkpoint import PreemptedRun
+                progress = (f"{it0 + sweeps_done} of {total_it} burn-in "
+                            "sweeps" if in_burnin else
+                            f"{base_samples + done} of "
+                            f"{base_samples + int(samples)} recorded samples")
                 raise PreemptedRun(
                     f"run preempted by signal {preempt['signum']} after "
-                    f"{base_samples + done} of {base_samples + int(samples)} "
-                    f"recorded samples; resumable checkpoint: {wrote} "
+                    f"{progress}; resumable checkpoint: {wrote} "
                     "(continue with resume_run or "
                     "`python -m hmsc_tpu run --resume`)",
                     checkpoint_path=wrote,
                     samples_done=base_samples + done,
                     signum=preempt["signum"])
         final_state = state_cur
-        _flush_pending()
+        writer.barrier()              # all fetches + snapshots complete
+        _merge_segs()
         recs = host_segs[0]
     finally:
+        try:
+            writer.shutdown()         # drain in-flight writes even on error
+        except NameError:
+            pass                      # failed before the writer existed
         if restore_handlers:
             import signal as _signal
             for s, h in restore_handlers:
                 _signal.signal(s, h)
     t2 = time.perf_counter()
+    io_stats = {"pipeline": bool(pipeline), "segments": len(plan),
+                "checkpoints": n_ck_writes,
+                "max_queue_depth": writer.max_depth_seen,
+                "writer_busy_s": writer.busy_s}
 
-    post = Posterior(hM, spec, recs, samples=samples, transient=transient,
-                     thin=thin)
+    post = Posterior(hM, spec, recs, samples=samples,
+                     transient=_transient_base + int(transient), thin=thin)
     post.timing = {"setup_s": t1 - t0, "run_s": t2 - t1}
+    post.io_stats = io_stats
 
     # divergence observability + containment: report each poisoned chain's
     # first non-finite sweep and exclude it from pooled summaries (a user
@@ -815,7 +1098,8 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
             # spliced (healthy) posterior and any extension continues from
             # the replacement chains' healthy carry, not the poisoned one
             post.nf_saturation = nf_sat_counts
-            _write_ck(int(samples), post_override=post,
+            _write_ck(int(samples), final_state, keys, first_bad,
+                      n_ck_writes, post_override=post,
                       state_override=final_state)
 
     # factor-cap observability: warn when burn-in adaptation wanted to add

@@ -27,7 +27,8 @@ from ..model import FIXED_SIGMA2, Hmsc
 from ..precompute import DataParams, compute_initial_parameters
 
 __all__ = ["LevelSpec", "ModelSpec", "LevelData", "ModelData", "LevelState",
-           "GibbsState", "build_model_data", "build_state", "DEFAULT_NF_CAP"]
+           "GibbsState", "build_model_data", "build_state", "state_nbytes",
+           "DEFAULT_NF_CAP"]
 
 # static cap on latent factors per level (reference grows nf up to ns,
 # updateNf.R:26; static XLA shapes need a concrete bound)
@@ -185,6 +186,16 @@ class GibbsState(struct.PyTreeNode):
 
 
 # ---------------------------------------------------------------------------
+
+def state_nbytes(state) -> int:
+    """Total bytes of a carry pytree (all chains).  The sampler's segment
+    runner donates its carry buffers, so steady-state HBM holds exactly ONE
+    copy of this — the pre-donation footprint was two (input + output);
+    ``benchmarks/bench_host_loop.py`` and the pipeline tests report it."""
+    import jax
+    return sum(int(x.nbytes) for x in jax.tree_util.tree_leaves(state)
+               if hasattr(x, "nbytes"))
+
 
 def build_spec(hM: Hmsc, nf_cap: int = DEFAULT_NF_CAP) -> ModelSpec:
     level_specs = []

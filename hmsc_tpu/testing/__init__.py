@@ -4,7 +4,9 @@ drill kill → resume recovery against their own models, not just the test
 suite's."""
 
 from .faults import (InjectedFault, InjectedDeviceLoss, device_loss_after,
-                     flip_bytes, inject_nan, sigterm_after)
+                     failing_checkpoint_writes, flip_bytes, inject_nan,
+                     sigterm_after, slow_checkpoint_writes)
 
 __all__ = ["InjectedFault", "InjectedDeviceLoss", "device_loss_after",
-           "flip_bytes", "inject_nan", "sigterm_after"]
+           "failing_checkpoint_writes", "flip_bytes", "inject_nan",
+           "sigterm_after", "slow_checkpoint_writes"]

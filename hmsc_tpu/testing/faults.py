@@ -19,7 +19,8 @@ import signal
 import numpy as np
 
 __all__ = ["InjectedFault", "InjectedDeviceLoss", "inject_nan",
-           "device_loss_after", "sigterm_after", "flip_bytes"]
+           "device_loss_after", "sigterm_after", "flip_bytes",
+           "slow_checkpoint_writes", "failing_checkpoint_writes"]
 
 
 class InjectedFault(RuntimeError):
@@ -70,7 +71,9 @@ def device_loss_after(samples_done: int):
     """Progress callback raising :class:`InjectedDeviceLoss` once the run
     has recorded ``samples_done`` samples — simulating losing the device
     between two compiled segments.  The auto-checkpoint for that boundary is
-    written *before* the callback fires, so ``resume_run`` recovers from it.
+    submitted before the callback fires and the sampler drains its writer
+    thread before unwinding, so the snapshot is durably on disk by the time
+    the error escapes ``sample_mcmc`` and ``resume_run`` recovers from it.
     """
     def cb(done, total):
         if done >= samples_done:
@@ -91,6 +94,53 @@ def sigterm_after(samples_done: int):
             fired["done"] = True
             os.kill(os.getpid(), signal.SIGTERM)
     return cb
+
+
+@contextlib.contextmanager
+def slow_checkpoint_writes(delay_s: float):
+    """Make every checkpoint payload write sleep ``delay_s`` first — a
+    slow-disk rehearsal for the pipelined sampler's backpressure path: the
+    background writer falls behind, its bounded queue fills, and the
+    segment loop must block (not buffer unboundedly) until the disk
+    catches up.  Patches ``utils.checkpoint._atomic_savez``, which both
+    sample and burn-in snapshots go through."""
+    import time
+
+    from ..utils import checkpoint as ck
+
+    real = ck._atomic_savez
+
+    def slow(path, payload, **kw):
+        time.sleep(delay_s)
+        real(path, payload, **kw)
+
+    ck._atomic_savez = slow
+    try:
+        yield
+    finally:
+        ck._atomic_savez = real
+
+
+@contextlib.contextmanager
+def failing_checkpoint_writes(exc: BaseException | None = None):
+    """Make every checkpoint write raise (default: ``OSError`` — a full
+    disk).  The write happens on the sampler's background writer thread;
+    this proves the failure is captured there and re-raised on the driver
+    thread instead of being silently swallowed with the run reporting
+    success over checkpoints that do not exist."""
+    from ..utils import checkpoint as ck
+
+    real = ck._atomic_savez
+
+    def failing(path, payload, **kw):
+        raise exc if exc is not None else OSError(
+            f"injected checkpoint write failure for {path} (disk full)")
+
+    ck._atomic_savez = failing
+    try:
+        yield
+    finally:
+        ck._atomic_savez = real
 
 
 def flip_bytes(path: str, n: int = 16, offset: int | None = None,

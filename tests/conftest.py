@@ -19,6 +19,19 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent on-disk XLA compilation cache: the suite is compile-dominated
+# (hundreds of tiny programs), and the cache-purge fixture below drops the
+# *in-memory* executables between modules, forcing recompiles of the same
+# programs.  The disk cache is keyed on the HLO content hash, so hits are
+# correct by construction (donation/aliasing live in the HLO), repeated
+# programs compile once per container instead of once per module, and the
+# second full run of the suite is dramatically faster than the first.
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("HMSC_TEST_XLA_CACHE",
+                                 "/tmp/hmsc_tpu_xla_cache"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -130,7 +130,9 @@ def test_corrupt_checkpoint_rejected_and_fallback(tmp_path, model, full_post):
     with pytest.raises(InjectedDeviceLoss):
         sample_mcmc(model, **RUN_KW, checkpoint_every=4, checkpoint_path=d,
                     progress_callback=device_loss_after(8))
-    assert len(checkpoint_files(d)) == 2            # slots 4 and 8
+    # slots 4 and 8, plus the burn-in (state-only) snapshot at sweep 4
+    assert [os.path.basename(p) for p in checkpoint_files(d)] == \
+        ["ckpt-00000008.npz", "ckpt-00000004.npz", "ckpt-t00000004.npz"]
     newest = checkpoint_files(d)[0]
     flip_bytes(newest)
 

@@ -1,0 +1,322 @@
+"""Pipelined host-loop suite: donated carries, the background segment
+writer (double-buffered device→host streaming + off-critical-path
+checkpoint writes), segmented burn-in with state-only snapshots, rotation
+policies, and resume overrides.
+
+The acceptance bar: the draw stream is *bit-identical* for every
+(pipelining × segmentation × checkpoint cadence) combination — the carried
+per-chain key makes segmentation draw-invariant, and the pipeline only
+moves host-side work, so any difference is a bug.  Writer failures must
+reach the driver, backpressure must bound host memory, and a preemption
+mid-flight must drain cleanly.
+
+Deliberately fast (tier-1): one tiny model config; the variants are chosen
+to share the same compiled segment programs wherever possible (same
+segment sizes → same static config → cache hit).
+"""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from hmsc_tpu import PreemptedRun, sample_mcmc, resume_run
+from hmsc_tpu.utils.checkpoint import (checkpoint_files, load_checkpoint_full,
+                                       rotate_checkpoints)
+from hmsc_tpu.testing import (InjectedDeviceLoss, device_loss_after,
+                              failing_checkpoint_writes, sigterm_after,
+                              slow_checkpoint_writes)
+
+from util import small_model
+
+pytestmark = pytest.mark.pipeline
+
+M_KW = dict(ny=24, ns=3, nc=2, distr="normal", n_units=5, seed=3)
+RUN_KW = dict(samples=8, transient=4, thin=1, n_chains=2, seed=7, nf_cap=2,
+              align_post=False)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return small_model(**M_KW)
+
+
+@pytest.fixture(scope="module")
+def ref_run(model, tmp_path_factory):
+    """(posterior, checkpoint dir) of the pipelined + checkpointed
+    reference run every variant must reproduce bit-exactly (its own
+    equality with an unsegmented plain run is proven by the
+    fault-tolerance module's slow test).  The directory is kept so tests
+    can inspect the snapshots without paying another run."""
+    d = os.fspath(tmp_path_factory.mktemp("ref") / "ck")
+    return sample_mcmc(model, **RUN_KW, checkpoint_every=4,
+                       checkpoint_path=d), d
+
+
+@pytest.fixture(scope="module")
+def ref_post(ref_run):
+    return ref_run[0]
+
+
+def _assert_bit_identical(post, ref):
+    assert set(post.arrays) == set(ref.arrays)
+    for k in ref.arrays:
+        np.testing.assert_array_equal(post.arrays[k], ref.arrays[k],
+                                      err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: pipelining on/off, any segmentation
+# ---------------------------------------------------------------------------
+
+def test_pipeline_off_bit_identical(tmp_path, model, ref_post):
+    """pipeline=False serialises the host loop (inline writer, no overlap);
+    the draw stream is device-side only, so draws must not change."""
+    d = os.fspath(tmp_path / "ck")
+    post = sample_mcmc(model, **RUN_KW, checkpoint_every=4,
+                       checkpoint_path=d, pipeline=False)
+    assert post.io_stats["pipeline"] is False
+    _assert_bit_identical(post, ref_post)
+
+
+def test_io_stats_reported(ref_post):
+    st = ref_post.io_stats
+    assert st["pipeline"] is True
+    # burn-in segment + two sampling segments; burn-in + two sample snapshots
+    assert st["segments"] == 3 and st["checkpoints"] == 3
+    assert st["max_queue_depth"] >= 1 and st["writer_busy_s"] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# donated carries
+# ---------------------------------------------------------------------------
+
+def test_segment_runner_donates_carry(model):
+    """The jitted segment runner donates state/keys/divergence-tracker
+    (argnums 1..3): every carry leaf must carry an input→output alias in
+    the lowering, so the scan carry is updated in place (one copy of the
+    state pytree in HBM, not two)."""
+    import jax
+    import jax.numpy as jnp
+
+    from hmsc_tpu.mcmc import sampler as sampler_mod
+    from hmsc_tpu.mcmc import spatial
+    from hmsc_tpu.precompute import compute_data_parameters
+    from hmsc_tpu.mcmc.structs import (build_model_data, build_spec,
+                                       build_state)
+
+    spec = build_spec(model, 2)
+    data = build_model_data(model, compute_data_parameters(model), spec)
+    states = [build_state(model, spec, s) for s in (0, 1)]
+    state = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+    keys = jax.vmap(lambda s: jax.random.key(s, impl="threefry2x32"))(
+        jnp.arange(2))
+    bad = jnp.full((2,), -1, jnp.int32)
+
+    fn = sampler_mod._compiled_runner(
+        spec, None, (RUN_KW["transient"],), 4, 0, 1, True, None,
+        spatial._NNGP_DENSE_MAX)
+    txt = fn.lower(data, state, keys, bad).as_text()
+    n_carry_leaves = len(jax.tree_util.tree_leaves(state))
+    # + 2: the key array and the divergence tracker are donated too
+    assert txt.count("tf.aliasing_output") >= n_carry_leaves + 2
+
+
+def test_caller_init_state_survives_donation(tmp_path, model):
+    """Donation must consume a *private copy*: the caller's init_state (and
+    init_keys) stay readable after the run (they may be reused)."""
+    import jax
+
+    d = os.fspath(tmp_path / "ck")       # checkpointed: reuses the module's
+    post1, state = sample_mcmc(model, **RUN_KW, checkpoint_every=4,
+                               checkpoint_path=d, return_state=True)
+    a = sample_mcmc(model, samples=4, transient=0, adapt_nf=4, n_chains=2,
+                    seed=2, nf_cap=2, init_state=state, align_post=False)
+    # a second run from the SAME state object: donation of the caller's
+    # buffers would raise on deleted arrays / change the draws
+    b = sample_mcmc(model, samples=4, transient=0, adapt_nf=4, n_chains=2,
+                    seed=2, nf_cap=2, init_state=state, align_post=False)
+    _assert_bit_identical(a, b)
+    for leaf in jax.tree_util.tree_leaves(state):
+        assert np.asarray(leaf) is not None     # still fetchable
+
+
+# ---------------------------------------------------------------------------
+# writer thread: exception propagation, backpressure, preemption drain
+# ---------------------------------------------------------------------------
+
+def test_writer_failure_propagates_to_driver(tmp_path, model):
+    """A checkpoint write failing on the writer thread (disk full) must
+    abort the run with the original exception — never a silent success over
+    snapshots that do not exist."""
+    d = os.fspath(tmp_path / "ck")
+    with failing_checkpoint_writes():
+        with pytest.raises(OSError, match="injected checkpoint write"):
+            sample_mcmc(model, **RUN_KW, checkpoint_every=4,
+                        checkpoint_path=d)
+
+
+def test_backpressure_bounds_queue(tmp_path, model, ref_post):
+    """With an artificially slow disk the bounded queue must block the
+    segment loop (backpressure) instead of buffering unboundedly — and the
+    draws still come out bit-identical."""
+    d = os.fspath(tmp_path / "ck")
+    with slow_checkpoint_writes(0.15):
+        post = sample_mcmc(model, **RUN_KW, checkpoint_every=4,
+                           checkpoint_path=d, pipeline_depth=1)
+    assert post.io_stats["max_queue_depth"] <= 1
+    _assert_bit_identical(post, ref_post)
+
+
+def test_sigterm_mid_flight_drains_cleanly(tmp_path, model, ref_post):
+    """SIGTERM while the writer is busy: the in-flight segment finishes,
+    all queued writes (including the final snapshot) drain through the
+    fsync barrier before PreemptedRun unwinds — no torn tmp files, and the
+    snapshot resumes bit-exactly."""
+    d = os.fspath(tmp_path / "ck")
+    prev = signal.getsignal(signal.SIGTERM)
+    with slow_checkpoint_writes(0.1):
+        with pytest.raises(PreemptedRun) as ei:
+            sample_mcmc(model, **RUN_KW, checkpoint_every=4,
+                        checkpoint_path=d,
+                        progress_callback=sigterm_after(4))
+    assert signal.getsignal(signal.SIGTERM) is prev
+    assert ei.value.checkpoint_path.endswith("ckpt-00000004.npz")
+    assert os.path.exists(ei.value.checkpoint_path)      # drained, durable
+    assert not [f for f in os.listdir(d) if ".tmp" in f]
+    res = resume_run(model, d)
+    _assert_bit_identical(res, ref_post)
+
+
+# ---------------------------------------------------------------------------
+# segmented burn-in: state-only snapshots, kill → resume mid-transient
+# ---------------------------------------------------------------------------
+
+def test_burnin_snapshot_written_and_loadable(ref_run, model):
+    _, d = ref_run                       # inspect the fixture's snapshots
+    names = [os.path.basename(p) for p in checkpoint_files(d)]
+    # burn-in snapshot sorts below every sample snapshot
+    assert names == ["ckpt-00000008.npz", "ckpt-00000004.npz",
+                     "ckpt-t00000004.npz"]
+    ck = load_checkpoint_full(checkpoint_files(d)[-1], model)
+    assert ck.post.arrays == {} and ck.post.n_chains == 2
+    assert ck.run_meta["samples_done"] == 0
+    assert ck.run_meta["transient_done"] == 4
+    assert ck.keys is not None
+
+
+def test_kill_during_burnin_resume_bit_exact(tmp_path, model):
+    """Acceptance for the ROADMAP gap: a kill during a long transient no
+    longer loses the burn-in done so far — resume continues mid-transient
+    and reproduces the uninterrupted run's draws bit-exactly."""
+    kw = dict(samples=8, transient=8, thin=1, n_chains=2, seed=7, nf_cap=2,
+              align_post=False, adapt_nf=4)
+    d_ref = os.fspath(tmp_path / "ref")
+    ref = sample_mcmc(model, **kw, checkpoint_every=4, checkpoint_path=d_ref)
+
+    d = os.fspath(tmp_path / "ck")
+    with pytest.raises(PreemptedRun) as ei:
+        sample_mcmc(model, **kw, checkpoint_every=4, checkpoint_path=d,
+                    progress_callback=sigterm_after(0))
+    assert ei.value.samples_done == 0
+    assert ei.value.checkpoint_path.endswith("ckpt-t00000004.npz")
+    assert "burn-in sweeps" in str(ei.value)
+
+    res = resume_run(model, d)
+    assert res.samples == 8 and res.transient == 8
+    _assert_bit_identical(res, ref)
+
+
+# ---------------------------------------------------------------------------
+# resume overrides: cadence/verbosity re-segment, never change draws
+# ---------------------------------------------------------------------------
+
+def test_resume_overrides_do_not_change_draws(tmp_path, model, ref_post):
+    d = os.fspath(tmp_path / "ck")
+    with pytest.raises(InjectedDeviceLoss):
+        sample_mcmc(model, **RUN_KW, checkpoint_every=4, checkpoint_path=d,
+                    progress_callback=device_loss_after(4))
+    res = resume_run(model, d, checkpoint_every=8, verbose=4)
+    _assert_bit_identical(res, ref_post)
+    # the override became the continuation's stored cadence
+    ck = load_checkpoint_full(checkpoint_files(d)[0], model)
+    assert ck.run_meta["checkpoint_every"] == 8
+
+    with pytest.raises(ValueError, match="checkpoint_every override"):
+        resume_run(model, d, checkpoint_every=-1)
+
+
+# ---------------------------------------------------------------------------
+# rotation policies: age-based deletion, archive-every-Nth
+# ---------------------------------------------------------------------------
+
+def test_rotate_checkpoints_age_policy(tmp_path):
+    d = os.fspath(tmp_path)
+    names = ["ckpt-t00000002.npz", "ckpt-00000004.npz", "ckpt-00000008.npz"]
+    for i, n in enumerate(names):
+        p = os.path.join(d, n)
+        with open(p, "wb") as f:
+            f.write(b"x")
+        os.utime(p, (1.0, 1.0) if i < 2 else None)   # two ancient, one fresh
+    # count policy alone keeps all three
+    rotate_checkpoints(d, keep=3)
+    assert len(checkpoint_files(d)) == 3
+    # age policy deletes the ancient ones inside the keep window — but the
+    # newest always survives, even if ancient
+    rotate_checkpoints(d, keep=3, max_age_s=3600)
+    assert [os.path.basename(p) for p in checkpoint_files(d)] == \
+        ["ckpt-00000008.npz"]
+    os.utime(os.path.join(d, "ckpt-00000008.npz"), (1.0, 1.0))
+    rotate_checkpoints(d, keep=3, max_age_s=3600)
+    assert len(checkpoint_files(d)) == 1
+
+
+def test_archive_every_nth_exempt_from_rotation(tmp_path, model, ref_post):
+    d = os.fspath(tmp_path / "ck")
+    post = sample_mcmc(model, **RUN_KW, checkpoint_every=4,
+                       checkpoint_path=d, checkpoint_keep=1,
+                       checkpoint_archive_every=2)
+    _assert_bit_identical(post, ref_post)
+    # keep=1 rotated everything but the final slot...
+    assert [os.path.basename(p) for p in checkpoint_files(d)] == \
+        ["ckpt-00000008.npz"]
+    # ...but every 2nd snapshot (write ordinals 2 = ckpt-4) was archived
+    # and survives rotation
+    assert sorted(os.listdir(os.path.join(d, "archive"))) == \
+        ["ckpt-00000004.npz"]
+
+
+# ---------------------------------------------------------------------------
+# the writer primitive itself (no MCMC: pure unit tests)
+# ---------------------------------------------------------------------------
+
+def test_segment_writer_fifo_and_error_delivery():
+    from hmsc_tpu.mcmc.sampler import _SegmentWriter
+
+    seen = []
+    w = _SegmentWriter(depth=2)
+    try:
+        for i in range(5):
+            w.submit(lambda i=i: seen.append(i))
+        w.barrier()
+        assert seen == [0, 1, 2, 3, 4]              # FIFO order
+
+        def boom():
+            raise RuntimeError("writer boom")
+        w.submit(boom)
+        with pytest.raises(RuntimeError, match="writer boom"):
+            w.barrier()
+        # after delivery the writer keeps working
+        w.submit(lambda: seen.append(99))
+        w.barrier()
+        assert seen[-1] == 99
+    finally:
+        w.shutdown()
+    w.shutdown()                                    # idempotent
+
+
+def test_segment_writer_rejects_bad_depth():
+    from hmsc_tpu.mcmc.sampler import _SegmentWriter
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        _SegmentWriter(depth=0)
