@@ -204,7 +204,19 @@ def main():
     probe_timeout = int(os.environ.get("HMSC_BENCH_PROBE_TIMEOUT_S", "180"))
     probe_retries = int(os.environ.get("HMSC_BENCH_PROBE_RETRIES", "3"))
     probe_wait = float(os.environ.get("HMSC_BENCH_PROBE_WAIT_S", "180"))
-    _transient = ("timed out", "connection", "unavailable", "deadline")
+    # transient = worth waiting out.  Classified by exception TYPE first
+    # (the probe runs in a subprocess, so a hang surfaces as
+    # subprocess.TimeoutExpired with no message to substring-match), then
+    # by message shape for errors that arrive stringified via stderr
+    import subprocess as _subprocess
+    _transient_types = (TimeoutError, ConnectionError,
+                        _subprocess.TimeoutExpired)
+    _transient_msgs = ("timed out", "connection", "unavailable", "deadline")
+
+    def _is_transient(e):
+        return (isinstance(e, _transient_types)
+                or any(s in str(e).lower() for s in _transient_msgs))
+
     plat, last, last_transient = None, None, False
     for attempt in range(max(1, probe_retries)):
         if attempt:
@@ -214,9 +226,11 @@ def main():
             break
         except Exception as e:                  # noqa: BLE001
             last = e
-            last_transient = any(s in str(e).lower() for s in _transient)
+            last_transient = _is_transient(e)
             print(f"bench.py: device probe attempt {attempt + 1}/"
-                  f"{probe_retries} failed ({e})", file=sys.stderr)
+                  f"{probe_retries} failed "
+                  f"({'transient' if last_transient else 'permanent'}: "
+                  f"{type(e).__name__}: {e})", file=sys.stderr)
             if not last_transient:
                 break                           # same-every-time failure
     if plat is None:

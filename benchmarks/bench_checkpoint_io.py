@@ -1,0 +1,132 @@
+"""Checkpoint-I/O scaling gate (CPU, fast): per-snapshot bytes written must
+be O(segment) — flat in run length — under the append-only layout.
+
+The legacy self-contained layout re-serialises the FULL draw history into
+every rotating snapshot, so per-snapshot bytes grow O(S) and total bytes
+O(S²) over a run; the background writer hides the cost only until a
+snapshot outweighs a segment's compute, which it inevitably does on exactly
+the long runs the north star cares about.  The append-only layout flushes
+each segment once as an immutable shard plus an O(state) state file and an
+O(#shards) manifest, so per-snapshot cost must not depend on how much
+history precedes it.
+
+Gate (ISSUE 3 acceptance): with the same cadence, the mean per-snapshot
+bytes of a 4x-longer append-layout run must be <= 1.1x the short run's —
+and the snapshots within the long run must themselves be flat (max <= 1.1x
+min).  The legacy layout is measured alongside for the contrast ratios and
+the ``Posterior.io_stats`` deltas; its growth is reported, not gated (it is
+the known-bad baseline).
+
+Runs on any backend (defaults to CPU); prints one JSON line per measurement
+plus a summary line in the driver contract shape.
+Usage:  python benchmarks/bench_checkpoint_io.py [--samples N] [--cadence N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _model(ny, ns, nf):
+    from hmsc_tpu.bench_cli import _model as cli_model
+    return cli_model(ny, ns, nf)
+
+
+def _run(hM, layout, samples, cadence, chains, nf):
+    from hmsc_tpu.mcmc.sampler import sample_mcmc
+    with tempfile.TemporaryDirectory() as d:
+        post = sample_mcmc(hM, samples=samples, transient=10,
+                           n_chains=chains, seed=0, nf_cap=nf,
+                           align_post=False, checkpoint_every=cadence,
+                           checkpoint_path=d, checkpoint_layout=layout)
+    return post
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="checkpoint I/O scaling gate")
+    ap.add_argument("--ny", type=int, default=200)
+    ap.add_argument("--ns", type=int, default=60)
+    ap.add_argument("--nf", type=int, default=2)
+    ap.add_argument("--samples", type=int, default=48,
+                    help="short-run recorded samples; the long run is 4x")
+    ap.add_argument("--cadence", type=int, default=12)
+    ap.add_argument("--chains", type=int, default=2)
+    ap.add_argument("--tolerance", type=float, default=1.1,
+                    help="flatness bound: long-run mean per-snapshot bytes "
+                         "<= tolerance x short-run mean (and max <= "
+                         "tolerance x min within the long run)")
+    args = ap.parse_args(argv)
+    if args.samples % args.cadence:
+        ap.error("--samples must be a multiple of --cadence (equal-size "
+                 "segments keep the compiled program shared)")
+
+    hM = _model(args.ny, args.ns, args.nf)
+    runs = {}
+    for layout in ("append", "rotating"):
+        for mult, tag in ((1, "short"), (4, "long")):
+            post = _run(hM, layout, args.samples * mult, args.cadence,
+                        args.chains, args.nf)
+            st = post.io_stats
+            # sample snapshots only (this config writes no burn-in
+            # snapshots: transient < cadence*thin)
+            snaps = st["snapshot_bytes"]
+            runs[(layout, tag)] = {
+                "snapshots": len(snaps),
+                "per_snapshot_mean": float(np.mean(snaps)),
+                "per_snapshot_min": int(min(snaps)),
+                "per_snapshot_max": int(max(snaps)),
+                "bytes_written": st["bytes_written"],
+                "shards_written": st["shards_written"],
+                "writer_busy_s": round(st["writer_busy_s"], 4),
+            }
+            print(json.dumps({"metric": f"checkpoint io ({layout}, {tag} "
+                                        f"run, {args.samples * mult} samples,"
+                                        f" cadence {args.cadence})",
+                              **runs[(layout, tag)]}))
+
+    a_s, a_l = runs[("append", "short")], runs[("append", "long")]
+    r_s, r_l = runs[("rotating", "short")], runs[("rotating", "long")]
+
+    flat_across = a_l["per_snapshot_mean"] / a_s["per_snapshot_mean"]
+    flat_within = a_l["per_snapshot_max"] / a_l["per_snapshot_min"]
+    legacy_growth = r_l["per_snapshot_max"] / r_l["per_snapshot_min"]
+    total_ratio = r_l["bytes_written"] / a_l["bytes_written"]
+    ok = flat_across <= args.tolerance and flat_within <= args.tolerance
+    # sanity: the contrast must actually show the O(S) pathology, or the
+    # gate is measuring a config where draws never dominate
+    contrast_ok = legacy_growth >= 2.0
+
+    print(json.dumps({
+        "metric": "append-layout per-snapshot bytes: flat in run length "
+                  f"(4x run, cadence {args.cadence})",
+        "value": round(flat_across, 4),
+        "unit": "x short-run mean (gate <= %.2f)" % args.tolerance,
+        "vs_baseline": round(total_ratio, 2),
+        "pass_flat_across_runs": bool(flat_across <= args.tolerance),
+        "pass_flat_within_run": bool(flat_within <= args.tolerance),
+        "flat_within_run": round(flat_within, 4),
+        "legacy_per_snapshot_growth": round(legacy_growth, 2),
+        "legacy_contrast_ok": bool(contrast_ok),
+        "io_stats_delta": {
+            "bytes_written_append_long": a_l["bytes_written"],
+            "bytes_written_rotating_long": r_l["bytes_written"],
+            "writer_busy_s_append_long": a_l["writer_busy_s"],
+            "writer_busy_s_rotating_long": r_l["writer_busy_s"],
+        },
+    }))
+    return 0 if (ok and contrast_ok) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -21,7 +21,9 @@ from .post import (Posterior, pool_mcmc_chains, compute_associations,
 from .predict import (predict, predict_latent_factor, compute_predicted_values,
                       create_partition, construct_gradient, prepare_gradient)
 from .utils.checkpoint import (save_checkpoint, load_checkpoint,
-                               load_checkpoint_full, concat_posteriors,
+                               load_checkpoint_full,
+                               load_manifest_checkpoint, gc_checkpoints,
+                               concat_posteriors,
                                resume_run, CheckpointError,
                                CheckpointCorruptError,
                                CheckpointSpecMismatchError, PreemptedRun)
@@ -69,6 +71,7 @@ __all__ = [
     "predict", "predict_latent_factor", "compute_predicted_values",
     "create_partition", "construct_gradient", "prepare_gradient",
     "save_checkpoint", "load_checkpoint", "load_checkpoint_full",
+    "load_manifest_checkpoint", "gc_checkpoints",
     "concat_posteriors", "resume_run", "CheckpointError",
     "CheckpointCorruptError", "CheckpointSpecMismatchError", "PreemptedRun",
     "make_mesh",

@@ -16,8 +16,11 @@ serialises for A/B), exits with code 75 (EX_TEMPFAIL) when preempted by
 SIGTERM/SIGINT after writing a resumable snapshot, and ``--resume``
 continues from the newest valid one (corrupt slots fall back to the
 previous rotation slot; ``--verbose`` / ``--checkpoint-every`` act as
-draw-invariant overrides).  Rotation: ``--keep`` newest, ``--keep-age-s``
-age policy, ``--archive-every`` Nth snapshot archived.
+draw-invariant overrides).  Snapshots use the append-only layout by
+default (O(segment) per snapshot; ``--layout rotating`` keeps the legacy
+self-contained files).  Rotation: ``--keep`` newest, ``--keep-age-s`` age
+policy, ``--max-bytes`` total-bytes budget, ``--archive-every`` Nth
+snapshot archived.
 """
 
 from __future__ import annotations
@@ -95,7 +98,9 @@ def run_main(argv=None):
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--verbose", type=int, default=0)
     parser.add_argument("--checkpoint-dir", required=True,
-                        help="directory for the rotating ckpt-<n>.npz files")
+                        help="snapshot directory (append layout: shards + "
+                             "state files + manifests; --layout rotating: "
+                             "self-contained ckpt-<n>.npz files)")
     parser.add_argument("--checkpoint-every", type=int, default=None,
                         help="recorded samples between snapshots "
                              "(default 25; on --resume the stored cadence "
@@ -109,10 +114,23 @@ def run_main(argv=None):
     parser.add_argument("--keep-age-s", type=float, default=None,
                         help="additionally delete kept snapshots older than "
                              "this many seconds (newest always survives)")
-    parser.add_argument("--archive-every", type=int, default=0,
+    parser.add_argument("--archive-every", type=int, default=None,
                         help="hard-link every Nth snapshot into "
                              "<checkpoint-dir>/archive/, exempt from "
-                             "rotation (post-hoc divergence debugging)")
+                             "rotation (post-hoc divergence debugging); "
+                             "an explicit 0 on --resume stops archiving")
+    parser.add_argument("--max-bytes", type=int, default=None,
+                        help="total on-disk bytes budget for the snapshot "
+                             "layout; oldest snapshots are dropped first "
+                             "(the newest always survives)")
+    parser.add_argument("--layout", choices=("append", "rotating"),
+                        default=None,
+                        help="snapshot layout: 'append' (default) writes "
+                             "each flushed segment once as an immutable "
+                             "shard + a small state file + a manifest "
+                             "(O(segment) per snapshot); 'rotating' keeps "
+                             "the legacy self-contained ckpt-<n>.npz files "
+                             "(O(history) per snapshot)")
     parser.add_argument("--no-pipeline", action="store_true",
                         help="disable the background writer / donated-carry "
                              "pipeline (serialised host loop, for A/B)")
@@ -152,13 +170,17 @@ def run_main(argv=None):
                 print(f"run --resume: {', '.join(ignored)} ignored — the "
                       "run configuration comes from the checkpoint "
                       "(overridable: --verbose, --checkpoint-every, --keep, "
-                      "--keep-age-s, --archive-every)", file=sys.stderr)
+                      "--keep-age-s, --archive-every, --max-bytes, "
+                      "--layout)", file=sys.stderr)
             post = resume_run(hM, args.checkpoint_dir, verbose=args.verbose,
                               checkpoint_every=args.checkpoint_every,
                               checkpoint_keep=args.keep,
                               checkpoint_max_age_s=args.keep_age_s,
-                              checkpoint_archive_every=(
-                                  args.archive_every or None),
+                              # pass an explicit 0 through: it means "stop
+                              # archiving", not "use the stored cadence"
+                              checkpoint_archive_every=args.archive_every,
+                              checkpoint_max_bytes=args.max_bytes,
+                              checkpoint_layout=args.layout,
                               pipeline=not args.no_pipeline)
         else:
             os.makedirs(args.checkpoint_dir, exist_ok=True)
@@ -173,7 +195,9 @@ def run_main(argv=None):
                 checkpoint_path=args.checkpoint_dir,
                 checkpoint_keep=3 if args.keep is None else args.keep,
                 checkpoint_max_age_s=args.keep_age_s,
-                checkpoint_archive_every=args.archive_every,
+                checkpoint_archive_every=args.archive_every or 0,
+                checkpoint_max_bytes=args.max_bytes,
+                checkpoint_layout=args.layout or "append",
                 pipeline=not args.no_pipeline)
     except PreemptedRun as e:
         print(json.dumps({

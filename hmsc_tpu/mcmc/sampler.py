@@ -333,6 +333,46 @@ def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin,
                    donate_argnums=(1, 2, 3))
 
 
+def _find_warm_restart(ck_dir, hM, bad, base_samples, samples):
+    """Newest manifest in this run's snapshot directory at which every
+    chain in ``bad`` was still healthy.  Returns (full carry state at that
+    snapshot, local recorded samples at it, absolute transient_done for
+    burn-in snapshots) or None when no such snapshot survives rotation —
+    the caller then falls back to the cold from-scratch restart.
+
+    Only snapshots inside this call's own sampling window qualify
+    (``0 <= samples_at_snapshot - base_samples < samples``): a fresh run
+    owns its directory and a resumed run continues it, so everything in
+    that window is this logical run's history; a snapshot that predates the
+    continuation cannot be spliced here (its draws live in the base
+    segment), and the final post-divergence snapshot is excluded by the
+    health check.  The manifest is loaded with ``mmap=True``: only the
+    O(state) carry is read — the lazily-assembled posterior view is never
+    touched, so probing candidates costs nothing even for long histories."""
+    from ..utils import checkpoint as ckm
+
+    for p in ckm.checkpoint_files(ck_dir):
+        if not p.endswith(".json"):
+            continue
+        try:
+            man = ckm.load_manifest(p)
+        except ckm.CheckpointError:
+            continue
+        s0 = int(man.get("samples", 0)) - int(base_samples)
+        if s0 < 0 or s0 >= int(samples):
+            continue               # outside this call's sampling window
+        fb = man.get("first_bad_it")
+        if fb is None or any(int(fb[int(c)]) >= 0 for c in bad):
+            continue               # some retried chain was already poisoned
+        try:
+            ck = ckm.load_manifest_checkpoint(p, hM, mmap=True)
+        except ckm.CheckpointError:
+            continue
+        t_done = int(man.get("run", {}).get("transient_done", 0))
+        return ck.state, s0, t_done
+    return None
+
+
 def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 n_chains: int = 1, seed: int | None = None, init_par=None,
                 adapt_nf=None, updater: dict | None = None,
@@ -348,10 +388,12 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 checkpoint_keep: int = 3,
                 checkpoint_max_age_s: float | None = None,
                 checkpoint_archive_every: int = 0,
+                checkpoint_max_bytes: int | None = None,
+                checkpoint_layout: str = "append",
                 pipeline: bool = True, pipeline_depth: int = 2,
                 init_keys=None,
                 progress_callback=None, _ckpt_base=None,
-                _transient_base: int = 0):
+                _transient_base: int = 0, _ckpt_shards=None):
     """Run the blocked Gibbs sampler; returns a :class:`~hmsc_tpu.post.Posterior`.
 
     Arguments mirror the reference's ``sampleMcmc`` (samples/transient/thin/
@@ -411,12 +453,21 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
       corresponding Eta is recorded; wRRR on reduced-rank models).
       Un-recorded parameters raise a clear KeyError downstream.
     - ``checkpoint_every=N`` with ``checkpoint_path=DIR`` writes a resumable
-      snapshot (recorded draws so far + carry state + carried RNG keys) every
-      N recorded samples, atomically (tmp + rename), rotating the newest
-      ``checkpoint_keep`` files as ``ckpt-<samples>.npz``.  Snapshots land on
-      host-segment boundaries — the same segmentation machinery ``verbose``
-      uses — so the key stream (and therefore every draw) is bit-identical
-      for any checkpointing cadence.  While active, SIGTERM/SIGINT is
+      snapshot every N recorded samples.  With the default
+      ``checkpoint_layout="append"`` a snapshot is O(segment), flat in run
+      length: the draws recorded since the previous snapshot are flushed
+      once into an immutable ``seg-<proc>-<first>-<last>.npz`` shard, the
+      carry state + RNG keys land in a small ``state-<samples>.npz``, and an
+      atomically-renamed ``manifest-<samples>.json`` (per-payload crc32
+      checksums, spec fingerprint) is the commit point — total checkpoint
+      bytes over a run are O(S) instead of the self-contained layout's
+      O(S²).  ``checkpoint_layout="rotating"`` keeps the legacy
+      self-contained ``ckpt-<samples>.npz`` files (each holding all draws so
+      far); both layouts load via the same ``resume_run`` /
+      ``load_checkpoint``.  Snapshots land on host-segment boundaries — the
+      same segmentation machinery ``verbose`` uses — so the key stream (and
+      therefore every draw) is bit-identical for any checkpointing cadence
+      and either layout.  While active, SIGTERM/SIGINT is
       intercepted: the in-flight segment finishes, a final snapshot is
       written, and the run unwinds with
       :class:`~hmsc_tpu.utils.checkpoint.PreemptedRun`.  Continue with
@@ -426,16 +477,24 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
       ``checkpoint_path`` alone (no ``checkpoint_every``) writes a single
       snapshot at completion.  While checkpointing (or ``verbose``) is on,
       the *transient* scan is segmented too: burn-in reports progress and
-      writes resumable state-only snapshots (``ckpt-t<sweep>.npz`` — carry
-      state + RNG keys, no draws), so a kill during a long burn-in no
-      longer loses it.
-    - ``checkpoint_keep`` rotates the newest K snapshots;
-      ``checkpoint_max_age_s`` additionally deletes kept snapshots older
-      than the given age (the newest always survives), and
+      writes resumable state-only snapshots (``manifest-t<sweep>.json`` /
+      legacy ``ckpt-t<sweep>.npz`` — carry state + RNG keys, no draws), so a
+      kill during a long burn-in no longer loses it.
+    - ``checkpoint_keep`` rotates the newest K snapshots (under the append
+      layout rotation deletes *manifests*; shards referenced by no surviving
+      manifest are garbage-collected); ``checkpoint_max_age_s`` additionally
+      deletes kept snapshots older than the given age (the newest always
+      survives); ``checkpoint_max_bytes`` bounds the layout's total on-disk
+      bytes, dropping the oldest snapshots first (never the newest); and
       ``checkpoint_archive_every=N`` hard-links every Nth written snapshot
-      into ``<checkpoint_path>/archive/`` exempt from rotation (post-hoc
-      divergence debugging: old snapshots stay inspectable after the
-      rotation window has moved on).
+      into ``<checkpoint_path>/archive/`` exempt from rotation and GC
+      (post-hoc divergence debugging: old snapshots stay inspectable after
+      the rotation window has moved on).  With the append layout,
+      ``retry_diverged`` warm-restarts a diverged chain from the last
+      manifest at which it was still healthy — keeping its healthy draws
+      and re-running only the remainder — instead of repeating the full
+      burn-in from scratch (the cold restart remains the fallback when no
+      healthy snapshot exists).
     - ``pipeline`` (default on) runs the host loop as a pipeline: the
       jitted segment runner *donates* its carry buffers (the scan carry is
       updated in place — one copy of the state pytree in HBM instead of
@@ -661,6 +720,16 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
     if archive_every < 0:
         raise ValueError("checkpoint_archive_every must be >= 0, "
                          f"got {archive_every}")
+    if checkpoint_layout not in ("append", "rotating"):
+        raise ValueError("checkpoint_layout must be 'append' or 'rotating', "
+                         f"got {checkpoint_layout!r}")
+    if int(checkpoint_keep) < 0:
+        raise ValueError("checkpoint_keep must be >= 0 (0 keeps every "
+                         f"snapshot), got {checkpoint_keep}")
+    if checkpoint_max_bytes is not None and int(checkpoint_max_bytes) < 1:
+        raise ValueError("checkpoint_max_bytes must be >= 1, got "
+                         f"{checkpoint_max_bytes}")
+    append_layout = checkpoint_layout == "append"
     if checkpoint_path is not None and ck_every == 0:
         ck_every = int(samples)       # single snapshot at completion
     if int(samples) == 0:
@@ -709,10 +778,11 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         ck_dir = os.fspath(checkpoint_path)
         os.makedirs(ck_dir, exist_ok=True)
         if init_state is None and base_post is None:
-            # a FRESH run owns its snapshot directory: stale ckpt-*.npz from
+            # a FRESH run owns its snapshot directory: stale snapshots from
             # an earlier run would outnumber this run's early snapshots and
             # resume_run would silently return the old run's posterior
-            from ..utils.checkpoint import checkpoint_files as _ck_files
+            from ..utils.checkpoint import (_layout_files as _lf,
+                                            checkpoint_files as _ck_files)
             stale = _ck_files(ck_dir)
             if stale:
                 import warnings
@@ -722,11 +792,12 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                     "resume_run cannot confuse the runs (use resume_run "
                     "instead of a fresh call to continue the old one)",
                     RuntimeWarning, stacklevel=2)
-                for p in stale:
-                    try:
-                        os.unlink(p)
-                    except OSError:
-                        pass
+            # clear shards/state files too, not just the resume candidates
+            for p in (_lf(ck_dir) if stale else []):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
 
     # preemption-safe shutdown: while auto-checkpointing, SIGTERM/SIGINT set
     # a flag that the segment loop checks after each compiled chunk — finish
@@ -790,6 +861,31 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                   else _InlineWriter())
         n_ck_writes = 0               # snapshot ordinal (archive cadence)
 
+        # append-layout bookkeeping.  `flush` tracks which prefix of the
+        # recorded draws is already durable as immutable shards (`cursor`
+        # counts GLOBAL recorded samples, `idx` indexes host_segs), the
+        # shard sequence manifests reference, a one-time base segment
+        # pending flush when a legacy self-contained run is continued in
+        # the append layout, and the repair ordinal for post-splice shard
+        # re-writes.  `io` counts checkpoint bytes for Posterior.io_stats
+        # (the bench gate asserts per-snapshot bytes are O(segment)).
+        # Everything here is touched only by writer-thread callables, which
+        # run in FIFO order — no locking needed.
+        from ..utils.checkpoint import _SHARD_RE as _shard_re
+        flush = {"idx": 0, "cursor": base_samples,
+                 "shards": [dict(s) for s in _ckpt_shards or []],
+                 "base": (base_post
+                          if (append_layout and base_post is not None
+                              and not _ckpt_shards) else None),
+                 # seed past any repair ordinal a resumed shard list carries
+                 # so a later splice-rewrite never reuses a repair file name
+                 "repair": max((int(m.group(4) or 0) for m in
+                                (_shard_re.fullmatch(s["file"])
+                                 for s in _ckpt_shards or []) if m),
+                               default=0)}
+        io = {"bytes": 0, "snapshot_bytes": [], "shards_written": 0}
+        shard_slot = int(jax.process_index())
+
         def _collect(packed):
             host_segs.append(_unpack_records(*packed))
 
@@ -831,27 +927,37 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 "checkpoint_keep": int(checkpoint_keep),
                 "checkpoint_max_age_s": checkpoint_max_age_s,
                 "checkpoint_archive_every": archive_every,
+                "checkpoint_max_bytes": checkpoint_max_bytes,
+                "checkpoint_layout": checkpoint_layout,
             }
+
+        def _archive_link(src):
+            # hard-link (copy fallback) into archive/, exempt from rotation
+            # and GC — post-hoc divergence debugging; links share the inode
+            # so archiving a live shard costs no extra bytes
+            adir = os.path.join(ck_dir, "archive")
+            os.makedirs(adir, exist_ok=True)
+            apath = os.path.join(adir, os.path.basename(src))
+            try:
+                if os.path.exists(apath):
+                    os.unlink(apath)
+                os.link(src, apath)
+            except OSError:
+                import shutil
+                shutil.copy2(src, apath)
 
         def _finish_ck(path, partial, state_arg, keys_arg, meta, ordinal):
             from ..utils import checkpoint as _ck
             _ck.save_checkpoint(path, partial, state_arg, keys=keys_arg,
                                 keys_impl=rng_impl, run_meta=meta)
-            _ck.rotate_checkpoints(ck_dir, int(checkpoint_keep),
-                                   max_age_s=checkpoint_max_age_s)
+            nbytes = int(os.path.getsize(path))
+            io["bytes"] += nbytes
+            io["snapshot_bytes"].append(nbytes)
+            _ck.gc_checkpoints(ck_dir, int(checkpoint_keep),
+                               max_age_s=checkpoint_max_age_s,
+                               max_bytes=checkpoint_max_bytes)
             if archive_every and ordinal % archive_every == 0:
-                # hard-link (copy fallback) into archive/, exempt from
-                # rotation — post-hoc divergence debugging
-                adir = os.path.join(ck_dir, "archive")
-                os.makedirs(adir, exist_ok=True)
-                apath = os.path.join(adir, os.path.basename(path))
-                try:
-                    if os.path.exists(apath):
-                        os.unlink(apath)
-                    os.link(path, apath)
-                except OSError:
-                    import shutil
-                    shutil.copy2(path, apath)
+                _archive_link(path)
 
         def _write_ck(done_now, state_snap, keys_snap, bad_snap, ordinal,
                       post_override=None, state_override=None):
@@ -919,11 +1025,166 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
             _finish_ck(path, partial, state_snap, keys_snap, meta, ordinal)
             return path
 
+        def _flush_shards(done_now):
+            """Make every draw recorded up to ``done_now`` durable as
+            immutable shards.  Runs on the writer thread AFTER all pending
+            segment fetches (FIFO), so host_segs holds everything up to the
+            snapshot boundary; cost is O(draws since the last flush), never
+            O(history) — the layout's whole point."""
+            from ..utils import checkpoint as _ck
+            if flush["base"] is not None:
+                # one-time migration: a legacy self-contained run continued
+                # in the append layout flushes its base draws as one shard
+                bp, flush["base"] = flush["base"], None
+                entry = _ck.save_shard(
+                    ck_dir, {k: np.asarray(v) for k, v in bp.arrays.items()},
+                    0, base_samples - 1, shard_index=shard_slot)
+                flush["shards"].append(entry)
+                io["bytes"] += entry["nbytes"]
+                io["shards_written"] += 1
+            done_g = base_samples + done_now
+            if done_g <= flush["cursor"]:
+                return
+            new = host_segs[flush["idx"]:]
+            arrays = (new[0] if len(new) == 1
+                      else jax.tree.map(
+                          lambda *xs: np.concatenate(xs, axis=1), *new))
+            entry = _ck.save_shard(ck_dir, arrays, flush["cursor"],
+                                   done_g - 1, shard_index=shard_slot)
+            flush["idx"] = len(host_segs)
+            flush["cursor"] = done_g
+            flush["shards"].append(entry)
+            io["bytes"] += entry["nbytes"]
+            io["shards_written"] += 1
+
+        def _append_manifest(tag, done_now, state_snap, keys_snap, bad_snap,
+                             meta, ordinal):
+            """State file + manifest commit + archive + GC for one
+            append-layout snapshot (writer thread)."""
+            import hmsc_tpu as _pkg
+
+            from ..utils import checkpoint as _ck
+            st_entry = _ck.save_state_file(ck_dir, tag, spec, state_snap,
+                                           keys_data=keys_snap)
+            fb = np.asarray(bad_snap)
+            if base_post is not None:
+                fb0 = np.asarray(base_post.chain_health["first_bad_it"])
+                fb = np.where(fb0 >= 0, fb0, fb)
+            man = {
+                "package_version": _pkg.__version__,
+                "samples": base_samples + done_now,
+                "transient": int(base_post.transient if base_post is not None
+                                 else _transient_base + int(transient)),
+                "thin": int(thin), "n_chains": int(n_chains),
+                "nf_cap": int(nf_cap),
+                "spec_sha256": _ck.spec_fingerprint(spec),
+                "keys_impl": rng_impl,
+                "first_bad_it": [int(x) for x in fb],
+                "nf_saturation": {
+                    str(r): np.asarray(
+                        state_snap.levels[r].nf_sat).reshape(-1).tolist()
+                    for r in range(spec.nr)},
+                "state": st_entry,
+                "shards": [dict(s) for s in flush["shards"]],
+                "run": meta,
+            }
+            path = _ck.save_manifest(ck_dir, tag, man)
+            io["bytes"] += st_entry["nbytes"] + int(os.path.getsize(path))
+            if archive_every and ordinal % archive_every == 0:
+                _archive_link(path)
+                _archive_link(os.path.join(ck_dir, st_entry["file"]))
+                for s in man["shards"]:
+                    src = os.path.join(ck_dir, s["file"])
+                    dst = os.path.join(ck_dir, "archive", s["file"])
+                    try:
+                        # same inode = already archived (hard link); a
+                        # same-NAME file from a previous run in a reused
+                        # directory must be re-linked, or this manifest's
+                        # archive copy would pair with the old run's bytes
+                        if os.path.exists(dst) and os.path.samefile(src,
+                                                                    dst):
+                            continue
+                    except OSError:
+                        pass
+                    _archive_link(src)
+            _ck.gc_checkpoints(ck_dir, int(checkpoint_keep),
+                               max_age_s=checkpoint_max_age_s,
+                               max_bytes=checkpoint_max_bytes)
+            return path
+
+        def _write_append_ck(done_now, state_snap, keys_snap, bad_snap,
+                             ordinal):
+            b0 = io["bytes"]
+            _flush_shards(done_now)
+            path = _append_manifest(f"{base_samples + done_now:08d}",
+                                    done_now, state_snap, keys_snap,
+                                    bad_snap, _run_meta(done_now), ordinal)
+            io["snapshot_bytes"].append(io["bytes"] - b0)
+            return path
+
+        def _write_burnin_append_ck(it_now, state_snap, keys_snap, bad_snap,
+                                    ordinal):
+            b0 = io["bytes"]
+            meta = _run_meta(0)
+            meta["transient_done"] = int(it_now)
+            path = _append_manifest(f"t{it_now:08d}", 0, state_snap,
+                                    keys_snap, bad_snap, meta, ordinal)
+            io["snapshot_bytes"].append(io["bytes"] - b0)
+            return path
+
+        def _rewrite_spliced_append(changed_from, state_fin, keys_data_fin,
+                                    fb_fin, post_fin):
+            """Post-splice repair of a completed append-layout run
+            (driver thread, after the writer drained): shards entirely
+            before the changed window are untouched; the changed tail is
+            re-written ONCE as a repair shard (immutable files never mutate
+            — a repaired window gets a new name), and a new final manifest
+            commits the repaired sequence.  Cost is O(changed draws): a
+            warm-restart splice re-writes only the post-snapshot tail."""
+            from ..utils import checkpoint as _ck
+            changed_g = base_samples + int(changed_from)
+            keep_shards, doomed = [], []
+            for s in flush["shards"]:
+                (keep_shards if int(s["last"]) < changed_g
+                 else doomed).append(s)
+            # the repair window opens at the first superseded shard's start
+            # (a shard straddling the change boundary is replaced whole)
+            rep_first = (min(int(s["first"]) for s in doomed)
+                         if doomed else changed_g)
+            end_g = base_samples + int(samples)
+            if rep_first < end_g:
+                flush["repair"] += 1
+                lo = rep_first - base_samples
+                arrays = {k: np.asarray(v)[:, lo:]
+                          for k, v in post_fin.arrays.items()}
+                entry = _ck.save_shard(ck_dir, arrays, rep_first, end_g - 1,
+                                       shard_index=shard_slot,
+                                       repair=flush["repair"])
+                keep_shards.append(entry)
+                io["bytes"] += entry["nbytes"]
+                io["shards_written"] += 1
+            flush["shards"] = keep_shards
+            return _append_manifest(f"{end_g:08d}", int(samples), state_fin,
+                                    keys_data_fin, fb_fin,
+                                    _run_meta(int(samples)), n_ck_writes)
+
         def _submit_ck(in_burnin, done_now, it_now):
             nonlocal n_ck_writes
             n_ck_writes += 1
             st, kd, bd = _snap_carry()
-            if in_burnin:
+            if append_layout:
+                tag = (f"t{it_now:08d}" if in_burnin
+                       else f"{base_samples + done_now:08d}")
+                path = os.path.join(ck_dir, f"manifest-{tag}.json")
+                if in_burnin:
+                    writer.submit(functools.partial(
+                        _write_burnin_append_ck, it_now, st, kd, bd,
+                        n_ck_writes))
+                else:
+                    writer.submit(functools.partial(
+                        _write_append_ck, done_now, st, kd, bd,
+                        n_ck_writes))
+            elif in_burnin:
                 path = os.path.join(ck_dir, f"ckpt-t{it_now:08d}.npz")
                 writer.submit(functools.partial(
                     _write_burnin_ck, it_now, st, kd, bd, n_ck_writes))
@@ -1004,8 +1265,12 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
     t2 = time.perf_counter()
     io_stats = {"pipeline": bool(pipeline), "segments": len(plan),
                 "checkpoints": n_ck_writes,
+                "checkpoint_layout": checkpoint_layout if ck_every else None,
                 "max_queue_depth": writer.max_depth_seen,
-                "writer_busy_s": writer.busy_s}
+                "writer_busy_s": writer.busy_s,
+                "bytes_written": io["bytes"],
+                "snapshot_bytes": list(io["snapshot_bytes"]),
+                "shards_written": io["shards_written"]}
 
     post = Posterior(hM, spec, recs, samples=samples,
                      transient=_transient_base + int(transient), thin=thin)
@@ -1035,14 +1300,9 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
     # spliced posterior targets the same distribution)
     if retry_diverged > 0 and (first_bad >= 0).any():
         bad = np.nonzero(first_bad >= 0)[0]
-        # always re-initialise from scratch: a poisoned carry state (the
-        # init_state case) would diverge again immediately.  Burn-in covers
-        # the original chain's total progress (it0 + transient), adapt_nf is
-        # re-derived from the caller's argument against that burn-in (a
-        # resumed run's resolved (0,...) must not skip adaptation in a
-        # from-scratch restart), and the mesh is forwarded when the retry
-        # chain count still lays out evenly over its chain axis (so an
-        # HBM-bound species-sharded model can fit during the retry too)
+        # the mesh is forwarded when the retry chain count still lays out
+        # evenly over its chain axis (so an HBM-bound species-sharded model
+        # can fit during the retry too)
         sub_mesh = mesh
         if mesh is not None and len(bad) % int(mesh.shape[chain_axis]) != 0:
             sub_mesh = None
@@ -1051,17 +1311,56 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         # pre-splice state would hand a later resume_run(extra_samples=...)
         # the NaN-poisoned carry of the very chain the retry just replaced
         want_state = return_state or bool(ck_every)
-        sub = sample_mcmc(hM, samples=samples,
-                          transient=int(transient) + it0, thin=thin,
-                          n_chains=len(bad), seed=int(rng.integers(2**31 - 1)),
-                          init_par=init_par, adapt_nf=adapt_nf_arg,
-                          updater=updater, nf_cap=nf_cap, dtype=dtype,
-                          data_par=data_par, align_post=False, verbose=verbose,
-                          mesh=sub_mesh, chain_axis=chain_axis,
-                          species_axis=species_axis,
-                          rng_impl=rng_impl, record_dtype=record_dtype,
-                          retry_diverged=retry_diverged - 1,
-                          record=record, return_state=want_state)
+        # warm restart (append layout): the newest manifest at which every
+        # diverged chain was still healthy carries a usable mid-run carry —
+        # keep those chains' healthy draws up to that snapshot and re-run
+        # only the remainder with a FRESH key stream (the carried key would
+        # replay the exact same path into the same divergence), instead of
+        # repeating the whole burn-in from scratch
+        warm = (_find_warm_restart(ck_dir, hM, bad, base_samples, samples)
+                if ck_every and append_layout else None)
+        if warm is not None:
+            warm_state, warm_s0, warm_t_done = warm
+            sub_init = jax.tree.map(
+                lambda x: jnp.asarray(np.asarray(x)[bad]), warm_state)
+            rem_t = (max(0, (it0 + int(transient)) - int(warm_t_done))
+                     if warm_s0 == 0 and warm_t_done else 0)
+            sub = sample_mcmc(hM, samples=samples - warm_s0,
+                              transient=rem_t, thin=thin,
+                              n_chains=len(bad),
+                              seed=int(rng.integers(2**31 - 1)),
+                              adapt_nf=[int(a) for a in adapt_nf],
+                              updater=updater, nf_cap=nf_cap, dtype=dtype,
+                              data_par=data_par, align_post=False,
+                              verbose=verbose, mesh=sub_mesh,
+                              chain_axis=chain_axis,
+                              species_axis=species_axis,
+                              init_state=sub_init,
+                              rng_impl=rng_impl, record_dtype=record_dtype,
+                              retry_diverged=retry_diverged - 1,
+                              record=record, return_state=want_state)
+            splice_from = int(warm_s0)
+        else:
+            # cold restart: re-initialise from scratch — without a healthy
+            # snapshot a poisoned carry would diverge again immediately.
+            # Burn-in covers the original chain's total progress
+            # (it0 + transient); adapt_nf is re-derived from the caller's
+            # argument against that burn-in (a resumed run's resolved
+            # (0,...) must not skip adaptation in a from-scratch restart)
+            sub = sample_mcmc(hM, samples=samples,
+                              transient=int(transient) + it0, thin=thin,
+                              n_chains=len(bad),
+                              seed=int(rng.integers(2**31 - 1)),
+                              init_par=init_par, adapt_nf=adapt_nf_arg,
+                              updater=updater, nf_cap=nf_cap, dtype=dtype,
+                              data_par=data_par, align_post=False,
+                              verbose=verbose,
+                              mesh=sub_mesh, chain_axis=chain_axis,
+                              species_axis=species_axis,
+                              rng_impl=rng_impl, record_dtype=record_dtype,
+                              retry_diverged=retry_diverged - 1,
+                              record=record, return_state=want_state)
+            splice_from = 0
         if want_state:
             sub, sub_state = sub
 
@@ -1074,7 +1373,7 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
             a = post.arrays[k]
             if not a.flags.writeable:        # np.asarray views of jax buffers
                 a = a.copy()
-            a[bad] = sub.arrays[k]
+            a[bad, splice_from:] = sub.arrays[k]
             post.arrays[k] = a
         first_bad = first_bad.copy()
         first_bad[bad] = sub.chain_health["first_bad_it"]
@@ -1087,6 +1386,7 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
             "healthy_after_retry": tuple(
                 bool(b < 0) for b in
                 np.asarray(sub.chain_health["first_bad_it"])),
+            "warm_start_samples": splice_from if warm is not None else None,
         }
         for r in range(spec.nr):          # replacement chains' counts
             nf_sat_counts[r] = nf_sat_counts[r].copy()
@@ -1098,9 +1398,20 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
             # spliced (healthy) posterior and any extension continues from
             # the replacement chains' healthy carry, not the poisoned one
             post.nf_saturation = nf_sat_counts
-            _write_ck(int(samples), final_state, keys, first_bad,
-                      n_ck_writes, post_override=post,
-                      state_override=final_state)
+            if append_layout:
+                _rewrite_spliced_append(
+                    splice_from, final_state,
+                    jnp.array(jax.random.key_data(keys)), first_bad, post)
+            else:
+                _write_ck(int(samples), final_state, keys, first_bad,
+                          n_ck_writes, post_override=post,
+                          state_override=final_state)
+            # the rewrite ran after io_stats was snapshotted — refresh the
+            # byte accounting so the repair shard / re-written slot counts
+            post.io_stats.update(
+                bytes_written=io["bytes"],
+                snapshot_bytes=list(io["snapshot_bytes"]),
+                shards_written=io["shards_written"])
 
     # factor-cap observability: warn when burn-in adaptation wanted to add
     # factors past the static nf_max cap — the residual associations may be

@@ -28,12 +28,23 @@ class Posterior:
                  thin: int):
         self.hM = hM
         self.spec = spec
-        self.arrays = {k: np.asarray(v) for k, v in arrays.items()}
+        if isinstance(arrays, dict):
+            self.arrays = {k: np.asarray(v) for k, v in arrays.items()}
+        else:
+            # lazily-materialised mapping (checkpoint.ShardBackedArrays):
+            # keep it as-is so constructing a Posterior from a multi-GB
+            # manifest copies nothing — each parameter loads on first access
+            self.arrays = arrays
         self.samples = samples
         self.transient = transient
         self.thin = thin
-        self.n_chains = next(iter(self.arrays.values())).shape[0] if self.arrays else 0
+        hint = getattr(self.arrays, "chains", None)
+        self.n_chains = (int(hint) if hint else
+                         (next(iter(self.arrays.values())).shape[0]
+                          if len(self.arrays) else 0))
         self.timing = None          # {"setup_s", "run_s"} set by sample_mcmc
+        self.io_stats = {}          # host-loop/checkpoint-IO counters
+                                    # (sample_mcmc; empty when loaded)
         # {level: (chains,) int} blocked factor-growth attempts per chain,
         # set by sample_mcmc (empty when unknown, e.g. from_prior/subset-free
         # construction)

@@ -43,6 +43,15 @@ def inject_nan(updater: str = "update_beta_lambda", at_iteration: int = 1,
     from the module at trace time) and clears the compiled-program cache on
     entry and exit, so the poison is actually traced in and is fully gone
     afterwards.  Affects every chain — chains are vmapped over one program.
+
+    Yields a ``disarm()`` callable that restores the updater early (and
+    clears the compile cache) — a real blow-up is stochastic and does NOT
+    recur when the chain re-runs the same sweep with a fresh key stream,
+    but this gate is on the deterministic iteration counter, so any restart
+    covering ``at_iteration`` would be re-poisoned.  Calling ``disarm()``
+    from a ``progress_callback`` once the poison has struck models the
+    real, non-recurring failure (the warm-restart tests rely on this);
+    disarming is idempotent and the context exit remains a no-op after it.
     """
     import jax.numpy as jnp
 
@@ -58,13 +67,17 @@ def inject_nan(updater: str = "update_beta_lambda", at_iteration: int = 1,
         return state.replace(**{field: tgt + hit * jnp.asarray(
             jnp.nan, dtype=tgt.dtype)})
 
+    def disarm():
+        if getattr(U, updater) is not real:
+            setattr(U, updater, real)
+            sampler_mod._compiled_runner.cache_clear()
+
     setattr(U, updater, poisoned)
     sampler_mod._compiled_runner.cache_clear()
     try:
-        yield
+        yield disarm
     finally:
-        setattr(U, updater, real)
-        sampler_mod._compiled_runner.cache_clear()
+        disarm()
 
 
 def device_loss_after(samples_done: int):

@@ -20,6 +20,26 @@ run.  Corruption (flipped bytes, truncation) is detected via the checksums
 and rejected with :class:`CheckpointCorruptError`; ``resume_run`` then falls
 back to the previous rotation slot.  Legacy v1 files (pickled metadata) are
 readable only behind an explicit ``allow_legacy_pickle=True``.
+
+Append-only run layout (manifest v1, the auto-checkpoint default): instead
+of re-serialising the full draw history into every rotating snapshot (O(S²)
+total bytes over a long run), each flushed sample segment becomes an
+immutable ``seg-<proc>-<first>-<last>.npz`` shard written exactly once, a
+snapshot is a small ``state-<n>.npz`` (carry leaves + RNG key data) plus a
+``manifest-<n>.json`` listing the shard sequence with per-payload crc32
+checksums — the atomic manifest rename is the commit point, so per-snapshot
+cost is O(segment), flat in run length.  ``load_manifest_checkpoint``
+assembles the posterior from the manifest (eagerly verified by default, or
+as a lazily-materialised memory-mapped view via ``mmap=True``);
+``latest_valid_checkpoint`` treats a corrupt shard like a corrupt rotating
+slot and falls back to the newest manifest whose shard prefix is intact.
+Rotation is manifest-driven (``gc_checkpoints``): manifests rotate by
+count / age / total-bytes budget, and shards or state files referenced by
+no surviving manifest are garbage-collected.  The per-process shard index
+in the file name is the designed-for basis of the multi-host checkpoint
+story (one shard stream per process + a coordinated manifest).  The legacy
+self-contained ``ckpt-<n>.npz`` format stays fully readable (and writable
+via ``sample_mcmc(checkpoint_layout="rotating")``) alongside.
 """
 
 from __future__ import annotations
@@ -39,17 +59,25 @@ import numpy as np
 __all__ = [
     "save_checkpoint", "load_checkpoint", "load_checkpoint_full",
     "concat_posteriors", "resume_run", "checkpoint_files",
-    "rotate_checkpoints", "latest_valid_checkpoint", "spec_fingerprint",
+    "rotate_checkpoints", "gc_checkpoints", "latest_valid_checkpoint",
+    "spec_fingerprint", "save_shard", "save_state_file", "save_manifest",
+    "load_manifest", "load_manifest_checkpoint", "ShardBackedArrays",
     "CheckpointError", "CheckpointCorruptError",
     "CheckpointSpecMismatchError", "PreemptedRun", "LoadedCheckpoint",
-    "CKPT_VERSION",
+    "CKPT_VERSION", "MANIFEST_VERSION",
 ]
 
 CKPT_VERSION = 2
+MANIFEST_VERSION = 1
 _HEADER_KEY = "__hmsc_ckpt_header__"
 # ckpt-<samples>.npz: sample snapshot; ckpt-t<sweep>.npz: state-only burn-in
 # snapshot (no draws yet — always older than any sample snapshot)
 _CKPT_RE = re.compile(r"ckpt-(t?)(\d+)\.npz")
+# append-only layout: the manifest is the commit point; shards and state
+# files are only ever reached through a manifest that references them
+_MANIFEST_RE = re.compile(r"manifest-(t?)(\d+)\.json")
+_SHARD_RE = re.compile(r"seg-(\d+)-(\d+)-(\d+)(?:-r(\d+))?\.npz")
+_STATE_RE = re.compile(r"state-(t?)(\d+)\.npz")
 
 
 class CheckpointError(RuntimeError):
@@ -134,44 +162,75 @@ def spec_fingerprint(spec) -> str:
 
 
 def _crc(a) -> str:
-    return f"{zlib.crc32(np.ascontiguousarray(a).tobytes()) & 0xFFFFFFFF:08x}"
+    # checksum over the buffer in place: .tobytes() would materialise a
+    # second full copy of every payload on the writer thread per snapshot
+    buf = memoryview(np.ascontiguousarray(a)).cast("B")
+    return f"{zlib.crc32(buf) & 0xFFFFFFFF:08x}"
 
 
-def _atomic_savez(path: str, payload: dict, compress: bool = False) -> None:
-    """tmp + fsync + rename so a kill mid-write never leaves a torn file
-    under the final name.
+def _fsync_dir(path: str) -> None:
+    """fsync the containing directory so a completed rename is durable —
+    the background writer's barrier relies on a completed write meaning
+    "survives power loss", not just "visible to this process"."""
+    try:
+        dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass                   # directory fsync unsupported (non-POSIX)
 
-    Uncompressed by default: posterior draws are high-entropy f32 (measured
-    ~13% size reduction for ~7x the serialisation CPU), and checkpoint
-    serialisation rides the sampler's background writer thread — cheap
-    writes keep it off the compute cores the XLA CPU backend shares.  Pass
-    ``compress=True`` for cold archival copies; ``np.load`` reads both."""
+
+def _atomic_write(path: str, write_cb, fsync_dir: bool = True) -> None:
+    """The atomic durable-write protocol, shared by every on-disk artifact:
+    serialise into a tmp file via ``write_cb(fileobj)``, fsync the content,
+    rename over the final name, optionally fsync the directory — a kill at
+    any instant leaves either the old file or the new one, never a torn
+    mix.
+
+    ``fsync_dir=False`` skips the directory fsync: append-layout shard and
+    state writes precede a manifest commit in the SAME directory, and the
+    manifest's directory fsync durably publishes all three dirents at once
+    (measured: each directory fsync costs about as much as the data write
+    at segment scale — one per snapshot instead of three keeps the
+    per-snapshot cost flat).  A crash before the manifest's fsync loses at
+    worst an uncommitted orphan, which resume regenerates."""
     tmp = f"{path}.tmp.{os.getpid()}"
-    savez = np.savez_compressed if compress else np.savez
     try:
         with open(tmp, "wb") as f:
-            savez(f, **payload)
+            write_cb(f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
-        # fsync the directory so the rename itself is durable — the
-        # background writer's barrier relies on a completed write meaning
-        # "survives power loss", not just "visible to this process"
-        try:
-            dfd = os.open(os.path.dirname(os.path.abspath(path)),
-                          os.O_RDONLY)
-            try:
-                os.fsync(dfd)
-            finally:
-                os.close(dfd)
-        except OSError:
-            pass               # directory fsync unsupported (non-POSIX)
+        if fsync_dir:
+            _fsync_dir(path)
     finally:
         if os.path.exists(tmp):
             try:
                 os.unlink(tmp)
             except OSError:
                 pass
+
+
+def _atomic_savez(path: str, payload: dict, compress: bool = False,
+                  fsync_dir: bool = True) -> None:
+    """Atomic durable ``.npz`` write (see :func:`_atomic_write`).
+
+    Uncompressed by default: posterior draws are high-entropy f32 (measured
+    ~13% size reduction for ~7x the serialisation CPU), and checkpoint
+    serialisation rides the sampler's background writer thread — cheap
+    writes keep it off the compute cores the XLA CPU backend shares.  Pass
+    ``compress=True`` for cold archival copies; ``np.load`` reads both.
+    (Uncompressed members are also what makes the shard mmap view possible —
+    a deflated member cannot be memory-mapped.)"""
+    savez = np.savez_compressed if compress else np.savez
+    _atomic_write(path, lambda f: savez(f, **payload), fsync_dir=fsync_dir)
+
+
+def _atomic_write_bytes(path: str, data: bytes) -> None:
+    """Atomic durable write of raw bytes (the manifest commit point)."""
+    _atomic_write(path, lambda f: f.write(data))
 
 
 # ---------------------------------------------------------------------------
@@ -253,6 +312,8 @@ def load_checkpoint_full(path: str, hM, *,
     from ..post.posterior import Posterior
 
     path = os.fspath(path)
+    if path.endswith(".json"):            # append-only layout manifest
+        return load_manifest_checkpoint(path, hM)
     try:
         with np.load(path, allow_pickle=False) as z:
             files = set(z.files)
@@ -369,9 +430,407 @@ def _load_legacy_v1(z, hM, path, allow_legacy_pickle) -> LoadedCheckpoint:
 def load_checkpoint(path: str, hM, *, allow_legacy_pickle: bool = False):
     """Returns (Posterior, carry_state) ready for
     ``sample_mcmc(hM, ..., init_state=carry_state)`` — see
-    :func:`load_checkpoint_full` for the RNG keys and run metadata."""
+    :func:`load_checkpoint_full` for the RNG keys and run metadata.
+    Accepts both a self-contained ``.npz`` checkpoint and an append-only
+    ``manifest-<n>.json``."""
     ck = load_checkpoint_full(path, hM, allow_legacy_pickle=allow_legacy_pickle)
     return ck.post, ck.state
+
+
+# ---------------------------------------------------------------------------
+# append-only run layout: shards + state files + manifests
+# ---------------------------------------------------------------------------
+
+def save_shard(dirpath: str, arrays: dict, first: int, last: int, *,
+               shard_index: int = 0, repair: int = 0,
+               compress: bool = False) -> dict:
+    """Write one immutable posterior shard covering the recorded-sample
+    window ``[first, last]`` (inclusive, global indices) and return its
+    manifest entry (file name, window, per-payload crc32 checksums, size).
+
+    ``shard_index`` is the writing process's slot (``jax.process_index()``
+    on a multi-host mesh; 0 single-host) — each process appends its own
+    shard stream, which is what the coordinated multi-host manifest will
+    stitch together.  ``repair`` disambiguates a re-written window (the
+    ``retry_diverged`` splice re-writes the tail of a completed run): shard
+    files are immutable, so a repaired window gets a NEW file name and the
+    superseded shard is garbage-collected once no manifest references it."""
+    if last < first:
+        raise ValueError(f"save_shard: empty window [{first}, {last}]")
+    rep = f"-r{int(repair)}" if repair else ""
+    fname = f"seg-{int(shard_index)}-{first:08d}-{last:08d}{rep}.npz"
+    payload = {f"post:{k}": np.ascontiguousarray(v) for k, v in arrays.items()}
+    if not payload:
+        raise ValueError("save_shard: no arrays to write")
+    n = next(iter(payload.values())).shape[1]
+    if n != last - first + 1:
+        raise ValueError(
+            f"save_shard: arrays carry {n} samples for window "
+            f"[{first}, {last}] ({last - first + 1} wide)")
+    checks = {k: _crc(v) for k, v in payload.items()}
+    path = os.path.join(dirpath, fname)
+    # content fsync only: the manifest commit fsyncs the shared directory
+    _atomic_savez(path, payload, compress=compress, fsync_dir=False)
+    return {"file": fname, "first": int(first), "last": int(last),
+            "chains": int(next(iter(payload.values())).shape[0]),
+            "nbytes": int(os.path.getsize(path)), "checksums": checks}
+
+
+def save_state_file(dirpath: str, tag: str, spec, state, *,
+                    keys_data=None) -> dict:
+    """Write the O(state) part of an append-only snapshot: the carry leaves
+    (structurally named, like format v2) plus the raw RNG key data.  Returns
+    the manifest entry (file name, checksums, size).  ``tag`` is the
+    snapshot tag (``"00000008"`` for 8 recorded samples, ``"t00000004"`` for
+    a burn-in snapshot at sweep 4)."""
+    import jax
+
+    names, skel_def = _state_skeleton(spec)
+    leaves, state_def = jax.tree_util.tree_flatten(state)
+    if state_def != skel_def:
+        raise CheckpointError(
+            "carry state structure does not match the layout derived from "
+            "the model spec — refusing to write an unloadable snapshot")
+    payload = {f"state:{n}": np.asarray(x) for n, x in zip(names, leaves)}
+    if keys_data is not None:
+        payload["rngkeys"] = np.asarray(keys_data)
+    checks = {k: _crc(v) for k, v in payload.items()}
+    fname = f"state-{tag}.npz"
+    path = os.path.join(dirpath, fname)
+    # content fsync only: the manifest commit fsyncs the shared directory
+    _atomic_savez(path, payload, fsync_dir=False)
+    return {"file": fname, "checksums": checks,
+            "nbytes": int(os.path.getsize(path))}
+
+
+def save_manifest(dirpath: str, tag: str, manifest: dict) -> str:
+    """Atomically write ``manifest-<tag>.json`` — the snapshot's commit
+    point: a kill before the rename leaves the previous manifest (and every
+    file it references) fully intact, so the newest *visible* manifest is
+    always consistent."""
+    manifest = dict(manifest)
+    manifest["format"] = "hmsc_tpu-manifest"
+    manifest["version"] = MANIFEST_VERSION
+    path = os.path.join(dirpath, f"manifest-{tag}.json")
+    _atomic_write_bytes(path, json.dumps(manifest, sort_keys=True).encode())
+    return path
+
+
+def load_manifest(path: str) -> dict:
+    """Parse + structurally validate one manifest file (no payload reads).
+
+    Every malformation — unreadable bytes, non-JSON, or JSON that parses
+    but is missing/mistyping required fields (a flipped byte inside a key
+    name still decodes as valid JSON) — raises
+    :class:`CheckpointCorruptError`, so callers' corrupt-slot fallback
+    catches it; a bare KeyError must never escape a corrupt manifest."""
+    try:
+        with open(path, "rb") as f:
+            man = json.loads(f.read().decode())
+    except (OSError, ValueError, UnicodeDecodeError) as e:
+        raise CheckpointCorruptError(
+            f"{path}: unreadable manifest ({type(e).__name__}: {e})") from e
+    if not isinstance(man, dict) or man.get("format") != "hmsc_tpu-manifest":
+        raise CheckpointCorruptError(f"{path}: not an hmsc_tpu manifest")
+    try:
+        if int(man.get("version", 1)) > MANIFEST_VERSION:
+            # raised as a plain CheckpointError (not Corrupt): every slot
+            # of a future-format run mismatches the same way, so falling
+            # back slot-by-slot would only bury the real message
+            raise CheckpointError(
+                f"{path}: manifest version {man['version']} is newer than "
+                f"this package reads (<= {MANIFEST_VERSION}) — upgrade "
+                "hmsc_tpu to resume this run")
+        for key in ("samples", "transient", "thin", "n_chains", "nf_cap",
+                    "spec_sha256", "state"):
+            if key not in man:
+                raise CheckpointCorruptError(
+                    f"{path}: manifest is missing {key!r} — corrupt")
+        for key in ("samples", "transient", "thin", "n_chains", "nf_cap"):
+            int(man[key])          # mangled value -> ValueError -> corrupt
+        if not isinstance(man["state"], dict) or "file" not in man["state"]:
+            raise CheckpointCorruptError(
+                f"{path}: manifest carries no state-file entry — corrupt")
+        shards = man.get("shards", [])
+        cursor = 0
+        for s in shards:
+            if int(s["first"]) != cursor:
+                raise CheckpointCorruptError(
+                    f"{path}: shard sequence is not contiguous — "
+                    f"{s['file']} starts at {s['first']}, expected {cursor}")
+            cursor = int(s["last"]) + 1
+        if cursor != int(man["samples"]):
+            raise CheckpointCorruptError(
+                f"{path}: shards cover {cursor} samples, manifest claims "
+                f"{man.get('samples')}")
+    except CheckpointError:
+        raise
+    except (KeyError, TypeError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"{path}: structurally corrupt manifest "
+            f"({type(e).__name__}: {e})") from e
+    return man
+
+
+def _npz_member_mmap(path: str, name: str):
+    """Memory-map one member of an *uncompressed* ``.npz`` without copying.
+
+    ``np.load(mmap_mode=...)`` silently ignores mmap for zipped archives, so
+    the member's raw ``.npy`` bytes are located via the zip local header and
+    mapped directly.  Returns ``None`` when the member is deflated or the
+    layout is unexpected — callers fall back to a regular (copying) read."""
+    import zipfile
+    try:
+        with zipfile.ZipFile(path) as zf:
+            info = zf.getinfo(name + ".npy")
+            if info.compress_type != zipfile.ZIP_STORED:
+                return None
+        with open(path, "rb") as f:
+            f.seek(info.header_offset)
+            hdr = f.read(30)
+            if len(hdr) < 30 or hdr[:4] != b"PK\x03\x04":
+                return None
+            f.seek(info.header_offset + 30
+                   + int.from_bytes(hdr[26:28], "little")
+                   + int.from_bytes(hdr[28:30], "little"))
+            version = np.lib.format.read_magic(f)
+            shape, fortran, dtype = np.lib.format._read_array_header(f,
+                                                                     version)
+            if dtype.hasobject:
+                return None
+            return np.memmap(path, dtype=dtype, mode="r", offset=f.tell(),
+                             shape=shape, order="F" if fortran else "C")
+    except (KeyError, OSError, ValueError, zipfile.BadZipFile,
+            AttributeError):
+        return None
+
+
+def _read_shard_member(path: str, key: str, entry: dict | None = None, *,
+                       mmap: bool = False, verify: bool = True, npz=None):
+    """One payload array out of a shard: mmap view when possible and asked
+    for (unverified — the fast trusted path), else a verified read.  Pass
+    an already-open ``npz`` (NpzFile) to amortise the archive open over
+    many members of the same shard."""
+    if mmap:
+        a = _npz_member_mmap(path, key)
+        if a is not None:
+            return a
+    try:
+        if npz is not None:
+            if key not in npz.files:
+                raise CheckpointCorruptError(
+                    f"{path}: payload {key!r} is missing — the shard is "
+                    "truncated or corrupt")
+            a = npz[key]
+        else:
+            with np.load(path, allow_pickle=False) as z:
+                if key not in z.files:
+                    raise CheckpointCorruptError(
+                        f"{path}: payload {key!r} is missing — the shard "
+                        "is truncated or corrupt")
+                a = z[key]
+    except CheckpointError:
+        raise
+    except (zipfile.BadZipFile, zlib.error, OSError, ValueError, KeyError,
+            EOFError) as e:
+        raise CheckpointCorruptError(
+            f"{path}: unreadable shard ({type(e).__name__}: {e})") from e
+    if verify and entry is not None:
+        want = entry.get("checksums", {}).get(key)
+        if want is not None and _crc(a) != want:
+            raise CheckpointCorruptError(
+                f"{path}: payload {key!r} failed its integrity checksum — "
+                "the shard is corrupt; resume falls back to the newest "
+                "manifest whose shard prefix is intact")
+    return a
+
+
+class ShardBackedArrays:
+    """Posterior arrays assembled lazily from a manifest's shard sequence.
+
+    A MutableMapping drop-in for ``Posterior.arrays``: each parameter is
+    materialised (and cached) only when first accessed, reading just that
+    parameter's payload from each shard — so constructing a Posterior from a
+    multi-GB manifest costs nothing, and a Beta-only workflow never loads
+    Eta at all.  With ``mmap=True`` single-shard parameters come back as
+    zero-copy ``np.memmap`` views (multi-shard parameters still concatenate
+    — one copy of that parameter, not of the history); mmap views skip
+    checksum verification (the fast trusted path — use the default eager
+    load when integrity matters more than RAM)."""
+
+    def __init__(self, dirpath: str, shards: list, *, mmap: bool = False,
+                 verify: bool = True):
+        self._dir = os.fspath(dirpath)
+        self._shards = [dict(s) for s in shards]
+        self._mmap = bool(mmap)
+        self._verify = bool(verify)
+        self._data = {}
+        self._lazy = ([k[5:] for k in self._shards[0].get("checksums", {})
+                       if k.startswith("post:")] if self._shards else [])
+        # chain-count hint so Posterior need not materialise a parameter
+        # just to read its leading axis
+        self.chains = (int(self._shards[0].get("chains", 0))
+                       if self._shards else 0)
+
+    def __getitem__(self, key):
+        if key in self._data:
+            return self._data[key]
+        if key not in self._lazy:
+            raise KeyError(key)
+        parts = [_read_shard_member(os.path.join(self._dir, s["file"]),
+                                    f"post:{key}", s, mmap=self._mmap,
+                                    verify=self._verify)
+                 for s in self._shards]
+        a = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=1)
+        self._data[key] = a
+        self._lazy.remove(key)       # materialised: exactly one home per key
+        return a
+
+    def __setitem__(self, key, value):
+        self._data[key] = np.asarray(value)
+        if key in self._lazy:
+            self._lazy.remove(key)
+
+    def __delitem__(self, key):
+        found = key in self._data or key in self._lazy
+        self._data.pop(key, None)
+        if key in self._lazy:
+            self._lazy.remove(key)
+        if not found:
+            raise KeyError(key)
+
+    def __contains__(self, key):
+        return key in self._data or key in self._lazy
+
+    def __iter__(self):
+        # snapshot: materialising a key mid-iteration (items()/values())
+        # moves it from _lazy to _data, which must not shift the iterator
+        yield from [*self._data, *self._lazy]
+
+    def __len__(self):
+        return len(self._data) + len(self._lazy)
+
+    def keys(self):
+        return list(self)
+
+    def values(self):
+        return (self[k] for k in self)
+
+    def items(self):
+        return ((k, self[k]) for k in self)
+
+    def get(self, key, default=None):
+        return self[key] if key in self else default
+
+    def materialize(self) -> dict:
+        """Force every parameter into a plain dict (one pass, cached)."""
+        return {k: self[k] for k in self}
+
+
+def load_manifest_checkpoint(path: str, hM, *, mmap: bool = False,
+                             verify: bool = True) -> LoadedCheckpoint:
+    """Load an append-only snapshot from its ``manifest-<n>.json``.
+
+    The carry state (and its checksums) is always read eagerly — it is
+    O(state), and a resume cannot start from an unverified carry.  The
+    posterior is assembled from the shard sequence: eagerly with full
+    checksum verification by default (a corrupt shard raises
+    :class:`CheckpointCorruptError`, and ``latest_valid_checkpoint`` then
+    falls back to the newest manifest whose shard prefix is intact — the
+    truncate-to-last-consistent-prefix guarantee), or as a lazily
+    materialised, optionally memory-mapped view with ``mmap=True`` so a
+    multi-GB draw history loads without a full host-RAM copy."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..mcmc.structs import build_spec
+    from ..post.posterior import Posterior
+
+    path = os.fspath(path)
+    d = os.path.dirname(path) or "."
+    man = load_manifest(path)
+
+    spec = build_spec(hM, int(man["nf_cap"]))
+    got_fp = spec_fingerprint(spec)
+    if got_fp != man["spec_sha256"]:
+        raise CheckpointSpecMismatchError(
+            f"{path}: model spec fingerprint mismatch "
+            f"({got_fp[:12]}… != {man['spec_sha256'][:12]}…) — the snapshot "
+            "was written for a different model; rebuild the matching Hmsc "
+            "object to resume")
+
+    st_entry = man["state"]
+    spath = os.path.join(d, st_entry["file"])
+    try:
+        with np.load(spath, allow_pickle=False) as z:
+            data = {k: z[k] for k in z.files}
+    except (zipfile.BadZipFile, zlib.error, OSError, ValueError, KeyError,
+            EOFError) as e:
+        raise CheckpointCorruptError(
+            f"{spath}: unreadable state file ({type(e).__name__}: {e})") \
+            from e
+    for k, want in st_entry.get("checksums", {}).items():
+        if k not in data:
+            raise CheckpointCorruptError(
+                f"{spath}: payload {k!r} is missing — truncated or corrupt")
+        if _crc(data[k]) != want:
+            raise CheckpointCorruptError(
+                f"{spath}: payload {k!r} failed its integrity checksum — "
+                "the state file is corrupt; fall back to an earlier "
+                "manifest")
+    names, treedef = _state_skeleton(spec)
+    missing = [n for n in names if f"state:{n}" not in data]
+    if missing:
+        raise CheckpointCorruptError(
+            f"{spath}: carry-state leaves missing: {missing}")
+    state = jax.tree_util.tree_unflatten(
+        treedef, [jnp.asarray(data[f"state:{n}"]) for n in names])
+    keys = None
+    if "rngkeys" in data and man.get("keys_impl"):
+        keys = jax.random.wrap_key_data(
+            jnp.asarray(data["rngkeys"]), impl=man["keys_impl"])
+
+    shards = man.get("shards", [])
+    if mmap:
+        # mapped members skip checksum verification (the documented fast
+        # trusted path); `verify` still governs any fallback copy-read of
+        # a member that cannot be mapped (e.g. a compressed shard)
+        arrays = ShardBackedArrays(d, shards, mmap=True, verify=verify)
+    else:
+        # eager: verify + materialise in one pass, opening each shard's
+        # archive once and reading each payload exactly once (NpzFile
+        # re-inflates the zip member on every access)
+        parts = {}
+        for s in shards:
+            sp = os.path.join(d, s["file"])
+            try:
+                with np.load(sp, allow_pickle=False) as z:
+                    for k in s.get("checksums", {}):
+                        a = _read_shard_member(sp, k, s, verify=verify,
+                                               npz=z)
+                        parts.setdefault(k[5:], []).append(a)
+            except CheckpointError:
+                raise
+            except (zipfile.BadZipFile, zlib.error, OSError, ValueError,
+                    KeyError, EOFError) as e:
+                raise CheckpointCorruptError(
+                    f"{sp}: unreadable shard ({type(e).__name__}: {e})") \
+                    from e
+        arrays = {k: (v[0] if len(v) == 1 else np.concatenate(v, axis=1))
+                  for k, v in parts.items()}
+
+    post = Posterior(hM, spec, arrays, samples=int(man["samples"]),
+                     transient=int(man["transient"]),
+                     thin=int(man["thin"]))
+    if not len(post.arrays):
+        post.n_chains = int(man.get("n_chains", 0))
+    if "first_bad_it" in man:
+        post.set_chain_health(np.asarray(man["first_bad_it"]))
+    post.nf_saturation = {int(r): np.asarray(v)
+                          for r, v in man.get("nf_saturation", {}).items()}
+    return LoadedCheckpoint(post=post, state=state, keys=keys,
+                            run_meta=dict(man.get("run", {})),
+                            header=man, path=path)
 
 
 # ---------------------------------------------------------------------------
@@ -379,9 +838,12 @@ def load_checkpoint(path: str, hM, *, allow_legacy_pickle: bool = False):
 # ---------------------------------------------------------------------------
 
 def checkpoint_files(path: str) -> list[str]:
-    """Auto-checkpoint files under a directory, newest first: sample
+    """Resume candidates under a directory, newest first: append-layout
+    manifests and legacy self-contained snapshots interleaved — sample
     snapshots (most samples first), then burn-in snapshots (most sweeps
-    first — every burn-in snapshot predates every sample snapshot).  A
+    first — every burn-in snapshot predates every sample snapshot); at
+    equal recency a manifest outranks a legacy file.  Shard and state files
+    are *not* listed (they are only reachable through a manifest).  A
     direct file path is returned as a single-element list; an ``archive/``
     subdirectory is never scanned."""
     path = os.fspath(path)
@@ -393,15 +855,49 @@ def checkpoint_files(path: str) -> list[str]:
     entries = []
     for fn in os.listdir(path):
         m = _CKPT_RE.fullmatch(fn)
+        pref = 0
+        if m is None:
+            m = _MANIFEST_RE.fullmatch(fn)
+            pref = 1                           # manifest outranks legacy
         if m:
             kind = 0 if m.group(1) else 1      # burn-in sorts below samples
-            entries.append(((kind, int(m.group(2))), os.path.join(path, fn)))
+            entries.append(((kind, int(m.group(2)), pref),
+                            os.path.join(path, fn)))
     return [p for _, p in sorted(entries, reverse=True)]
+
+
+_TMP_RE = re.compile(r"(.+)\.tmp\.\d+")
+
+
+def _is_layout_name(fn: str) -> bool:
+    return bool(_CKPT_RE.fullmatch(fn) or _MANIFEST_RE.fullmatch(fn)
+                or _SHARD_RE.fullmatch(fn) or _STATE_RE.fullmatch(fn))
+
+
+def _layout_files(path: str) -> list[str]:
+    """Every file the checkpoint layouts own under a directory (legacy
+    snapshots, manifests, shards, state files, and stale ``*.tmp.<pid>``
+    atomic-write leftovers from a kill mid-write) — the set a fresh run
+    clears so a later ``resume_run`` cannot mix two runs, and the set the
+    ``checkpoint_max_bytes`` budget counts."""
+    path = os.fspath(path)
+    if not os.path.isdir(path):
+        return []
+    out = []
+    for fn in os.listdir(path):
+        m = _TMP_RE.fullmatch(fn)
+        if _is_layout_name(fn) or (m and _is_layout_name(m.group(1))):
+            out.append(os.path.join(path, fn))
+    return out
 
 
 def rotate_checkpoints(path: str, keep: int, *,
                        max_age_s: float | None = None) -> None:
-    """Delete all but the newest ``keep`` auto-checkpoints in a directory.
+    """Delete all but the newest ``keep`` snapshots in a directory
+    (manifests and legacy self-contained files alike — deleting a manifest
+    is the append layout's rotation primitive; the shards it alone
+    referenced are reclaimed by :func:`gc_checkpoints`).  ``keep <= 0``
+    keeps every snapshot (rotation off; age/bytes policies still apply).
 
     ``max_age_s`` adds an age-based policy on top: snapshots whose mtime is
     older than ``max_age_s`` seconds are deleted even inside the keep
@@ -427,11 +923,140 @@ def rotate_checkpoints(path: str, keep: int, *,
             pass
 
 
+def _gc_orphans(path: str) -> int:
+    """Delete shard / state files referenced by no surviving manifest.
+
+    Shards are immutable and shared between manifests, so this is the only
+    way they are ever reclaimed: rotation deletes manifests, GC sweeps what
+    nothing references any more (including shards orphaned by a kill
+    between a shard write and its manifest commit).  Unreadable manifests
+    contribute no references — their unique files age out with them.
+    Returns the number of files removed."""
+    path = os.fspath(path)
+    if not os.path.isdir(path):
+        return 0
+    fns = os.listdir(path)
+    referenced = set()
+    for fn in fns:
+        if not _MANIFEST_RE.fullmatch(fn):
+            continue
+        try:
+            man = load_manifest(os.path.join(path, fn))
+        except CheckpointError:
+            continue
+        referenced.add(man["state"]["file"])
+        referenced.update(s["file"] for s in man.get("shards", []))
+    removed = 0
+    for fn in fns:
+        doomed = ((_SHARD_RE.fullmatch(fn) or _STATE_RE.fullmatch(fn))
+                  and fn not in referenced)
+        if not doomed:
+            # stale atomic-write tmp from a kill mid-write (a SIGKILL can
+            # leave up to a full segment of draws behind, invisible to
+            # rotation): reclaim any layout-named tmp not owned by this
+            # process — our own in-flight tmps clean themselves up and GC
+            # runs FIFO-after every write on the same thread anyway
+            m = _TMP_RE.fullmatch(fn)
+            doomed = (m is not None and _is_layout_name(m.group(1))
+                      and not fn.endswith(f".{os.getpid()}"))
+        if doomed:
+            try:
+                os.unlink(os.path.join(path, fn))
+                removed += 1
+            except OSError:
+                pass
+    return removed
+
+
+def _snapshot_floor_bytes(newest: str) -> int:
+    """On-disk footprint of one snapshot and everything it references —
+    the irreducible floor the ``max_bytes`` budget can reach while that
+    snapshot survives.  Unreadable snapshots contribute 0 (the budget loop
+    then proceeds normally)."""
+    try:
+        if newest.endswith(".json"):
+            man = load_manifest(newest)
+            d = os.path.dirname(newest) or "."
+            total = os.path.getsize(newest)
+            total += os.path.getsize(os.path.join(d, man["state"]["file"]))
+            total += sum(int(s.get("nbytes", 0))
+                         for s in man.get("shards", []))
+            return total
+        return os.path.getsize(newest)
+    except (CheckpointError, OSError):
+        return 0
+
+
+def _layout_bytes(path: str) -> int:
+    """Total bytes the checkpoint layouts hold under a directory (manifests
+    + state files + shards + legacy snapshots; ``archive/`` excluded)."""
+    total = 0
+    for p in _layout_files(path):
+        try:
+            total += os.path.getsize(p)
+        except OSError:
+            pass
+    return total
+
+
+def gc_checkpoints(path: str, keep: int, *, max_age_s: float | None = None,
+                   max_bytes: int | None = None) -> None:
+    """Manifest-driven rotation for the append-only layout (also rotates
+    any legacy self-contained snapshots sharing the directory).
+
+    Count (``keep`` newest) and age (``max_age_s``) policies first, then an
+    optional total-bytes budget: while the layout holds more than
+    ``max_bytes`` on disk and more than one snapshot survives, the oldest
+    surviving snapshot is dropped (the newest is never deleted — a run must
+    not GC away its only resume point).  Finally, shard and state files no
+    surviving manifest references are deleted.  Files hard-linked into
+    ``archive/`` are exempt throughout (hard links share the inode, so
+    archiving live shards costs no extra bytes until GC would have
+    reclaimed them)."""
+    rotate_checkpoints(path, keep, max_age_s=max_age_s)
+    _gc_orphans(path)
+    if max_bytes is not None:
+        files = checkpoint_files(path)
+        # the newest snapshot plus everything it references is the floor:
+        # a budget below it is unsatisfiable, and burning the fallback
+        # slots would buy nothing but lost resumability — keep them and
+        # warn instead (warnings dedup per call site, so a long run says
+        # this once, not once per snapshot)
+        floor = _snapshot_floor_bytes(files[0]) if files else 0
+        if floor > max_bytes:
+            # stable message (no byte counts): the default warning filter
+            # dedups on the exact text, so a long run says this once — an
+            # embedded, growing footprint would re-fire every snapshot
+            warnings.warn(
+                "checkpoint_max_bytes is below the newest snapshot's own "
+                "footprint (manifest + state + referenced shards); "
+                "deleting older snapshots cannot meet the budget, so they "
+                "are kept as fallback resume slots.  Raise the budget or "
+                "lower the shard volume (record= selection, record_dtype)",
+                RuntimeWarning, stacklevel=3)
+        elif floor > 0:
+            # floor == 0 means the newest snapshot is unreadable: trimming
+            # by budget then would delete the only VALID fallback slots
+            # while sparing the corrupt newest — leave the directory to
+            # the resume-time corrupt-slot fallback instead
+            while len(files) > 1 and _layout_bytes(path) > max_bytes:
+                victim = files.pop()           # oldest snapshot
+                try:
+                    os.unlink(victim)
+                except OSError:
+                    pass
+                _gc_orphans(path)
+
+
 def latest_valid_checkpoint(path: str, hM, *,
                             allow_legacy_pickle: bool = False) -> LoadedCheckpoint:
     """Newest checkpoint that loads cleanly; corrupt slots are skipped with
-    a warning (falling back to the previous rotation slot).  A spec mismatch
-    is raised immediately — every slot would mismatch the same way."""
+    a warning (falling back to the previous rotation slot).  Under the
+    append-only layout a corrupt *shard* corrupts every manifest that
+    references it, so the fallback lands on the newest manifest whose shard
+    prefix is fully intact — truncation to the last consistent prefix.  A
+    spec mismatch is raised immediately — every slot would mismatch the
+    same way."""
     cands = checkpoint_files(path)
     if not cands:
         raise CheckpointError(f"no checkpoints found under {path!r}")
@@ -467,6 +1092,8 @@ def resume_run(hM, checkpoint_path: str, *, verbose: int = 0,
                checkpoint_keep: int | None = None,
                checkpoint_max_age_s: float | None = None,
                checkpoint_archive_every: int | None = None,
+               checkpoint_max_bytes: int | None = None,
+               checkpoint_layout: str | None = None,
                allow_legacy_pickle: bool = False, mesh=None,
                chain_axis: str = "chains", species_axis: str = "species",
                pipeline: bool = True):
@@ -488,10 +1115,15 @@ def resume_run(hM, checkpoint_path: str, *, verbose: int = 0,
     carried per-chain key makes the draw stream segmentation-invariant, so
     neither can change a single draw (asserted by the pipeline test suite).
     The rotation knobs (``checkpoint_keep`` / ``checkpoint_max_age_s`` /
-    ``checkpoint_archive_every``) are likewise overridable — they only
-    manage files on disk.  Parameters that *would* change the stream (seed,
-    thin, updaters, RNG impl, record selection) are deliberately not
-    overridable and always come from the checkpoint.  A device ``mesh`` is not serializable, so a
+    ``checkpoint_archive_every`` / ``checkpoint_max_bytes``) and the
+    on-disk ``checkpoint_layout`` (``"append"`` / ``"rotating"``) are
+    likewise overridable — they only manage files on disk (resuming a
+    legacy rotating directory continues in the append-only layout by
+    default: the base draws are flushed once as a base shard and every
+    later snapshot is O(segment); see MIGRATION.md).  Parameters that
+    *would* change the stream (seed, thin, updaters, RNG impl, record
+    selection) are deliberately not overridable and always come from the
+    checkpoint.  A device ``mesh`` is not serializable, so a
     sharded run passes its (possibly different) mesh back in via
     ``mesh=``/``chain_axis=``/``species_axis=``."""
     import jax.numpy as jnp
@@ -511,6 +1143,24 @@ def resume_run(hM, checkpoint_path: str, *, verbose: int = 0,
         if ck_every < 0:
             raise ValueError(
                 f"checkpoint_every override must be >= 0, got {ck_every}")
+    # rotation-policy overrides manage files only — validate them here so a
+    # bad override fails before any sampling (they can never change draws)
+    if checkpoint_keep is not None and int(checkpoint_keep) < 0:
+        raise ValueError("checkpoint_keep override must be >= 0 (0 keeps "
+                         f"every snapshot), got {checkpoint_keep}")
+    if checkpoint_max_age_s is not None and checkpoint_max_age_s <= 0:
+        raise ValueError("checkpoint_max_age_s override must be > 0, got "
+                         f"{checkpoint_max_age_s}")
+    if checkpoint_archive_every is not None and checkpoint_archive_every < 0:
+        raise ValueError("checkpoint_archive_every override must be >= 0, "
+                         f"got {checkpoint_archive_every}")
+    if checkpoint_max_bytes is not None and int(checkpoint_max_bytes) < 1:
+        raise ValueError("checkpoint_max_bytes override must be >= 1, got "
+                         f"{checkpoint_max_bytes}")
+    if checkpoint_layout is not None \
+            and checkpoint_layout not in ("append", "rotating"):
+        raise ValueError("checkpoint_layout override must be 'append' or "
+                         f"'rotating', got {checkpoint_layout!r}")
 
     total = int(meta["samples_total"]) + int(extra_samples)
     done = int(meta["samples_done"])
@@ -564,8 +1214,19 @@ def resume_run(hM, checkpoint_path: str, *, verbose: int = 0,
         checkpoint_archive_every=int(
             (meta.get("checkpoint_archive_every", 0) or 0)
             if checkpoint_archive_every is None else checkpoint_archive_every),
+        checkpoint_max_bytes=(meta.get("checkpoint_max_bytes")
+                              if checkpoint_max_bytes is None
+                              else checkpoint_max_bytes),
+        checkpoint_layout=(meta.get("checkpoint_layout", "append")
+                           if checkpoint_layout is None
+                           else checkpoint_layout),
         pipeline=pipeline,
-        _ckpt_base=base, _transient_base=t_done if base is None else 0)
+        _ckpt_base=base, _transient_base=t_done if base is None else 0,
+        # append-layout continuation: the already-flushed shard sequence is
+        # carried forward so new manifests reference it instead of the base
+        # draws being re-serialised into every snapshot
+        _ckpt_shards=list(ck.header.get("shards", []))
+        if ck.path.endswith(".json") else None)
     if base is None:
         out = cont
     else:

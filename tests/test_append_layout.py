@@ -1,0 +1,476 @@
+"""Append-only posterior I/O suite: immutable shards, manifest commit
+points, O(segment) snapshots, manifest-driven GC (count / age / bytes
+budget), memory-mapped lazy loading, legacy-layout migration, and
+warm divergence restarts.
+
+The acceptance bar (ISSUE 3): bytes written per snapshot are independent of
+the total recorded draws (the bench gate in
+``benchmarks/bench_checkpoint_io.py`` asserts the flatness bound; here the
+per-snapshot byte accounting is checked structurally), and kill → resume
+under the append-only layout remains bit-identical to an uninterrupted run
+— including a kill between a shard write and its manifest commit, and a
+corrupt shard forcing the fallback to the last consistent prefix.
+
+Deliberately fast (tier-1): the same tiny model config as the pipeline and
+fault suites, so the compiled segment programs are shared; only the
+warm-restart test is ``slow`` (disarming the NaN injector clears the
+compile cache mid-run).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from hmsc_tpu import resume_run, sample_mcmc
+from hmsc_tpu.utils.checkpoint import (CheckpointCorruptError,
+                                       CheckpointError, MANIFEST_VERSION,
+                                       ShardBackedArrays, checkpoint_files,
+                                       load_checkpoint_full, load_manifest,
+                                       load_manifest_checkpoint, save_shard)
+from hmsc_tpu.testing import (InjectedDeviceLoss, device_loss_after,
+                              flip_bytes, inject_nan)
+
+from util import small_model
+
+pytestmark = pytest.mark.append_layout
+
+M_KW = dict(ny=24, ns=3, nc=2, distr="normal", n_units=5, seed=3)
+RUN_KW = dict(samples=8, transient=4, thin=1, n_chains=2, seed=7, nf_cap=2,
+              align_post=False)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return small_model(**M_KW)
+
+
+@pytest.fixture(scope="module")
+def ref_run(model, tmp_path_factory):
+    """(posterior, checkpoint dir) of the append-layout reference run; the
+    directory is kept so tests can inspect the layout without re-running."""
+    d = os.fspath(tmp_path_factory.mktemp("ref") / "ck")
+    return sample_mcmc(model, **RUN_KW, checkpoint_every=4,
+                       checkpoint_path=d), d
+
+
+@pytest.fixture(scope="module")
+def ref_post(ref_run):
+    return ref_run[0]
+
+
+def _assert_bit_identical(post, ref):
+    assert set(post.arrays) == set(ref.arrays)
+    for k in ref.arrays:
+        np.testing.assert_array_equal(np.asarray(post.arrays[k]),
+                                      np.asarray(ref.arrays[k]), err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# layout structure + O(segment) byte accounting
+# ---------------------------------------------------------------------------
+
+def test_layout_files_and_manifest_structure(ref_run, model):
+    post, d = ref_run
+    names = sorted(os.listdir(d))
+    assert names == ["manifest-00000004.json", "manifest-00000008.json",
+                     "manifest-t00000004.json", "seg-0-00000000-00000003.npz",
+                     "seg-0-00000004-00000007.npz", "state-00000004.npz",
+                     "state-00000008.npz", "state-t00000004.npz"]
+    # newest-first discovery: manifests only (shards/states are internal)
+    assert [os.path.basename(p) for p in checkpoint_files(d)] == \
+        ["manifest-00000008.json", "manifest-00000004.json",
+         "manifest-t00000004.json"]
+
+    man = load_manifest(os.path.join(d, "manifest-00000008.json"))
+    assert man["samples"] == 8 and man["version"] >= 1
+    assert [(s["first"], s["last"]) for s in man["shards"]] == \
+        [(0, 3), (4, 7)]
+    # every shard entry checksums every recorded parameter
+    keys = {k for s in man["shards"] for k in s["checksums"]}
+    assert keys == {f"post:{k}" for k in post.arrays}
+    # the intermediate manifest references exactly the first shard — the
+    # shard files themselves are shared, written once, never rewritten
+    man4 = load_manifest(os.path.join(d, "manifest-00000004.json"))
+    assert [s["file"] for s in man4["shards"]] == \
+        ["seg-0-00000000-00000003.npz"]
+
+
+def test_io_stats_per_snapshot_bytes_are_o_segment(ref_post):
+    st = ref_post.io_stats
+    assert st["checkpoint_layout"] == "append"
+    assert st["shards_written"] == 2
+    assert st["bytes_written"] == sum(st["snapshot_bytes"])
+    # the two SAMPLE snapshots each flush one segment of 4 draws: their
+    # byte cost must be flat (the second writes the same shard size + a
+    # slightly longer manifest), NOT the 2x growth the self-contained
+    # layout would show at 4 -> 8 recorded samples
+    s4, s8 = st["snapshot_bytes"][-2:]
+    assert s8 <= 1.1 * s4, (s4, s8)
+
+
+def test_rotating_layout_grows_append_does_not(tmp_path, model, ref_post):
+    """The regression the layout exists to fix, measured end-to-end at toy
+    scale: the legacy self-contained snapshot doubles when the history
+    doubles; the append snapshot does not."""
+    d = os.fspath(tmp_path / "rot")
+    post = sample_mcmc(model, **RUN_KW, checkpoint_every=4,
+                       checkpoint_path=d, checkpoint_layout="rotating")
+    _assert_bit_identical(post, ref_post)
+    # at this toy scale the carry state dominates both snapshots, so compare
+    # snapshot-to-snapshot GROWTH against the size of one segment of draws:
+    # the rotating snapshot re-serialises the first segment a second time,
+    # the append snapshot pays only manifest metadata growth (the bench gate
+    # asserts the headline flatness bound at a draw-dominated scale)
+    seg_bytes = sum(np.asarray(v).nbytes
+                    for v in ref_post.arrays.values()) // 2
+    r4, r8 = post.io_stats["snapshot_bytes"][-2:]
+    assert r8 - r4 >= 0.9 * seg_bytes, (r4, r8, seg_bytes)
+    a4, a8 = ref_post.io_stats["snapshot_bytes"][-2:]
+    assert a8 - a4 <= 0.5 * seg_bytes, (a4, a8, seg_bytes)
+
+
+# ---------------------------------------------------------------------------
+# kill -> resume bit-identity, mid-manifest-write kill, corrupt-shard prefix
+# ---------------------------------------------------------------------------
+
+def test_kill_resume_bit_exact(tmp_path, model, ref_post):
+    d = os.fspath(tmp_path / "ck")
+    with pytest.raises(InjectedDeviceLoss):
+        sample_mcmc(model, **RUN_KW, checkpoint_every=4, checkpoint_path=d,
+                    progress_callback=device_loss_after(4))
+    assert os.path.basename(checkpoint_files(d)[0]) == \
+        "manifest-00000004.json"
+    res = resume_run(model, d)
+    assert res.samples == 8
+    _assert_bit_identical(res, ref_post)
+    # the continuation appended its shard; nothing was rewritten
+    man = load_manifest(os.path.join(d, "manifest-00000008.json"))
+    assert [s["file"] for s in man["shards"]] == \
+        ["seg-0-00000000-00000003.npz", "seg-0-00000004-00000007.npz"]
+
+
+def test_mid_manifest_write_kill_resumes_bit_exact(tmp_path, model,
+                                                   ref_post):
+    """A kill AFTER the second shard hit disk but BEFORE its manifest
+    commit: the orphan shard (and a torn manifest tmp file) must be
+    invisible to resume — the previous manifest is the newest consistent
+    snapshot, the continuation atomically overwrites the orphan with the
+    identical re-generated draws, and the result is bit-exact."""
+    d = os.fspath(tmp_path / "ck")
+    with pytest.raises(InjectedDeviceLoss):
+        sample_mcmc(model, **RUN_KW, checkpoint_every=4, checkpoint_path=d,
+                    progress_callback=device_loss_after(4))
+    # fabricate the kill window: an orphan shard full of garbage draws plus
+    # a torn manifest tmp (the atomic rename never happened)
+    ck = load_manifest_checkpoint(
+        os.path.join(d, "manifest-00000004.json"), model)
+    garbage = {k: np.zeros_like(np.asarray(v))
+               for k, v in ck.post.arrays.items()}
+    save_shard(d, garbage, 4, 7)
+    with open(os.path.join(d, "manifest-00000008.json.tmp.999"), "w") as f:
+        f.write('{"format": "hmsc_tpu-manifest", "samp')   # torn JSON
+
+    assert os.path.basename(checkpoint_files(d)[0]) == \
+        "manifest-00000004.json"                 # tmp file is not a slot
+    res = resume_run(model, d)
+    _assert_bit_identical(res, ref_post)
+    # the orphan was atomically replaced: the committed manifest's checksum
+    # matches the real draws now in the shard
+    ck8 = load_manifest_checkpoint(
+        os.path.join(d, "manifest-00000008.json"), model)
+    _assert_bit_identical(ck8.post, ref_post)
+
+
+def test_corrupt_shard_falls_back_to_last_consistent_prefix(tmp_path, model,
+                                                            ref_post):
+    """Flipped bytes in the newest shard poison every manifest referencing
+    it; resume must fall back to the newest manifest whose shard prefix is
+    intact and still complete bit-exactly."""
+    d = os.fspath(tmp_path / "ck")
+    post = sample_mcmc(model, **RUN_KW, checkpoint_every=4,
+                       checkpoint_path=d)
+    _assert_bit_identical(post, ref_post)
+    flip_bytes(os.path.join(d, "seg-0-00000004-00000007.npz"))
+
+    with pytest.raises(CheckpointCorruptError):
+        load_manifest_checkpoint(
+            os.path.join(d, "manifest-00000008.json"), model)
+    with pytest.warns(RuntimeWarning, match="corrupt checkpoint"):
+        res = resume_run(model, d)               # falls back to manifest-4
+    _assert_bit_identical(res, ref_post)
+
+
+def test_structurally_corrupt_manifest_falls_back(tmp_path, model, ref_post):
+    """A flipped byte inside a JSON key still parses as valid JSON; the
+    structural validation must turn it into CheckpointCorruptError so the
+    fallback (not a bare KeyError) handles it — on resume AND on the
+    writer-thread GC walk."""
+    d = os.fspath(tmp_path / "ck")
+    sample_mcmc(model, **RUN_KW, checkpoint_every=4, checkpoint_path=d)
+    mp = os.path.join(d, "manifest-00000008.json")
+    with open(mp) as f:
+        man = json.load(f)
+    man["statf"] = man.pop("state")              # key-name bit-rot
+    with open(mp, "w") as f:
+        json.dump(man, f)
+    with pytest.raises(CheckpointCorruptError, match="missing 'state'"):
+        load_manifest(mp)
+    with pytest.warns(RuntimeWarning, match="corrupt checkpoint"):
+        res = resume_run(model, d)               # falls back to manifest-4
+    _assert_bit_identical(res, ref_post)
+    # a FUTURE manifest version gets a clear upgrade message, not a
+    # corrupt-slot fallback (every slot of that run would mismatch alike)
+    man["state"] = man.pop("statf")
+    man["version"] = MANIFEST_VERSION + 1
+    with open(mp, "w") as f:
+        json.dump(man, f)
+    with pytest.raises(CheckpointError, match="newer than"):
+        load_manifest(mp)
+
+
+def test_corrupt_state_file_detected(tmp_path, model, ref_post):
+    d = os.fspath(tmp_path / "ck")
+    sample_mcmc(model, **RUN_KW, checkpoint_every=4, checkpoint_path=d)
+    flip_bytes(os.path.join(d, "state-00000008.npz"))
+    with pytest.raises(CheckpointCorruptError):
+        load_manifest_checkpoint(
+            os.path.join(d, "manifest-00000008.json"), model)
+    with pytest.warns(RuntimeWarning, match="corrupt checkpoint"):
+        res = resume_run(model, d)
+    _assert_bit_identical(res, ref_post)
+
+
+# ---------------------------------------------------------------------------
+# mmap / lazy loading
+# ---------------------------------------------------------------------------
+
+def test_mmap_load_is_lazy_and_correct(ref_run, model):
+    post, d = ref_run
+    ck = load_manifest_checkpoint(checkpoint_files(d)[0], model, mmap=True)
+    arrays = ck.post.arrays
+    assert isinstance(arrays, ShardBackedArrays)
+    assert arrays.chains == 2 and ck.post.n_chains == 2
+    assert set(arrays) == set(post.arrays)       # keys known without reads
+    assert len(arrays._data) == 0                # nothing materialised yet
+    np.testing.assert_array_equal(np.asarray(ck.post["Beta"]),
+                                  post.arrays["Beta"])
+    assert set(arrays._data) == {"Beta"}         # only the touched key
+    # materialisation must not duplicate the key in the mapping
+    assert list(arrays).count("Beta") == 1
+    assert len(arrays) == len(post.arrays)
+    # summaries work straight off the lazy view
+    assert ck.post.pooled("Beta").shape[0] == 16
+    _assert_bit_identical(ck.post, post)         # full materialisation
+    # iteration that materialises mid-walk (items() moves keys from the
+    # lazy list to the cache) must still visit EVERY parameter exactly once
+    ck2 = load_manifest_checkpoint(checkpoint_files(d)[0], model, mmap=True)
+    assert dict(ck2.post.arrays.items()).keys() == set(post.arrays)
+
+
+def test_mmap_single_shard_is_zero_copy_view(tmp_path, model):
+    """With one shard per parameter the mmap view IS an np.memmap — no
+    host-RAM copy of the draw history at all."""
+    d = os.fspath(tmp_path / "ck")
+    sample_mcmc(model, **RUN_KW, checkpoint_path=d)    # single final snapshot
+    ck = load_manifest_checkpoint(checkpoint_files(d)[0], model, mmap=True)
+    assert isinstance(ck.post["Beta"], np.memmap)
+
+
+# ---------------------------------------------------------------------------
+# rotation / GC policies (incl. resume overrides — satellite: ROADMAP item)
+# ---------------------------------------------------------------------------
+
+def test_gc_reclaims_unreferenced_shards(tmp_path, model, ref_post):
+    d = os.fspath(tmp_path / "ck")
+    post = sample_mcmc(model, **RUN_KW, checkpoint_every=4,
+                       checkpoint_path=d, checkpoint_keep=1)
+    _assert_bit_identical(post, ref_post)
+    # only the final manifest survives — but it references BOTH shards, so
+    # GC must keep them (shards are shared; nothing is ever rewritten)
+    assert sorted(os.listdir(d)) == \
+        ["manifest-00000008.json", "seg-0-00000000-00000003.npz",
+         "seg-0-00000004-00000007.npz", "state-00000008.npz"]
+
+
+def test_gc_sweeps_stale_tmp_files(tmp_path, model):
+    """A kill mid-atomic-write leaves a *.tmp.<pid> file; it must be
+    counted by the budget and reclaimed by GC (never accumulate forever),
+    while a foreign non-layout file is left alone."""
+    from hmsc_tpu.utils.checkpoint import _layout_files, gc_checkpoints
+
+    d = os.fspath(tmp_path / "ck")
+    sample_mcmc(model, **RUN_KW, checkpoint_every=4, checkpoint_path=d)
+    stale = os.path.join(d, "seg-0-00000008-00000011.npz.tmp.99999")
+    with open(stale, "wb") as f:
+        f.write(b"x" * 64)
+    other = os.path.join(d, "notes.txt")
+    with open(other, "w") as f:
+        f.write("mine")
+    assert stale in _layout_files(d)
+    gc_checkpoints(d, keep=3)
+    assert not os.path.exists(stale)
+    assert os.path.exists(other)
+
+
+def test_size_budget_drops_oldest_snapshots_never_newest(tmp_path, model,
+                                                         ref_post):
+    from hmsc_tpu.utils.checkpoint import (_layout_bytes,
+                                           _snapshot_floor_bytes,
+                                           gc_checkpoints)
+
+    d = os.fspath(tmp_path / "ck")
+    post = sample_mcmc(model, **RUN_KW, checkpoint_every=4,
+                       checkpoint_path=d)
+    _assert_bit_identical(post, ref_post)
+    floor = _snapshot_floor_bytes(checkpoint_files(d)[0])
+    total = _layout_bytes(d)
+    assert 0 < floor < total
+    # a budget between the newest snapshot's floor and the full layout:
+    # oldest fallback slots are dropped until the budget is met, the
+    # newest (the resume point) always survives
+    budget = (floor + total) // 2
+    gc_checkpoints(d, keep=3, max_bytes=budget)
+    assert _layout_bytes(d) <= budget
+    assert os.path.basename(checkpoint_files(d)[0]) == \
+        "manifest-00000008.json"
+    res = resume_run(model, d)
+    _assert_bit_identical(res, ref_post)         # policy never touches draws
+    # an UNSATISFIABLE budget (below the newest snapshot's own footprint)
+    # must keep the surviving fallback slots and warn, not silently burn
+    # every fallback for a budget it can never reach
+    n_before = len(checkpoint_files(d))
+    with pytest.warns(RuntimeWarning, match="own footprint"):
+        gc_checkpoints(d, keep=3, max_bytes=1)
+    assert len(checkpoint_files(d)) == n_before
+
+
+def test_budget_gc_spares_fallbacks_behind_corrupt_newest(tmp_path, model,
+                                                          ref_post):
+    """When the newest manifest is unreadable, the bytes-budget pass must
+    not trim the older, still-valid slots — they are the only resume
+    points left, and the corrupt-slot fallback needs them."""
+    from hmsc_tpu.utils.checkpoint import gc_checkpoints
+
+    d = os.fspath(tmp_path / "ck")
+    sample_mcmc(model, **RUN_KW, checkpoint_every=4, checkpoint_path=d)
+    with open(checkpoint_files(d)[0], "w") as f:
+        f.write("{broken json")
+    n = len(checkpoint_files(d))
+    gc_checkpoints(d, keep=3, max_bytes=10)      # aggressive budget
+    assert len(checkpoint_files(d)) == n
+    with pytest.warns(RuntimeWarning, match="corrupt checkpoint"):
+        res = resume_run(model, d)
+    _assert_bit_identical(res, ref_post)
+
+
+def test_resume_rotation_overrides_draw_invariant(tmp_path, model, ref_post):
+    """ROADMAP item: checkpoint_keep / rotation policies are overridable on
+    resume — they only manage files, so the draw stream must be unchanged;
+    invalid overrides fail fast."""
+    d = os.fspath(tmp_path / "ck")
+    with pytest.raises(InjectedDeviceLoss):
+        sample_mcmc(model, **RUN_KW, checkpoint_every=4, checkpoint_path=d,
+                    progress_callback=device_loss_after(4))
+
+    for bad_kw in (dict(checkpoint_keep=-1), dict(checkpoint_max_age_s=-1.0),
+                   dict(checkpoint_archive_every=-1),
+                   dict(checkpoint_max_bytes=0),
+                   dict(checkpoint_layout="sideways")):
+        with pytest.raises(ValueError, match="override"):
+            resume_run(model, d, **bad_kw)
+
+    res = resume_run(model, d, checkpoint_keep=1, checkpoint_max_bytes=10**9,
+                     checkpoint_archive_every=1)
+    _assert_bit_identical(res, ref_post)
+    # the keep=1 override governed the continuation's rotation...
+    assert [os.path.basename(p) for p in checkpoint_files(d)] == \
+        ["manifest-00000008.json"]
+    # ...and became the stored policy for later resumes
+    meta = load_checkpoint_full(checkpoint_files(d)[0], model).run_meta
+    assert meta["checkpoint_keep"] == 1
+    assert meta["checkpoint_max_bytes"] == 10**9
+    # archive_every=1 archived the continuation's snapshot self-contained
+    assert "manifest-00000008.json" in os.listdir(os.path.join(d, "archive"))
+
+
+# ---------------------------------------------------------------------------
+# legacy (rotating self-contained) interop: migration on resume
+# ---------------------------------------------------------------------------
+
+def test_legacy_resume_migrates_to_append_layout(tmp_path, model, ref_post):
+    """Resuming a legacy rotating directory continues in the append layout:
+    the base draws are flushed ONCE as a base shard, later snapshots are
+    O(segment), and the draws stay bit-identical."""
+    d = os.fspath(tmp_path / "ck")
+    with pytest.raises(InjectedDeviceLoss):
+        sample_mcmc(model, **RUN_KW, checkpoint_every=4, checkpoint_path=d,
+                    checkpoint_layout="rotating",
+                    progress_callback=device_loss_after(4))
+    assert os.path.basename(checkpoint_files(d)[0]) == "ckpt-00000004.npz"
+
+    res = resume_run(model, d, checkpoint_layout="append")
+    _assert_bit_identical(res, ref_post)
+    man = load_manifest(os.path.join(d, "manifest-00000008.json"))
+    assert [(s["first"], s["last"]) for s in man["shards"]] == \
+        [(0, 3), (4, 7)]                         # base shard + new segment
+    ck = load_manifest_checkpoint(os.path.join(d, "manifest-00000008.json"),
+                                  model)
+    _assert_bit_identical(ck.post, ref_post)
+
+
+# ---------------------------------------------------------------------------
+# warm divergence restart (ROADMAP item: no more from-scratch burn-in)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_warm_retry_restarts_from_last_healthy_manifest(tmp_path, model):
+    """A chain that diverges mid-sampling is warm-restarted from the newest
+    manifest at which it was still healthy: its healthy draws are kept, only
+    the remainder is re-run (fresh key stream), the repaired tail is
+    committed as a repair shard, and resume returns the spliced posterior
+    from a finite carry."""
+    import jax
+
+    d = os.fspath(tmp_path / "ck")
+    # poison sweep 10 (transient 4 + recorded samples 5..8), then disarm once
+    # it struck — a real blow-up does not recur under a fresh key stream
+    with inject_nan(updater="update_beta_lambda", at_iteration=10,
+                    field="Beta") as disarm:
+        def cb(done, total):
+            if done >= 8:
+                disarm()
+        with pytest.warns(RuntimeWarning, match="diverged"):
+            post = sample_mcmc(model, **RUN_KW, checkpoint_every=4,
+                               checkpoint_path=d, retry_diverged=1,
+                               progress_callback=cb)
+
+    assert post.retry_info["retried_chains"] == (0, 1)
+    assert post.retry_info["healthy_after_retry"] == (True, True)
+    assert post.retry_info["warm_start_samples"] == 4    # manifest-4 reused
+    assert post.chain_health["good_chains"].all()
+    assert np.isfinite(post["Beta"]).all()
+
+    # draws BEFORE the warm-start point are the original healthy draws
+    ck4 = load_manifest_checkpoint(os.path.join(d, "manifest-00000004.json"),
+                                   model)
+    for k in ck4.post.arrays:
+        np.testing.assert_array_equal(post.arrays[k][:, :4],
+                                      ck4.post.arrays[k], err_msg=k)
+
+    # the repaired tail lives in a NEW immutable repair shard; the
+    # superseded shard was GC'd
+    man = load_manifest(os.path.join(d, "manifest-00000008.json"))
+    assert [s["file"] for s in man["shards"]] == \
+        ["seg-0-00000000-00000003.npz", "seg-0-00000004-00000007-r1.npz"]
+    assert not os.path.exists(os.path.join(d, "seg-0-00000004-00000007.npz"))
+
+    # resume of the completed run returns the spliced posterior, and the
+    # stored carry is the finite replacement (an extension must not restart
+    # from the poisoned state)
+    res = resume_run(model, d)
+    _assert_bit_identical(res, post)
+    ck = load_checkpoint_full(checkpoint_files(d)[0], model)
+    for leaf in jax.tree_util.tree_leaves(ck.state):
+        assert np.isfinite(np.asarray(leaf, dtype=np.float64)).all()

@@ -5,6 +5,12 @@ resumed from its auto-checkpoint must reproduce the uninterrupted run's
 draws *bit-exactly*, and a byte-flipped checkpoint must be rejected with a
 clear error while resume falls back to the previous rotation slot.
 
+Tests that assert ``ckpt-*.npz`` file names pin the legacy
+``checkpoint_layout="rotating"`` — the self-contained format must stay
+fully writable and readable; the append-only layout (the default) gets the
+same treatment in ``tests/test_append_layout.py``.  Layout-agnostic tests
+run on the default (append) layout.
+
 Deliberately fast (not ``slow``): checkpoint regressions must surface in the
 default ``pytest -m 'not slow'`` tier-1 run.  All tests share one tiny model
 config and exactly two compiled segment programs; only the
@@ -80,7 +86,7 @@ def test_autocheckpoint_rotation_and_invariance(tmp_path, model, full_post):
     and reproduces the reference draws."""
     d = os.fspath(tmp_path / "ck")
     post = sample_mcmc(model, **RUN_KW, checkpoint_every=4, checkpoint_path=d,
-                       checkpoint_keep=1)
+                       checkpoint_keep=1, checkpoint_layout="rotating")
     _assert_bit_identical(post, full_post)
 
     files = checkpoint_files(d)
@@ -101,7 +107,8 @@ def test_autocheckpoint_rotation_and_invariance(tmp_path, model, full_post):
     # previous run are cleared (resume_run must never mix the two runs)
     with pytest.warns(RuntimeWarning, match="previous run"):
         post3 = sample_mcmc(model, **RUN_KW, checkpoint_every=4,
-                            checkpoint_path=d, checkpoint_keep=1)
+                            checkpoint_path=d, checkpoint_keep=1,
+                            checkpoint_layout="rotating")
     _assert_bit_identical(post3, full_post)
     assert [os.path.basename(p) for p in checkpoint_files(d)] == \
         ["ckpt-00000008.npz"]
@@ -114,6 +121,7 @@ def test_kill_resume_bit_exact(tmp_path, model, full_post):
     d = os.fspath(tmp_path / "ck")
     with pytest.raises(InjectedDeviceLoss):
         sample_mcmc(model, **RUN_KW, checkpoint_every=4, checkpoint_path=d,
+                    checkpoint_layout="rotating",
                     progress_callback=device_loss_after(4))
     assert os.path.basename(checkpoint_files(d)[0]) == "ckpt-00000004.npz"
 
@@ -129,6 +137,7 @@ def test_corrupt_checkpoint_rejected_and_fallback(tmp_path, model, full_post):
     d = os.fspath(tmp_path / "ck")
     with pytest.raises(InjectedDeviceLoss):
         sample_mcmc(model, **RUN_KW, checkpoint_every=4, checkpoint_path=d,
+                    checkpoint_layout="rotating",
                     progress_callback=device_loss_after(8))
     # slots 4 and 8, plus the burn-in (state-only) snapshot at sweep 4
     assert [os.path.basename(p) for p in checkpoint_files(d)] == \
@@ -147,7 +156,8 @@ def test_payload_checksum_detects_silent_tamper(tmp_path, model):
     """A tampered payload that still parses as a valid npz (no zip-level
     damage) is caught by the per-payload crc32 and named in the error."""
     d = os.fspath(tmp_path / "ck")
-    sample_mcmc(model, **RUN_KW, checkpoint_every=4, checkpoint_path=d)
+    sample_mcmc(model, **RUN_KW, checkpoint_every=4, checkpoint_path=d,
+                checkpoint_layout="rotating")
     path = checkpoint_files(d)[0]
     with np.load(path, allow_pickle=False) as z:
         payload = {k: z[k] for k in z.files}
@@ -182,6 +192,7 @@ def test_sigterm_finishes_segment_checkpoints_and_unwinds(tmp_path, model,
     prev = signal.getsignal(signal.SIGTERM)
     with pytest.raises(PreemptedRun) as ei:
         sample_mcmc(model, **RUN_KW, checkpoint_every=4, checkpoint_path=d,
+                    checkpoint_layout="rotating",
                     progress_callback=sigterm_after(4))
     assert signal.getsignal(signal.SIGTERM) is prev
     assert ei.value.samples_done == 4

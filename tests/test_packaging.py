@@ -21,10 +21,10 @@ pytestmark = pytest.mark.slow
 
 
 def test_wheel_builds_and_imports(tmp_path):
-    # the repo's committed build/ artifact directory shadows the PyPA
-    # 'build' module as a namespace package, so importorskip alone
-    # false-passes and the `python -m build` below explodes — require a
-    # real installation (ProjectBuilder) before running the wheel check
+    # a stray build/ artifact directory (now untracked + gitignored) would
+    # shadow the PyPA 'build' module as a namespace package, so importorskip
+    # alone false-passes and the `python -m build` below explodes — require
+    # a real installation (ProjectBuilder) before running the wheel check
     build_mod = pytest.importorskip("build")
     if not hasattr(build_mod, "ProjectBuilder"):
         pytest.skip("PyPA 'build' is not installed (the repo's build/ "
@@ -62,6 +62,16 @@ def test_wheel_builds_and_imports(tmp_path):
     for sub in ("mcmc", "post", "predict", "ops", "utils", "data", "testing"):
         assert sub in names, f"subpackage {sub} missing from wheel"
 
+    import os
+
+    # the scrubbed env keeps the import honest (no repo dir on the path),
+    # but must preserve PYTHONPATH — with the extracted wheel FIRST — so
+    # environments that provision dependencies (jax, pandas) via PYTHONPATH
+    # don't fail spuriously on the dependency imports instead of testing
+    # the wheel
+    pythonpath = os.pathsep.join(
+        [str(site)] + [p for p in os.environ.get("PYTHONPATH", "").split(
+            os.pathsep) if p])
     r = subprocess.run(
         [sys.executable, "-c",
          "import sys; sys.path.insert(0, sys.argv[1]); "
@@ -72,7 +82,7 @@ def test_wheel_builds_and_imports(tmp_path):
          "print(hm.__version__)",
          str(site)],
         capture_output=True, text=True, timeout=300,
-        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
-             "HOME": str(tmp_path)})
+        env={"PATH": "/usr/bin:/bin", "PYTHONPATH": pythonpath,
+             "JAX_PLATFORMS": "cpu", "HOME": str(tmp_path)})
     assert r.returncode == 0, r.stderr[-2000:]
     assert r.stdout.strip() == ver, (r.stdout, ver)

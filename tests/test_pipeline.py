@@ -182,7 +182,7 @@ def test_sigterm_mid_flight_drains_cleanly(tmp_path, model, ref_post):
                         checkpoint_path=d,
                         progress_callback=sigterm_after(4))
     assert signal.getsignal(signal.SIGTERM) is prev
-    assert ei.value.checkpoint_path.endswith("ckpt-00000004.npz")
+    assert ei.value.checkpoint_path.endswith("manifest-00000004.json")
     assert os.path.exists(ei.value.checkpoint_path)      # drained, durable
     assert not [f for f in os.listdir(d) if ".tmp" in f]
     res = resume_run(model, d)
@@ -197,8 +197,8 @@ def test_burnin_snapshot_written_and_loadable(ref_run, model):
     _, d = ref_run                       # inspect the fixture's snapshots
     names = [os.path.basename(p) for p in checkpoint_files(d)]
     # burn-in snapshot sorts below every sample snapshot
-    assert names == ["ckpt-00000008.npz", "ckpt-00000004.npz",
-                     "ckpt-t00000004.npz"]
+    assert names == ["manifest-00000008.json", "manifest-00000004.json",
+                     "manifest-t00000004.json"]
     ck = load_checkpoint_full(checkpoint_files(d)[-1], model)
     assert ck.post.arrays == {} and ck.post.n_chains == 2
     assert ck.run_meta["samples_done"] == 0
@@ -220,7 +220,7 @@ def test_kill_during_burnin_resume_bit_exact(tmp_path, model):
         sample_mcmc(model, **kw, checkpoint_every=4, checkpoint_path=d,
                     progress_callback=sigterm_after(0))
     assert ei.value.samples_done == 0
-    assert ei.value.checkpoint_path.endswith("ckpt-t00000004.npz")
+    assert ei.value.checkpoint_path.endswith("manifest-t00000004.json")
     assert "burn-in sweeps" in str(ei.value)
 
     res = resume_run(model, d)
@@ -278,13 +278,22 @@ def test_archive_every_nth_exempt_from_rotation(tmp_path, model, ref_post):
                        checkpoint_path=d, checkpoint_keep=1,
                        checkpoint_archive_every=2)
     _assert_bit_identical(post, ref_post)
-    # keep=1 rotated everything but the final slot...
+    # keep=1 rotated everything but the final manifest (and GC swept the
+    # shard/state files only older manifests referenced)...
     assert [os.path.basename(p) for p in checkpoint_files(d)] == \
-        ["ckpt-00000008.npz"]
-    # ...but every 2nd snapshot (write ordinals 2 = ckpt-4) was archived
-    # and survives rotation
+        ["manifest-00000008.json"]
+    # ...but every 2nd snapshot (write ordinal 2 = the 4-sample snapshot)
+    # was archived — manifest + state + referenced shards hard-linked, so
+    # the archived snapshot stays loadable after GC reclaimed the main dir
     assert sorted(os.listdir(os.path.join(d, "archive"))) == \
-        ["ckpt-00000004.npz"]
+        ["manifest-00000004.json", "seg-0-00000000-00000003.npz",
+         "state-00000004.npz"]
+    ck = load_checkpoint_full(
+        os.path.join(d, "archive", "manifest-00000004.json"), model)
+    assert ck.post.samples == 4
+    for k in ck.post.arrays:
+        np.testing.assert_array_equal(ck.post.arrays[k],
+                                      ref_post.arrays[k][:, :4], err_msg=k)
 
 
 # ---------------------------------------------------------------------------
