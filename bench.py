@@ -181,6 +181,9 @@ def _skip(reason: str):
         "value": None,
         "unit": "samples/sec",
         "vs_baseline": None,
+        # hardware shape unknown: the device was never reachable
+        "n_devices": None,
+        "process_count": None,
         "skipped": True,
         "reason": reason,
     }))
@@ -305,6 +308,7 @@ def main():
 
     # the R engine runs chains sequentially per process (SOCK fan-out uses
     # one core per chain); compare per-chip throughput to per-core baseline
+    import jax
     print(json.dumps({
         "metric": "posterior samples/sec/chip, 1000-species probit JSDM "
                   f"(4 chains; {rec_note}; TD-scale smoke rate "
@@ -314,6 +318,11 @@ def main():
         # symmetric units: TPU sweeps/sec over baseline sweeps/sec (the
         # TPU wall-clock includes its transient sweeps)
         "vs_baseline": round(sweeps_big / base_rate, 2),
+        # hardware shape: perf trajectories across rounds must distinguish
+        # a 1-chip box from a pod slice (and a single-process run from a
+        # multi-process mesh) before comparing rates
+        "n_devices": int(jax.device_count()),
+        "process_count": int(jax.process_count()),
     }))
 
 

@@ -1,0 +1,222 @@
+"""Multi-process chain-sharding benchmark (CPU-emulated, fast).
+
+ISSUE 4 scales chains over R coordinated processes; this probe gates the
+two ways the protocol could tax throughput:
+
+1. **Scaling efficiency (consumed-CPU terms)** — sharding 4 chains over
+   R=2 emulated CPU processes (each worker CPU-pinned to its own core —
+   the single-thread eigen flag alone does not stop XLA-CPU's intra-op
+   pool from spreading one worker over every core) must not inflate the
+   total compute spent per draw versus the identical run in ONE pinned
+   process:
+
+       eff = C_1proc / (2 x C_2proc)   >= 0.80
+
+   where C_1proc is the 1-process worker's steady-state *process CPU
+   time* and C_2proc the mean over the two ranks' (all threads, so
+   writer-thread serialisation and coordination work are billed).  CPU
+   time — not wall — is the scaling signal a shared CI box can actually
+   measure: concurrent wall-clock on an oversubscribed or sandboxed
+   host measures the hypervisor's vCPU delivery, not the protocol
+   (measured here: with both cores demanded each worker is delivered
+   ~0.7 core, capping ideal-code wall scaling at ~75% — below any
+   honest gate — while CPU per draw is far steadier).  The estimator
+   matters too: the virtualised CPU clock itself drifts ~±10% in
+   episodes lasting seconds, so the bench computes one efficiency per
+   rep from TEMPORALLY ADJACENT 1proc/2proc runs (paired, so clock
+   drift hits numerator and denominator alike) and gates the MEDIAN
+   across reps — min- or max-selection across reps would systematically
+   pick deflated/inflated clock readings and bias the ratio down ~15
+   points.  Wall-based efficiency and the per-rank delivered-core
+   fraction are still reported as context; on quiet dedicated hardware
+   wall eff converges to the CPU number.
+
+2. **Commit overhead (wall, like-for-like)** — what the coordinated
+   manifest commits add on top of the same 2-process run with a single
+   final snapshot (that one commit sits behind the run-end durability
+   barrier either way, so the delta isolates the per-cadence gather +
+   stitch + manifest cost):
+
+       (T_ck - T_off) / T_off  < 5%
+
+   Both sides have the same process shape, so host noise hits them
+   alike and best-of-reps cancels it.  Blocking coordination stalls
+   (barrier sleeps burn no CPU, so gate 1 cannot see them) land
+   squarely in this number: in-window commits include the pipelined
+   drain of the previous mark's gather + stitch + manifest.
+
+Windows are STEADY-STATE: cut from each worker's progress marks, first
+sampling-segment boundary -> last.  A spawned worker's total ``run_s``
+is dominated by per-process one-time costs — tracing the sweep program
+and loading the persistent XLA compile cache — identical for 1 and 2
+processes, which would drown the signal (a fixed cost F on both sides
+pushes T1/(2*T2) toward 50% no matter how well the protocol scales).
+All variants run ``verbose=cadence`` so their segment plans (and
+windows) are identical; draw-stream invariance to process count and
+segmentation is asserted elsewhere (test_multiproc / test_pipeline).
+
+Usage:  python benchmarks/bench_multiproc.py [--samples N] [--reps N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# large enough that per-chain compute dominates per-sweep dispatch and
+# per-mark host costs (a 2-chain sweep costs ~0.5x a 4-chain one here —
+# at test-suite model sizes the sweep is dispatch-bound and halving chains
+# barely halves anything).  Probit: the ny x ns truncated-normal draw per
+# sweep is ALU-heavy compute that scales cleanly with the chain count and
+# keeps records (no ny-sized parameter is recorded) small.
+M_KW = dict(ny=1000, ns=100, nc=3, n_units=40, seed=3, nf=3,
+            distr="probit")
+
+
+def _window(prog):
+    """Steady-state (wall_s, cpu_s, draws) from one worker's
+    [perf_counter, process_time, done] marks: first sampling-segment
+    boundary (tracing/compile of the sampling program lands in that
+    segment) to the last mark."""
+    marks = [(w, c, d) for w, c, d in prog if d > 0]
+    if len(marks) < 2:
+        raise RuntimeError(f"need >=2 sampling marks for a window, "
+                           f"got {len(marks)} (prog={prog!r})")
+    (w0, c0, d0), (w1, c1, d1) = marks[0], marks[-1]
+    return w1 - w0, c1 - c0, d1 - d0
+
+
+def _spawn(nprocs, run_kw, tag):
+    """One coordinated run; returns (max-rank wall_s, max-rank cpu_s,
+    window_draws, per-rank io_stats, per-rank (wall, cpu))."""
+    from hmsc_tpu.testing.multiproc import spawn_workers
+
+    td = tempfile.mkdtemp(prefix=f"bench-mp-{tag}-")
+    try:
+        recs = spawn_workers(
+            nprocs, ckpt_dir=os.path.join(td, "ck"),
+            coord_dir=os.path.join(td, "coord"), model_kw=M_KW,
+            run_kw=run_kw, out_dir=td, timeout_s=600, wall_timeout_s=1800,
+            pin_cpus=True)
+        bad = [r for r in recs if r["returncode"] != 0]
+        if bad:
+            raise RuntimeError(
+                f"bench worker failed (rank {bad[0]['rank']}, "
+                f"rc {bad[0]['returncode']}):\n{bad[0]['stderr'][-2000:]}")
+        wins = [_window(r["result"]["prog"]) for r in recs]
+        draws = {d for _, _, d in wins}
+        if len(draws) != 1:
+            raise RuntimeError(f"ranks disagree on window draws: {wins}")
+        return (max(w for w, _, _ in wins), max(c for _, c, _ in wins),
+                draws.pop(), [r["result"]["io_stats"] for r in recs],
+                [(w, c) for w, c, _ in wins])
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="multi-process scaling probe")
+    ap.add_argument("--samples", type=int, default=160)
+    ap.add_argument("--transient", type=int, default=8)
+    ap.add_argument("--chains", type=int, default=4)
+    ap.add_argument("--cadence", type=int, default=32,
+                    help="checkpoint_every for the coordinated runs")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="timed best-of passes per variant (one unmeasured "
+                         "warm-up pass each precedes them)")
+    args = ap.parse_args(argv)
+
+    # verbose=cadence segments EVERY variant identically (the off variant
+    # has no checkpoint marks of its own), so windows are comparable
+    base = dict(samples=args.samples, transient=args.transient, thin=1,
+                n_chains=args.chains, seed=11, verbose=args.cadence,
+                align_post=False, nf_cap=M_KW["nf"])
+    ck = dict(base, checkpoint_every=args.cadence)
+    variants = [("1proc_ck", 1, ck), ("2proc_ck", 2, ck),
+                ("2proc_off", 2, base)]   # off = single final snapshot
+
+    for name, nprocs, kw in variants:     # warm-up: compile into disk cache
+        _spawn(nprocs, kw, f"warm-{name}")
+
+    reps = []                             # interleaved: pairs stay adjacent
+    for _ in range(args.reps):
+        reps.append({name: _spawn(nprocs, kw, name)
+                     for name, nprocs, kw in variants})
+
+    n_draws = reps[0]["1proc_ck"][2]
+    # paired per-rep efficiency: total consumed CPU for the same draws,
+    # 1 process vs summed over both ranks (adjacent runs, so the box's
+    # CPU-clock drift largely cancels in the ratio); gate the median
+    effs = sorted(r["1proc_ck"][1] / sum(c for _, c in r["2proc_ck"][4])
+                  for r in reps)
+    eff_cpu = (effs[len(effs) // 2] if len(effs) % 2 else
+               0.5 * (effs[len(effs) // 2 - 1] + effs[len(effs) // 2]))
+    med_rep = min(reps, key=lambda r: abs(
+        r["1proc_ck"][1] / sum(c for _, c in r["2proc_ck"][4]) - eff_cpu))
+
+    wall = {name: min(r[name][0] for r in reps)
+            for name, _, _ in variants}   # like-for-like best-of walls
+    eff_wall = wall["1proc_ck"] / (2.0 * wall["2proc_ck"])
+    commit_pct = ((wall["2proc_ck"] - wall["2proc_off"])
+                  / wall["2proc_off"] * 100.0)
+    # hypervisor context: fraction of a core each concurrent worker was
+    # actually delivered inside its (commit-free) steady-state window
+    delivered = [round(c / w, 3) for w, c in med_rep["2proc_off"][4]]
+    coord_stats = {
+        f"rank{i}": {"barrier_wait_s": round(s["barrier_wait_s"], 4),
+                     "manifest_commit_s": round(s["manifest_commit_s"], 4)}
+        for i, s in enumerate(med_rep["2proc_ck"][3])}
+
+    cpu_1p = med_rep["1proc_ck"][1]
+    cpu_2p = sum(c for _, c in med_rep["2proc_ck"][4])
+    print(json.dumps({
+        "metric": "multi-process chain-throughput scaling (2 emulated CPU "
+                  "processes, coordinated checkpoints)",
+        "value": round(eff_cpu * 100.0, 1),
+        "unit": "% scaling efficiency (C_1p / sum-rank C_2p, paired "
+                "steady-state consumed-CPU windows, median of reps)",
+        "per_rep_efficiency_pct": [round(e * 100.0, 1) for e in effs],
+        "cpu_window_1proc_s": round(cpu_1p, 3),
+        "cpu_window_2proc_sum_s": round(cpu_2p, 3),
+        "wall_window_1proc_s": round(wall["1proc_ck"], 3),
+        "wall_window_2proc_s": round(wall["2proc_ck"], 3),
+        "wall_scaling_efficiency_pct": round(eff_wall * 100.0, 1),
+        "delivered_core_fraction_2proc": delivered,
+        "window_draws": n_draws,
+        "aggregate_draws_per_cpu_s_1proc":
+            round(n_draws * args.chains / cpu_1p, 2),
+        "aggregate_draws_per_cpu_s_2proc":
+            round(n_draws * args.chains / cpu_2p, 2),
+        "pass_ge_80pct": bool(eff_cpu >= 0.80),
+    }))
+    print(json.dumps({
+        "metric": "coordinated manifest-commit overhead (2 processes, "
+                  f"cadence {args.cadence} vs single final snapshot)",
+        "value": round(commit_pct, 2),
+        "unit": "% window wall vs cadence-inf",
+        "window_ck_s": round(wall["2proc_ck"], 3),
+        "window_off_s": round(wall["2proc_off"], 3),
+        "coordination": coord_stats,
+        "pass_lt_5pct": bool(commit_pct < 5.0),
+    }))
+    ok = eff_cpu >= 0.80 and commit_pct < 5.0
+    print(json.dumps({
+        "metric": "bench_multiproc gates",
+        "scaling_efficiency_pct": round(eff_cpu * 100.0, 1),
+        "commit_overhead_pct": round(commit_pct, 2),
+        "pass": bool(ok),
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

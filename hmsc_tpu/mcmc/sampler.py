@@ -391,7 +391,7 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 checkpoint_max_bytes: int | None = None,
                 checkpoint_layout: str = "append",
                 pipeline: bool = True, pipeline_depth: int = 2,
-                init_keys=None,
+                init_keys=None, coordinator=None,
                 progress_callback=None, _ckpt_base=None,
                 _transient_base: int = 0, _ckpt_shards=None):
     """Run the blocked Gibbs sampler; returns a :class:`~hmsc_tpu.post.Posterior`.
@@ -513,6 +513,28 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
     - ``init_keys`` resumes the per-chain RNG key stream from a checkpoint
       (requires ``init_state``); without it a resumed run draws a fresh
       stream seeded from (seed, carried iteration).
+    - ``coordinator`` scales chains across a multi-process mesh (the
+      reference's SOCK-cluster ``nParallel``, re-architected): ``n_chains``
+      is the GLOBAL count, process ``p`` of ``R`` samples the contiguous
+      chain slice ``[p·n/R, (p+1)·n/R)`` with seeds derived from the global
+      chain index — so the per-chain draw stream is bit-identical for ANY
+      process count, including single-process.  Chains never communicate;
+      processes coordinate only at checkpoint boundaries: each appends its
+      own ``seg-<proc>-…`` shard stream and ``state-<tag>-p<proc>.npz``
+      carry slice, a barrier certifies every process durably fsynced up to
+      the boundary, then process 0 alone commits the stitched
+      ``manifest-<tag>.json`` (and alone runs GC, which never reclaims a
+      peer's uncommitted newest shards).  SIGTERM on ANY process rides the
+      next boundary's gather, so every process unwinds resumably at the
+      same committed boundary.  Each process returns the Posterior of its
+      OWN chain slice; the committed manifest holds the global run
+      (``load_manifest_checkpoint`` / ``resume_run`` — which re-shards the
+      chains when the process count changes).  Defaults to
+      ``jax.distributed`` auto-detection; pass a
+      :class:`~hmsc_tpu.utils.coordination.FileCoordinator` to run the
+      full protocol over a shared filesystem (or in tests, subprocesses).
+      Multi-process runs require ``checkpoint_layout="append"``;
+      ``retry_diverged`` and ``from_prior`` are single-process-only.
     - ``progress_callback(samples_done, samples_total)`` is invoked on the
       host after every compiled segment (cumulative counts when continuing a
       checkpointed run; burn-in segments report ``samples_done`` still at
@@ -524,8 +546,50 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
     import time
 
     from ..post.posterior import Posterior
+    from ..utils.coordination import get_coordinator
 
     t0 = time.perf_counter()
+
+    # multi-process chain sharding: `n_chains` stays the GLOBAL count; this
+    # process samples its contiguous slice with seeds derived from the
+    # global chain index, so the per-chain draw stream is independent of
+    # the process layout (resume may re-shard freely)
+    coord = get_coordinator(coordinator)
+    n_procs = int(coord.process_count)
+    proc = int(coord.process_index)
+    n_chains = int(n_chains)
+    if n_chains % n_procs:
+        raise ValueError(
+            f"n_chains={n_chains} must be a multiple of the coordinator's "
+            f"process_count ({n_procs}) so chains shard evenly over "
+            "processes")
+    n_local = n_chains // n_procs
+    chains_lo = proc * n_local
+    # XLA batch-shape guard: vmapping ONE chain compiles a different
+    # (degenerate-batch) program than vmapping several, and its float32
+    # stream differs from the batched one at ULP level — which would break
+    # the layout-invariance contract exactly when R == n_chains.  A
+    # single-chain process therefore runs a 2-lane batch with its chain
+    # DUPLICATED (lanes never interact, so lane 0's stream is untouched
+    # and both lanes are bit-identical); the duplicate lane is sliced away
+    # before anything leaves the device (records, carry snapshots, the
+    # returned posterior).
+    n_dup = 1 if (n_procs > 1 and n_local == 1) else 0
+    n_batch = n_local + n_dup
+    if n_procs > 1:
+        if retry_diverged:
+            raise ValueError(
+                "retry_diverged is not supported under a multi-process "
+                "coordinator (the splice re-write has no coordinated "
+                "commit); retry divergences in a single-process resume")
+        if from_prior:
+            raise ValueError("from_prior does not shard over a "
+                             "multi-process coordinator")
+        if checkpoint_path is not None and checkpoint_layout != "append":
+            raise ValueError(
+                "multi-process checkpointing requires "
+                "checkpoint_layout='append' (the rotating self-contained "
+                "format has no per-process commit point)")
 
     adapt_nf_arg = adapt_nf          # pre-resolution value, for retry_diverged
     if adapt_nf is None:
@@ -598,7 +662,12 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
     data = build_model_data(hM, data_par, spec, dtype=dtype)
 
     rng = np.random.default_rng(seed)
-    chain_seeds = rng.integers(0, 2**31 - 1, size=n_chains)
+    # GLOBAL seed table sliced to this process's chains: chain c gets the
+    # same seed under every process layout
+    chain_seeds = rng.integers(0, 2**31 - 1,
+                               size=n_chains)[chains_lo:chains_lo + n_local]
+    if n_dup:
+        chain_seeds = np.concatenate([chain_seeds, chain_seeds[:1]])
 
     if from_prior:
         from .prior import sample_prior_chains
@@ -610,13 +679,19 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
     if init_state is not None:
         state0 = init_state                       # (chains, ...) carry pytree
         lead = int(jax.tree.leaves(state0)[0].shape[0])
-        if lead != n_chains:
-            raise ValueError(f"init_state carries {lead} chains, n_chains={n_chains}")
+        if lead != n_local:
+            raise ValueError(
+                f"init_state carries {lead} chains, expected {n_local} "
+                f"(n_chains={n_chains} over {n_procs} process(es))")
         it0 = int(np.asarray(state0.it).ravel()[0])
         # a resumed run must not replay the original run's key stream: mix
-        # the carried iteration count into the seed derivation
+        # the carried iteration count into the seed derivation (global
+        # table, then this process's slice — layout-invariant)
         rng = np.random.default_rng([0 if seed is None else int(seed), it0])
-        chain_seeds = rng.integers(0, 2**31 - 1, size=n_chains)
+        chain_seeds = rng.integers(
+            0, 2**31 - 1, size=n_chains)[chains_lo:chains_lo + n_local]
+        if n_dup:
+            chain_seeds = np.concatenate([chain_seeds, chain_seeds[:1]])
     else:
         states = [build_state(hM, spec, int(s), init_par, dtype=dtype)
                   for s in chain_seeds]
@@ -631,9 +706,12 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
     if init_state is not None:
         # the compiled runner donates its carry: the first segment would
         # consume (invalidate) the caller's init_state arrays — hand the
-        # runner a private copy instead
+        # runner a private copy instead (duplicating the chain lane when
+        # the single-chain batch guard applies)
+        _cp = ((lambda x: jnp.concatenate([x, x[:1]], axis=0)) if n_dup
+               else jnp.copy)
         state0 = jax.tree.map(
-            lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, state0)
+            lambda x: _cp(x) if isinstance(x, jax.Array) else x, state0)
 
     # structural gates for the opt-in collapsed updaters (reference
     # auto-gating, sampleMcmc.R:123-152; see updaters_marginal)
@@ -681,11 +759,11 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         # chains-only SOCK parallelism with dp x tp over one mesh.
         from jax.sharding import NamedSharding, PartitionSpec as P
         n_chain_devs = int(mesh.shape[chain_axis])
-        if n_chains % n_chain_devs:
+        if n_local % n_chain_devs:
             raise ValueError(
-                f"n_chains={n_chains} must be a multiple of the mesh's "
-                f"'{chain_axis}' extent ({n_chain_devs}) so chains lay out "
-                "evenly over devices")
+                f"{n_local} per-process chain(s) must be a multiple of the "
+                f"mesh's '{chain_axis}' extent ({n_chain_devs}) so chains "
+                "lay out evenly over devices")
         sp = species_axis if species_axis in mesh.axis_names else None
         if sp is not None and spec.ns % int(mesh.shape[sp]) != 0:
             import warnings
@@ -780,24 +858,29 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         if init_state is None and base_post is None:
             # a FRESH run owns its snapshot directory: stale snapshots from
             # an earlier run would outnumber this run's early snapshots and
-            # resume_run would silently return the old run's posterior
-            from ..utils.checkpoint import (_layout_files as _lf,
-                                            checkpoint_files as _ck_files)
-            stale = _ck_files(ck_dir)
-            if stale:
-                import warnings
-                warnings.warn(
-                    f"checkpoint_path {ck_dir!r} held {len(stale)} "
-                    "snapshot(s) from a previous run; removing them so "
-                    "resume_run cannot confuse the runs (use resume_run "
-                    "instead of a fresh call to continue the old one)",
-                    RuntimeWarning, stacklevel=2)
-            # clear shards/state files too, not just the resume candidates
-            for p in (_lf(ck_dir) if stale else []):
-                try:
-                    os.unlink(p)
-                except OSError:
-                    pass
+            # resume_run would silently return the old run's posterior.
+            # On a multi-process mesh only the committer clears (peers wait
+            # at the barrier so none of them can write before the sweep).
+            if n_procs == 1 or coord.is_coordinator:
+                from ..utils.checkpoint import (_layout_files as _lf,
+                                                checkpoint_files as _ck_files)
+                stale = _ck_files(ck_dir)
+                if stale:
+                    import warnings
+                    warnings.warn(
+                        f"checkpoint_path {ck_dir!r} held {len(stale)} "
+                        "snapshot(s) from a previous run; removing them so "
+                        "resume_run cannot confuse the runs (use resume_run "
+                        "instead of a fresh call to continue the old one)",
+                        RuntimeWarning, stacklevel=2)
+                # clear shards/state files too, not just resume candidates
+                for p in (_lf(ck_dir) if stale else []):
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
+            if n_procs > 1:
+                coord.barrier("fresh-dir")
 
     # preemption-safe shutdown: while auto-checkpointing, SIGTERM/SIGINT set
     # a flag that the segment loop checks after each compiled chunk — finish
@@ -830,7 +913,7 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         host_segs = []                # fetched host record trees, in order
         state_cur = state0
         skip_z = init_state is not None
-        bad_cur = jnp.full((n_chains,), -1, dtype=jnp.int32)
+        bad_cur = jnp.full((n_batch,), -1, dtype=jnp.int32)
         if rng_impl is None:
             plat = jax.default_backend()
             rng_impl = "rbg" if ("tpu" in plat or "axon" in plat) \
@@ -843,12 +926,15 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
             if init_state is None:
                 raise ValueError("init_keys requires init_state (both come "
                                  "from the same checkpoint)")
-            if int(init_keys.shape[0]) != n_chains:
+            if int(init_keys.shape[0]) != n_local:
                 raise ValueError(
                     f"init_keys carries {int(init_keys.shape[0])} chain "
-                    f"keys, n_chains={n_chains}")
+                    f"keys, expected {n_local} (n_chains={n_chains} over "
+                    f"{n_procs} process(es))")
             # private copy: the donated carry must not consume the caller's
             keys = jnp.copy(init_keys)
+            if n_dup:
+                keys = jnp.concatenate([keys, keys[:1]])
         else:
             keys = jax.vmap(lambda s: jax.random.key(s, impl=rng_impl))(
                 jnp.asarray(chain_seeds))
@@ -859,32 +945,6 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         # serialisation run here while the next segment computes on-device
         writer = (_SegmentWriter(int(pipeline_depth)) if pipeline
                   else _InlineWriter())
-        n_ck_writes = 0               # snapshot ordinal (archive cadence)
-
-        # append-layout bookkeeping.  `flush` tracks which prefix of the
-        # recorded draws is already durable as immutable shards (`cursor`
-        # counts GLOBAL recorded samples, `idx` indexes host_segs), the
-        # shard sequence manifests reference, a one-time base segment
-        # pending flush when a legacy self-contained run is continued in
-        # the append layout, and the repair ordinal for post-splice shard
-        # re-writes.  `io` counts checkpoint bytes for Posterior.io_stats
-        # (the bench gate asserts per-snapshot bytes are O(segment)).
-        # Everything here is touched only by writer-thread callables, which
-        # run in FIFO order — no locking needed.
-        from ..utils.checkpoint import _SHARD_RE as _shard_re
-        flush = {"idx": 0, "cursor": base_samples,
-                 "shards": [dict(s) for s in _ckpt_shards or []],
-                 "base": (base_post
-                          if (append_layout and base_post is not None
-                              and not _ckpt_shards) else None),
-                 # seed past any repair ordinal a resumed shard list carries
-                 # so a later splice-rewrite never reuses a repair file name
-                 "repair": max((int(m.group(4) or 0) for m in
-                                (_shard_re.fullmatch(s["file"])
-                                 for s in _ckpt_shards or []) if m),
-                               default=0)}
-        io = {"bytes": 0, "snapshot_bytes": [], "shards_written": 0}
-        shard_slot = int(jax.process_index())
 
         def _collect(packed):
             host_segs.append(_unpack_records(*packed))
@@ -901,10 +961,11 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
             so the writer thread must fetch from copies dispatched before
             that.  Keys are snapshotted as raw uint32 key data."""
             st = jax.tree.map(
-                lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x,
+                lambda x: (jnp.copy(x[:n_local]) if n_dup else jnp.copy(x))
+                if isinstance(x, jax.Array) else x,
                 state_cur)
-            kd = jnp.array(jax.random.key_data(keys))
-            return st, kd, jnp.copy(bad_cur)
+            kd = jnp.array(jax.random.key_data(keys))[:n_local]
+            return st, kd, jnp.copy(bad_cur[:n_local])
 
         def _run_meta(done_now):
             return {
@@ -929,271 +990,36 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 "checkpoint_archive_every": archive_every,
                 "checkpoint_max_bytes": checkpoint_max_bytes,
                 "checkpoint_layout": checkpoint_layout,
+                "process_count": n_procs,
             }
 
-        def _archive_link(src):
-            # hard-link (copy fallback) into archive/, exempt from rotation
-            # and GC — post-hoc divergence debugging; links share the inode
-            # so archiving a live shard costs no extra bytes
-            adir = os.path.join(ck_dir, "archive")
-            os.makedirs(adir, exist_ok=True)
-            apath = os.path.join(adir, os.path.basename(src))
-            try:
-                if os.path.exists(apath):
-                    os.unlink(apath)
-                os.link(src, apath)
-            except OSError:
-                import shutil
-                shutil.copy2(src, apath)
-
-        def _finish_ck(path, partial, state_arg, keys_arg, meta, ordinal):
-            from ..utils import checkpoint as _ck
-            _ck.save_checkpoint(path, partial, state_arg, keys=keys_arg,
-                                keys_impl=rng_impl, run_meta=meta)
-            nbytes = int(os.path.getsize(path))
-            io["bytes"] += nbytes
-            io["snapshot_bytes"].append(nbytes)
-            _ck.gc_checkpoints(ck_dir, int(checkpoint_keep),
-                               max_age_s=checkpoint_max_age_s,
-                               max_bytes=checkpoint_max_bytes)
-            if archive_every and ordinal % archive_every == 0:
-                _archive_link(path)
-
-        def _write_ck(done_now, state_snap, keys_snap, bad_snap, ordinal,
-                      post_override=None, state_override=None):
-            """Snapshot draws-so-far (prepending a resumed run's base
-            segment) + carry state + carried keys; atomic write, rotate.
-            Runs on the writer thread (FIFO after all prior segment
-            collects) from on-device carry snapshots.
-            ``post_override``/``state_override`` re-write a slot from an
-            already-built posterior and spliced carry state (the
-            retry_diverged splice re-writes the final one)."""
-            from ..post.posterior import Posterior as _P
-            from ..utils import checkpoint as _ck
-            if post_override is None:
-                _merge_segs()
-                arrays = {k: np.asarray(v) for k, v in host_segs[0].items()}
-                fb = np.asarray(bad_snap)
-            else:
-                arrays = {k: np.asarray(v)
-                          for k, v in post_override.arrays.items()}
-                fb = np.asarray(post_override.chain_health["first_bad_it"])
-            if base_post is not None:
-                if set(arrays) != set(base_post.arrays):
-                    raise _ck.CheckpointError(
-                        "continuation records different parameters than the "
-                        "checkpointed base segment — was record= changed?")
-                arrays = {k: np.concatenate([base_post.arrays[k], arrays[k]],
-                                            axis=1) for k in arrays}
-            partial = _P(hM, spec, arrays, samples=base_samples + done_now,
-                         transient=int(base_post.transient
-                                       if base_post is not None
-                                       else _transient_base + int(transient)),
-                         thin=int(thin))
-            if base_post is not None:
-                fb0 = np.asarray(base_post.chain_health["first_bad_it"])
-                fb = np.where(fb0 >= 0, fb0, fb)
-            partial.set_chain_health(fb)
-            partial.nf_saturation = (
-                dict(post_override.nf_saturation) if post_override is not None
-                else {r: np.asarray(state_snap.levels[r].nf_sat).reshape(-1)
-                      for r in range(spec.nr)})
-            path = os.path.join(
-                ck_dir, f"ckpt-{base_samples + done_now:08d}.npz")
-            _finish_ck(path, partial,
-                       state_snap if state_override is None else state_override,
-                       keys_snap, _run_meta(done_now), ordinal)
-            return path
-
-        def _write_burnin_ck(it_now, state_snap, keys_snap, bad_snap,
-                             ordinal):
-            """State-only burn-in snapshot (carry + keys, no draws): a kill
-            during a long transient resumes from here instead of restarting
-            burn-in from scratch."""
-            from ..post.posterior import Posterior as _P
-            partial = _P(hM, spec, {}, samples=0,
-                         transient=_transient_base + int(transient),
-                         thin=int(thin))
-            partial.n_chains = int(n_chains)
-            partial.set_chain_health(np.asarray(bad_snap))
-            partial.nf_saturation = {
-                r: np.asarray(state_snap.levels[r].nf_sat).reshape(-1)
-                for r in range(spec.nr)}
-            meta = _run_meta(0)
-            meta["transient_done"] = int(it_now)
-            path = os.path.join(ck_dir, f"ckpt-t{it_now:08d}.npz")
-            _finish_ck(path, partial, state_snap, keys_snap, meta, ordinal)
-            return path
-
-        def _flush_shards(done_now):
-            """Make every draw recorded up to ``done_now`` durable as
-            immutable shards.  Runs on the writer thread AFTER all pending
-            segment fetches (FIFO), so host_segs holds everything up to the
-            snapshot boundary; cost is O(draws since the last flush), never
-            O(history) — the layout's whole point."""
-            from ..utils import checkpoint as _ck
-            if flush["base"] is not None:
-                # one-time migration: a legacy self-contained run continued
-                # in the append layout flushes its base draws as one shard
-                bp, flush["base"] = flush["base"], None
-                entry = _ck.save_shard(
-                    ck_dir, {k: np.asarray(v) for k, v in bp.arrays.items()},
-                    0, base_samples - 1, shard_index=shard_slot)
-                flush["shards"].append(entry)
-                io["bytes"] += entry["nbytes"]
-                io["shards_written"] += 1
-            done_g = base_samples + done_now
-            if done_g <= flush["cursor"]:
-                return
-            new = host_segs[flush["idx"]:]
-            arrays = (new[0] if len(new) == 1
-                      else jax.tree.map(
-                          lambda *xs: np.concatenate(xs, axis=1), *new))
-            entry = _ck.save_shard(ck_dir, arrays, flush["cursor"],
-                                   done_g - 1, shard_index=shard_slot)
-            flush["idx"] = len(host_segs)
-            flush["cursor"] = done_g
-            flush["shards"].append(entry)
-            io["bytes"] += entry["nbytes"]
-            io["shards_written"] += 1
-
-        def _append_manifest(tag, done_now, state_snap, keys_snap, bad_snap,
-                             meta, ordinal):
-            """State file + manifest commit + archive + GC for one
-            append-layout snapshot (writer thread)."""
-            import hmsc_tpu as _pkg
-
-            from ..utils import checkpoint as _ck
-            st_entry = _ck.save_state_file(ck_dir, tag, spec, state_snap,
-                                           keys_data=keys_snap)
-            fb = np.asarray(bad_snap)
-            if base_post is not None:
-                fb0 = np.asarray(base_post.chain_health["first_bad_it"])
-                fb = np.where(fb0 >= 0, fb0, fb)
-            man = {
-                "package_version": _pkg.__version__,
-                "samples": base_samples + done_now,
-                "transient": int(base_post.transient if base_post is not None
-                                 else _transient_base + int(transient)),
-                "thin": int(thin), "n_chains": int(n_chains),
-                "nf_cap": int(nf_cap),
-                "spec_sha256": _ck.spec_fingerprint(spec),
-                "keys_impl": rng_impl,
-                "first_bad_it": [int(x) for x in fb],
-                "nf_saturation": {
-                    str(r): np.asarray(
-                        state_snap.levels[r].nf_sat).reshape(-1).tolist()
-                    for r in range(spec.nr)},
-                "state": st_entry,
-                "shards": [dict(s) for s in flush["shards"]],
-                "run": meta,
-            }
-            path = _ck.save_manifest(ck_dir, tag, man)
-            io["bytes"] += st_entry["nbytes"] + int(os.path.getsize(path))
-            if archive_every and ordinal % archive_every == 0:
-                _archive_link(path)
-                _archive_link(os.path.join(ck_dir, st_entry["file"]))
-                for s in man["shards"]:
-                    src = os.path.join(ck_dir, s["file"])
-                    dst = os.path.join(ck_dir, "archive", s["file"])
-                    try:
-                        # same inode = already archived (hard link); a
-                        # same-NAME file from a previous run in a reused
-                        # directory must be re-linked, or this manifest's
-                        # archive copy would pair with the old run's bytes
-                        if os.path.exists(dst) and os.path.samefile(src,
-                                                                    dst):
-                            continue
-                    except OSError:
-                        pass
-                    _archive_link(src)
-            _ck.gc_checkpoints(ck_dir, int(checkpoint_keep),
-                               max_age_s=checkpoint_max_age_s,
-                               max_bytes=checkpoint_max_bytes)
-            return path
-
-        def _write_append_ck(done_now, state_snap, keys_snap, bad_snap,
-                             ordinal):
-            b0 = io["bytes"]
-            _flush_shards(done_now)
-            path = _append_manifest(f"{base_samples + done_now:08d}",
-                                    done_now, state_snap, keys_snap,
-                                    bad_snap, _run_meta(done_now), ordinal)
-            io["snapshot_bytes"].append(io["bytes"] - b0)
-            return path
-
-        def _write_burnin_append_ck(it_now, state_snap, keys_snap, bad_snap,
-                                    ordinal):
-            b0 = io["bytes"]
-            meta = _run_meta(0)
-            meta["transient_done"] = int(it_now)
-            path = _append_manifest(f"t{it_now:08d}", 0, state_snap,
-                                    keys_snap, bad_snap, meta, ordinal)
-            io["snapshot_bytes"].append(io["bytes"] - b0)
-            return path
-
-        def _rewrite_spliced_append(changed_from, state_fin, keys_data_fin,
-                                    fb_fin, post_fin):
-            """Post-splice repair of a completed append-layout run
-            (driver thread, after the writer drained): shards entirely
-            before the changed window are untouched; the changed tail is
-            re-written ONCE as a repair shard (immutable files never mutate
-            — a repaired window gets a new name), and a new final manifest
-            commits the repaired sequence.  Cost is O(changed draws): a
-            warm-restart splice re-writes only the post-snapshot tail."""
-            from ..utils import checkpoint as _ck
-            changed_g = base_samples + int(changed_from)
-            keep_shards, doomed = [], []
-            for s in flush["shards"]:
-                (keep_shards if int(s["last"]) < changed_g
-                 else doomed).append(s)
-            # the repair window opens at the first superseded shard's start
-            # (a shard straddling the change boundary is replaced whole)
-            rep_first = (min(int(s["first"]) for s in doomed)
-                         if doomed else changed_g)
-            end_g = base_samples + int(samples)
-            if rep_first < end_g:
-                flush["repair"] += 1
-                lo = rep_first - base_samples
-                arrays = {k: np.asarray(v)[:, lo:]
-                          for k, v in post_fin.arrays.items()}
-                entry = _ck.save_shard(ck_dir, arrays, rep_first, end_g - 1,
-                                       shard_index=shard_slot,
-                                       repair=flush["repair"])
-                keep_shards.append(entry)
-                io["bytes"] += entry["nbytes"]
-                io["shards_written"] += 1
-            flush["shards"] = keep_shards
-            return _append_manifest(f"{end_g:08d}", int(samples), state_fin,
-                                    keys_data_fin, fb_fin,
-                                    _run_meta(int(samples)), n_ck_writes)
+        # ALL snapshot-write/layout logic lives in CheckpointWriter
+        # (utils/checkpoint.py — unit-tested in isolation); the loop below
+        # only snapshots the carry and submits.  The writer also runs the
+        # multi-process commit protocol: gather-certified durability,
+        # committer-only manifest + GC, coordinated preemption flags.
+        ckw = None
+        if ck_every:
+            from ..utils.checkpoint import CheckpointWriter
+            ckw = CheckpointWriter(
+                ck_dir, checkpoint_layout, spec, hM=hM, records=host_segs,
+                base_post=base_post, base_samples=base_samples,
+                shards=_ckpt_shards, keep=int(checkpoint_keep),
+                max_age_s=checkpoint_max_age_s, archive_every=archive_every,
+                max_bytes=checkpoint_max_bytes, keys_impl=rng_impl,
+                shard_index=(proc if n_procs > 1
+                             else int(jax.process_index())),
+                coordinator=coord if n_procs > 1 else None,
+                preempt_fn=lambda: preempt["signum"] is not None)
 
         def _submit_ck(in_burnin, done_now, it_now):
-            nonlocal n_ck_writes
-            n_ck_writes += 1
             st, kd, bd = _snap_carry()
-            if append_layout:
-                tag = (f"t{it_now:08d}" if in_burnin
-                       else f"{base_samples + done_now:08d}")
-                path = os.path.join(ck_dir, f"manifest-{tag}.json")
-                if in_burnin:
-                    writer.submit(functools.partial(
-                        _write_burnin_append_ck, it_now, st, kd, bd,
-                        n_ck_writes))
-                else:
-                    writer.submit(functools.partial(
-                        _write_append_ck, done_now, st, kd, bd,
-                        n_ck_writes))
-            elif in_burnin:
-                path = os.path.join(ck_dir, f"ckpt-t{it_now:08d}.npz")
-                writer.submit(functools.partial(
-                    _write_burnin_ck, it_now, st, kd, bd, n_ck_writes))
-            else:
-                path = os.path.join(
-                    ck_dir, f"ckpt-{base_samples + done_now:08d}.npz")
-                writer.submit(functools.partial(
-                    _write_ck, done_now, st, kd, bd, n_ck_writes))
-            return path
+            meta = _run_meta(0 if in_burnin else done_now)
+            writer.submit(functools.partial(
+                ckw.snapshot, 0 if in_burnin else done_now, st, kd, bd,
+                meta, burnin_it=it_now if in_burnin else None))
+            return ckw.path_for(done_now,
+                                burnin_it=it_now if in_burnin else None)
 
         done = 0
         sweeps_done = 0
@@ -1213,6 +1039,8 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 # the original record tree immediately — keeping it alive
                 # through the fetch would double record HBM (the pack holds
                 # the only live copy)
+                if n_dup:             # drop the duplicate guard lane on
+                    recs = jax.tree.map(lambda x: x[:n_local], recs)  # device
                 writer.submit(functools.partial(
                     _collect, _pack_records(recs, record_dtype)))
                 del recs
@@ -1225,12 +1053,37 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
             wrote = None
             at_mark = (sweeps_done in t_ck_marks if in_burnin
                        else done in ck_marks)
-            if ck_every and (at_mark or preempt["signum"] is not None):
+            # single-process preemption snapshots at ANY segment boundary;
+            # a multi-process run defers to the next *scheduled* checkpoint
+            # mark — the last boundary COMMON to every process — where the
+            # commit gather carries the preemption flags
+            if ck_every and (at_mark or (preempt["signum"] is not None
+                                         and n_procs == 1)):
+                if n_procs > 1:
+                    # coordinated commits are pipelined by ONE mark: drain
+                    # the PREVIOUS commit here (it overlapped the segment
+                    # that just finished — shard flush, gather, manifest
+                    # all off the critical path, like the single-process
+                    # writer) and read the abort verdict its gather
+                    # carried.  Every process reads commit k's verdict at
+                    # mark k+1, so a preemption (or a dead peer, surfacing
+                    # as CoordinationError at this drain) still unwinds
+                    # every process at the SAME committed boundary.
+                    writer.barrier()
                 wrote = _submit_ck(in_burnin, done, it0 + sweeps_done)
             if progress_callback is not None:
                 progress_callback(base_samples + done,
                                   base_samples + int(samples))
-            if preempt["signum"] is not None:
+            # the abort verdict is SET by the background writer whenever a
+            # commit's gather completes — mid-segment, at rank-dependent
+            # times.  Act on it only at marks (right after the drain above),
+            # where every rank deterministically reads commit k's verdict at
+            # mark k+1: acting at a finer verbose-only boundary would
+            # snapshot at whatever `done` each rank happened to be at,
+            # mispairing the coordinated commit's collectives.
+            peer_abort = (ckw is not None and ckw.abort_agreed
+                          and (n_procs == 1 or at_mark))
+            if (preempt["signum"] is not None and n_procs == 1) or peer_abort:
                 if ck_every and wrote is None:
                     wrote = _submit_ck(in_burnin, done, it0 + sweeps_done)
                 # durability barrier: the snapshot (and every pending write)
@@ -1241,8 +1094,11 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                             "sweeps" if in_burnin else
                             f"{base_samples + done} of "
                             f"{base_samples + int(samples)} recorded samples")
+                whom = (f"signal {preempt['signum']}"
+                        if preempt["signum"] is not None
+                        else "a preempted peer process")
                 raise PreemptedRun(
-                    f"run preempted by signal {preempt['signum']} after "
+                    f"run preempted by {whom} after "
                     f"{progress}; resumable checkpoint: {wrote} "
                     "(continue with resume_run or "
                     "`python -m hmsc_tpu run --resume`)",
@@ -1250,6 +1106,13 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                     samples_done=base_samples + done,
                     signum=preempt["signum"])
         final_state = state_cur
+        if n_dup:                     # shed the duplicate guard lane: the
+            # posterior, health report and returned carry hold real chains
+            final_state = jax.tree.map(
+                lambda x: x[:n_local] if isinstance(x, jax.Array) else x,
+                final_state)
+            bad_cur = bad_cur[:n_local]
+            keys = keys[:n_local]
         writer.barrier()              # all fetches + snapshots complete
         _merge_segs()
         recs = host_segs[0]
@@ -1263,14 +1126,24 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
             for s, h in restore_handlers:
                 _signal.signal(s, h)
     t2 = time.perf_counter()
+    ck_io = (ckw.io if ckw is not None else
+             {"bytes": 0, "snapshot_bytes": [], "shards_written": 0,
+              "barrier_wait_s": 0.0, "manifest_commit_s": 0.0})
     io_stats = {"pipeline": bool(pipeline), "segments": len(plan),
-                "checkpoints": n_ck_writes,
+                "checkpoints": ckw.n_writes if ckw is not None else 0,
                 "checkpoint_layout": checkpoint_layout if ck_every else None,
                 "max_queue_depth": writer.max_depth_seen,
                 "writer_busy_s": writer.busy_s,
-                "bytes_written": io["bytes"],
-                "snapshot_bytes": list(io["snapshot_bytes"]),
-                "shards_written": io["shards_written"]}
+                "bytes_written": ck_io["bytes"],
+                "snapshot_bytes": list(ck_io["snapshot_bytes"]),
+                "shards_written": ck_io["shards_written"],
+                # coordination observability: time this process spent
+                # waiting on cross-process barriers/gathers, and time the
+                # committer spent writing manifest commits (both 0.0 for a
+                # run without checkpointing)
+                "barrier_wait_s": ck_io["barrier_wait_s"],
+                "manifest_commit_s": ck_io["manifest_commit_s"],
+                "process_count": n_procs, "process_index": proc}
 
     post = Posterior(hM, spec, recs, samples=samples,
                      transient=_transient_base + int(transient), thin=thin)
@@ -1399,19 +1272,19 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
             # the replacement chains' healthy carry, not the poisoned one
             post.nf_saturation = nf_sat_counts
             if append_layout:
-                _rewrite_spliced_append(
-                    splice_from, final_state,
-                    jnp.array(jax.random.key_data(keys)), first_bad, post)
+                ckw.rewrite_spliced(
+                    splice_from, int(samples), final_state,
+                    jnp.array(jax.random.key_data(keys)), first_bad, post,
+                    _run_meta(int(samples)))
             else:
-                _write_ck(int(samples), final_state, keys, first_bad,
-                          n_ck_writes, post_override=post,
-                          state_override=final_state)
+                ckw.rewrite_rotating(int(samples), final_state, keys,
+                                     first_bad, post, _run_meta(int(samples)))
             # the rewrite ran after io_stats was snapshotted — refresh the
             # byte accounting so the repair shard / re-written slot counts
             post.io_stats.update(
-                bytes_written=io["bytes"],
-                snapshot_bytes=list(io["snapshot_bytes"]),
-                shards_written=io["shards_written"])
+                bytes_written=ckw.io["bytes"],
+                snapshot_bytes=list(ckw.io["snapshot_bytes"]),
+                shards_written=ckw.io["shards_written"])
 
     # factor-cap observability: warn when burn-in adaptation wanted to add
     # factors past the static nf_max cap — the residual associations may be

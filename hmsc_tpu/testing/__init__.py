@@ -6,7 +6,11 @@ suite's."""
 from .faults import (InjectedFault, InjectedDeviceLoss, device_loss_after,
                      failing_checkpoint_writes, flip_bytes, inject_nan,
                      sigterm_after, slow_checkpoint_writes)
+from .multiproc import (EXIT_COORDINATION, EXIT_OK, EXIT_PREEMPTED,
+                        build_worker_model, spawn_workers, worker_main)
 
 __all__ = ["InjectedFault", "InjectedDeviceLoss", "device_loss_after",
            "failing_checkpoint_writes", "flip_bytes", "inject_nan",
-           "sigterm_after", "slow_checkpoint_writes"]
+           "sigterm_after", "slow_checkpoint_writes",
+           "build_worker_model", "spawn_workers", "worker_main",
+           "EXIT_OK", "EXIT_PREEMPTED", "EXIT_COORDINATION"]

@@ -1,0 +1,271 @@
+"""Multi-process protocol harness: subprocess workers + spawn helpers.
+
+The cross-host story must be testable without a TPU pod: each *worker* is a
+plain CPU subprocess running ``sample_mcmc`` over its chain slice under a
+:class:`~hmsc_tpu.utils.coordination.FileCoordinator`, so the FULL
+multi-process checkpoint protocol — barrier-gated manifest commits,
+committer-only GC, kill-one-process timeouts, resume under a different
+process count — runs in tier-1 tests and in
+``benchmarks/bench_multiproc.py`` on any machine.
+
+Run one worker by hand::
+
+    python -m hmsc_tpu.testing.multiproc --rank 0 --nprocs 2 \
+        --coord-dir /tmp/coord --ckpt-dir /tmp/ck \
+        --run '{"samples": 8, "n_chains": 2, "checkpoint_every": 4}'
+
+Exit codes: 0 success, 75 preempted (resumable — the CLI convention),
+76 coordination failure (a peer died or timed out), 1 anything else.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+EXIT_OK = 0
+EXIT_PREEMPTED = 75
+EXIT_COORDINATION = 76
+
+__all__ = ["build_worker_model", "worker_main", "spawn_workers",
+           "EXIT_OK", "EXIT_PREEMPTED", "EXIT_COORDINATION"]
+
+
+def build_worker_model(ny: int = 24, ns: int = 3, nc: int = 2,
+                       distr: str = "normal", n_units: int = 5,
+                       seed: int = 3, nf: int = 2):
+    """A compact one-random-level model every worker (and the in-test
+    reference run) builds identically from the same kwargs — the
+    multi-process bit-identity assertions compare runs of THIS model."""
+    import numpy as np
+    import pandas as pd
+
+    from ..model import Hmsc
+    from ..random_level import HmscRandomLevel, set_priors_random_level
+
+    rng = np.random.default_rng(seed)
+    X = np.column_stack([np.ones(ny), rng.standard_normal((ny, nc - 1))])
+    Y = rng.standard_normal((ny, ns)) + X @ rng.standard_normal((nc, ns))
+    if distr == "probit":
+        Y = (Y > 0).astype(float)
+    units = [f"u{i:02d}" for i in rng.integers(0, n_units, ny)]
+    for i in range(n_units):
+        units[i % ny] = f"u{i:02d}"
+    study = pd.DataFrame({"lvl": units})
+    rl = HmscRandomLevel(units=study["lvl"])
+    set_priors_random_level(rl, nf_max=nf, nf_min=nf)
+    return Hmsc(Y=Y, X=X, distr=distr, study_design=study,
+                ran_levels={"lvl": rl})
+
+
+def worker_main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="multi-process sampling worker")
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--nprocs", type=int, required=True)
+    ap.add_argument("--coord-dir", required=True,
+                    help="FileCoordinator sentinel directory (fresh per "
+                         "run attempt)")
+    ap.add_argument("--ckpt-dir", required=True)
+    ap.add_argument("--model", default="{}",
+                    help="JSON kwargs for build_worker_model")
+    ap.add_argument("--run", default="{}",
+                    help="JSON kwargs for sample_mcmc (checkpoint_path is "
+                         "set to --ckpt-dir automatically)")
+    ap.add_argument("--action", choices=("run", "resume"), default="run")
+    ap.add_argument("--kill-at", type=int, default=None,
+                    help="hard-kill (SIGKILL) this worker once its "
+                         "progress counter reaches N recorded samples — "
+                         "the mid-run death the protocol must survive")
+    ap.add_argument("--kill-calls", type=int, default=None,
+                    help="hard-kill after the Nth progress callback — "
+                         "reaches burn-in boundaries, where the recorded-"
+                         "sample counter --kill-at keys on is still 0")
+    ap.add_argument("--sigterm-at", type=int, default=None,
+                    help="deliver SIGTERM (once) at N recorded samples — "
+                         "the preemption rehearsal: EVERY rank must unwind "
+                         "with PreemptedRun at the same committed boundary")
+    ap.add_argument("--timeout", type=float, default=120.0,
+                    help="coordination timeout (seconds)")
+    ap.add_argument("--pin-cpu", type=int, default=None,
+                    help="restrict this worker (all threads) to one CPU "
+                         "core — XLA-CPU's intra-op pool otherwise spreads "
+                         "each worker over every core, so R 'single-core' "
+                         "workers silently share the whole box and scaling "
+                         "numbers lie")
+    ap.add_argument("--out", default=None,
+                    help="write a JSON result record here on success")
+    args = ap.parse_args(argv)
+
+    if args.pin_cpu is not None and hasattr(os, "sched_setaffinity"):
+        os.sched_setaffinity(0, {args.pin_cpu})
+
+    from ..utils.coordination import CoordinationError, FileCoordinator
+    from ..utils.checkpoint import PreemptedRun, resume_run
+
+    coord = FileCoordinator(args.coord_dir, args.rank, args.nprocs,
+                            timeout_s=args.timeout)
+    hM = build_worker_model(**json.loads(args.model))
+    run_kw = json.loads(args.run)
+
+    import time as _time
+    prog = []                         # [perf_counter, process_time,
+                                      # samples_done] per segment boundary
+                                      # (bench steady-state windows are cut
+                                      # from these; process_time gives the
+                                      # hypervisor-noise-immune CPU window)
+    kill_at, kill_calls = args.kill_at, args.kill_calls
+    sigterm_at, sigterm_fired = args.sigterm_at, [False]
+
+    def progress_callback(done, total):
+        prog.append([_time.perf_counter(), _time.process_time(), int(done)])
+        if (kill_at is not None and done >= kill_at) or \
+                (kill_calls is not None and len(prog) >= kill_calls):
+            import signal
+            os.kill(os.getpid(), signal.SIGKILL)
+        if sigterm_at is not None and done >= sigterm_at \
+                and not sigterm_fired[0]:
+            sigterm_fired[0] = True
+            import signal
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    try:
+        if args.action == "resume":
+            post = resume_run(hM, args.ckpt_dir, coordinator=coord,
+                              progress_callback=progress_callback,
+                              **run_kw)
+        else:
+            from ..mcmc.sampler import sample_mcmc
+            post = sample_mcmc(hM, coordinator=coord,
+                               checkpoint_path=args.ckpt_dir,
+                               progress_callback=progress_callback,
+                               **run_kw)
+    except PreemptedRun as e:
+        print(f"worker {args.rank}: preempted ({e})", file=sys.stderr)
+        return EXIT_PREEMPTED
+    except CoordinationError as e:
+        print(f"worker {args.rank}: coordination failed ({e})",
+              file=sys.stderr)
+        return EXIT_COORDINATION
+    finally:
+        coord.cleanup()
+
+    if args.out:
+        import numpy as np
+        rec = {
+            "rank": args.rank, "nprocs": args.nprocs,
+            "samples": int(post.samples), "n_chains": int(post.n_chains),
+            "io_stats": {k: v for k, v in post.io_stats.items()
+                         if not isinstance(v, list)},
+            # a cheap draw digest per parameter for cross-run comparisons
+            "digest": {k: float(np.asarray(v, dtype=np.float64).sum())
+                       for k, v in post.arrays.items()},
+            "timing": post.timing,
+            "prog": prog,
+        }
+        with open(args.out, "w") as f:
+            json.dump(rec, f)
+    return EXIT_OK
+
+
+def spawn_workers(nprocs: int, *, ckpt_dir: str, coord_dir: str,
+                  model_kw: dict | None = None, run_kw: dict | None = None,
+                  action: str = "run", kill_at: int | None = None,
+                  kill_calls: int | None = None,
+                  sigterm_at: int | None = None,
+                  kill_rank: int | None = None, timeout_s: float = 30.0,
+                  wall_timeout_s: float = 600.0, out_dir: str | None = None,
+                  env: dict | None = None,
+                  pin_cpus: bool = False) -> list[dict]:
+    """Launch ``nprocs`` workers and wait for all of them.
+
+    Returns one record per rank: ``{"rank", "returncode", "stdout",
+    "stderr", "result"}`` (``result`` parsed from the worker's ``--out``
+    JSON when present).  ``kill_at``/``kill_calls`` + ``kill_rank`` arm the
+    SIGKILL fault on one rank (by recorded-sample count, or by progress-
+    callback count for deaths at burn-in boundaries where the sample
+    counter is still 0).  Workers run with ``JAX_PLATFORMS=cpu`` and
+    single-threaded XLA-CPU eigen; ``pin_cpus=True`` additionally pins
+    rank ``r`` (all its threads) to CPU core ``r % n_cores`` — the eigen
+    flag alone does NOT stop XLA-CPU's intra-op pool from spreading each
+    worker over every core, so without pinning R "single-core" workers
+    silently share the whole box and a scaling measurement lies (the
+    bench pins; protocol tests don't care)."""
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    base_env = dict(os.environ)
+    base_env["JAX_PLATFORMS"] = "cpu"
+    flags = base_env.get("XLA_FLAGS", "")
+    if "xla_cpu_multi_thread_eigen" not in flags:
+        flags = (flags + " --xla_cpu_multi_thread_eigen=false").strip()
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags + " --xla_force_host_platform_device_count=1").strip()
+    base_env["XLA_FLAGS"] = flags
+    base_env["PYTHONPATH"] = os.pathsep.join(
+        [pkg_root] + ([base_env["PYTHONPATH"]]
+                      if base_env.get("PYTHONPATH") else []))
+    # share the persistent XLA compilation cache across workers (same dir
+    # the test conftest uses): each spawned interpreter would otherwise
+    # recompile the identical sampling program from scratch
+    base_env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.environ.get("HMSC_TEST_XLA_CACHE", "/tmp/hmsc_tpu_xla_cache"))
+    base_env.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+    base_env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    base_env.update(env or {})
+
+    procs, outs = [], []
+    for r in range(int(nprocs)):
+        out = (os.path.join(out_dir, f"worker-{r}.json")
+               if out_dir is not None else None)
+        outs.append(out)
+        # -c (not -m): `-m hmsc_tpu.testing.multiproc` imports this module
+        # twice (once as __main__), which runpy warns about since the
+        # testing package re-exports the worker entry points
+        cmd = [sys.executable, "-c",
+               "from hmsc_tpu.testing.multiproc import worker_main; "
+               "raise SystemExit(worker_main())",
+               "--rank", str(r), "--nprocs", str(nprocs),
+               "--coord-dir", coord_dir, "--ckpt-dir", ckpt_dir,
+               "--model", json.dumps(model_kw or {}),
+               "--run", json.dumps(run_kw or {}),
+               "--action", action, "--timeout", str(timeout_s)]
+        if out is not None:
+            cmd += ["--out", out]
+        if kill_at is not None and r == (kill_rank or 0):
+            cmd += ["--kill-at", str(kill_at)]
+        if kill_calls is not None and r == (kill_rank or 0):
+            cmd += ["--kill-calls", str(kill_calls)]
+        if sigterm_at is not None and r == (kill_rank or 0):
+            cmd += ["--sigterm-at", str(sigterm_at)]
+        if pin_cpus:
+            cmd += ["--pin-cpu", str(r % (os.cpu_count() or 1))]
+        procs.append(subprocess.Popen(
+            cmd, cwd=pkg_root, env=base_env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+
+    records = []
+    for r, p in enumerate(procs):
+        try:
+            so, se = p.communicate(timeout=wall_timeout_s)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            so, se = p.communicate()
+            se = (se or "") + "\n[spawn_workers: wall timeout, killed]"
+        result = None
+        if outs[r] is not None and os.path.exists(outs[r]):
+            try:
+                with open(outs[r]) as f:
+                    result = json.load(f)
+            except (OSError, ValueError):
+                pass
+        records.append({"rank": r, "returncode": p.returncode,
+                        "stdout": so, "stderr": se, "result": result})
+    return records
+
+
+if __name__ == "__main__":
+    raise SystemExit(worker_main())

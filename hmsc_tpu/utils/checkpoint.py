@@ -49,6 +49,7 @@ import hashlib
 import json
 import os
 import re
+import time
 import warnings
 import zipfile
 import zlib
@@ -62,13 +63,19 @@ __all__ = [
     "rotate_checkpoints", "gc_checkpoints", "latest_valid_checkpoint",
     "spec_fingerprint", "save_shard", "save_state_file", "save_manifest",
     "load_manifest", "load_manifest_checkpoint", "ShardBackedArrays",
+    "ChunkedShardView", "CheckpointWriter",
     "CheckpointError", "CheckpointCorruptError",
     "CheckpointSpecMismatchError", "PreemptedRun", "LoadedCheckpoint",
     "CKPT_VERSION", "MANIFEST_VERSION",
 ]
 
 CKPT_VERSION = 2
-MANIFEST_VERSION = 1
+# manifest v1: single-process (one state file, one contiguous shard stream);
+# v2 adds the multi-process fields ("process_count", "states", per-window
+# shard groups).  Single-process runs keep WRITING v1 so their snapshots
+# stay readable by older packages; v2 is stamped only when the run actually
+# spans processes.
+MANIFEST_VERSION = 2
 _HEADER_KEY = "__hmsc_ckpt_header__"
 # ckpt-<samples>.npz: sample snapshot; ckpt-t<sweep>.npz: state-only burn-in
 # snapshot (no draws yet — always older than any sample snapshot)
@@ -77,7 +84,9 @@ _CKPT_RE = re.compile(r"ckpt-(t?)(\d+)\.npz")
 # files are only ever reached through a manifest that references them
 _MANIFEST_RE = re.compile(r"manifest-(t?)(\d+)\.json")
 _SHARD_RE = re.compile(r"seg-(\d+)-(\d+)-(\d+)(?:-r(\d+))?\.npz")
-_STATE_RE = re.compile(r"state-(t?)(\d+)\.npz")
+# state-<tag>.npz: single-process carry; state-<tag>-p<proc>.npz: one
+# process's chain-slice carry on a multi-process mesh
+_STATE_RE = re.compile(r"state-(t?)(\d+)(?:-p(\d+))?\.npz")
 
 
 class CheckpointError(RuntimeError):
@@ -472,17 +481,21 @@ def save_shard(dirpath: str, arrays: dict, first: int, last: int, *,
     # content fsync only: the manifest commit fsyncs the shared directory
     _atomic_savez(path, payload, compress=compress, fsync_dir=False)
     return {"file": fname, "first": int(first), "last": int(last),
+            "proc": int(shard_index),
             "chains": int(next(iter(payload.values())).shape[0]),
             "nbytes": int(os.path.getsize(path)), "checksums": checks}
 
 
 def save_state_file(dirpath: str, tag: str, spec, state, *,
-                    keys_data=None) -> dict:
+                    keys_data=None, proc: int | None = None,
+                    compress: bool = False) -> dict:
     """Write the O(state) part of an append-only snapshot: the carry leaves
     (structurally named, like format v2) plus the raw RNG key data.  Returns
     the manifest entry (file name, checksums, size).  ``tag`` is the
     snapshot tag (``"00000008"`` for 8 recorded samples, ``"t00000004"`` for
-    a burn-in snapshot at sweep 4)."""
+    a burn-in snapshot at sweep 4).  ``proc`` names the writing process on a
+    multi-process mesh (``state-<tag>-p<proc>.npz``, one chain-slice carry
+    per process); ``None`` keeps the single-process ``state-<tag>.npz``."""
     import jax
 
     names, skel_def = _state_skeleton(spec)
@@ -495,22 +508,40 @@ def save_state_file(dirpath: str, tag: str, spec, state, *,
     if keys_data is not None:
         payload["rngkeys"] = np.asarray(keys_data)
     checks = {k: _crc(v) for k, v in payload.items()}
-    fname = f"state-{tag}.npz"
+    fname = (f"state-{tag}.npz" if proc is None
+             else f"state-{tag}-p{int(proc)}.npz")
     path = os.path.join(dirpath, fname)
     # content fsync only: the manifest commit fsyncs the shared directory
-    _atomic_savez(path, payload, fsync_dir=False)
-    return {"file": fname, "checksums": checks,
-            "nbytes": int(os.path.getsize(path))}
+    _atomic_savez(path, payload, compress=compress, fsync_dir=False)
+    entry = {"file": fname, "checksums": checks,
+             "nbytes": int(os.path.getsize(path))}
+    if proc is not None:
+        entry["proc"] = int(proc)
+        # chain-slice extent so resume can re-shard under a different
+        # process count without opening every state file first
+        lead = [int(np.asarray(x).shape[0]) for x in leaves
+                if np.asarray(x).ndim > 0]
+        entry["chains"] = lead[0] if lead else 0
+    return entry
 
 
 def save_manifest(dirpath: str, tag: str, manifest: dict) -> str:
     """Atomically write ``manifest-<tag>.json`` — the snapshot's commit
     point: a kill before the rename leaves the previous manifest (and every
     file it references) fully intact, so the newest *visible* manifest is
-    always consistent."""
+    always consistent.  Single-process manifests are stamped format v1
+    (readable by older packages); the multi-process fields (``states``,
+    ``process_count``) bump the stamp to v2 so an old reader refuses
+    cleanly instead of resuming from one process's chain slice."""
     manifest = dict(manifest)
     manifest["format"] = "hmsc_tpu-manifest"
-    manifest["version"] = MANIFEST_VERSION
+    # v2 whenever the snapshot is structurally multi-process: per-process
+    # state files, or a shard history whose windows stitch several streams
+    # (a v1 reader's contiguity check would misread either as corruption)
+    multi = ("states" in manifest
+             or len({_shard_proc(s)
+                     for s in manifest.get("shards", [])}) > 1)
+    manifest["version"] = 2 if multi else 1
     path = os.path.join(dirpath, f"manifest-{tag}.json")
     _atomic_write_bytes(path, json.dumps(manifest, sort_keys=True).encode())
     return path
@@ -551,14 +582,36 @@ def load_manifest(path: str) -> dict:
         if not isinstance(man["state"], dict) or "file" not in man["state"]:
             raise CheckpointCorruptError(
                 f"{path}: manifest carries no state-file entry — corrupt")
-        shards = man.get("shards", [])
-        cursor = 0
-        for s in shards:
-            if int(s["first"]) != cursor:
+        if "states" in man:
+            states = man["states"]
+            if (not isinstance(states, list) or not states
+                    or any(not isinstance(s, dict) or "file" not in s
+                           for s in states)):
                 raise CheckpointCorruptError(
-                    f"{path}: shard sequence is not contiguous — "
-                    f"{s['file']} starts at {s['first']}, expected {cursor}")
-            cursor = int(s["last"]) + 1
+                    f"{path}: malformed per-process 'states' list — corrupt")
+            chains = sum(int(s.get("chains", 0)) for s in states)
+            if chains and chains != int(man["n_chains"]):
+                raise CheckpointCorruptError(
+                    f"{path}: per-process state files carry {chains} chains, "
+                    f"manifest claims {man['n_chains']}")
+        # the shard streams: windows along the sample axis must tile
+        # [0, samples) contiguously; within a window one shard per writing
+        # process, together covering every chain.  A single-process run is
+        # the one-shard-per-window special case (the v1 layout).
+        cursor = 0
+        for (first, last), group in _group_shard_windows(
+                man.get("shards", [])):
+            if first != cursor:
+                raise CheckpointCorruptError(
+                    f"{path}: shard sequence is not contiguous — window "
+                    f"[{first}, {last}] starts at {first}, expected "
+                    f"{cursor}")
+            cursor = last + 1
+            chains = sum(int(s.get("chains", 0)) for s in group)
+            if chains and chains != int(man["n_chains"]):
+                raise CheckpointCorruptError(
+                    f"{path}: shards for window [{first}, {last}] cover "
+                    f"{chains} chains, manifest claims {man['n_chains']}")
         if cursor != int(man["samples"]):
             raise CheckpointCorruptError(
                 f"{path}: shards cover {cursor} samples, manifest claims "
@@ -570,6 +623,38 @@ def load_manifest(path: str) -> dict:
             f"{path}: structurally corrupt manifest "
             f"({type(e).__name__}: {e})") from e
     return man
+
+
+def _shard_proc(entry: dict) -> int:
+    """A shard entry's writing-process slot (explicit field, or parsed from
+    the ``seg-<proc>-…`` file name for entries written before the field
+    existed)."""
+    if "proc" in entry:
+        return int(entry["proc"])
+    m = _SHARD_RE.fullmatch(entry.get("file", ""))
+    return int(m.group(1)) if m else 0
+
+
+def _group_shard_windows(shards: list) -> list:
+    """Group a manifest's shard entries by their sample window: a sorted
+    list of ``((first, last), [entries in process order])``.  Overlapping
+    but non-identical windows (corruption, or streams from incompatible
+    runs mixed into one directory) raise
+    :class:`CheckpointCorruptError`."""
+    wins: dict = {}
+    for s in shards:
+        wins.setdefault((int(s["first"]), int(s["last"])), []).append(s)
+    out = sorted(wins.items())
+    for (a, b), _ in out:
+        if b < a:
+            raise CheckpointCorruptError(
+                f"shard window [{a}, {b}] is empty — corrupt manifest")
+    for ((a1, b1), _), ((a2, _b2), _g2) in zip(out, out[1:]):
+        if a2 <= b1:
+            raise CheckpointCorruptError(
+                f"shard windows [{a1}, {b1}] and starting at {a2} overlap "
+                "without being identical — corrupt manifest")
+    return [(w, sorted(g, key=_shard_proc)) for w, g in out]
 
 
 def _npz_member_mmap(path: str, name: str):
@@ -645,6 +730,103 @@ def _read_shard_member(path: str, key: str, entry: dict | None = None, *,
     return a
 
 
+class ChunkedShardView:
+    """Zero-copy virtual concatenation of per-shard sample windows.
+
+    A parameter that spans multiple shards used to be materialised by
+    ``np.concatenate`` — one full host-RAM copy of that parameter's whole
+    history, defeating the point of ``mmap=True`` on exactly the long runs
+    with many shards.  This view keeps the per-shard (typically
+    memory-mapped) chunks as-is and implements windowed ``__getitem__``
+    over the sample axis: an access copies only the rows it touches, so
+    ``post["Beta"][:, -100:]`` reads one shard's tail, not the run.
+
+    Supported without full materialisation: basic indexing whose sample-axis
+    component is an int or a slice (any step), with any basic/advanced
+    index on the chain axis and basic indices beyond — i.e. every access
+    pattern ``Posterior`` itself issues (``subset``, ``pooled``,
+    ``post_list``).  Anything more exotic falls back to ``__array__``
+    (one full copy, the old behaviour).  The view is read-only."""
+
+    def __init__(self, chunks: list):
+        if not chunks:
+            raise ValueError("ChunkedShardView: no chunks")
+        self._chunks = list(chunks)
+        rest = chunks[0].shape[2:]
+        if any(c.shape[0] != chunks[0].shape[0] or c.shape[2:] != rest
+               for c in chunks):
+            raise ValueError("ChunkedShardView: chunk shapes disagree "
+                             "beyond the sample axis")
+        self._offsets = np.cumsum([0] + [c.shape[1] for c in chunks])
+        self.shape = (chunks[0].shape[0], int(self._offsets[-1]), *rest)
+        self.dtype = chunks[0].dtype
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def nbytes(self):
+        return int(np.prod(self.shape)) * self.dtype.itemsize
+
+    def __len__(self):
+        return self.shape[0]
+
+    def __array__(self, dtype=None, copy=None):
+        a = np.concatenate([np.asarray(c) for c in self._chunks], axis=1)
+        return a.astype(dtype) if dtype is not None else a
+
+    def reshape(self, *shape):
+        return np.asarray(self).reshape(*shape)
+
+    def copy(self):
+        return np.asarray(self)
+
+    def _chunk_slices(self, s: slice):
+        """Per-chunk (index, local slice) list realising a global
+        sample-axis slice (positive step; the caller normalises)."""
+        start, stop, step = s.indices(self.shape[1])
+        out = []
+        for i, c in enumerate(self._chunks):
+            o, n = int(self._offsets[i]), c.shape[1]
+            if stop <= o or start >= o + n:
+                continue
+            lo = start if start >= o else start + step * (-(-(o - start) // step))
+            if lo >= min(stop, o + n):
+                continue
+            out.append((i, slice(lo - o, min(stop, o + n) - o, step)))
+        return out
+
+    def __getitem__(self, key):
+        if not isinstance(key, tuple):
+            key = (key,)
+        if any(k is Ellipsis or k is None for k in key):
+            return np.asarray(self)[key]
+        key = key + (slice(None),) * (2 - len(key)) if len(key) < 2 else key
+        k0, k1, rest = key[0], key[1], tuple(key[2:])
+        if isinstance(k1, (int, np.integer)):
+            k1 = int(k1) + (self.shape[1] if k1 < 0 else 0)
+            if not 0 <= k1 < self.shape[1]:
+                raise IndexError(f"sample index {key[1]} out of range "
+                                 f"for {self.shape[1]} samples")
+            i = int(np.searchsorted(self._offsets, k1, side="right")) - 1
+            return self._chunks[i][(k0, k1 - int(self._offsets[i])) + rest]
+        if isinstance(k1, slice) and (k1.step or 1) > 0:
+            parts = [self._chunks[i][(k0, ls) + rest]
+                     for i, ls in self._chunk_slices(k1)]
+            # ints before the sample axis collapse it one position left
+            axis = 0 if isinstance(k0, (int, np.integer)) else 1
+            if not parts:
+                shape = list(self.shape)
+                shape[1] = 0
+                empty = np.empty(tuple(shape), self.dtype)
+                return empty[(k0, slice(0, 0)) + rest]
+            return (parts[0] if len(parts) == 1
+                    else np.concatenate(parts, axis=axis))
+        # negative-step slice or an advanced sample-axis index: materialise
+        return np.asarray(self)[key]
+
+
 class ShardBackedArrays:
     """Posterior arrays assembled lazily from a manifest's shard sequence.
 
@@ -653,35 +835,44 @@ class ShardBackedArrays:
     parameter's payload from each shard — so constructing a Posterior from a
     multi-GB manifest costs nothing, and a Beta-only workflow never loads
     Eta at all.  With ``mmap=True`` single-shard parameters come back as
-    zero-copy ``np.memmap`` views (multi-shard parameters still concatenate
-    — one copy of that parameter, not of the history); mmap views skip
-    checksum verification (the fast trusted path — use the default eager
-    load when integrity matters more than RAM)."""
+    zero-copy ``np.memmap`` views and multi-shard parameters as a
+    :class:`ChunkedShardView` over the per-shard maps (windowed access
+    copies only what it touches — nothing concatenates the history);
+    mmap views skip checksum verification (the fast trusted path — use the
+    default eager load when integrity matters more than RAM).  Multi-process
+    manifests stitch each sample window's per-process shards along the
+    chain axis."""
 
     def __init__(self, dirpath: str, shards: list, *, mmap: bool = False,
                  verify: bool = True):
         self._dir = os.fspath(dirpath)
-        self._shards = [dict(s) for s in shards]
+        self._windows = _group_shard_windows([dict(s) for s in shards])
         self._mmap = bool(mmap)
         self._verify = bool(verify)
         self._data = {}
-        self._lazy = ([k[5:] for k in self._shards[0].get("checksums", {})
-                       if k.startswith("post:")] if self._shards else [])
+        first = self._windows[0][1] if self._windows else []
+        self._lazy = ([k[5:] for k in first[0].get("checksums", {})
+                       if k.startswith("post:")] if first else [])
         # chain-count hint so Posterior need not materialise a parameter
         # just to read its leading axis
-        self.chains = (int(self._shards[0].get("chains", 0))
-                       if self._shards else 0)
+        self.chains = sum(int(s.get("chains", 0)) for s in first)
+
+    def _read_window(self, group, key):
+        parts = [_read_shard_member(os.path.join(self._dir, s["file"]),
+                                    f"post:{key}", s, mmap=self._mmap,
+                                    verify=self._verify)
+                 for s in group]
+        # one shard per window is the single-process case (zero-copy mmap);
+        # a multi-process window stitches chain slices (one window's copy)
+        return parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
 
     def __getitem__(self, key):
         if key in self._data:
             return self._data[key]
         if key not in self._lazy:
             raise KeyError(key)
-        parts = [_read_shard_member(os.path.join(self._dir, s["file"]),
-                                    f"post:{key}", s, mmap=self._mmap,
-                                    verify=self._verify)
-                 for s in self._shards]
-        a = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=1)
+        chunks = [self._read_window(g, key) for _, g in self._windows]
+        a = chunks[0] if len(chunks) == 1 else ChunkedShardView(chunks)
         self._data[key] = a
         self._lazy.remove(key)       # materialised: exactly one home per key
         return a
@@ -759,36 +950,55 @@ def load_manifest_checkpoint(path: str, hM, *, mmap: bool = False,
             "was written for a different model; rebuild the matching Hmsc "
             "object to resume")
 
-    st_entry = man["state"]
-    spath = os.path.join(d, st_entry["file"])
-    try:
-        with np.load(spath, allow_pickle=False) as z:
-            data = {k: z[k] for k in z.files}
-    except (zipfile.BadZipFile, zlib.error, OSError, ValueError, KeyError,
-            EOFError) as e:
-        raise CheckpointCorruptError(
-            f"{spath}: unreadable state file ({type(e).__name__}: {e})") \
-            from e
-    for k, want in st_entry.get("checksums", {}).items():
-        if k not in data:
+    def _read_state_payload(st_entry):
+        spath = os.path.join(d, st_entry["file"])
+        try:
+            with np.load(spath, allow_pickle=False) as z:
+                data = {k: z[k] for k in z.files}
+        except (zipfile.BadZipFile, zlib.error, OSError, ValueError,
+                KeyError, EOFError) as e:
             raise CheckpointCorruptError(
-                f"{spath}: payload {k!r} is missing — truncated or corrupt")
-        if _crc(data[k]) != want:
+                f"{spath}: unreadable state file "
+                f"({type(e).__name__}: {e})") from e
+        for k, want in st_entry.get("checksums", {}).items():
+            if k not in data:
+                raise CheckpointCorruptError(
+                    f"{spath}: payload {k!r} is missing — truncated or "
+                    "corrupt")
+            if _crc(data[k]) != want:
+                raise CheckpointCorruptError(
+                    f"{spath}: payload {k!r} failed its integrity checksum "
+                    "— the state file is corrupt; fall back to an earlier "
+                    "manifest")
+        names, _ = _state_skeleton(spec)
+        missing = [n for n in names if f"state:{n}" not in data]
+        if missing:
             raise CheckpointCorruptError(
-                f"{spath}: payload {k!r} failed its integrity checksum — "
-                "the state file is corrupt; fall back to an earlier "
-                "manifest")
+                f"{spath}: carry-state leaves missing: {missing}")
+        return data
+
+    # multi-process manifests carry one chain-slice state file per process;
+    # concatenating their leaves in rank order reassembles the GLOBAL carry
+    # — which is what lets resume re-shard the chains under a different
+    # process count than the run that wrote the snapshot
+    st_entries = man.get("states") or [man["state"]]
+    payloads = [_read_state_payload(e) for e in st_entries]
     names, treedef = _state_skeleton(spec)
-    missing = [n for n in names if f"state:{n}" not in data]
-    if missing:
-        raise CheckpointCorruptError(
-            f"{spath}: carry-state leaves missing: {missing}")
+
+    def _concat(key):
+        parts = [p[key] for p in payloads]
+        if len(parts) == 1:
+            return parts[0]
+        # scalar leaves (none today) would be replicated, not stacked
+        return (np.concatenate(parts, axis=0) if parts[0].ndim > 0
+                else parts[0])
+
     state = jax.tree_util.tree_unflatten(
-        treedef, [jnp.asarray(data[f"state:{n}"]) for n in names])
+        treedef, [jnp.asarray(_concat(f"state:{n}")) for n in names])
     keys = None
-    if "rngkeys" in data and man.get("keys_impl"):
+    if all("rngkeys" in p for p in payloads) and man.get("keys_impl"):
         keys = jax.random.wrap_key_data(
-            jnp.asarray(data["rngkeys"]), impl=man["keys_impl"])
+            jnp.asarray(_concat("rngkeys")), impl=man["keys_impl"])
 
     shards = man.get("shards", [])
     if mmap:
@@ -799,23 +1009,29 @@ def load_manifest_checkpoint(path: str, hM, *, mmap: bool = False,
     else:
         # eager: verify + materialise in one pass, opening each shard's
         # archive once and reading each payload exactly once (NpzFile
-        # re-inflates the zip member on every access)
+        # re-inflates the zip member on every access).  Windows concatenate
+        # along samples; a multi-process window stitches chains first.
         parts = {}
-        for s in shards:
-            sp = os.path.join(d, s["file"])
-            try:
-                with np.load(sp, allow_pickle=False) as z:
-                    for k in s.get("checksums", {}):
-                        a = _read_shard_member(sp, k, s, verify=verify,
-                                               npz=z)
-                        parts.setdefault(k[5:], []).append(a)
-            except CheckpointError:
-                raise
-            except (zipfile.BadZipFile, zlib.error, OSError, ValueError,
-                    KeyError, EOFError) as e:
-                raise CheckpointCorruptError(
-                    f"{sp}: unreadable shard ({type(e).__name__}: {e})") \
-                    from e
+        for _, group in _group_shard_windows(shards):
+            win_parts = {}
+            for s in group:
+                sp = os.path.join(d, s["file"])
+                try:
+                    with np.load(sp, allow_pickle=False) as z:
+                        for k in s.get("checksums", {}):
+                            a = _read_shard_member(sp, k, s, verify=verify,
+                                                   npz=z)
+                            win_parts.setdefault(k[5:], []).append(a)
+                except CheckpointError:
+                    raise
+                except (zipfile.BadZipFile, zlib.error, OSError, ValueError,
+                        KeyError, EOFError) as e:
+                    raise CheckpointCorruptError(
+                        f"{sp}: unreadable shard "
+                        f"({type(e).__name__}: {e})") from e
+            for k, v in win_parts.items():
+                parts.setdefault(k, []).append(
+                    v[0] if len(v) == 1 else np.concatenate(v, axis=0))
         arrays = {k: (v[0] if len(v) == 1 else np.concatenate(v, axis=1))
                   for k, v in parts.items()}
 
@@ -923,7 +1139,7 @@ def rotate_checkpoints(path: str, keep: int, *,
             pass
 
 
-def _gc_orphans(path: str) -> int:
+def _gc_orphans(path: str, *, protect_uncommitted: bool = False) -> int:
     """Delete shard / state files referenced by no surviving manifest.
 
     Shards are immutable and shared between manifests, so this is the only
@@ -931,26 +1147,51 @@ def _gc_orphans(path: str) -> int:
     nothing references any more (including shards orphaned by a kill
     between a shard write and its manifest commit).  Unreadable manifests
     contribute no references — their unique files age out with them.
-    Returns the number of files removed."""
+    Returns the number of files removed.
+
+    ``protect_uncommitted`` is the multi-process guard: on a shared
+    directory the committer's GC must never reclaim a PEER's newest shards
+    — durably written but not yet referenced because their manifest commit
+    is still in flight.  It spares any shard or state file whose boundary
+    lies at/after the newest readable manifest's, and skips the foreign
+    ``*.tmp.<pid>`` sweep entirely (a pid check cannot distinguish a dead
+    writer's leftover from a live peer's in-flight tmp)."""
     path = os.fspath(path)
     if not os.path.isdir(path):
         return 0
     fns = os.listdir(path)
     referenced = set()
+    # boundary ordering mirrors checkpoint_files: any sample snapshot is
+    # newer than every burn-in snapshot
+    newest = (-1, -1)
     for fn in fns:
-        if not _MANIFEST_RE.fullmatch(fn):
+        m = _MANIFEST_RE.fullmatch(fn)
+        if not m:
             continue
         try:
             man = load_manifest(os.path.join(path, fn))
         except CheckpointError:
             continue
+        newest = max(newest, (0 if m.group(1) else 1, int(m.group(2))))
         referenced.add(man["state"]["file"])
+        referenced.update(s["file"] for s in man.get("states", []))
         referenced.update(s["file"] for s in man.get("shards", []))
+
+    def _uncommitted_newest(fn):
+        ms = _SHARD_RE.fullmatch(fn)
+        if ms:
+            return (1, int(ms.group(3)) + 1) >= newest
+        mt = _STATE_RE.fullmatch(fn)
+        if mt:
+            return (0 if mt.group(1) else 1, int(mt.group(2))) >= newest
+        return False
+
     removed = 0
     for fn in fns:
         doomed = ((_SHARD_RE.fullmatch(fn) or _STATE_RE.fullmatch(fn))
-                  and fn not in referenced)
-        if not doomed:
+                  and fn not in referenced
+                  and not (protect_uncommitted and _uncommitted_newest(fn)))
+        if not doomed and not protect_uncommitted:
             # stale atomic-write tmp from a kill mid-write (a SIGKILL can
             # leave up to a full segment of draws behind, invisible to
             # rotation): reclaim any layout-named tmp not owned by this
@@ -978,7 +1219,9 @@ def _snapshot_floor_bytes(newest: str) -> int:
             man = load_manifest(newest)
             d = os.path.dirname(newest) or "."
             total = os.path.getsize(newest)
-            total += os.path.getsize(os.path.join(d, man["state"]["file"]))
+            states = man.get("states") or [man["state"]]
+            total += sum(os.path.getsize(os.path.join(d, s["file"]))
+                         for s in states)
             total += sum(int(s.get("nbytes", 0))
                          for s in man.get("shards", []))
             return total
@@ -1000,7 +1243,8 @@ def _layout_bytes(path: str) -> int:
 
 
 def gc_checkpoints(path: str, keep: int, *, max_age_s: float | None = None,
-                   max_bytes: int | None = None) -> None:
+                   max_bytes: int | None = None,
+                   protect_uncommitted: bool = False) -> None:
     """Manifest-driven rotation for the append-only layout (also rotates
     any legacy self-contained snapshots sharing the directory).
 
@@ -1012,9 +1256,15 @@ def gc_checkpoints(path: str, keep: int, *, max_age_s: float | None = None,
     surviving manifest references are deleted.  Files hard-linked into
     ``archive/`` are exempt throughout (hard links share the inode, so
     archiving live shards costs no extra bytes until GC would have
-    reclaimed them)."""
+    reclaimed them).
+
+    ``protect_uncommitted`` (multi-process runs: the committer's GC on a
+    directory other processes append to) additionally spares unreferenced
+    shard/state files at or beyond the newest manifest's boundary — a
+    peer's durably-written-but-not-yet-committed newest files — and skips
+    the foreign tmp sweep (see :func:`_gc_orphans`)."""
     rotate_checkpoints(path, keep, max_age_s=max_age_s)
-    _gc_orphans(path)
+    _gc_orphans(path, protect_uncommitted=protect_uncommitted)
     if max_bytes is not None:
         files = checkpoint_files(path)
         # the newest snapshot plus everything it references is the floor:
@@ -1045,7 +1295,7 @@ def gc_checkpoints(path: str, keep: int, *, max_age_s: float | None = None,
                     os.unlink(victim)
                 except OSError:
                     pass
-                _gc_orphans(path)
+                _gc_orphans(path, protect_uncommitted=protect_uncommitted)
 
 
 def latest_valid_checkpoint(path: str, hM, *,
@@ -1076,6 +1326,452 @@ def latest_valid_checkpoint(path: str, hM, *,
 
 
 # ---------------------------------------------------------------------------
+# CheckpointWriter: the sampler's on-disk snapshot machinery
+# ---------------------------------------------------------------------------
+
+class CheckpointWriter:
+    """Every on-disk artifact of an auto-checkpointing run, in one object.
+
+    Extracted from ``sample_mcmc`` (ROADMAP item): the sampler's loop now
+    only *submits* snapshot calls; all layout logic — append-only shards /
+    state files / manifest commits, the legacy rotating self-contained
+    files, rotation + GC + archive links, splice repairs, and the
+    multi-process manifest coordination — lives here, constructed from
+    ``(dir, layout, base, shards)`` explicitly and unit-testable with no
+    sampler in the loop (``tests/test_checkpoint_writer.py``).
+
+    Threading contract: every mutating method runs on the sampler's single
+    background writer thread (FIFO submission order), so the internal
+    bookkeeping needs no locks.  ``records`` is the (shared, sampler-owned)
+    list of fetched host record trees; the writer reads and folds it only
+    from that same thread.
+
+    Multi-process runs (``coordinator`` with ``process_count > 1``): each
+    process's writer appends ONLY its own ``seg-<proc>-…`` shard stream and
+    ``state-<tag>-p<proc>.npz`` chain-slice carry; a snapshot then
+    all-gathers the per-process manifest entries (an implicit barrier that
+    certifies every process fsynced its files up to the boundary), the
+    committer (rank 0) alone writes the stitched ``manifest-<tag>.json``
+    and runs GC (with ``protect_uncommitted`` so a peer's newest
+    not-yet-committed files are never reclaimed), and a final barrier
+    releases the peers only after the commit is durable (it doubles as
+    the per-mark pacing that keeps rank skew from accumulating into
+    gather stalls).  The gather also carries each process's preemption
+    flag, so a SIGTERM on ANY process unwinds EVERY process at the same
+    committed boundary (``abort_agreed``)."""
+
+    def __init__(self, dirpath: str, layout: str, spec, *, hM=None,
+                 records: list | None = None, base_post=None,
+                 base_samples: int = 0, shards: list | None = None,
+                 keep: int = 3, max_age_s: float | None = None,
+                 archive_every: int = 0, max_bytes: int | None = None,
+                 keys_impl: str | None = None, shard_index: int = 0,
+                 coordinator=None, compress: bool = False,
+                 preempt_fn=None):
+        if layout not in ("append", "rotating"):
+            raise ValueError(f"layout must be 'append' or 'rotating', "
+                             f"got {layout!r}")
+        self.dir = os.fspath(dirpath)
+        os.makedirs(self.dir, exist_ok=True)
+        self.layout = layout
+        self.spec = spec
+        self.hM = hM
+        self.records = records if records is not None else []
+        self.base_post = base_post
+        self.base_samples = int(base_samples)
+        self.keep = int(keep)
+        self.max_age_s = max_age_s
+        self.archive_every = int(archive_every)
+        self.max_bytes = max_bytes
+        self.keys_impl = keys_impl
+        self.shard_index = int(shard_index)
+        self.coordinator = coordinator
+        self.compress = bool(compress)
+        self._preempt_fn = preempt_fn or (lambda: False)
+        self._multi = (coordinator is not None
+                       and int(coordinator.process_count) > 1)
+        if self._multi and layout != "append":
+            raise ValueError(
+                "multi-process checkpointing requires the append layout "
+                "(the rotating self-contained format has no per-process "
+                "commit point)")
+        self._carried = [dict(s) for s in shards or []]
+        self._own: list = []
+        # one-time legacy migration: a rotating-layout run continued in the
+        # append layout flushes its base draws once as a base shard
+        self._base_flush = (base_post
+                            if (layout == "append" and base_post is not None
+                                and not self._carried) else None)
+        if self._multi and self._base_flush is not None:
+            raise ValueError(
+                "resuming a legacy rotating directory on a multi-process "
+                "mesh is not supported — resume it single-process once to "
+                "migrate it to the append layout first")
+        self._flush = {
+            "idx": 0, "cursor": self.base_samples,
+            # seed past any repair ordinal the carried shard list holds so
+            # a later splice-rewrite never reuses a repair file name
+            "repair": max((int(m.group(4) or 0) for m in
+                           (_SHARD_RE.fullmatch(s["file"])
+                            for s in self._carried) if m), default=0)}
+        self.n_writes = 0
+        self.abort_agreed = False
+        self.io = {"bytes": 0, "snapshot_bytes": [], "shards_written": 0,
+                   "barrier_wait_s": 0.0, "manifest_commit_s": 0.0}
+
+    # -- shared helpers ----------------------------------------------------
+
+    @property
+    def _is_committer(self) -> bool:
+        return (not self._multi) or self.coordinator.is_coordinator
+
+    def path_for(self, done: int = 0, burnin_it: int | None = None) -> str:
+        """The snapshot path a matching :meth:`snapshot` call will commit
+        (the preemption message names it before the write has drained)."""
+        tag = (f"t{burnin_it:08d}" if burnin_it is not None
+               else f"{self.base_samples + int(done):08d}")
+        if self.layout == "append":
+            return os.path.join(self.dir, f"manifest-{tag}.json")
+        return os.path.join(self.dir, f"ckpt-{tag}.npz")
+
+    def _merged_records(self) -> dict:
+        """Fold the fetched host segments into one tree (kept folded so
+        repeated rotating snapshots stay linear, not quadratic)."""
+        import jax
+        if len(self.records) > 1:
+            merged = jax.tree.map(lambda *xs: np.concatenate(xs, axis=1),
+                                  *self.records)
+            self.records[:] = [merged]
+        return self.records[0] if self.records else {}
+
+    def _merged_first_bad(self, first_bad) -> np.ndarray:
+        fb = np.asarray(first_bad)
+        if self.base_post is not None:
+            fb0 = np.asarray(self.base_post.chain_health["first_bad_it"])
+            fb = np.where(fb0 >= 0, fb0, fb)
+        return fb
+
+    def _nf_sat(self, state) -> dict:
+        return {str(r): np.asarray(state.levels[r].nf_sat).reshape(-1)
+                for r in range(self.spec.nr)}
+
+    def _gc(self) -> None:
+        gc_checkpoints(self.dir, self.keep, max_age_s=self.max_age_s,
+                       max_bytes=self.max_bytes,
+                       protect_uncommitted=self._multi)
+
+    def _archive_link(self, src: str) -> None:
+        # hard-link (copy fallback) into archive/, exempt from rotation
+        # and GC — post-hoc divergence debugging; links share the inode
+        # so archiving a live shard costs no extra bytes
+        adir = os.path.join(self.dir, "archive")
+        os.makedirs(adir, exist_ok=True)
+        apath = os.path.join(adir, os.path.basename(src))
+        try:
+            if os.path.exists(apath):
+                os.unlink(apath)
+            os.link(src, apath)
+        except OSError:
+            import shutil
+            shutil.copy2(src, apath)
+
+    # -- the one public snapshot entry point -------------------------------
+
+    def snapshot(self, done: int, state, keys, first_bad, meta: dict, *,
+                 burnin_it: int | None = None) -> str:
+        """Commit one snapshot: recorded draws up to local count ``done``
+        (plus any resumed base segment), the carry ``state``, the RNG
+        ``keys`` (typed keys or raw key data), and divergence health.
+        ``burnin_it`` marks a state-only burn-in snapshot at that absolute
+        sweep.  ``meta`` is the sampler's run-metadata dict (resume reads
+        the run configuration from it)."""
+        self.n_writes += 1
+        ordinal = self.n_writes
+        if burnin_it is not None:
+            meta = dict(meta, transient_done=int(burnin_it))
+        if self.layout == "append":
+            b0 = self.io["bytes"]
+            if burnin_it is None:
+                self._flush_shards(done)
+                tag = f"{self.base_samples + int(done):08d}"
+            else:
+                tag = f"t{burnin_it:08d}"
+            path = self._append_snapshot(
+                tag,
+                meta["samples_done"] if burnin_it is None
+                else self.base_samples,
+                state, keys, first_bad, meta, ordinal)
+            self.io["snapshot_bytes"].append(self.io["bytes"] - b0)
+            return path
+        if burnin_it is not None:
+            return self._write_burnin_ck(burnin_it, state, keys, first_bad,
+                                         meta, ordinal)
+        return self._write_ck(done, state, keys, first_bad, meta, ordinal)
+
+    # -- append-only layout ------------------------------------------------
+
+    def _flush_shards(self, done: int) -> None:
+        """Make every draw recorded up to local count ``done`` durable as
+        immutable shards of THIS process's stream.  Runs FIFO after all
+        pending segment fetches, so ``records`` holds everything up to the
+        snapshot boundary; cost is O(draws since the last flush), never
+        O(history) — the layout's whole point."""
+        import jax
+        if self._base_flush is not None:
+            bp, self._base_flush = self._base_flush, None
+            entry = save_shard(
+                self.dir, {k: np.asarray(v) for k, v in bp.arrays.items()},
+                0, self.base_samples - 1, shard_index=self.shard_index,
+                compress=self.compress)
+            self._own.append(entry)
+            self.io["bytes"] += entry["nbytes"]
+            self.io["shards_written"] += 1
+        done_g = self.base_samples + int(done)
+        if done_g <= self._flush["cursor"]:
+            return
+        new = self.records[self._flush["idx"]:]
+        arrays = (new[0] if len(new) == 1
+                  else jax.tree.map(
+                      lambda *xs: np.concatenate(xs, axis=1), *new))
+        entry = save_shard(self.dir, arrays, self._flush["cursor"],
+                           done_g - 1, shard_index=self.shard_index,
+                           compress=self.compress)
+        self._flush["idx"] = len(self.records)
+        self._flush["cursor"] = done_g
+        self._own.append(entry)
+        self.io["bytes"] += entry["nbytes"]
+        self.io["shards_written"] += 1
+
+    def _manifest_common(self, samples_done: int, meta: dict) -> dict:
+        import hmsc_tpu as _pkg
+        return {
+            "package_version": _pkg.__version__,
+            "samples": int(samples_done),
+            "transient": int(meta["transient"]),
+            "thin": int(meta["thin"]), "n_chains": int(meta["n_chains"]),
+            "nf_cap": int(meta["nf_cap"]),
+            "spec_sha256": spec_fingerprint(self.spec),
+            "keys_impl": self.keys_impl,
+            "run": meta,
+        }
+
+    def _append_snapshot(self, tag: str, samples_done: int, state, keys,
+                         first_bad, meta: dict, ordinal: int) -> str:
+        """State file + coordinated manifest commit + archive + GC for one
+        append-layout snapshot."""
+        st_entry = save_state_file(
+            self.dir, tag, self.spec, state, keys_data=keys,
+            proc=self.shard_index if self._multi else None,
+            compress=self.compress)
+        self.io["bytes"] += st_entry["nbytes"]
+        if self._multi:
+            # each process publishes its own dirents durably before the
+            # barrier certifies the boundary (single-process relies on the
+            # manifest commit's directory fsync covering all three)
+            _fsync_dir(os.path.join(self.dir, st_entry["file"]))
+        fb = [int(x) for x in self._merged_first_bad(first_bad)]
+        nf_sat = {r: v.tolist() for r, v in self._nf_sat(state).items()}
+        man = self._manifest_common(samples_done, meta)
+        path = os.path.join(self.dir, f"manifest-{tag}.json")
+        if not self._multi:
+            man.update(state=st_entry, shards=self._carried + self._own,
+                       first_bad_it=fb, nf_saturation=nf_sat)
+            t0 = time.perf_counter()
+            save_manifest(self.dir, tag, man)
+            self.io["manifest_commit_s"] += time.perf_counter() - t0
+            self.io["bytes"] += int(os.path.getsize(path))
+            self._maybe_archive(path, man, ordinal)
+            self._gc()
+            return path
+        coord = self.coordinator
+        payload = {"state": st_entry, "shards": self._own,
+                   "first_bad_it": fb, "nf_saturation": nf_sat,
+                   "preempt": bool(self._preempt_fn())}
+        t0 = time.perf_counter()
+        parts = coord.all_gather(payload, tag=f"ck-{tag}")
+        self.io["barrier_wait_s"] += time.perf_counter() - t0
+        if any(p["preempt"] for p in parts):
+            self.abort_agreed = True
+        if coord.is_coordinator:
+            # stitch: per-process new shards regrouped into sample windows
+            # (process order within a window); the carried prefix is the
+            # prior manifest's already-global sequence
+            new = [dict(s) for p in parts for s in p["shards"]]
+            stitched = [s for _, grp in _group_shard_windows(new)
+                        for s in grp]
+            states = [p["state"] for p in parts]
+            man.update(
+                state=states[0], states=states,
+                process_count=int(coord.process_count),
+                shards=self._carried + stitched,
+                first_bad_it=[x for p in parts for x in p["first_bad_it"]],
+                nf_saturation={
+                    r: [x for p in parts for x in p["nf_saturation"][r]]
+                    for r in nf_sat},
+            )
+            t1 = time.perf_counter()
+            save_manifest(self.dir, tag, man)
+            self.io["manifest_commit_s"] += time.perf_counter() - t1
+            self.io["bytes"] += int(os.path.getsize(path))
+            self._maybe_archive(path, man, ordinal)
+            self._gc()
+        # Every commit ends with a release barrier.  It buys two things:
+        # no rank exits the run (normal completion or preemption unwind)
+        # before the manifest its exit message names is durable, and —
+        # just as important — it re-paces the ranks' writer threads each
+        # mark.  Skipping it on intermediate commits looks like a free
+        # win (the next mark's gather already orders ranks behind the
+        # committer's manifest write), but was measured to be a large
+        # regression on an oversubscribed host: without the per-mark
+        # resync, rank skew accumulates, the committer stalls in
+        # ever-longer gather polls, its bounded queue fills, and the
+        # backpressure lands on the driver (A/B on the same box:
+        # commit overhead 1.5% with the barrier vs 27% without;
+        # scaling efficiency 97% vs 62%).
+        t2 = time.perf_counter()
+        coord.barrier(f"committed-{tag}")
+        self.io["barrier_wait_s"] += time.perf_counter() - t2
+        return path
+
+    def _maybe_archive(self, man_path: str, man: dict, ordinal: int) -> None:
+        if not (self.archive_every and ordinal % self.archive_every == 0):
+            return
+        self._archive_link(man_path)
+        for st in (man.get("states") or [man["state"]]):
+            self._archive_link(os.path.join(self.dir, st["file"]))
+        for s in man.get("shards", []):
+            src = os.path.join(self.dir, s["file"])
+            dst = os.path.join(self.dir, "archive", s["file"])
+            try:
+                # same inode = already archived (hard link); a same-NAME
+                # file from a previous run in a reused directory must be
+                # re-linked, or this manifest's archive copy would pair
+                # with the old run's bytes
+                if os.path.exists(dst) and os.path.samefile(src, dst):
+                    continue
+            except OSError:
+                pass
+            self._archive_link(src)
+
+    def rewrite_spliced(self, changed_from: int, total_samples: int,
+                        state, keys, first_bad, post, meta: dict) -> str:
+        """Post-splice repair of a completed append-layout run (after the
+        background writer drained): shards entirely before the changed
+        window are untouched; the changed tail is re-written ONCE as a
+        repair shard (immutable files never mutate — a repaired window gets
+        a new name), and a new final manifest commits the repaired
+        sequence.  Cost is O(changed draws): a warm-restart splice
+        re-writes only the post-snapshot tail."""
+        if self._multi:
+            raise CheckpointError(
+                "splice repair is single-process only (retry_diverged is "
+                "not supported under a multi-process coordinator)")
+        changed_g = self.base_samples + int(changed_from)
+        keep_shards, doomed = [], []
+        for s in self._carried + self._own:
+            (keep_shards if int(s["last"]) < changed_g
+             else doomed).append(s)
+        # the repair window opens at the first superseded shard's start
+        # (a shard straddling the change boundary is replaced whole)
+        rep_first = (min(int(s["first"]) for s in doomed)
+                     if doomed else changed_g)
+        end_g = self.base_samples + int(total_samples)
+        if rep_first < end_g:
+            self._flush["repair"] += 1
+            lo = rep_first - self.base_samples
+            arrays = {k: np.asarray(v)[:, lo:]
+                      for k, v in post.arrays.items()}
+            entry = save_shard(self.dir, arrays, rep_first, end_g - 1,
+                               shard_index=self.shard_index,
+                               repair=self._flush["repair"],
+                               compress=self.compress)
+            keep_shards.append(entry)
+            self.io["bytes"] += entry["nbytes"]
+            self.io["shards_written"] += 1
+        self._carried, self._own = [], keep_shards
+        return self._append_snapshot(f"{end_g:08d}", end_g, state, keys,
+                                     first_bad, meta, self.n_writes)
+
+    # -- legacy rotating self-contained layout ------------------------------
+
+    def _finish_ck(self, path, partial, state, keys, meta, ordinal) -> None:
+        save_checkpoint(path, partial, state, keys=keys,
+                        keys_impl=self.keys_impl, run_meta=meta,
+                        compress=self.compress)
+        nbytes = int(os.path.getsize(path))
+        self.io["bytes"] += nbytes
+        self.io["snapshot_bytes"].append(nbytes)
+        self._gc()
+        if self.archive_every and ordinal % self.archive_every == 0:
+            self._archive_link(path)
+
+    def _write_ck(self, done: int, state, keys, first_bad, meta: dict,
+                  ordinal: int, post_override=None,
+                  state_override=None) -> str:
+        """Self-contained snapshot: draws-so-far (prepending a resumed
+        run's base segment) + carry state + carried keys; atomic write,
+        rotate.  ``post_override``/``state_override`` re-write a slot from
+        an already-built posterior and spliced carry state (the
+        retry_diverged splice re-writes the final one)."""
+        from ..post.posterior import Posterior as _P
+        if post_override is None:
+            arrays = {k: np.asarray(v)
+                      for k, v in self._merged_records().items()}
+            fb = np.asarray(first_bad)
+        else:
+            arrays = {k: np.asarray(v)
+                      for k, v in post_override.arrays.items()}
+            fb = np.asarray(post_override.chain_health["first_bad_it"])
+        if self.base_post is not None:
+            if set(arrays) != set(self.base_post.arrays):
+                raise CheckpointError(
+                    "continuation records different parameters than the "
+                    "checkpointed base segment — was record= changed?")
+            arrays = {k: np.concatenate([self.base_post.arrays[k],
+                                         arrays[k]], axis=1)
+                      for k in arrays}
+            fb0 = np.asarray(self.base_post.chain_health["first_bad_it"])
+            fb = np.where(fb0 >= 0, fb0, fb)
+        partial = _P(self.hM, self.spec, arrays,
+                     samples=int(meta["samples_done"]),
+                     transient=int(meta["transient"]),
+                     thin=int(meta["thin"]))
+        partial.set_chain_health(fb)
+        partial.nf_saturation = (
+            dict(post_override.nf_saturation) if post_override is not None
+            else self._nf_sat(state))
+        path = os.path.join(self.dir,
+                            f"ckpt-{int(meta['samples_done']):08d}.npz")
+        self._finish_ck(path, partial,
+                        state if state_override is None else state_override,
+                        keys, meta, ordinal)
+        return path
+
+    def _write_burnin_ck(self, it_now: int, state, keys, first_bad,
+                         meta: dict, ordinal: int) -> str:
+        """State-only burn-in snapshot (carry + keys, no draws): a kill
+        during a long transient resumes from here instead of restarting
+        burn-in from scratch."""
+        from ..post.posterior import Posterior as _P
+        partial = _P(self.hM, self.spec, {}, samples=0,
+                     transient=int(meta["transient"]),
+                     thin=int(meta["thin"]))
+        partial.n_chains = int(meta["n_chains"])
+        partial.set_chain_health(np.asarray(first_bad))
+        partial.nf_saturation = self._nf_sat(state)
+        path = os.path.join(self.dir, f"ckpt-t{int(it_now):08d}.npz")
+        self._finish_ck(path, partial, state, keys, meta, ordinal)
+        return path
+
+    def rewrite_rotating(self, total_samples: int, state, keys, first_bad,
+                         post, meta: dict) -> str:
+        """Re-write the final rotating slot from a spliced posterior."""
+        return self._write_ck(int(total_samples), state, keys, first_bad,
+                              meta, self.n_writes, post_override=post,
+                              state_override=state)
+
+
+# ---------------------------------------------------------------------------
 # resume / concat
 # ---------------------------------------------------------------------------
 
@@ -1096,7 +1792,7 @@ def resume_run(hM, checkpoint_path: str, *, verbose: int = 0,
                checkpoint_layout: str | None = None,
                allow_legacy_pickle: bool = False, mesh=None,
                chain_axis: str = "chains", species_axis: str = "species",
-               pipeline: bool = True):
+               pipeline: bool = True, coordinator=None):
     """Continue an auto-checkpointed ``sample_mcmc`` run to completion.
 
     Locates the newest valid checkpoint under ``checkpoint_path`` (corrupt
@@ -1125,9 +1821,24 @@ def resume_run(hM, checkpoint_path: str, *, verbose: int = 0,
     selection) are deliberately not overridable and always come from the
     checkpoint.  A device ``mesh`` is not serializable, so a
     sharded run passes its (possibly different) mesh back in via
-    ``mesh=``/``chain_axis=``/``species_axis=``."""
+    ``mesh=``/``chain_axis=``/``species_axis=``.
+
+    ``coordinator`` continues the run on a multi-process mesh — with ANY
+    process count, equal to or different from the one that wrote the
+    snapshot: the loaded checkpoint carries the GLOBAL chain state (a
+    multi-process manifest's per-process state files are stitched on
+    load), and each process re-shards to its contiguous chain slice.  The
+    per-chain draw stream is layout-invariant, so a 2-process run resumed
+    single-process (or vice versa) reproduces the identical draws.  Each
+    process returns the Posterior of its own chain slice; the committed
+    final manifest holds the global run."""
+    import jax
     import jax.numpy as jnp
 
+    from .coordination import get_coordinator
+
+    coord = get_coordinator(coordinator)
+    n_procs = int(coord.process_count)
     ck = latest_valid_checkpoint(checkpoint_path, hM,
                                  allow_legacy_pickle=allow_legacy_pickle)
     meta = dict(ck.run_meta)
@@ -1167,6 +1878,15 @@ def resume_run(hM, checkpoint_path: str, *, verbose: int = 0,
     align = bool(meta.get("align_post", True))
     if total <= done:
         out = ck.post
+        if n_procs > 1 and len(out.arrays):
+            if out.n_chains % n_procs:
+                raise CheckpointError(
+                    f"{ck.path}: carries {out.n_chains} chains, not "
+                    f"divisible over {n_procs} processes — resume with a "
+                    "process count that divides the chain count")
+            k = out.n_chains // n_procs
+            lo = int(coord.process_index) * k
+            out = out.subset(chain_index=np.arange(lo, lo + k))
         if align and out.spec.nr > 0:
             _bounded_align(out)
         return out
@@ -1178,6 +1898,27 @@ def resume_run(hM, checkpoint_path: str, *, verbose: int = 0,
                    if done == 0 and t_done else 0)
     base = ck.post if ck.post.arrays else None
 
+    # multi-process continuation: the checkpoint carries the GLOBAL chain
+    # state; this process takes its contiguous slice (the process count may
+    # differ from the writing run's — chains re-shard freely because seeds
+    # and key streams are derived from the global chain index)
+    init_state, init_keys = ck.state, ck.keys
+    n_chains_g = int(ck.post.n_chains)
+    if n_procs > 1:
+        if n_chains_g % n_procs:
+            raise CheckpointError(
+                f"{ck.path}: carries {n_chains_g} chains, not divisible "
+                f"over {n_procs} processes — resume with a process count "
+                "that divides the chain count")
+        k = n_chains_g // n_procs
+        lo = int(coord.process_index) * k
+        sl = slice(lo, lo + k)
+        init_state = jax.tree_util.tree_map(lambda x: x[sl], ck.state)
+        if init_keys is not None:
+            init_keys = init_keys[sl]
+        if base is not None:
+            base = base.subset(chain_index=np.arange(lo, lo + k))
+
     rd = meta.get("record_dtype")
     record = meta.get("record")
     ckdir = (os.fspath(checkpoint_path) if os.path.isdir(checkpoint_path)
@@ -1186,8 +1927,9 @@ def resume_run(hM, checkpoint_path: str, *, verbose: int = 0,
     cont = sample_mcmc(
         hM, samples=total - done, transient=remaining_t,
         thin=int(meta["thin"]),
-        n_chains=ck.post.n_chains, seed=meta.get("seed"),
-        init_state=ck.state, init_keys=ck.keys,
+        n_chains=n_chains_g, seed=meta.get("seed"),
+        init_state=init_state, init_keys=init_keys,
+        coordinator=coordinator,
         # the original (resolved) adaptation window: its gate is on the
         # carried iteration counter, so it is a no-op here — but matching it
         # lets the continuation reuse the original run's compiled program
@@ -1200,7 +1942,11 @@ def resume_run(hM, checkpoint_path: str, *, verbose: int = 0,
         record=tuple(record) if record else None,
         record_dtype=None if rd is None else getattr(jnp, rd),
         rng_impl=meta.get("rng_impl"),
-        retry_diverged=int(meta.get("retry_diverged", 0)),
+        # the divergence splice-rewrite is single-process machinery (and
+        # sample_mcmc rejects it under a coordinator): a multi-process
+        # continuation forgoes warm retries rather than failing to resume
+        retry_diverged=(0 if n_procs > 1
+                        else int(meta.get("retry_diverged", 0))),
         align_post=False, verbose=verbose, mesh=mesh,
         chain_axis=chain_axis, species_axis=species_axis,
         progress_callback=progress_callback,

@@ -1,0 +1,251 @@
+"""Cross-process coordination for multi-host sampling runs.
+
+The reference fans chains over a SOCK cluster of R processes
+(``nParallel``); this package's equivalent is R independent JAX processes,
+each sampling its slice of the chains, coordinated ONLY at checkpoint
+boundaries (chains never communicate mid-sweep — the Gibbs sweep is
+embarrassingly parallel over chains, the same property Hmsc-HPC exploits
+across GPUs).  What does need agreement is durability: every process
+appends its own immutable shard stream, and one process (the *committer*,
+process 0) publishes the atomically-renamed manifest only after a barrier
+confirms every peer fsynced its shards up to the boundary — the
+single-committer manifest discipline of multi-host array-checkpointing
+systems (Orbax-style).
+
+Three backends behind one tiny interface (``barrier`` / ``broadcast`` /
+``all_gather``):
+
+- :class:`SingleProcessCoordinator` — the degenerate R=1 case; every
+  collective is a local no-op.  ``sample_mcmc`` without a coordinator
+  behaves exactly as before.
+- :class:`FileCoordinator` — filesystem sentinels in a shared directory.
+  Slow-path but dependency-free, which is the point: the FULL multi-process
+  protocol (barrier-gated commits, kill-one-process timeouts, committer-only
+  GC) runs in tier-1 CPU tests via plain subprocesses, no TPU pod or
+  ``jax.distributed`` rendezvous server required.  Also usable for real
+  multi-host runs whose hosts share a filesystem (NFS/GCS-fuse).
+- :class:`DistributedCoordinator` — ``jax.distributed`` /
+  ``jax.experimental.multihost_utils`` collectives for a real multi-process
+  mesh (objects ride pickled uint8 arrays over the existing DCN channel).
+
+Collective calls are SPMD: every process must issue the SAME sequence of
+collectives (each call consumes one slot of an internal sequence counter —
+that counter is what names the sentinel files / sync keys, so a diverging
+call order deadlocks instead of silently mispairing payloads).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = [
+    "Coordinator", "SingleProcessCoordinator", "FileCoordinator",
+    "DistributedCoordinator", "CoordinationError", "get_coordinator",
+]
+
+
+class CoordinationError(RuntimeError):
+    """A collective failed: a peer died, timed out, or answered garbage.
+
+    Raised instead of hanging forever — the caller (the sampling loop's
+    writer thread) propagates it like any other writer failure, so a killed
+    peer surfaces as a clean run failure with every already-committed
+    manifest intact."""
+
+
+class Coordinator:
+    """Interface: R processes, rank ``process_index``, process 0 commits.
+
+    ``barrier(tag)`` blocks until every process reaches it;
+    ``broadcast(obj)`` returns process 0's object on every process;
+    ``all_gather(obj)`` returns the list of every process's object in rank
+    order.  All three are collectives — every process must call them in the
+    same order (see module docstring)."""
+
+    process_index: int = 0
+    process_count: int = 1
+
+    @property
+    def is_coordinator(self) -> bool:
+        """Whether this process is the committer (rank 0): the only rank
+        that writes manifests and runs GC."""
+        return self.process_index == 0
+
+    def barrier(self, tag: str = "barrier") -> None:
+        raise NotImplementedError
+
+    def broadcast(self, obj, tag: str = "bcast"):
+        return self.all_gather(obj, tag=tag)[0]
+
+    def all_gather(self, obj, tag: str = "gather") -> list:
+        raise NotImplementedError
+
+
+class SingleProcessCoordinator(Coordinator):
+    """R = 1: every collective completes immediately with local data."""
+
+    def barrier(self, tag: str = "barrier") -> None:
+        pass
+
+    def all_gather(self, obj, tag: str = "gather") -> list:
+        return [obj]
+
+
+class FileCoordinator(Coordinator):
+    """Filesystem-sentinel collectives over a shared directory.
+
+    Each collective call ``n`` writes an atomically-renamed
+    ``coord-<n>-<rank>.json`` sentinel carrying the (JSON-serialisable)
+    payload, then polls until all R sentinels for slot ``n`` exist.  A
+    process may delete its OWN slot-``n-1`` sentinel once its slot-``n``
+    gather completes: every peer writing slot ``n`` has by construction
+    finished READING slot ``n-1`` (collectives are ordered), so the
+    directory holds O(R) live files regardless of run length.
+
+    ``timeout_s`` bounds every wait: a peer that died mid-protocol turns
+    into :class:`CoordinationError` instead of a hang — the
+    kill-one-process-mid-segment story depends on this.  The directory must
+    be empty of another run's sentinels (use a fresh subdirectory per run
+    attempt; ``resume`` attempts get their own)."""
+
+    def __init__(self, dirpath: str, process_index: int, process_count: int,
+                 *, timeout_s: float = 120.0, poll_s: float = 0.001):
+        if not (0 <= int(process_index) < int(process_count)):
+            raise ValueError(
+                f"process_index {process_index} out of range for "
+                f"process_count {process_count}")
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        self._dir = os.fspath(dirpath)
+        self._timeout = float(timeout_s)
+        self._poll = float(poll_s)
+        self._seq = 0
+        os.makedirs(self._dir, exist_ok=True)
+
+    def _path(self, seq: int, rank: int) -> str:
+        return os.path.join(self._dir, f"coord-{seq:08d}-{rank}.json")
+
+    def barrier(self, tag: str = "barrier") -> None:
+        self.all_gather(None, tag=tag)
+
+    def all_gather(self, obj, tag: str = "gather") -> list:
+        seq = self._seq
+        self._seq += 1
+        mine = self._path(seq, self.process_index)
+        tmp = f"{mine}.tmp.{os.getpid()}"
+        body = json.dumps({"tag": tag, "payload": obj})
+        # no fsync: sentinels are transient coordination data, not
+        # durability artifacts — the atomic rename is what makes the
+        # payload visible to peers, and a crash simply resumes from the
+        # committed manifests (whose own writes DO fsync).  Sentinel
+        # fsyncs would add several ms to every collective for nothing.
+        with open(tmp, "w") as f:
+            f.write(body)
+        os.replace(tmp, mine)
+
+        deadline = time.monotonic() + self._timeout
+        out = [None] * self.process_count
+        pending = set(range(self.process_count))
+        while pending:
+            for r in sorted(pending):
+                p = self._path(seq, r)
+                try:
+                    with open(p) as f:
+                        rec = json.loads(f.read())
+                except (OSError, ValueError):
+                    continue           # not there yet / mid-rename
+                if rec.get("tag") != tag:
+                    raise CoordinationError(
+                        f"collective #{seq} mispaired: rank {r} is at "
+                        f"{rec.get('tag')!r}, this rank at {tag!r} — the "
+                        "processes issued diverging collective sequences")
+                out[r] = rec["payload"]
+                pending.discard(r)
+            if pending:
+                if time.monotonic() > deadline:
+                    raise CoordinationError(
+                        f"collective {tag!r} (#{seq}) timed out after "
+                        f"{self._timeout:.0f}s waiting for rank(s) "
+                        f"{sorted(pending)} of {self.process_count} — a "
+                        "peer process died or stalled; committed "
+                        "checkpoints are intact, resume with resume_run")
+                time.sleep(self._poll)
+        # every peer has started slot `seq`, so all of them finished
+        # reading slot `seq-1`: our previous sentinel is reclaimable
+        if seq > 0:
+            try:
+                os.unlink(self._path(seq - 1, self.process_index))
+            except OSError:
+                pass
+        return out
+
+    def cleanup(self) -> None:
+        """Reclaim this rank's stale sentinels at shutdown.
+
+        Only slots every peer provably finished reading (≤ ``_seq - 2``:
+        a peer that completed slot ``n`` has read slot ``n - 1``) are
+        removable — the LAST sentinel must stay, because a slower peer may
+        still be polling it (deleting it would strand that peer until its
+        timeout).  The leftover is O(R) tiny files in a per-attempt
+        directory, reclaimed with the directory itself."""
+        for seq in range(self._seq - 1):
+            try:
+                os.unlink(self._path(seq, self.process_index))
+            except OSError:
+                pass
+
+
+class DistributedCoordinator(Coordinator):
+    """Collectives over an initialised ``jax.distributed`` runtime.
+
+    Objects are pickled onto uint8 device arrays and gathered with
+    ``jax.experimental.multihost_utils`` (two collectives per gather: one
+    for the byte lengths, one for the padded payloads) — metadata-sized
+    traffic only, the draw shards themselves never cross hosts.  Requires
+    ``jax.distributed.initialize()`` to have run (or a single-process
+    context, where it degenerates gracefully)."""
+
+    def __init__(self):
+        import jax
+        self.process_index = int(jax.process_index())
+        self.process_count = int(jax.process_count())
+
+    def barrier(self, tag: str = "barrier") -> None:
+        if self.process_count == 1:
+            return
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(tag)
+
+    def all_gather(self, obj, tag: str = "gather") -> list:
+        import pickle
+
+        import numpy as np
+
+        if self.process_count == 1:
+            return [obj]
+        from jax.experimental import multihost_utils
+        data = pickle.dumps(obj)
+        lens = np.asarray(multihost_utils.process_allgather(
+            np.array([len(data)], dtype=np.int64))).reshape(-1)
+        buf = np.zeros(int(lens.max()), dtype=np.uint8)
+        buf[:len(data)] = np.frombuffer(data, dtype=np.uint8)
+        allbuf = np.asarray(multihost_utils.process_allgather(buf))
+        return [pickle.loads(allbuf[r, :int(lens[r])].tobytes())
+                for r in range(self.process_count)]
+
+
+def get_coordinator(coordinator=None) -> Coordinator:
+    """Resolve the coordinator ``sample_mcmc`` runs under.
+
+    An explicit coordinator wins; otherwise a multi-process JAX runtime
+    (``jax.process_count() > 1`` — i.e. ``jax.distributed`` was
+    initialised) gets the :class:`DistributedCoordinator`, and the common
+    single-process case gets the no-op :class:`SingleProcessCoordinator`."""
+    if coordinator is not None:
+        return coordinator
+    import jax
+    if int(jax.process_count()) > 1:
+        return DistributedCoordinator()
+    return SingleProcessCoordinator()

@@ -278,6 +278,42 @@ def test_mmap_single_shard_is_zero_copy_view(tmp_path, model):
     assert isinstance(ck.post["Beta"], np.memmap)
 
 
+def test_mmap_multi_shard_is_chunked_view(ref_run, model):
+    """A parameter spanning several shards comes back as a ChunkedShardView
+    (ISSUE 4 satellite — the old path np.concatenate'd a full host copy):
+    the per-shard memmaps stay as-is, windowed access copies only the rows
+    it touches, and every access pattern Posterior issues round-trips."""
+    from hmsc_tpu.utils.checkpoint import ChunkedShardView
+    post, d = ref_run                              # 2 shards of 4 samples
+    ck = load_manifest_checkpoint(checkpoint_files(d)[0], model, mmap=True)
+    v = ck.post["Beta"]
+    ref = np.asarray(post.arrays["Beta"])
+    assert isinstance(v, ChunkedShardView)
+    assert v.shape == ref.shape and v.dtype == ref.dtype
+    assert len(v) == ref.shape[0] and v.ndim == ref.ndim
+    assert all(isinstance(c, np.memmap) for c in v._chunks)
+    # windowed sample-axis access: within one shard, across the seam,
+    # strided, scalar, negative index
+    for idx in [(slice(None), slice(0, 3)),        # inside shard 0
+                (slice(None), slice(2, 7)),        # straddles the seam
+                (slice(None), slice(-3, None)),    # tail (shard 1 only)
+                (slice(None), slice(1, 8, 3)),     # strided across shards
+                (slice(None), 5), (slice(None), -1),
+                (0, slice(None)), (slice(None), slice(8, 8))]:
+        np.testing.assert_array_equal(v[idx], ref[idx], err_msg=str(idx))
+    # exotic indices fall back to one full materialisation
+    np.testing.assert_array_equal(v[:, ::-1], ref[:, ::-1])
+    np.testing.assert_array_equal(v[..., 0], ref[..., 0])
+    np.testing.assert_array_equal(np.asarray(v), ref)
+    # posterior summaries work straight off the chunked view
+    np.testing.assert_array_equal(ck.post.pooled("Beta"),
+                                  post.pooled("Beta"))
+    sub = ck.post.subset(start=2, thin=2)
+    refsub = post.subset(start=2, thin=2)
+    np.testing.assert_array_equal(np.asarray(sub.arrays["Beta"]),
+                                  np.asarray(refsub.arrays["Beta"]))
+
+
 # ---------------------------------------------------------------------------
 # rotation / GC policies (incl. resume overrides — satellite: ROADMAP item)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,325 @@
+"""CheckpointWriter in isolation — no sampler in the loop.
+
+ISSUE 4 extracted the ~10 snapshot-write closures out of ``sample_mcmc``
+into :class:`hmsc_tpu.utils.checkpoint.CheckpointWriter`, which takes
+(dir, layout, base, shards) explicitly.  This suite drives that object
+directly with pre-recorded draw segments and a real carry state: the
+layout matrix (append × rotating, compress on/off), burn-in (state-only)
+snapshots, base-segment prepending, splice-rewrite repair naming, and the
+orphan/tmp GC sweep — every path the sampler exercises, minus the sampler.
+
+One tiny MCMC run per module supplies genuine (records, state) material;
+after that the writer is driven synchronously (its threading contract is
+FIFO single-thread, which a plain call sequence satisfies trivially).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from hmsc_tpu import sample_mcmc
+from hmsc_tpu.utils.checkpoint import (CheckpointError, CheckpointWriter,
+                                       checkpoint_files, gc_checkpoints,
+                                       latest_valid_checkpoint,
+                                       load_manifest, _gc_orphans)
+
+from util import small_model
+
+pytestmark = pytest.mark.append_layout
+
+M_KW = dict(ny=24, ns=3, nc=2, distr="normal", n_units=5, seed=3)
+RUN_KW = dict(samples=8, transient=2, thin=1, n_chains=2, seed=7, nf_cap=2,
+              align_post=False)
+N, HALF = RUN_KW["samples"], RUN_KW["samples"] // 2
+
+
+@pytest.fixture(scope="module")
+def material():
+    """(model, full record tree, final carry state, key data): real sampler
+    output, grabbed once — the writer tests never run the sampler again."""
+    m = small_model(**M_KW)
+    post, state = sample_mcmc(m, **RUN_KW, return_state=True)
+    kd = np.arange(RUN_KW["n_chains"] * 2, dtype=np.uint32).reshape(-1, 2)
+    arrays = {k: np.asarray(v) for k, v in post.arrays.items()}
+    return m, post.spec, arrays, state, kd
+
+
+def _segments(arrays):
+    """The full record tree split into two per-segment trees, as the host
+    loop would deliver them."""
+    a = {k: v[:, :HALF] for k, v in arrays.items()}
+    b = {k: v[:, HALF:] for k, v in arrays.items()}
+    return a, b
+
+
+def _meta(done):
+    return {"samples_total": N, "samples_done": done,
+            "transient": RUN_KW["transient"], "thin": RUN_KW["thin"],
+            "n_chains": RUN_KW["n_chains"], "nf_cap": RUN_KW["nf_cap"],
+            "checkpoint_every": HALF, "seed": RUN_KW["seed"]}
+
+
+def _fb():
+    return np.full(RUN_KW["n_chains"], -1, dtype=np.int32)
+
+
+def _drive_two_snapshots(d, layout, material, compress=False, keep=3):
+    m, spec, arrays, state, kd = material
+    seg_a, seg_b = _segments(arrays)
+    records = [seg_a]
+    w = CheckpointWriter(d, layout, spec, hM=m, records=records, keep=keep,
+                        keys_impl="threefry2x32", compress=compress)
+    w.snapshot(HALF, state, kd, _fb(), _meta(HALF))
+    records.append(seg_b)
+    w.snapshot(N, state, kd, _fb(), _meta(N))
+    return w
+
+
+# ---------------------------------------------------------------------------
+# layout matrix: append x rotating, compress on/off
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("layout", ["append", "rotating"])
+@pytest.mark.parametrize("compress", [False, True])
+def test_layout_matrix_roundtrip(tmp_path, material, layout, compress):
+    m, spec, arrays, state, kd = material
+    d = os.fspath(tmp_path)
+    w = _drive_two_snapshots(d, layout, material, compress=compress)
+    names = sorted(os.listdir(d))
+    if layout == "append":
+        assert names == [f"manifest-{HALF:08d}.json", f"manifest-{N:08d}.json",
+                         f"seg-0-{0:08d}-{HALF - 1:08d}.npz",
+                         f"seg-0-{HALF:08d}-{N - 1:08d}.npz",
+                         f"state-{HALF:08d}.npz", f"state-{N:08d}.npz"]
+    else:
+        assert names == [f"ckpt-{HALF:08d}.npz", f"ckpt-{N:08d}.npz"]
+    assert w.n_writes == 2
+    assert len(w.io["snapshot_bytes"]) == 2
+    assert w.io["bytes"] == sum(os.path.getsize(os.path.join(d, f))
+                                for f in names)
+    # single-process writers never touch coordination
+    assert w.io["barrier_wait_s"] == 0.0
+    ck = latest_valid_checkpoint(d, m)
+    assert int(ck.post.samples) == N
+    for k in arrays:
+        np.testing.assert_array_equal(np.asarray(ck.post.arrays[k]),
+                                      arrays[k], err_msg=k)
+    # the carried keys round-trip (loaders restore typed keys)
+    import jax
+    restored = ck.keys
+    if jax.dtypes.issubdtype(getattr(restored, "dtype", np.uint32),
+                             jax.dtypes.prng_key):
+        restored = jax.random.key_data(restored)
+    np.testing.assert_array_equal(np.asarray(restored), kd)
+
+
+def test_compress_shrinks_bytes(tmp_path, material):
+    raw = _drive_two_snapshots(os.fspath(tmp_path / "raw"), "append",
+                               material, compress=False)
+    packed = _drive_two_snapshots(os.fspath(tmp_path / "packed"), "append",
+                                  material, compress=True)
+    assert packed.io["bytes"] < raw.io["bytes"]
+
+
+@pytest.mark.parametrize("layout", ["append", "rotating"])
+def test_burnin_snapshot_is_state_only(tmp_path, material, layout):
+    m, spec, arrays, state, kd = material
+    d = os.fspath(tmp_path)
+    w = CheckpointWriter(d, layout, spec, hM=m, records=[],
+                        keys_impl="threefry2x32")
+    path = w.snapshot(0, state, kd, _fb(), _meta(0), burnin_it=2)
+    tag = f"t{2:08d}"
+    want = (f"manifest-{tag}.json" if layout == "append"
+            else f"ckpt-{tag}.npz")
+    assert os.path.basename(path) == want and os.path.exists(path)
+    # no draws yet -> no shards, and the loaded posterior is empty
+    assert not [f for f in os.listdir(d) if f.startswith("seg-")]
+    ck = latest_valid_checkpoint(d, m)
+    assert not ck.post.arrays and int(ck.run_meta["transient_done"]) == 2
+
+
+def test_path_for_names_the_upcoming_commit(tmp_path, material):
+    m, spec, arrays, state, kd = material
+    for layout, want in (("append", "manifest-%s.json"),
+                         ("rotating", "ckpt-%s.npz")):
+        w = CheckpointWriter(os.fspath(tmp_path), layout, spec, hM=m)
+        assert os.path.basename(w.path_for(HALF)) == want % f"{HALF:08d}"
+        assert os.path.basename(w.path_for(0, burnin_it=3)) \
+            == want % f"t{3:08d}"
+
+
+def test_base_segment_prepended(tmp_path, material):
+    """A writer continuing from a base posterior (resumed run) prepends the
+    base draws: rotating re-serialises them, append references the carried
+    shard entries instead."""
+    m, spec, arrays, state, kd = material
+    base_arrays = {k: v[:, :HALF] for k, v in arrays.items()}
+    tail = {k: v[:, HALF:] for k, v in arrays.items()}
+    from hmsc_tpu.post.posterior import Posterior
+    base = Posterior(m, spec, base_arrays, samples=HALF,
+                     transient=RUN_KW["transient"], thin=1)
+    base.set_chain_health(_fb())
+    d = os.fspath(tmp_path)
+    # the carried shard list: the base window, already durable on disk
+    from hmsc_tpu.utils.checkpoint import save_shard
+    entry = save_shard(d, base_arrays, 0, HALF - 1)
+    w = CheckpointWriter(d, "append", spec, hM=m, records=[tail],
+                        base_post=base, base_samples=HALF, shards=[entry],
+                        keys_impl="threefry2x32")
+    w.snapshot(HALF, state, kd, _fb(), _meta(N))
+    man = load_manifest(os.path.join(d, f"manifest-{N:08d}.json"))
+    assert [s["file"] for s in man["shards"]] == \
+        [entry["file"], f"seg-0-{HALF:08d}-{N - 1:08d}.npz"]
+    ck = latest_valid_checkpoint(d, m)
+    for k in arrays:
+        np.testing.assert_array_equal(np.asarray(ck.post.arrays[k]),
+                                      arrays[k], err_msg=k)
+
+
+def test_rejects_unknown_layout_and_multi_rotating(tmp_path, material):
+    m, spec, *_ = material
+
+    class _FakeCoord:
+        process_index, process_count, is_coordinator = 0, 2, True
+
+    with pytest.raises(ValueError, match="append.*rotating"):
+        CheckpointWriter(os.fspath(tmp_path), "sideways", spec)
+    with pytest.raises(ValueError, match="append layout"):
+        CheckpointWriter(os.fspath(tmp_path), "rotating", spec,
+                        coordinator=_FakeCoord())
+
+
+# ---------------------------------------------------------------------------
+# splice-rewrite repair naming
+# ---------------------------------------------------------------------------
+
+def test_splice_rewrite_repair_naming(tmp_path, material):
+    """A post-splice rewrite keeps shards strictly before the changed
+    window, re-writes the tail ONCE under a -r<k> repair name (immutable
+    files never mutate), and commits a manifest referencing the repaired
+    sequence; a second repair bumps the ordinal."""
+    m, spec, arrays, state, kd = material
+    d = os.fspath(tmp_path)
+    w = _drive_two_snapshots(d, "append", material)
+    from hmsc_tpu.post.posterior import Posterior
+    post = Posterior(m, spec, arrays, samples=N,
+                     transient=RUN_KW["transient"], thin=1)
+    post.set_chain_health(_fb())
+    post.nf_saturation = {r: np.zeros(RUN_KW["n_chains"])
+                          for r in range(spec.nr)}
+    # change opens inside the SECOND shard: the first survives untouched
+    w.rewrite_spliced(HALF + 1, N, state, kd, _fb(), post, _meta(N))
+    man = load_manifest(os.path.join(d, f"manifest-{N:08d}.json"))
+    assert [s["file"] for s in man["shards"]] == \
+        [f"seg-0-{0:08d}-{HALF - 1:08d}.npz",
+         f"seg-0-{HALF:08d}-{N - 1:08d}-r1.npz"]
+    # a second repair of the same window gets a NEW ordinal, never reuses
+    w.rewrite_spliced(HALF + 1, N, state, kd, _fb(), post, _meta(N))
+    man = load_manifest(os.path.join(d, f"manifest-{N:08d}.json"))
+    assert man["shards"][-1]["file"] == \
+        f"seg-0-{HALF:08d}-{N - 1:08d}-r2.npz"
+    ck = latest_valid_checkpoint(d, m)
+    for k in arrays:
+        np.testing.assert_array_equal(np.asarray(ck.post.arrays[k]),
+                                      arrays[k], err_msg=k)
+
+
+def test_splice_rewrite_covering_everything(tmp_path, material):
+    """A change window opening at sample 0 supersedes every shard: the
+    repair shard spans the whole run."""
+    m, spec, arrays, state, kd = material
+    d = os.fspath(tmp_path)
+    w = _drive_two_snapshots(d, "append", material)
+    from hmsc_tpu.post.posterior import Posterior
+    post = Posterior(m, spec, arrays, samples=N,
+                     transient=RUN_KW["transient"], thin=1)
+    post.set_chain_health(_fb())
+    post.nf_saturation = {r: np.zeros(RUN_KW["n_chains"])
+                          for r in range(spec.nr)}
+    w.rewrite_spliced(0, N, state, kd, _fb(), post, _meta(N))
+    man = load_manifest(os.path.join(d, f"manifest-{N:08d}.json"))
+    assert [s["file"] for s in man["shards"]] == \
+        [f"seg-0-{0:08d}-{N - 1:08d}-r1.npz"]
+
+
+def test_splice_rewrite_multi_process_refused(tmp_path, material):
+    m, spec, arrays, state, kd = material
+
+    class _FakeCoord:
+        process_index, process_count, is_coordinator = 0, 2, True
+
+    w = CheckpointWriter(os.fspath(tmp_path), "append", spec, hM=m,
+                        coordinator=_FakeCoord())
+    with pytest.raises(CheckpointError, match="single-process only"):
+        w.rewrite_spliced(0, N, state, kd, _fb(), None, _meta(N))
+
+
+# ---------------------------------------------------------------------------
+# orphan / tmp sweep
+# ---------------------------------------------------------------------------
+
+def test_orphan_and_tmp_sweep(tmp_path, material):
+    """GC reclaims shard/state files no manifest references and stale
+    atomic-write tmps from a killed writer — but never files a surviving
+    manifest references."""
+    m, spec, arrays, state, kd = material
+    d = os.fspath(tmp_path)
+    _drive_two_snapshots(d, "append", material)
+    # a kill between shard write and manifest commit leaves an orphan
+    # shard + a foreign (dead-pid) tmp; GC must reclaim both
+    from hmsc_tpu.utils.checkpoint import save_shard
+    orphan = save_shard(d, {k: v[:, :1] for k, v in arrays.items()},
+                        N, N, shard_index=0)
+    tmp = os.path.join(d, f"state-{N + 1:08d}.npz.tmp.999999")
+    with open(tmp, "wb") as f:
+        f.write(b"partial write")
+    removed = _gc_orphans(d)
+    assert removed == 2
+    assert not os.path.exists(os.path.join(d, orphan["file"]))
+    assert not os.path.exists(tmp)
+    # referenced files all survived; the directory still loads
+    ck = latest_valid_checkpoint(d, m)
+    assert int(ck.post.samples) == N
+
+
+def test_protect_uncommitted_spares_peer_newest(tmp_path, material):
+    """The multi-process committer's sweep must not reclaim a PEER's newest
+    shard/state — durably written, manifest commit still in flight."""
+    m, spec, arrays, state, kd = material
+    d = os.fspath(tmp_path)
+    _drive_two_snapshots(d, "append", material)
+    from hmsc_tpu.utils.checkpoint import save_shard, save_state_file
+    # peer rank 1: shard AND chain-slice state at the NEXT boundary,
+    # not referenced by any manifest yet
+    peer_shard = save_shard(d, {k: v[:, :1] for k, v in arrays.items()},
+                            N, N, shard_index=1)
+    peer_state = save_state_file(d, f"{N + 1:08d}", spec, state,
+                                 keys_data=kd, proc=1)
+    # a peer's in-flight tmp must also survive a protected sweep
+    tmp = os.path.join(d, f"seg-1-{N + 1:08d}-{N + 1:08d}.npz.tmp.999999")
+    with open(tmp, "wb") as f:
+        f.write(b"in flight")
+    assert _gc_orphans(d, protect_uncommitted=True) == 0
+    assert os.path.exists(os.path.join(d, peer_shard["file"]))
+    assert os.path.exists(os.path.join(d, peer_state["file"]))
+    assert os.path.exists(tmp)
+    # an OLD orphan (inside committed history) is still reclaimed
+    old = save_shard(d, {k: v[:, :1] for k, v in arrays.items()},
+                     0, 0, shard_index=7)
+    assert _gc_orphans(d, protect_uncommitted=True) == 1
+    assert not os.path.exists(os.path.join(d, old["file"]))
+
+
+def test_gc_rotation_through_writer(tmp_path, material):
+    """keep=1 via the writer's own GC leaves exactly one loadable snapshot
+    and reclaims the shards only the dropped manifest referenced."""
+    m, spec, arrays, state, kd = material
+    d = os.fspath(tmp_path)
+    _drive_two_snapshots(d, "append", material, keep=1)
+    assert [os.path.basename(p) for p in checkpoint_files(d)] == \
+        [f"manifest-{N:08d}.json"]
+    # both shards survive: the survivor references the full history
+    segs = sorted(f for f in os.listdir(d) if f.startswith("seg-"))
+    assert len(segs) == 2
+    assert latest_valid_checkpoint(d, m).post.samples == N
