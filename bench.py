@@ -135,18 +135,23 @@ def _tpu_rate(hM, samples, transient, n_chains, nf, **extra):
     # symmetric rather than cherry-picked)
     sample_mcmc(hM, samples=samples, transient=transient, n_chains=n_chains,
                 seed=0, align_post=False, nf_cap=nf, **extra)
-    t = np.inf
+    t, telem = np.inf, None
     for rep in range(3):
         t0 = time.time()
         post = sample_mcmc(hM, samples=samples, transient=transient,
                            n_chains=n_chains, seed=1 + rep, align_post=False,
                            nf_cap=nf, **extra)
-        t = min(t, time.time() - t0)
+        dt = time.time() - t0
+        if dt < t:
+            t, telem = dt, post.telemetry
         assert np.all(np.isfinite(np.asarray(post["Beta"],
                                              dtype=np.float32)))
     # (samples rate for the headline metric; sweeps rate for the symmetric
-    # vs-baseline comparison — the wall includes the transient sweeps)
-    return n_chains * samples / t, n_chains * (samples + transient) / t
+    # vs-baseline comparison — the wall includes the transient sweeps; the
+    # best window's telemetry summary rides along so the record carries
+    # stall structure, not just wall time)
+    return (n_chains * samples / t, n_chains * (samples + transient) / t,
+            telem)
 
 
 def _probe_device(timeout_s: int):
@@ -263,8 +268,8 @@ def main():
 
     # smoke config (BASELINE.md config 1): TD-scale probit
     hM1, Y1, X1 = _config(ny=50, ns=4, nf=2)
-    rate_small, _ = _tpu_rate(hM1, samples=250, transient=50,
-                              n_chains=n_chains, nf=2)
+    rate_small, _, _ = _tpu_rate(hM1, samples=250, transient=50,
+                                 n_chains=n_chains, nf=2)
 
     # headline (BASELINE.md headline target): 1000-species probit JSDM,
     # 4 chains on one chip, vs the measured reference-style engine.
@@ -277,19 +282,19 @@ def main():
     # is reported, with the full-record rate disclosed alongside.
     ny, ns, nf = 1000, 1000, 8
     hM2, Y2, X2 = _config(ny=ny, ns=ns, nf=nf)
-    rate_full, sweeps_full = _tpu_rate(hM2, samples=200, transient=10,
-                                       n_chains=n_chains, nf=nf)
+    rate_full, sweeps_full, tel_full = _tpu_rate(
+        hM2, samples=200, transient=10, n_chains=n_chains, nf=nf)
     import jax.numpy as jnp
-    rate_rec, sweeps_rec = _tpu_rate(
+    rate_rec, sweeps_rec, tel_rec = _tpu_rate(
         hM2, samples=200, transient=10, n_chains=n_chains, nf=nf,
         record=("Beta", "Lambda", "Delta", "sigma"),
         record_dtype=jnp.bfloat16)
     if rate_rec >= rate_full:
-        rate_big, sweeps_big = rate_rec, sweeps_rec
+        rate_big, sweeps_big, tel_big = rate_rec, sweeps_rec, tel_rec
         rec_note = (f"record=assoc-blocks bf16; full-record rate "
                     f"{round(rate_full, 1)}/s")
     else:
-        rate_big, sweeps_big = rate_full, sweeps_full
+        rate_big, sweeps_big, tel_big = rate_full, sweeps_full, tel_full
         rec_note = (f"full record; record-selection rate "
                     f"{round(rate_rec, 1)}/s")
 
@@ -309,6 +314,8 @@ def main():
     # the R engine runs chains sequentially per process (SOCK fan-out uses
     # one core per chain); compare per-chip throughput to per-core baseline
     import jax
+
+    from hmsc_tpu.obs import compact_summary
     print(json.dumps({
         "metric": "posterior samples/sec/chip, 1000-species probit JSDM "
                   f"(4 chains; {rec_note}; TD-scale smoke rate "
@@ -323,6 +330,10 @@ def main():
         # multi-process mesh) before comparing rates
         "n_devices": int(jax.device_count()),
         "process_count": int(jax.process_count()),
+        # span totals / skew / final throughput of the best headline
+        # window (hmsc_tpu.obs): the trajectory records WHERE the wall
+        # went, not only how long it was
+        "telemetry": compact_summary(tel_big),
     }))
 
 

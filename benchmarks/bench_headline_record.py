@@ -24,20 +24,23 @@ import jax.numpy as jnp
 
 from bench import _config
 from hmsc_tpu.mcmc.sampler import sample_mcmc
+from hmsc_tpu.obs import compact_summary
 
 
 def rate(m, reps=3, samples=200, transient=10, n_chains=4, nf=8, **extra):
     sample_mcmc(m, samples=samples, transient=transient, n_chains=n_chains,
                 seed=0, align_post=False, nf_cap=nf, **extra)      # compile
-    t = np.inf
+    t, telem = np.inf, None
     for rep in range(reps):
         t0 = time.time()
         post = sample_mcmc(m, samples=samples, transient=transient,
                            n_chains=n_chains, seed=1 + rep, align_post=False,
                            nf_cap=nf, **extra)
-        t = min(t, time.time() - t0)
+        dt = time.time() - t0
+        if dt < t:
+            t, telem = dt, post.telemetry
         assert np.isfinite(np.asarray(post["Beta"], dtype=np.float32)).all()
-    return n_chains * samples / t
+    return n_chains * samples / t, telem
 
 
 def main():
@@ -49,9 +52,13 @@ def main():
         ("record_assoc_bf16", {"record": assoc, "record_dtype": jnp.bfloat16}),
     ]
     for name, extra in variants:
-        r = rate(m, **extra)
+        r, telem = rate(m, **extra)
+        # each variant's record carries its best window's span totals /
+        # throughput digest, so the A/B shows where the wall went (e.g.
+        # device->host fetch shrinking under record-selection)
         print(json.dumps({"variant": name,
-                          "samples_per_s": round(r, 1)}), flush=True)
+                          "samples_per_s": round(r, 1),
+                          "telemetry": compact_summary(telem)}), flush=True)
 
 
 if __name__ == "__main__":

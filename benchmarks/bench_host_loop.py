@@ -17,6 +17,19 @@ the pipeline hides.  The no-checkpointing floor and the serialised loop
 (``pipeline=False`` — same writes, on the critical path) are measured
 alongside for contrast.
 
+A second gate covers the run-telemetry subsystem (``hmsc_tpu.obs``): the
+observability acceptance bar is <2% host-loop overhead with the JSONL
+event stream ON (the default) vs OFF (``telemetry=False``).  Draw
+bit-identity across the A/B is asserted end-to-end, but the overhead
+itself is gated on a *micro-measure*: one segment's exact telemetry work
+(span opens/closes, the running R-hat/ESS health pass over real draws,
+the event emit, the JSONL flush) timed in isolation at long-run volumes
+and scaled by the run's segment count against the measured pipelined
+wall.  The end-to-end paired wall/CPU A/B is printed alongside as an
+informational record — on a shared box its per-rep noise (measured ±20%
+consumed-CPU on ~1.3 s runs) swamps a millisecond-scale signal, so a
+gate on it would flap both ways.
+
 Runs on any backend (defaults to CPU — ``JAX_PLATFORMS=cpu``); prints one
 JSON line per measurement plus a summary line in the driver contract shape.
 Usage:  python benchmarks/bench_host_loop.py [--samples N] [--cadence N]
@@ -53,23 +66,61 @@ def _model(ny, ns, nf):
     return cli_model(ny, ns, nf)
 
 
+def _telemetry_ms_per_segment(post, cadence, reps=50):
+    """One segment's telemetry work, timed in isolation: the host-loop
+    spans a checkpointed segment opens/closes, the running-diagnostics
+    update + R-hat/ESS summary over a real flushed segment of draws, the
+    health emit, and the JSONL flush.  ``reps`` consecutive segments let
+    the diagnostics buffer grow as in a long run, so the returned
+    per-segment cost is the long-run average, not the cheap first
+    segment."""
+    import tempfile as _tf
+
+    from hmsc_tpu.obs import RunTelemetry, RunningDiagnostics
+
+    beta = np.asarray(post.arrays["Beta"], dtype=np.float32)
+    seg = beta[:, :cadence]
+    with _tf.TemporaryDirectory() as d:
+        telem = RunTelemetry(proc=0)
+        telem.attach_sink(os.path.join(d, "events-p0.jsonl"))
+        diag = RunningDiagnostics()
+        c0 = time.process_time()
+        for i in range(reps):
+            for name in ("dispatch", "fetch", "submit_wait", "shard_write",
+                         "state_write", "manifest_commit", "gc"):
+                with telem.span(name, seg=i):
+                    pass
+            diag.update({"Beta": seg})
+            s = diag.summary()
+            telem.emit("metric", "segment_health", seg=i, **s)
+            telem.flush()
+        return (time.process_time() - c0) / reps * 1e3
+
+
 def _measure(hM, variants, reps=3):
     """Interleaved best-of-``reps`` wall-clock per variant: one warm-up
     (compile) pass each, then round-robin timed passes so host contention
     hits every variant alike instead of whichever ran in the noisy window
-    (measured: back-to-back windows on a shared box swing 2x)."""
+    (measured: back-to-back windows on a shared box swing 2x).  Per-rep
+    consumed-CPU windows (``time.process_time``, all threads) are recorded
+    alongside: wall on a shared box measures the hypervisor, so the tight
+    telemetry gate pairs CPU windows rep-by-rep instead (the
+    ``bench_multiproc.py`` idiom)."""
     from hmsc_tpu.mcmc.sampler import sample_mcmc
 
     best = {name: np.inf for name, _ in variants}
+    cpu = {name: [] for name, _ in variants}
     posts = {}
     for name, kw in variants:                     # warm-up: compile
         sample_mcmc(hM, seed=0, **kw)
     for rep in range(reps):
         for name, kw in variants:
             t0 = time.perf_counter()
+            c0 = time.process_time()
             posts[name] = sample_mcmc(hM, seed=0, **kw)   # same seed
+            cpu[name].append(time.process_time() - c0)
             best[name] = min(best[name], time.perf_counter() - t0)
-    return best, posts
+    return best, cpu, posts
 
 
 def main(argv=None):
@@ -104,15 +155,21 @@ def main(argv=None):
     n_ck = args.samples // args.cadence
     with tempfile.TemporaryDirectory() as d_off, \
             tempfile.TemporaryDirectory() as d_pipe, \
-            tempfile.TemporaryDirectory() as d_ser:
+            tempfile.TemporaryDirectory() as d_ser, \
+            tempfile.TemporaryDirectory() as d_ntel:
         ck_off = dict(base, checkpoint_path=d_off)
         ck_pipe = dict(base, checkpoint_every=args.cadence,
                        checkpoint_path=d_pipe, pipeline=True)
         ck_ser = dict(base, checkpoint_every=args.cadence,
                       checkpoint_path=d_ser, pipeline=False)
-        best, posts = _measure(
+        # telemetry A/B: same checkpointed pipelined run, JSONL events off
+        ck_ntel = dict(base, checkpoint_every=args.cadence,
+                       checkpoint_path=d_ntel, pipeline=True,
+                       telemetry=False)
+        best, cpu, posts = _measure(
             hM, [("none", base), ("off", ck_off), ("pipelined", ck_pipe),
-                 ("serialised", ck_ser)], reps=args.reps)
+                 ("serialised", ck_ser), ("pipelined_notelem", ck_ntel)],
+            reps=args.reps)
     t_off, ref = best["off"], posts["off"]
     print(json.dumps({
         "metric": "host-loop floors",
@@ -144,6 +201,40 @@ def main(argv=None):
         records.append(rec)
         print(json.dumps(rec))
 
+    # telemetry on/off A/B: identical run, events + per-segment health
+    # recorded vs aggregates-only; the draws must be bit-identical either
+    # way.  The <2% gate is computed from the ISOLATED per-segment
+    # telemetry cost scaled by the run's segment count — the end-to-end
+    # paired consumed-CPU delta is printed as an informational record
+    # only, because this box's per-rep noise (±20% on ~1.3 s runs) swamps
+    # the millisecond-scale signal and a gate on it flaps both ways.
+    for k in ref.arrays:
+        np.testing.assert_array_equal(posts["pipelined_notelem"].arrays[k],
+                                      posts["pipelined"].arrays[k],
+                                      err_msg=k)
+    t_tel, t_ntel = best["pipelined"], best["pipelined_notelem"]
+    deltas = [(a - b) / b * 100.0
+              for a, b in zip(cpu["pipelined"], cpu["pipelined_notelem"])]
+    tel_ms = _telemetry_ms_per_segment(posts["pipelined"], args.cadence)
+    n_seg = posts["pipelined"].io_stats["segments"]
+    tel_overhead = tel_ms * n_seg / (t_tel * 1e3) * 100.0
+    tel_summary = posts["pipelined"].telemetry or {}
+    print(json.dumps({
+        "metric": f"telemetry overhead (events on vs off, pipelined, "
+                  f"cadence {args.cadence})",
+        "value": round(tel_overhead, 2),
+        "unit": "% of pipelined wall (isolated per-segment cost x "
+                "segments)",
+        "telemetry_ms_per_segment": round(tel_ms, 3),
+        "segments": int(n_seg),
+        "wall_on_s": round(t_tel, 3),
+        "wall_off_s": round(t_ntel, 3),
+        "endtoend_cpu_delta_pct_median": round(float(np.median(deltas)), 2),
+        "endtoend_cpu_deltas_pct": [round(d, 2) for d in deltas],
+        "events": tel_summary.get("events"),
+        "pass_lt_2pct": bool(tel_overhead < 2.0),
+    }))
+
     spec = build_spec(hM, args.nf)
     carry = state_nbytes(build_state(hM, spec, 0)) * args.chains
     piped = records[0]
@@ -154,9 +245,11 @@ def main(argv=None):
         "unit": "%",
         "vs_baseline": None,
         "pass_lt_5pct": bool(piped["value"] < 5.0),
+        "telemetry_overhead_pct": round(tel_overhead, 2),
+        "pass_lt_2pct_telemetry": bool(tel_overhead < 2.0),
         "carry_nbytes_donated": int(carry),
     }))
-    return 0 if piped["value"] < 5.0 else 1
+    return 0 if (piped["value"] < 5.0 and tel_overhead < 2.0) else 1
 
 
 if __name__ == "__main__":

@@ -24,7 +24,9 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 from hmsc_tpu.model import Hmsc
 from hmsc_tpu.random_level import HmscRandomLevel, set_priors_random_level
 from hmsc_tpu.mcmc.sampler import sample_mcmc
-from hmsc_tpu.post.diagnostics import effective_size
+# the obs subsystem's incremental-diagnostics entry point is the single
+# R-hat/ESS implementation in the repo; this post-hoc pass reuses it
+from hmsc_tpu.obs.health import rhat_ess
 
 
 def config2(rng):
@@ -69,7 +71,8 @@ def diagnose(name, samples=250, transient=125, thin=4, n_chains=4, seed=11,
     post = sample_mcmc(m, samples=samples, transient=transient, thin=thin,
                        n_chains=n_chains, seed=seed, updater=updater, **kw)
     B = post["Beta"]                                  # (c, s, nc, ns)
-    ess = effective_size(B)                           # (nc, ns)
+    d = rhat_ess(B)                                   # (nc, ns) each
+    ess, rhat = d["ess"], d["rhat"]
     lam = post.pooled("Lambda_0")
     lam = lam[..., 0] if lam.ndim == 4 else lam       # (n, nf, ns)
     mask = post.pooled("nfMask_0")                    # (n, nf)
@@ -95,11 +98,12 @@ def diagnose(name, samples=250, transient=125, thin=4, n_chains=4, seed=11,
     ess_sp = ess.min(axis=0)
     # the translation-ridge coordinate: per-factor Eta column means
     eta = post["Eta_0"]                               # (c, s, np, nf)
-    ess_eta_mean = effective_size(eta.mean(axis=2))   # (nf,)
+    ess_eta_mean = rhat_ess(eta.mean(axis=2))["ess"]  # (nf,)
     report = {
         "config": name,
         "n_draws": int(B.shape[0] * B.shape[1]),
         "ess_min": float(ess.min()), "ess_median": float(np.median(ess)),
+        "rhat_max": float(np.nanmax(rhat)),
         "nf_active": nf_act,
         "delta_mean": [round(float(d), 2) for d in delta.mean(axis=0)[:nf_act]],
         "corr_minESS_tailloading": float(np.corrcoef(ess_sp, tail)[0, 1]),

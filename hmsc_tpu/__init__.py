@@ -28,6 +28,7 @@ from .utils.checkpoint import (save_checkpoint, load_checkpoint,
                                CheckpointCorruptError,
                                CheckpointSpecMismatchError, PreemptedRun)
 from .utils.mesh import make_mesh
+from .obs import (RunTelemetry, RunningDiagnostics, get_logger, rhat_ess)
 from .utils.phylo import parse_newick, phylo_corr, vcv_from_newick
 from .plots import (plot_beta, plot_gamma, plot_gradient,
                     plot_variance_partitioning, bi_plot)
@@ -75,6 +76,7 @@ __all__ = [
     "concat_posteriors", "resume_run", "CheckpointError",
     "CheckpointCorruptError", "CheckpointSpecMismatchError", "PreemptedRun",
     "make_mesh",
+    "RunTelemetry", "RunningDiagnostics", "get_logger", "rhat_ess",
     "parse_newick", "phylo_corr", "vcv_from_newick",
     "plot_beta", "plot_gamma", "plot_gradient",
     "plot_variance_partitioning", "bi_plot",

@@ -1,8 +1,11 @@
 """``python -m hmsc_tpu`` — installed-package CLI.
 
 Subcommands: ``bench`` (default; the throughput probe, same entry as the
-``hmsc-tpu-bench`` console script) and ``run`` (checkpointed, preemption-safe
-long-run driver with ``--resume``).  Bare arguments keep the historical
+``hmsc-tpu-bench`` console script), ``run`` (checkpointed, preemption-safe
+long-run driver with ``--resume``), and ``report`` (render a run's
+telemetry — phase timeline, throughput, cross-rank skew, checkpoint I/O
+and MCMC health — from its ``events-p<rank>.jsonl`` streams; ``--prom``
+exports Prometheus textfile gauges).  Bare arguments keep the historical
 bench behaviour: ``python -m hmsc_tpu --ns 50`` still works.
 """
 
@@ -16,6 +19,9 @@ def main(argv=None):
     argv = list(sys.argv[1:]) if argv is None else list(argv)
     if argv[:1] == ["run"]:
         return run_main(argv[1:])
+    if argv[:1] == ["report"]:
+        from .obs.report import report_main
+        return report_main(argv[1:])
     if argv[:1] == ["bench"]:
         argv = argv[1:]
     return bench_main(argv)

@@ -373,6 +373,26 @@ def _find_warm_restart(ck_dir, hM, bad, base_samples, samples):
     return None
 
 
+def _sweep_stale_events(dirpath) -> None:
+    """Remove every ``events-p<r>.jsonl`` under ``dirpath``.  A fresh run
+    owns its directory; a previous run's streams — possibly from more
+    ranks than this run has, each rank only ever truncates its own — would
+    make ``report`` merge dead ranks into the new run."""
+    import os
+
+    from ..obs.events import EVENTS_FILE_RE
+    try:
+        names = os.listdir(dirpath)
+    except OSError:
+        return
+    for fn in names:
+        if EVENTS_FILE_RE.fullmatch(fn):
+            try:
+                os.unlink(os.path.join(dirpath, fn))
+            except OSError:
+                pass
+
+
 def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 n_chains: int = 1, seed: int | None = None, init_par=None,
                 adapt_nf=None, updater: dict | None = None,
@@ -392,6 +412,7 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 checkpoint_layout: str = "append",
                 pipeline: bool = True, pipeline_depth: int = 2,
                 init_keys=None, coordinator=None,
+                telemetry=None, profile_segments=None,
                 progress_callback=None, _ckpt_base=None,
                 _transient_base: int = 0, _ckpt_shards=None):
     """Run the blocked Gibbs sampler; returns a :class:`~hmsc_tpu.post.Posterior`.
@@ -535,6 +556,29 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
       full protocol over a shared filesystem (or in tests, subprocesses).
       Multi-process runs require ``checkpoint_layout="append"``;
       ``retry_diverged`` and ``from_prior`` are single-process-only.
+    - ``telemetry`` controls the run-telemetry subsystem
+      (:mod:`hmsc_tpu.obs`): every run keeps in-memory span/health
+      aggregates (surfaced as ``Posterior.telemetry`` and the
+      ``io_stats`` view), and a checkpointed run additionally writes a
+      structured, rank-tagged JSONL event stream
+      (``events-p<rank>.jsonl``, next to the snapshots, flushed on the
+      background writer so it never sits on the segment loop) — host-loop
+      spans (compile / dispatch / device→host fetch / shard, state and
+      manifest writes / barrier waits / GC / splice repairs), per-segment
+      MCMC health metrics (draws/sec, divergence counters, nf-adaptation
+      trajectory, running R-hat/ESS over a small monitored subset), and —
+      on a multi-process mesh — committer-recorded cross-rank skew riding
+      the commit gather.  ``None`` (default) auto-enables the stream
+      whenever checkpointing is on; a path enables it into that directory
+      for any run; ``True`` insists on recording (an error when there is
+      no checkpoint directory or path to write to); ``False`` disables
+      event recording entirely (the cheap aggregates remain).  Telemetry only ever sees host-side copies, so
+      the draw stream is bit-identical with it on, off, or at any cadence.
+      Render a recorded run with ``python -m hmsc_tpu report <run_dir>``.
+    - ``profile_segments=(start, stop)`` (with ``profile_dir``) captures a
+      ``jax.profiler`` trace covering only host segments ``start..stop``
+      (inclusive) — the deep-dive window for a stall telemetry located —
+      instead of ``profile_dir`` alone's whole-run trace.
     - ``progress_callback(samples_done, samples_total)`` is invoked on the
       host after every compiled segment (cumulative counts when continuing a
       checkpointed run; burn-in segments report ``samples_done`` still at
@@ -590,6 +634,28 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 "multi-process checkpointing requires "
                 "checkpoint_layout='append' (the rotating self-contained "
                 "format has no per-process commit point)")
+
+    # run telemetry (hmsc_tpu.obs): the aggregator always runs (io_stats
+    # and the multi-process skew gather are derived from it); JSONL event
+    # recording is what `telemetry=False` turns off.  A sink is attached
+    # below once the run directory is known.
+    from ..obs import (RunTelemetry, RunningDiagnostics, SCHEMA_VERSION,
+                       events_path, get_logger)
+    if not (telemetry is None or isinstance(telemetry, (bool, str))
+            or hasattr(telemetry, "__fspath__")):
+        raise ValueError("telemetry must be None, a bool, or a directory "
+                         f"path, got {telemetry!r}")
+    telem = RunTelemetry(proc=proc, enabled=telemetry is not False)
+    log = get_logger(telemetry=telem, proc=proc, n_procs=n_procs)
+    if profile_segments is not None:
+        if profile_dir is None:
+            raise ValueError("profile_segments requires profile_dir (the "
+                             "trace output directory)")
+        profile_segments = (int(profile_segments[0]),
+                            int(profile_segments[1]))
+        if not (0 <= profile_segments[0] <= profile_segments[1]):
+            raise ValueError("profile_segments must be (start, stop) with "
+                             f"0 <= start <= stop, got {profile_segments}")
 
     adapt_nf_arg = adapt_nf          # pre-resolution value, for retry_diverged
     if adapt_nf is None:
@@ -722,7 +788,7 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         updater = dict(updater)
         for name in ("Gamma2", "GammaEta"):
             if updater.get(name) is True and gates[name]:
-                print(f"Setting updater${name}=FALSE: {gates[name]}")
+                log.info(f"Setting updater${name}=FALSE: {gates[name]}")
                 updater[name] = False
 
     # structural gate for the opt-in location interweave (same print-style
@@ -733,7 +799,7 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         reason = location_gate(spec,
                                has_intercept=data.x_ones_ind is not None)
         if reason:
-            print(f"Setting updater$InterweaveLocation=FALSE: {reason}")
+            log.info(f"Setting updater$InterweaveLocation=FALSE: {reason}")
             updater = dict(updater)
             updater["InterweaveLocation"] = False
 
@@ -743,7 +809,7 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         reason = da_intercept_gate(
             spec, has_intercept=data.x_ones_ind is not None)
         if reason:
-            print(f"Setting updater$InterweaveDA=FALSE: {reason}")
+            log.info(f"Setting updater$InterweaveDA=FALSE: {reason}")
             updater = dict(updater)
             updater["InterweaveDA"] = False
 
@@ -879,8 +945,47 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                         os.unlink(p)
                     except OSError:
                         pass
+                # stale event streams go with them: a previous run's
+                # events-p<r>.jsonl (possibly from MORE ranks than this
+                # run has — each rank truncates only its own) would make
+                # `report` merge dead ranks into the fresh run
+                _sweep_stale_events(ck_dir)
             if n_procs > 1:
                 coord.barrier("fresh-dir")
+
+    # telemetry sink: events-p<rank>.jsonl next to the snapshots (or in the
+    # explicitly given telemetry directory) — a fresh run truncates its own
+    # rank's stream, a continuation appends to it.  Without a directory the
+    # events stay in memory (aggregates only).
+    tel_dir = None
+    if telemetry is not False:
+        if isinstance(telemetry, str) or hasattr(telemetry, "__fspath__"):
+            import os
+            tel_dir = os.fspath(telemetry)
+        elif ck_dir is not None:
+            tel_dir = ck_dir
+    if telemetry is True and tel_dir is None:
+        # an EXPLICIT request to record must not silently record nowhere
+        raise ValueError(
+            "telemetry=True needs somewhere to write the event stream: "
+            "enable checkpointing (checkpoint_path=...) or pass the "
+            "directory directly (telemetry='<dir>')")
+    if tel_dir is not None:
+        fresh = init_state is None and base_post is None
+        if fresh and tel_dir != ck_dir and n_procs == 1:
+            # explicit telemetry dir: same stale-rank sweep as the
+            # checkpoint dir above (single-process only — multi-process
+            # runs have no barrier protecting a non-checkpoint dir)
+            _sweep_stale_events(tel_dir)
+        telem.attach_sink(events_path(tel_dir, proc), truncate=fresh)
+    telem.emit("run", "start", schema=SCHEMA_VERSION,
+               samples=int(samples), transient=int(transient),
+               thin=int(thin), n_chains=int(n_chains),
+               process_count=n_procs,
+               seed=None if seed is None else int(seed),
+               checkpoint_every=ck_every,
+               checkpoint_layout=checkpoint_layout if ck_every else None,
+               pipeline=bool(pipeline), segments=len(seg_sizes) + len(t_cuts))
 
     # preemption-safe shutdown: while auto-checkpointing, SIGTERM/SIGINT set
     # a flag that the segment loop checks after each compiled chunk — finish
@@ -904,7 +1009,11 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
 
     t1 = time.perf_counter()
     import contextlib
-    ctx = (jax.profiler.trace(profile_dir) if profile_dir is not None
+    # profile_segments narrows the capture to its own start/stop window in
+    # the segment loop; the whole-run trace must stand down (the profiler
+    # allows only one active capture)
+    ctx = (jax.profiler.trace(profile_dir)
+           if profile_dir is not None and profile_segments is None
            else contextlib.nullcontext())
     try:
       with ctx:
@@ -946,8 +1055,44 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         writer = (_SegmentWriter(int(pipeline_depth)) if pipeline
                   else _InlineWriter())
 
-        def _collect(packed):
-            host_segs.append(_unpack_records(*packed))
+        def _collect(packed, seg_idx):
+            # the fetch span covers waiting out the segment's device
+            # compute + the device→host copy (both happen at np.asarray
+            # of the packed buffer, on this writer thread)
+            with telem.span("fetch", seg=seg_idx):
+                host_segs.append(_unpack_records(*packed))
+
+        # per-segment MCMC health: throughput, divergence counters, the
+        # nf-adaptation trajectory, and running R-hat/ESS over a small
+        # monitored subset — computed host-side from the segment just
+        # fetched, on the writer thread, so it never blocks the loop
+        diag = RunningDiagnostics()
+        health_t = {"t": time.perf_counter(), "armed": False}
+
+        def _health(seg_idx, done_now, seg_samples, bad_snap):
+            now = time.perf_counter()
+            dt = max(now - health_t["t"], 1e-9)
+            health_t["t"] = now
+            try:
+                seg_tree = host_segs[-1]
+                diag.update(seg_tree)
+                nf_act = {}
+                for r in range(spec.nr):
+                    mk = seg_tree.get(f"nfMask_{r}")
+                    if mk is not None and np.size(mk):
+                        nf_act[str(r)] = [
+                            int(x) for x in np.asarray(mk)[:, -1].sum(-1)]
+                n_bad = int((np.asarray(bad_snap) >= 0).sum())
+                s = diag.summary()
+                telem.emit(
+                    "metric", "segment_health", seg=seg_idx,
+                    samples_done=base_samples + int(done_now),
+                    draws_per_s=round(n_local * int(seg_samples) / dt, 3),
+                    diverged_chains=n_bad, nf_active=nf_act, **s)
+            except Exception as e:    # noqa: BLE001 — observability must
+                # never kill the run it observes
+                telem.emit("log", "health_error",
+                           text=f"{type(e).__name__}: {e}")
 
         def _merge_segs():
             if len(host_segs) > 1:    # fold so repeat snapshots stay linear
@@ -1010,7 +1155,8 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 shard_index=(proc if n_procs > 1
                              else int(jax.process_index())),
                 coordinator=coord if n_procs > 1 else None,
-                preempt_fn=lambda: preempt["signum"] is not None)
+                preempt_fn=lambda: preempt["signum"] is not None,
+                telemetry=telem)
 
         def _submit_ck(in_burnin, done_now, it_now):
             st, kd, bd = _snap_carry()
@@ -1024,13 +1170,28 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         done = 0
         sweeps_done = 0
         n_burn = len(t_cuts)          # leading plan entries are pure burn-in
+        prof_on = False
+        prof_done = False             # the window captures exactly once
         for si, (trans_seg, seg) in enumerate(plan):
             in_burnin = si < n_burn
+            if profile_segments is not None and not prof_on \
+                    and not prof_done and si >= profile_segments[0]:
+                # opt-in deep-dive window: trace only these host segments
+                jax.profiler.start_trace(profile_dir)
+                prof_on = True
+                telem.emit("metric", "profile_capture", seg=si,
+                           action="start")
+            miss0 = _compiled_runner.cache_info().misses
             fn = _compiled_runner(spec, updater_items, adapt_nf, seg,
                                   trans_seg, int(thin), skip_z, record,
                                   spatial._NNGP_DENSE_MAX)
-            recs, state_cur, bad_cur, keys = fn(data, state_cur, keys,
-                                                bad_cur)
+            # a cache miss means this static config is new to the process:
+            # the dispatch below pays XLA trace + compile synchronously —
+            # name the span for what it spends its time on
+            fresh = _compiled_runner.cache_info().misses > miss0
+            with telem.span("compile" if fresh else "dispatch", seg=si):
+                recs, state_cur, bad_cur, keys = fn(data, state_cur, keys,
+                                                    bad_cur)
             skip_z = True
             sweeps_done += trans_seg + int(seg) * int(thin)
             if not in_burnin:
@@ -1041,15 +1202,42 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 # the only live copy)
                 if n_dup:             # drop the duplicate guard lane on
                     recs = jax.tree.map(lambda x: x[:n_local], recs)  # device
-                writer.submit(functools.partial(
-                    _collect, _pack_records(recs, record_dtype)))
+                if telem.enabled and not health_t["armed"]:
+                    # start the throughput clock at the first sampling
+                    # segment's submission (on the writer, FIFO before its
+                    # fetch): the first draws/s point must not span
+                    # burn-in + compile
+                    health_t["armed"] = True
+                    writer.submit(lambda: health_t.update(
+                        t=time.perf_counter()))
+                with telem.span("submit_wait", seg=si):   # ≈0 unless the
+                    # bounded queue is full: measured time IS backpressure
+                    writer.submit(functools.partial(
+                        _collect, _pack_records(recs, record_dtype), si))
                 del recs
                 done += int(seg)
+                if telem.enabled:
+                    # per-segment health costs a device copy of the
+                    # divergence tracker + a host R-hat/ESS pass —
+                    # telemetry=False opts out of it along with the event
+                    # stream (so the bench A/B measures the real cost).
+                    # The copy is dispatched BEFORE the next segment
+                    # donates bad_cur's buffer; the writer reads the copy.
+                    bad_snap = jnp.copy(bad_cur[:n_local])
+                    writer.submit(functools.partial(
+                        _health, si, done, int(seg), bad_snap))
+            if profile_segments is not None and prof_on \
+                    and si >= profile_segments[1]:
+                jax.profiler.stop_trace()
+                prof_on = False
+                prof_done = True
+                telem.emit("metric", "profile_capture", seg=si,
+                           action="stop")
             if verbose:
                 it_now = it0 + sweeps_done
                 phase = ("sampling" if it_now > it0 + int(transient)
                          else "transient")
-                print(f"iteration {it_now} of {total_it} ({phase})")
+                log.info(f"iteration {it_now} of {total_it} ({phase})")
             wrote = None
             at_mark = (sweeps_done in t_ck_marks if in_burnin
                        else done in ck_marks)
@@ -1071,6 +1259,11 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                     # every process at the SAME committed boundary.
                     writer.barrier()
                 wrote = _submit_ck(in_burnin, done, it0 + sweeps_done)
+            if telem.has_sink:
+                # drain buffered events to disk on the writer thread (FIFO
+                # after this segment's fetch/snapshot items), keeping the
+                # stream readable for an in-flight `report`
+                writer.submit(telem.flush)
             if progress_callback is not None:
                 progress_callback(base_samples + done,
                                   base_samples + int(samples))
@@ -1097,6 +1290,10 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 whom = (f"signal {preempt['signum']}"
                         if preempt["signum"] is not None
                         else "a preempted peer process")
+                telem.emit("run", "preempted",
+                           samples_done=base_samples + done,
+                           signum=preempt["signum"])
+                telem.flush()
                 raise PreemptedRun(
                     f"run preempted by {whom} after "
                     f"{progress}; resumable checkpoint: {wrote} "
@@ -1113,22 +1310,39 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 final_state)
             bad_cur = bad_cur[:n_local]
             keys = keys[:n_local]
+        if prof_on:                   # stop beyond the last segment index
+            jax.profiler.stop_trace()
+            telem.emit("metric", "profile_capture", seg=len(plan) - 1,
+                       action="stop")
         writer.barrier()              # all fetches + snapshots complete
+        telem.emit("run", "end", samples_done=base_samples + done)
         _merge_segs()
         recs = host_segs[0]
     finally:
         try:
+            if prof_on:               # unwound inside the capture window
+                # (preemption, coordination failure, ...): the profiler
+                # must not stay active — it would poison the next
+                # start_trace in this process
+                jax.profiler.stop_trace()
+                telem.emit("metric", "profile_capture", action="abort")
+        except NameError:
+            pass                      # failed before the loop started
+        except Exception:             # noqa: BLE001 — cleanup must not
+            pass                      # mask the original unwind
+        try:
             writer.shutdown()         # drain in-flight writes even on error
         except NameError:
             pass                      # failed before the writer existed
+        telem.flush()                 # whatever the writer did not drain
         if restore_handlers:
             import signal as _signal
             for s, h in restore_handlers:
                 _signal.signal(s, h)
     t2 = time.perf_counter()
     ck_io = (ckw.io if ckw is not None else
-             {"bytes": 0, "snapshot_bytes": [], "shards_written": 0,
-              "barrier_wait_s": 0.0, "manifest_commit_s": 0.0})
+             {"bytes": 0, "snapshot_bytes": [], "shards_written": 0})
+    tel_tot = telem.totals()
     io_stats = {"pipeline": bool(pipeline), "segments": len(plan),
                 "checkpoints": ckw.n_writes if ckw is not None else 0,
                 "checkpoint_layout": checkpoint_layout if ck_every else None,
@@ -1140,15 +1354,23 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 # coordination observability: time this process spent
                 # waiting on cross-process barriers/gathers, and time the
                 # committer spent writing manifest commits (both 0.0 for a
-                # run without checkpointing)
-                "barrier_wait_s": ck_io["barrier_wait_s"],
-                "manifest_commit_s": ck_io["manifest_commit_s"],
-                "process_count": n_procs, "process_index": proc}
+                # run without checkpointing).  io_stats is a
+                # backward-compatible VIEW over the run telemetry: the
+                # time fields are THE span aggregates (CheckpointWriter
+                # times its stages through telem.span; there is no second
+                # accounting to drift), the event stream carries the rest
+                "barrier_wait_s": tel_tot.get("barrier_wait",
+                                              {}).get("total_s", 0.0),
+                "manifest_commit_s": tel_tot.get("manifest_commit",
+                                                 {}).get("total_s", 0.0),
+                "process_count": n_procs, "process_index": proc,
+                "telemetry_events": int(telem.n_events)}
 
     post = Posterior(hM, spec, recs, samples=samples,
                      transient=_transient_base + int(transient), thin=thin)
     post.timing = {"setup_s": t1 - t0, "run_s": t2 - t1}
     post.io_stats = io_stats
+    post.telemetry = telem.summary(wall_s=t2 - t1)
 
     # divergence observability + containment: report each poisoned chain's
     # first non-finite sweep and exclude it from pooled summaries (a user
@@ -1190,8 +1412,12 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         # only the remainder with a FRESH key stream (the carried key would
         # replay the exact same path into the same divergence), instead of
         # repeating the whole burn-in from scratch
-        warm = (_find_warm_restart(ck_dir, hM, bad, base_samples, samples)
-                if ck_every and append_layout else None)
+        if ck_every and append_layout:
+            with telem.span("warm_restart_find"):
+                warm = _find_warm_restart(ck_dir, hM, bad, base_samples,
+                                          samples)
+        else:
+            warm = None
         if warm is not None:
             warm_state, warm_s0, warm_t_done = warm
             sub_init = jax.tree.map(
@@ -1284,7 +1510,10 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
             post.io_stats.update(
                 bytes_written=ckw.io["bytes"],
                 snapshot_bytes=list(ckw.io["snapshot_bytes"]),
-                shards_written=ckw.io["shards_written"])
+                shards_written=ckw.io["shards_written"],
+                telemetry_events=int(telem.n_events))
+            post.telemetry = telem.summary(wall_s=t2 - t1)
+            telem.flush()             # the splice spans landed post-barrier
 
     # factor-cap observability: warn when burn-in adaptation wanted to add
     # factors past the static nf_max cap — the residual associations may be

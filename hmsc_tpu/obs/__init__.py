@@ -1,0 +1,31 @@
+"""Run telemetry and observability for hmsc_tpu.
+
+Every checkpointed run records a structured, rank-tagged JSONL event
+stream (``events-p<rank>.jsonl``, next to the snapshots) off the critical
+path via the sampler's background writer: timed host-loop spans (compile,
+dispatch, device→host fetch, shard/state/manifest writes, barrier waits,
+GC, splice repairs), per-segment MCMC health metrics (throughput,
+divergence counters, nf-adaptation trajectory, running R-hat/ESS over a
+small monitored subset), and cross-rank skew aggregated by the committer
+at every commit mark.  ``python -m hmsc_tpu report <run_dir>`` renders a
+completed or in-flight run from the stream; :mod:`hmsc_tpu.obs.log`
+routes all library progress output (rank-prefixed) in place of bare
+``print``.
+
+Telemetry is provably draw-stream-invariant — it only ever sees host-side
+copies — and adds <2% host-loop overhead
+(``benchmarks/bench_host_loop.py`` gates the isolated per-segment
+telemetry cost scaled by segment count, and asserts draw bit-identity
+across the on/off A/B).
+"""
+
+from .events import (RunTelemetry, SCHEMA_VERSION, compact_summary,
+                     events_path)
+from .log import RunLogger, get_logger
+from .health import RunningDiagnostics, rhat_ess
+
+__all__ = [
+    "RunTelemetry", "SCHEMA_VERSION", "compact_summary", "events_path",
+    "RunLogger", "get_logger",
+    "RunningDiagnostics", "rhat_ess",
+]

@@ -1,0 +1,287 @@
+"""Structured run-telemetry event stream (the subsystem's core).
+
+Every sampling run records what happened to it as an append-only JSONL
+stream of *events* — one file per process, ``events-p<rank>.jsonl``,
+written alongside the checkpoint directory.  Long-running services make
+latency and stall structure first-class telemetry (Dean & Barroso, "The
+Tail at Scale"); the ``print``-based progress and the after-the-fact
+``Posterior.io_stats`` dict gave this sampler neither: when a pod run
+stalls, skews, or diverges, there was no recorded timeline to diagnose it
+from.  This module records one.
+
+Event shapes (every event carries ``seq``/``t``/``wall``/``proc``/``kind``/
+``name``; ``t`` is monotonic seconds since the run's telemetry started —
+durations and ordering come from it, ``wall`` is coarse unix time for
+cross-host alignment only):
+
+- ``kind="run"`` — lifecycle marks: ``start`` (carries ``schema`` and the
+  run configuration), ``end``, ``preempted``.
+- ``kind="span"`` — a timed host-loop stage, emitted at CLOSE:
+  ``{"sid", "parent", "depth", "thread", "t0", "dur_s", ...}``.  Spans nest
+  per thread (the driver loop and the background segment writer each keep
+  their own stack), so a child's window lies inside its parent's.
+- ``kind="metric"`` — point measurements: ``segment_health`` (per-segment
+  MCMC health: throughput, divergence counters, nf-adaptation, running
+  R-hat/ESS), ``rank_skew`` (committer-side cross-rank skew at each commit
+  mark), ``profile_capture``.
+- ``kind="log"`` — messages routed through :mod:`hmsc_tpu.obs.log`.
+
+Threading contract: :class:`RunTelemetry` is shared between the sampler's
+driver thread and its background writer thread; one lock guards the buffer
+and the aggregates.  Disk writes happen only in :meth:`flush`, which the
+sampler submits to the background writer — telemetry stays off the
+segment loop's critical path, and the file is opened per flush (append
+mode), so there is no handle to leak across preemption unwinds.
+
+Draw-stream invariance: nothing in this module ever touches device data;
+the sampler hands it host-side copies only.  Telemetry on/off/cadence can
+therefore never change a draw (asserted by ``tests/test_telemetry.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["RunTelemetry", "SCHEMA_VERSION", "EVENTS_FILE_RE", "events_path",
+           "compact_summary"]
+
+SCHEMA_VERSION = 1
+
+# events-p<rank>.jsonl — one stream per writing process, next to the
+# checkpoint layout (but not part of it: GC/rotation never touch it)
+import re as _re
+
+EVENTS_FILE_RE = _re.compile(r"events-p(\d+)\.jsonl")
+
+# in-memory safety cap for sink-less runs: events beyond this are counted
+# (``dropped_events``) but not retained
+_MAX_BUFFER = 100_000
+
+
+def events_path(dirpath: str, proc: int = 0) -> str:
+    """The event-stream file for process ``proc`` under a run directory."""
+    return os.path.join(os.fspath(dirpath), f"events-p{int(proc)}.jsonl")
+
+
+def compact_summary(summary: dict | None) -> dict | None:
+    """Small telemetry digest for embedding into bench records: span
+    totals, cross-rank skew, final throughput/health — so the perf
+    trajectory carries stall structure, not just wall time."""
+    if not summary:
+        return None
+    health = summary.get("last", {}).get("segment_health", {})
+    return {
+        "spans_s": {k: v["total_s"]
+                    for k, v in summary.get("spans", {}).items()},
+        "skew_s": summary.get("counters", {}).get("rank_skew_s"),
+        "draws_per_s": health.get("draws_per_s"),
+        "rhat_max": health.get("rhat_max"),
+        "ess_min": health.get("ess_min"),
+        "events": summary.get("events"),
+    }
+
+
+class _Span:
+    """Handle returned by :meth:`RunTelemetry.span`: ``dur_s`` is valid
+    after the ``with`` block exits (used by callers that also keep legacy
+    accumulators, e.g. ``CheckpointWriter.io``)."""
+
+    __slots__ = ("name", "fields", "sid", "parent", "depth", "t0", "dur_s",
+                 "_telem")
+
+    def __init__(self, telem, name, fields):
+        self._telem = telem
+        self.name = name
+        self.fields = fields
+        self.dur_s = 0.0
+
+    def __enter__(self):
+        self._telem._open_span(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._telem._close_span(self)
+        return False
+
+
+class RunTelemetry:
+    """Per-run telemetry: thread-safe event buffer + span aggregates.
+
+    The aggregates (per-span totals/counts, counters, last metric values)
+    are maintained even when ``enabled=False`` — they are what the
+    backward-compatible ``Posterior.io_stats`` view and the multi-process
+    rank-skew gather are derived from — so disabling telemetry only stops
+    event *retention and JSONL writing*, never the cheap accounting."""
+
+    def __init__(self, proc: int = 0, enabled: bool = True):
+        self.proc = int(proc)
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._sink_lock = threading.Lock()       # serialises disk flushes
+        self._local = threading.local()          # per-thread span stack
+        self._t0 = time.perf_counter()
+        self._seq = 0
+        self._sid = 0
+        self._buffer: list[dict] = []
+        self._sink_path: str | None = None
+        self._spans: dict[str, dict] = {}        # name -> count/total/max
+        self._counters: dict[str, float] = {}
+        self._last: dict[str, dict] = {}         # latest metric per name
+        self._mark: dict[str, float] = {}        # span totals at last mark
+        self.n_events = 0
+        self.dropped_events = 0
+
+    # -- event emission ----------------------------------------------------
+
+    def _now(self) -> float:
+        return time.perf_counter() - self._t0
+
+    def emit(self, kind: str, name: str, **fields) -> None:
+        """Record one event (JSON-serialisable field values only)."""
+        with self._lock:
+            if kind == "metric":
+                self._last[name] = dict(fields)
+            self._append_locked(kind, name, fields)
+
+    def _append_locked(self, kind, name, fields) -> None:
+        self.n_events += 1
+        if not self.enabled:
+            return
+        if len(self._buffer) >= _MAX_BUFFER:
+            self.dropped_events += 1
+            return
+        ev = {"seq": self._seq, "t": round(self._now(), 6),
+              "wall": round(time.time(), 3), "proc": self.proc,
+              "kind": kind, "name": name}
+        ev.update(fields)
+        self._seq += 1
+        self._buffer.append(ev)
+
+    def count(self, name: str, value: float) -> None:
+        """Accumulate a named counter (surfaced in :meth:`summary`)."""
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + float(value)
+
+    # -- spans -------------------------------------------------------------
+
+    def span(self, name: str, **fields) -> _Span:
+        """Context manager timing one host-loop stage; nesting is tracked
+        per thread.  The span event is emitted at close (``t0``/``dur_s``
+        relative to the telemetry clock)."""
+        return _Span(self, name, fields)
+
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def _open_span(self, sp: _Span) -> None:
+        st = self._stack()
+        with self._lock:
+            sp.sid = self._sid
+            self._sid += 1
+        sp.parent = st[-1].sid if st else None
+        sp.depth = len(st)
+        st.append(sp)
+        sp.t0 = self._now()
+
+    def _close_span(self, sp: _Span) -> None:
+        sp.dur_s = self._now() - sp.t0
+        st = self._stack()
+        if st and st[-1] is sp:
+            st.pop()
+        with self._lock:
+            agg = self._spans.setdefault(
+                sp.name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+            agg["count"] += 1
+            agg["total_s"] += sp.dur_s
+            agg["max_s"] = max(agg["max_s"], sp.dur_s)
+            fields = dict(sp.fields)
+            fields.update(sid=sp.sid, parent=sp.parent, depth=sp.depth,
+                          thread=threading.get_ident(),
+                          t0=round(sp.t0, 6), dur_s=round(sp.dur_s, 6))
+            self._append_locked("span", sp.name, fields)
+
+    # -- sink / flushing ---------------------------------------------------
+
+    @property
+    def has_sink(self) -> bool:
+        return self._sink_path is not None
+
+    def attach_sink(self, path: str, truncate: bool = False) -> None:
+        """Bind the JSONL file this telemetry flushes to.  ``truncate``
+        starts the stream fresh (a new run owning its directory); append
+        mode continues it (resume).  The file is (re)opened per flush, so
+        no handle outlives a preemption unwind."""
+        path = os.fspath(path)
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        if truncate:
+            with open(path, "w"):
+                pass
+        self._sink_path = path
+
+    def flush(self) -> None:
+        """Append all buffered events to the sink (no-op without one).
+        Safe from any thread; the sampler submits it to the background
+        writer so the write never sits on the segment loop.  The sink lock
+        serialises flushes (keeping the file in seq order); the buffer
+        swap holds the main lock only briefly, so a slow or hung disk
+        never blocks ``emit``/span closes on the driver thread."""
+        with self._sink_lock:
+            with self._lock:
+                if self._sink_path is None or not self._buffer:
+                    return
+                batch, self._buffer = self._buffer, []
+            try:
+                with open(self._sink_path, "a") as f:
+                    for ev in batch:
+                        f.write(json.dumps(ev) + "\n")
+            except OSError:
+                # telemetry must never kill the run it observes; the
+                # events are dropped and accounted
+                with self._lock:
+                    self.dropped_events += len(batch)
+
+    # -- aggregation views -------------------------------------------------
+
+    def totals(self) -> dict:
+        """``{span name: {"count", "total_s", "max_s"}}`` so far."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._spans.items()}
+
+    def mark_delta(self) -> dict:
+        """Per-span total seconds since the previous mark (the payload each
+        rank contributes to the commit gather — the committer derives
+        cross-rank skew from these without any extra collective)."""
+        with self._lock:
+            cur = {k: v["total_s"] for k, v in self._spans.items()}
+            prev, self._mark = self._mark, cur
+            return {"spans": {k: round(v - prev.get(k, 0.0), 6)
+                              for k, v in cur.items()}}
+
+    def summary(self, wall_s: float | None = None) -> dict:
+        """JSON-safe roll-up attached to ``Posterior.telemetry`` and
+        embedded into bench records: span totals, counters, and the latest
+        health / skew metrics."""
+        with self._lock:
+            return {
+                "schema": SCHEMA_VERSION,
+                "proc": self.proc,
+                "enabled": self.enabled,
+                "wall_s": None if wall_s is None else round(wall_s, 4),
+                "events": self.n_events,
+                "dropped_events": self.dropped_events,
+                "spans": {k: {"count": v["count"],
+                              "total_s": round(v["total_s"], 6),
+                              "max_s": round(v["max_s"], 6)}
+                          for k, v in self._spans.items()},
+                "counters": {k: round(v, 6)
+                             for k, v in self._counters.items()},
+                "last": {k: dict(v) for k, v in self._last.items()},
+            }

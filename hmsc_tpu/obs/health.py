@@ -1,0 +1,129 @@
+"""MCMC health metrics: incremental R-hat/ESS over a monitored subset.
+
+The sampler emits a ``segment_health`` telemetry event per flushed segment
+(throughput, divergence counters, nf-adaptation trajectory) including a
+*running* split-R-hat / ESS computed host-side from the draws flushed so
+far — the persisted per-draw diagnostics idiom of ArviZ (Kumar et al.,
+JOSS 2019), kept cheap by monitoring a small fixed parameter subset
+instead of the full posterior.  The same machinery (:func:`rhat_ess`)
+backs ``benchmarks/diag_mixing.py``'s full-array post-hoc pass, so there
+is exactly one R-hat/ESS implementation in the repo (the estimators
+themselves live in :mod:`hmsc_tpu.post.diagnostics`).
+
+Everything here consumes host-side numpy arrays only — it can never touch
+the device draw stream.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["rhat_ess", "RunningDiagnostics", "DEFAULT_MONITOR_ENTRIES"]
+
+DEFAULT_MONITOR_ENTRIES = 8
+
+# split-R-hat needs two non-trivial half-chains; below this many draws the
+# running summary reports counts only
+_MIN_DRAWS = 4
+
+
+def rhat_ess(x) -> dict:
+    """Split-R-hat and ESS over ``(chains, samples, ...)`` in one call.
+
+    Returns ``{"rhat": array, "ess": array}`` with the trailing shape —
+    the shared entry point for the running per-segment diagnostics and the
+    post-hoc full-array passes (``diag_mixing``)."""
+    from ..post.diagnostics import effective_size, gelman_rhat
+
+    x = np.asarray(x, dtype=float)
+    return {"rhat": gelman_rhat(x), "ess": effective_size(x)}
+
+
+def _monitor_indices(shape, max_entries: int) -> np.ndarray:
+    """Evenly spaced flat indices into a parameter's trailing dims."""
+    m = int(np.prod(shape)) if shape else 1
+    k = max(1, min(int(max_entries), m))
+    return np.unique(np.linspace(0, m - 1, k).astype(np.int64))
+
+
+class RunningDiagnostics:
+    """Incremental R-hat/ESS over segment-wise flushed draws.
+
+    ``update(segment_arrays)`` appends the monitored entries of one flushed
+    host segment (``{name: (chains, seg_samples, ...)}``); ``summary()``
+    computes split-R-hat and ESS over everything accumulated so far.  The
+    monitored subset is resolved once, from the first segment: up to
+    ``max_entries`` evenly spaced scalar entries of each monitored
+    parameter (default: Beta, which every run records).  The buffer is
+    ``(chains, total_samples, n_monitored)`` float32 — a few KB per
+    thousand draws, so a long run's running diagnostics cost nothing.
+    """
+
+    def __init__(self, monitor: tuple = ("Beta",),
+                 max_entries: int = DEFAULT_MONITOR_ENTRIES):
+        self.monitor = tuple(monitor)
+        self.max_entries = int(max_entries)
+        self._idx: dict | None = None            # name -> flat indices
+        self._labels: list[str] = []
+        self._chunks: list[np.ndarray] = []
+        self.n_samples = 0
+
+    def _resolve(self, arrays) -> None:
+        self._idx = {}
+        for name in self.monitor:
+            a = arrays.get(name)
+            if a is None:
+                continue
+            idx = _monitor_indices(np.shape(a)[2:], self.max_entries)
+            self._idx[name] = idx
+            self._labels.extend(f"{name}[{int(i)}]" for i in idx)
+
+    @property
+    def labels(self) -> list[str]:
+        return list(self._labels)
+
+    def update(self, arrays) -> None:
+        """Append one flushed segment's monitored draws."""
+        if self._idx is None:
+            self._resolve(arrays)
+        cols = []
+        for name, idx in self._idx.items():
+            a = arrays.get(name)
+            if a is None:
+                continue
+            a = np.asarray(a)
+            flat = a.reshape(a.shape[0], a.shape[1], -1)
+            cols.append(flat[:, :, idx].astype(np.float32))
+        if not cols:
+            return
+        chunk = np.concatenate(cols, axis=2)
+        self._chunks.append(chunk)
+        self.n_samples += int(chunk.shape[1])
+
+    @property
+    def draws(self) -> np.ndarray | None:
+        """Accumulated monitored draws ``(chains, n, k)`` (folds chunks)."""
+        if not self._chunks:
+            return None
+        if len(self._chunks) > 1:
+            self._chunks = [np.concatenate(self._chunks, axis=1)]
+        return self._chunks[0]
+
+    def summary(self) -> dict:
+        """JSON-safe running diagnostics over everything seen so far."""
+        x = self.draws
+        out = {"n_draws": self.n_samples, "monitored": len(self._labels)}
+        if x is None or x.shape[1] < _MIN_DRAWS:
+            out.update(rhat_max=None, ess_min=None)
+            return out
+        d = rhat_ess(x)
+        rhat = np.asarray(d["rhat"], dtype=float).ravel()
+        ess = np.asarray(d["ess"], dtype=float).ravel()
+        finite = np.isfinite(rhat)
+        out.update(
+            rhat_max=(round(float(rhat[finite].max()), 4)
+                      if finite.any() else None),
+            ess_min=round(float(ess.min()), 1),
+            ess_median=round(float(np.median(ess)), 1),
+        )
+        return out

@@ -1,0 +1,45 @@
+"""Structured, rank-prefixed run logging.
+
+All library-side progress output goes through :func:`get_logger` instead of
+bare ``print`` (a lint test enforces this outside the obs module and the
+CLI entry points).  Single-process output is byte-identical to the old
+prints — ``verbose=N`` progress keeps its exact text — while multi-process
+runs prefix each line with ``[p<rank>]`` so interleaved pod logs stay
+attributable.  When a :class:`~hmsc_tpu.obs.events.RunTelemetry` is bound,
+every line is mirrored into the event stream as a ``kind="log"`` event, so
+the ``report`` CLI can replay a run's messages in timeline order.
+"""
+
+from __future__ import annotations
+
+import sys
+
+__all__ = ["RunLogger", "get_logger"]
+
+
+class RunLogger:
+    """Cheap per-run logger: ``info`` to stdout, ``warn`` to stderr."""
+
+    def __init__(self, telemetry=None, proc: int = 0, n_procs: int = 1):
+        self.telemetry = telemetry
+        self.proc = int(proc)
+        self.n_procs = int(n_procs)
+
+    def _write(self, stream, level: str, msg: str) -> None:
+        prefix = f"[p{self.proc}] " if self.n_procs > 1 else ""
+        print(prefix + msg, file=stream)
+        if self.telemetry is not None:
+            self.telemetry.emit("log", level, text=msg)
+
+    def info(self, msg: str) -> None:
+        self._write(sys.stdout, "info", msg)
+
+    def warn(self, msg: str) -> None:
+        self._write(sys.stderr, "warning", msg)
+
+
+def get_logger(telemetry=None, proc: int = 0, n_procs: int = 1) -> RunLogger:
+    """A logger bound to (telemetry, rank).  Loggers are stateless and
+    cheap — callers construct one per run (the sampler) or per call site
+    (library code with no run context: ``get_logger()``)."""
+    return RunLogger(telemetry, proc, n_procs)

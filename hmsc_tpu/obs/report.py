@@ -1,0 +1,328 @@
+"""``python -m hmsc_tpu report <run_dir>`` — render a run from its telemetry.
+
+Reads the per-process ``events-p<rank>.jsonl`` streams a checkpointed run
+writes alongside its snapshots (completed *or* in-flight: a truncated last
+line is tolerated) and prints:
+
+- **phase timeline** — per-span totals/counts as a share of run wall;
+- **throughput curve** — recorded samples and draws/sec per segment mark;
+- **stall / skew analysis** — cross-rank segment-time skew and per-rank
+  barrier waits at every commit mark (multi-process runs);
+- **checkpoint I/O breakdown** — shard/state/manifest write time + bytes;
+- **health summary** — divergence counters, nf-adaptation trajectory, and
+  the latest running R-hat/ESS per rank.
+
+``--json`` emits the structured report instead of text; ``--prom FILE``
+writes a Prometheus textfile-collector export of the final gauges (point
+the node exporter's ``--collector.textfile.directory`` at it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+__all__ = ["load_run_events", "build_report", "render_report",
+           "prometheus_textfile", "report_main"]
+
+
+def load_run_events(run_dir: str) -> dict:
+    """``{proc: [event, ...]}`` from every ``events-p*.jsonl`` under a run
+    directory (or a single events file path).  Unparseable lines — e.g. the
+    torn last line of an in-flight run — are skipped, not fatal."""
+    from .events import EVENTS_FILE_RE
+
+    run_dir = os.fspath(run_dir)
+    if os.path.isfile(run_dir):
+        paths = {0: run_dir}
+        m = EVENTS_FILE_RE.fullmatch(os.path.basename(run_dir))
+        if m:
+            paths = {int(m.group(1)): run_dir}
+    else:
+        paths = {}
+        for fn in sorted(os.listdir(run_dir)):
+            m = EVENTS_FILE_RE.fullmatch(fn)
+            if m:
+                paths[int(m.group(1))] = os.path.join(run_dir, fn)
+    out = {}
+    for proc, p in sorted(paths.items()):
+        events = []
+        try:
+            with open(p) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        ev = json.loads(line)
+                    except ValueError:
+                        continue              # torn tail of an in-flight run
+                    if isinstance(ev, dict):
+                        events.append(ev)
+        except OSError:
+            continue
+        out[proc] = events
+    return out
+
+
+def _span_totals(events) -> dict:
+    tot = {}
+    for ev in events:
+        if ev.get("kind") != "span":
+            continue
+        agg = tot.setdefault(ev.get("name", "?"),
+                             {"count": 0, "total_s": 0.0, "max_s": 0.0})
+        d = float(ev.get("dur_s", 0.0))
+        agg["count"] += 1
+        agg["total_s"] += d
+        agg["max_s"] = max(agg["max_s"], d)
+    return tot
+
+
+def _split_epochs(events) -> list:
+    """Split one rank's stream at each ``run start``: a resumed run APPENDS
+    its continuation with a fresh monotonic clock and seq, so every epoch
+    is one sampler invocation.  Events logged before an invocation's start
+    mark (e.g. updater-gate messages) belong to the epoch that follows —
+    they must not split off a phantom epoch of their own."""
+    epochs, cur, seen_start = [], [], False
+    for ev in events:
+        if ev.get("kind") == "run" and ev.get("name") == "start":
+            if seen_start:
+                epochs.append(cur)
+                cur = []
+            seen_start = True
+        cur.append(ev)
+    if cur:
+        epochs.append(cur)
+    return epochs
+
+
+def build_report(run_dir: str) -> dict:
+    """Structured report over every rank's event stream."""
+    streams = load_run_events(run_dir)
+    report = {"run_dir": os.fspath(run_dir),
+              "ranks": sorted(streams), "per_rank": {}, "skew": [],
+              "status": "no-events" if not streams else "unknown"}
+    for proc, events in streams.items():
+        # per-epoch clock re-basing: ``t`` restarts at ~0 in each appended
+        # continuation, so offset every epoch by the cumulative wall of the
+        # epochs before it — span totals then sum against a wall measured
+        # the same way, and the throughput timeline stays monotone
+        epochs = _split_epochs(events)
+        adj, offset = [], 0.0
+        for ep in epochs:
+            for e in ep:
+                if "t" in e:
+                    e = dict(e, t=round(float(e["t"]) + offset, 6))
+                adj.append(e)
+            offset += max((float(e.get("t", 0.0)) for e in ep), default=0.0)
+        events = adj
+        wall = offset
+        # config/status describe the MOST RECENT invocation (a resume's
+        # `samples` is the remainder; cumulative progress lives in the
+        # health events' samples_done)
+        start = next((e for e in reversed(events)
+                      if e.get("kind") == "run" and e.get("name") == "start"),
+                     None)
+        # the terminal mark must come from the FINAL epoch: an earlier
+        # epoch's `preempted` must not mask an in-flight continuation
+        final = events[-len(epochs[-1]):] if epochs else []
+        end = next((e for e in reversed(final) if e.get("kind") == "run"
+                    and e.get("name") in ("end", "preempted")), None)
+        health = [e for e in events if e.get("kind") == "metric"
+                  and e.get("name") == "segment_health"]
+        spans = _span_totals(events)
+        io = {k: {kk: (round(vv, 6) if isinstance(vv, float) else vv)
+                  for kk, vv in spans[k].items()}
+              for k in ("shard_write", "state_write", "manifest_commit",
+                        "snapshot_write", "gc", "splice_rewrite")
+              if k in spans}
+        # shard/state/snapshot spans carry the written payload size
+        for name in ("shard_write", "state_write", "snapshot_write"):
+            if name in io:
+                io[name]["nbytes"] = sum(
+                    int(e.get("nbytes", 0)) for e in events
+                    if e.get("kind") == "span" and e.get("name") == name)
+        logs = [e for e in events if e.get("kind") == "log"]
+        report["per_rank"][proc] = {
+            "config": ({k: v for k, v in start.items()
+                        if k not in ("seq", "t", "wall", "kind", "name")}
+                       if start else {}),
+            "status": (end["name"] if end else "in-flight"),
+            "wall_s": round(wall, 4),
+            "resumes": len(epochs) - 1,
+            "events": len(events),
+            "spans": {k: {kk: (round(vv, 6) if isinstance(vv, float) else vv)
+                          for kk, vv in v.items()}
+                      for k, v in sorted(spans.items())},
+            "throughput": [{"t": e.get("t"),
+                            "samples_done": e.get("samples_done"),
+                            "draws_per_s": e.get("draws_per_s")}
+                           for e in health],
+            "health": (health[-1] if health else None),
+            "io": io,
+            "log_lines": len(logs),
+        }
+        if proc == min(streams):          # committer stream carries the skew
+            report["skew"] = [
+                {k: e.get(k) for k in ("t", "tag", "segment_s",
+                                       "barrier_wait_s", "skew_s")}
+                for e in events if e.get("kind") == "metric"
+                and e.get("name") == "rank_skew"]
+    if streams:
+        # a continuation may run on FEWER ranks than the run it resumes
+        # (resume re-shards chains): streams of ranks beyond the newest
+        # invocation's process_count are history, not live participants —
+        # mark them retired and keep them out of the overall verdict,
+        # or a completed 4→2-rank resume would read "preempted" forever
+        committer = report["per_rank"][min(streams)]
+        cur_pc = committer["config"].get("process_count")
+        if isinstance(cur_pc, int) and cur_pc >= 1:
+            for proc, r in report["per_rank"].items():
+                if proc >= cur_pc:
+                    r["status"] = f"retired ({r['status']})"
+        live = [r["status"] for r in report["per_rank"].values()
+                if not r["status"].startswith("retired")]
+        report["status"] = ("preempted" if "preempted" in live else
+                            "in-flight" if "in-flight" in live else "end")
+    return report
+
+
+def _bar(frac: float, width: int = 24) -> str:
+    n = max(0, min(width, int(round(frac * width))))
+    return "#" * n + "." * (width - n)
+
+
+def render_report(report: dict) -> str:
+    """Human-readable text rendering of :func:`build_report`'s output."""
+    lines = [f"run telemetry report: {report['run_dir']}",
+             f"status: {report['status']}   "
+             f"ranks: {report['ranks'] or '(no event streams found)'}"]
+    for proc in report["ranks"]:
+        r = report["per_rank"][proc]
+        wall = max(r["wall_s"], 1e-9)
+        lines.append("")
+        resumed = (f", {r['resumes']} resume(s)" if r.get("resumes") else "")
+        lines.append(f"== rank {proc} ({r['status']}, wall {r['wall_s']:.2f}s"
+                     f"{resumed}, {r['events']} events) ==")
+        cfg = r["config"]
+        if cfg:
+            keys = ("samples", "transient", "thin", "n_chains",
+                    "process_count", "checkpoint_every")
+            lines.append("config: " + ", ".join(
+                f"{k}={cfg[k]}" for k in keys if k in cfg))
+        lines.append("-- phase timeline (span totals) --")
+        spans = sorted(r["spans"].items(), key=lambda kv: -kv[1]["total_s"])
+        for name, agg in spans:
+            frac = agg["total_s"] / wall
+            lines.append(f"  {name:<18} {_bar(frac)} {agg['total_s']:8.3f}s "
+                         f"({100 * frac:5.1f}%)  x{agg['count']} "
+                         f"max {agg['max_s']:.3f}s")
+        if not spans:
+            lines.append("  (no spans recorded)")
+        thr = r["throughput"]
+        if thr:
+            lines.append("-- throughput curve --")
+            peak = max((p["draws_per_s"] or 0.0) for p in thr) or 1.0
+            for p in thr:
+                rate = p["draws_per_s"] or 0.0
+                lines.append(f"  t={p['t']:8.2f}s  "
+                             f"samples={p['samples_done']!s:<8} "
+                             f"{_bar(rate / peak)} {rate:9.2f} draws/s")
+        h = r["health"]
+        if h:
+            lines.append("-- health (latest) --")
+            lines.append(
+                f"  diverged_chains={h.get('diverged_chains')}  "
+                f"rhat_max={h.get('rhat_max')}  ess_min={h.get('ess_min')}  "
+                f"monitored_draws={h.get('monitored')}x{h.get('n_draws')}")
+            if h.get("nf_active"):
+                lines.append(f"  nf_active per level: {h['nf_active']}")
+        io = r.get("io", {})
+        if io:
+            lines.append("-- checkpoint I/O breakdown --")
+            for k, v in io.items():
+                size = (f"  {v['nbytes'] / 1e6:.2f} MB"
+                        if v.get("nbytes") else "")
+                lines.append(f"  {k:<16} {v['total_s']:8.3f}s "
+                             f"x{v['count']}{size}")
+    if report["skew"]:
+        lines.append("")
+        lines.append("== cross-rank stall / skew (committer marks) ==")
+        for s in report["skew"]:
+            lines.append(
+                f"  mark {s.get('tag')}: segment skew (max-min) "
+                f"{s.get('skew_s'):.4f}s  per-rank segment_s="
+                f"{s.get('segment_s')}  barrier_wait_s="
+                f"{s.get('barrier_wait_s')}")
+    return "\n".join(lines)
+
+
+def prometheus_textfile(report: dict) -> str:
+    """Prometheus textfile-collector export of the report's final gauges."""
+    out = ["# HELP hmsc_tpu_span_seconds_total host-loop span time by stage",
+           "# TYPE hmsc_tpu_span_seconds_total gauge"]
+    for proc in report["ranks"]:
+        r = report["per_rank"][proc]
+        for name, agg in sorted(r["spans"].items()):
+            out.append(f'hmsc_tpu_span_seconds_total{{span="{name}",'
+                       f'proc="{proc}"}} {agg["total_s"]:.6f}')
+    out += ["# TYPE hmsc_tpu_run_wall_seconds gauge",
+            "# TYPE hmsc_tpu_samples_done gauge",
+            "# TYPE hmsc_tpu_draws_per_second gauge",
+            "# TYPE hmsc_tpu_diverged_chains gauge",
+            "# TYPE hmsc_tpu_rhat_max gauge",
+            "# TYPE hmsc_tpu_ess_min gauge"]
+    for proc in report["ranks"]:
+        r = report["per_rank"][proc]
+        out.append(f'hmsc_tpu_run_wall_seconds{{proc="{proc}"}} '
+                   f'{r["wall_s"]:.4f}')
+        h = r["health"]
+        if h:
+            for key, metric in (("samples_done", "hmsc_tpu_samples_done"),
+                                ("draws_per_s", "hmsc_tpu_draws_per_second"),
+                                ("diverged_chains",
+                                 "hmsc_tpu_diverged_chains"),
+                                ("rhat_max", "hmsc_tpu_rhat_max"),
+                                ("ess_min", "hmsc_tpu_ess_min")):
+                v = h.get(key)
+                if v is not None:
+                    out.append(f'{metric}{{proc="{proc}"}} {v}')
+    if report["skew"]:
+        out.append("# TYPE hmsc_tpu_rank_skew_seconds gauge")
+        out.append(f"hmsc_tpu_rank_skew_seconds "
+                   f"{report['skew'][-1].get('skew_s', 0.0)}")
+    return "\n".join(out) + "\n"
+
+
+def report_main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m hmsc_tpu report",
+        description="render a run's telemetry: phase timeline, throughput, "
+                    "cross-rank skew, checkpoint I/O, MCMC health")
+    ap.add_argument("run_dir",
+                    help="run directory holding events-p<rank>.jsonl "
+                         "(usually the checkpoint directory), or one "
+                         "events file")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the structured report as JSON")
+    ap.add_argument("--prom", metavar="FILE", default=None,
+                    help="also write a Prometheus textfile-collector "
+                         "export of the final gauges to FILE")
+    args = ap.parse_args(argv)
+
+    report = build_report(args.run_dir)
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(render_report(report))
+    if args.prom:
+        with open(args.prom, "w") as f:
+            f.write(prometheus_textfile(report))
+    return 0 if report["ranks"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(report_main())

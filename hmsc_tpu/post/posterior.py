@@ -45,6 +45,9 @@ class Posterior:
         self.timing = None          # {"setup_s", "run_s"} set by sample_mcmc
         self.io_stats = {}          # host-loop/checkpoint-IO counters
                                     # (sample_mcmc; empty when loaded)
+        self.telemetry = None       # run-telemetry summary (span totals,
+                                    # health, skew) set by sample_mcmc —
+                                    # see hmsc_tpu.obs
         # {level: (chains,) int} blocked factor-growth attempts per chain,
         # set by sample_mcmc (empty when unknown, e.g. from_prior/subset-free
         # construction)

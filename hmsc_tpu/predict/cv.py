@@ -126,9 +126,11 @@ def compute_predicted_values(post, partition=None, partition_sp=None,
             return pred
         return pred[np.resize(np.arange(pred.shape[0]), post_n)]
 
+    from ..obs import get_logger
+    log = get_logger()
     for ki, k in enumerate(folds):
         if verbose:
-            print(f"Cross-validation, fold {ki + 1} out of {len(folds)}")
+            log.info(f"Cross-validation, fold {ki + 1} out of {len(folds)}")
         train = partition != k
         val = partition == k
         hM1 = _fold_model(hM, train)

@@ -33,6 +33,11 @@ __all__ = ["build_worker_model", "worker_main", "spawn_workers",
            "EXIT_OK", "EXIT_PREEMPTED", "EXIT_COORDINATION"]
 
 
+def _log():
+    from ..obs import get_logger
+    return get_logger()
+
+
 def build_worker_model(ny: int = 24, ns: int = 3, nc: int = 2,
                        distr: str = "normal", n_units: int = 5,
                        seed: int = 3, nf: int = 2):
@@ -144,11 +149,10 @@ def worker_main(argv=None) -> int:
                                progress_callback=progress_callback,
                                **run_kw)
     except PreemptedRun as e:
-        print(f"worker {args.rank}: preempted ({e})", file=sys.stderr)
+        _log().warn(f"worker {args.rank}: preempted ({e})")
         return EXIT_PREEMPTED
     except CoordinationError as e:
-        print(f"worker {args.rank}: coordination failed ({e})",
-              file=sys.stderr)
+        _log().warn(f"worker {args.rank}: coordination failed ({e})")
         return EXIT_COORDINATION
     finally:
         coord.cleanup()
@@ -164,6 +168,7 @@ def worker_main(argv=None) -> int:
             "digest": {k: float(np.asarray(v, dtype=np.float64).sum())
                        for k, v in post.arrays.items()},
             "timing": post.timing,
+            "telemetry": post.telemetry,
             "prog": prog,
         }
         with open(args.out, "w") as f:

@@ -57,6 +57,8 @@ from typing import Any
 
 import numpy as np
 
+from ..obs.events import RunTelemetry
+
 __all__ = [
     "save_checkpoint", "load_checkpoint", "load_checkpoint_full",
     "concat_posteriors", "resume_run", "checkpoint_files",
@@ -1367,7 +1369,7 @@ class CheckpointWriter:
                  archive_every: int = 0, max_bytes: int | None = None,
                  keys_impl: str | None = None, shard_index: int = 0,
                  coordinator=None, compress: bool = False,
-                 preempt_fn=None):
+                 preempt_fn=None, telemetry=None):
         if layout not in ("append", "rotating"):
             raise ValueError(f"layout must be 'append' or 'rotating', "
                              f"got {layout!r}")
@@ -1388,6 +1390,11 @@ class CheckpointWriter:
         self.coordinator = coordinator
         self.compress = bool(compress)
         self._preempt_fn = preempt_fn or (lambda: False)
+        # spans for every on-disk/coordination stage land here; a writer
+        # constructed standalone (unit tests) gets a disabled telemetry
+        # whose aggregates still back the io accounting below
+        self.telem = (telemetry if telemetry is not None
+                      else RunTelemetry(proc=int(shard_index), enabled=False))
         self._multi = (coordinator is not None
                        and int(coordinator.process_count) > 1)
         if self._multi and layout != "append":
@@ -1416,10 +1423,25 @@ class CheckpointWriter:
                             for s in self._carried) if m), default=0)}
         self.n_writes = 0
         self.abort_agreed = False
-        self.io = {"bytes": 0, "snapshot_bytes": [], "shards_written": 0,
-                   "barrier_wait_s": 0.0, "manifest_commit_s": 0.0}
+        self.io = {"bytes": 0, "snapshot_bytes": [], "shards_written": 0}
 
     # -- shared helpers ----------------------------------------------------
+
+    def _span_total(self, name: str) -> float:
+        return self.telem.totals().get(name, {}).get("total_s", 0.0)
+
+    @property
+    def barrier_wait_s(self) -> float:
+        """Seconds spent in commit gathers + release barriers — derived
+        from the telemetry span aggregates (``io`` keeps only byte
+        counters, so the two accountings cannot drift)."""
+        return self._span_total("barrier_wait")
+
+    @property
+    def manifest_commit_s(self) -> float:
+        """Seconds the committer spent writing manifest commits (the
+        telemetry ``manifest_commit`` span aggregate)."""
+        return self._span_total("manifest_commit")
 
     @property
     def _is_committer(self) -> bool:
@@ -1456,9 +1478,10 @@ class CheckpointWriter:
                 for r in range(self.spec.nr)}
 
     def _gc(self) -> None:
-        gc_checkpoints(self.dir, self.keep, max_age_s=self.max_age_s,
-                       max_bytes=self.max_bytes,
-                       protect_uncommitted=self._multi)
+        with self.telem.span("gc"):
+            gc_checkpoints(self.dir, self.keep, max_age_s=self.max_age_s,
+                           max_bytes=self.max_bytes,
+                           protect_uncommitted=self._multi)
 
     def _archive_link(self, src: str) -> None:
         # hard-link (copy fallback) into archive/, exempt from rotation
@@ -1519,10 +1542,13 @@ class CheckpointWriter:
         import jax
         if self._base_flush is not None:
             bp, self._base_flush = self._base_flush, None
-            entry = save_shard(
-                self.dir, {k: np.asarray(v) for k, v in bp.arrays.items()},
-                0, self.base_samples - 1, shard_index=self.shard_index,
-                compress=self.compress)
+            with self.telem.span("shard_write", kind_of="base") as sp:
+                entry = save_shard(
+                    self.dir,
+                    {k: np.asarray(v) for k, v in bp.arrays.items()},
+                    0, self.base_samples - 1, shard_index=self.shard_index,
+                    compress=self.compress)
+                sp.fields["nbytes"] = entry["nbytes"]
             self._own.append(entry)
             self.io["bytes"] += entry["nbytes"]
             self.io["shards_written"] += 1
@@ -1533,9 +1559,12 @@ class CheckpointWriter:
         arrays = (new[0] if len(new) == 1
                   else jax.tree.map(
                       lambda *xs: np.concatenate(xs, axis=1), *new))
-        entry = save_shard(self.dir, arrays, self._flush["cursor"],
-                           done_g - 1, shard_index=self.shard_index,
-                           compress=self.compress)
+        with self.telem.span("shard_write", first=self._flush["cursor"],
+                             last=done_g - 1) as sp:
+            entry = save_shard(self.dir, arrays, self._flush["cursor"],
+                               done_g - 1, shard_index=self.shard_index,
+                               compress=self.compress)
+            sp.fields["nbytes"] = entry["nbytes"]
         self._flush["idx"] = len(self.records)
         self._flush["cursor"] = done_g
         self._own.append(entry)
@@ -1559,10 +1588,12 @@ class CheckpointWriter:
                          first_bad, meta: dict, ordinal: int) -> str:
         """State file + coordinated manifest commit + archive + GC for one
         append-layout snapshot."""
-        st_entry = save_state_file(
-            self.dir, tag, self.spec, state, keys_data=keys,
-            proc=self.shard_index if self._multi else None,
-            compress=self.compress)
+        with self.telem.span("state_write", tag=tag) as sp:
+            st_entry = save_state_file(
+                self.dir, tag, self.spec, state, keys_data=keys,
+                proc=self.shard_index if self._multi else None,
+                compress=self.compress)
+            sp.fields["nbytes"] = st_entry["nbytes"]
         self.io["bytes"] += st_entry["nbytes"]
         if self._multi:
             # each process publishes its own dirents durably before the
@@ -1576,20 +1607,22 @@ class CheckpointWriter:
         if not self._multi:
             man.update(state=st_entry, shards=self._carried + self._own,
                        first_bad_it=fb, nf_saturation=nf_sat)
-            t0 = time.perf_counter()
-            save_manifest(self.dir, tag, man)
-            self.io["manifest_commit_s"] += time.perf_counter() - t0
+            with self.telem.span("manifest_commit", tag=tag):
+                save_manifest(self.dir, tag, man)
             self.io["bytes"] += int(os.path.getsize(path))
             self._maybe_archive(path, man, ordinal)
             self._gc()
             return path
         coord = self.coordinator
+        # each rank rides its per-mark telemetry deltas on the commit
+        # gather (no extra collective): the committer derives cross-rank
+        # skew from them and records it at every mark
         payload = {"state": st_entry, "shards": self._own,
                    "first_bad_it": fb, "nf_saturation": nf_sat,
-                   "preempt": bool(self._preempt_fn())}
-        t0 = time.perf_counter()
-        parts = coord.all_gather(payload, tag=f"ck-{tag}")
-        self.io["barrier_wait_s"] += time.perf_counter() - t0
+                   "preempt": bool(self._preempt_fn()),
+                   "telemetry": self.telem.mark_delta()}
+        with self.telem.span("barrier_wait", tag=tag, what="commit-gather"):
+            parts = coord.all_gather(payload, tag=f"ck-{tag}")
         if any(p["preempt"] for p in parts):
             self.abort_agreed = True
         if coord.is_coordinator:
@@ -1609,10 +1642,10 @@ class CheckpointWriter:
                     r: [x for p in parts for x in p["nf_saturation"][r]]
                     for r in nf_sat},
             )
-            t1 = time.perf_counter()
-            save_manifest(self.dir, tag, man)
-            self.io["manifest_commit_s"] += time.perf_counter() - t1
+            with self.telem.span("manifest_commit", tag=tag):
+                save_manifest(self.dir, tag, man)
             self.io["bytes"] += int(os.path.getsize(path))
+            self._record_skew(tag, parts)
             self._maybe_archive(path, man, ordinal)
             self._gc()
         # Every commit ends with a release barrier.  It buys two things:
@@ -1628,10 +1661,28 @@ class CheckpointWriter:
         # backpressure lands on the driver (A/B on the same box:
         # commit overhead 1.5% with the barrier vs 27% without;
         # scaling efficiency 97% vs 62%).
-        t2 = time.perf_counter()
-        coord.barrier(f"committed-{tag}")
-        self.io["barrier_wait_s"] += time.perf_counter() - t2
+        with self.telem.span("barrier_wait", tag=tag, what="release"):
+            coord.barrier(f"committed-{tag}")
         return path
+
+    def _record_skew(self, tag: str, parts: list) -> None:
+        """Committer-side cross-rank skew at one commit mark, derived from
+        the per-rank telemetry deltas the gather carried: per-rank segment
+        time (compile + dispatch + device→host fetch since the previous
+        mark) and per-rank barrier wait.  ``skew_s`` is max−min segment
+        time — the quantity that, left unchecked, accumulates into gather
+        stalls (the PR 4 A/B measured 27% overhead without per-mark
+        pacing)."""
+        tels = [p.get("telemetry") or {} for p in parts]
+        seg = [round(sum(t.get("spans", {}).get(n, 0.0)
+                         for n in ("compile", "dispatch", "fetch")), 6)
+               for t in tels]
+        bar = [round(t.get("spans", {}).get("barrier_wait", 0.0), 6)
+               for t in tels]
+        skew = round(max(seg) - min(seg), 6) if seg else 0.0
+        self.telem.emit("metric", "rank_skew", tag=tag, segment_s=seg,
+                        barrier_wait_s=bar, skew_s=skew)
+        self.telem.count("rank_skew_s", skew)
 
     def _maybe_archive(self, man_path: str, man: dict, ordinal: int) -> None:
         if not (self.archive_every and ordinal % self.archive_every == 0):
@@ -1666,39 +1717,46 @@ class CheckpointWriter:
             raise CheckpointError(
                 "splice repair is single-process only (retry_diverged is "
                 "not supported under a multi-process coordinator)")
-        changed_g = self.base_samples + int(changed_from)
-        keep_shards, doomed = [], []
-        for s in self._carried + self._own:
-            (keep_shards if int(s["last"]) < changed_g
-             else doomed).append(s)
-        # the repair window opens at the first superseded shard's start
-        # (a shard straddling the change boundary is replaced whole)
-        rep_first = (min(int(s["first"]) for s in doomed)
-                     if doomed else changed_g)
-        end_g = self.base_samples + int(total_samples)
-        if rep_first < end_g:
-            self._flush["repair"] += 1
-            lo = rep_first - self.base_samples
-            arrays = {k: np.asarray(v)[:, lo:]
-                      for k, v in post.arrays.items()}
-            entry = save_shard(self.dir, arrays, rep_first, end_g - 1,
-                               shard_index=self.shard_index,
-                               repair=self._flush["repair"],
-                               compress=self.compress)
-            keep_shards.append(entry)
-            self.io["bytes"] += entry["nbytes"]
-            self.io["shards_written"] += 1
-        self._carried, self._own = [], keep_shards
-        return self._append_snapshot(f"{end_g:08d}", end_g, state, keys,
-                                     first_bad, meta, self.n_writes)
+        with self.telem.span("splice_rewrite",
+                             changed_from=int(changed_from)):
+            changed_g = self.base_samples + int(changed_from)
+            keep_shards, doomed = [], []
+            for s in self._carried + self._own:
+                (keep_shards if int(s["last"]) < changed_g
+                 else doomed).append(s)
+            # the repair window opens at the first superseded shard's start
+            # (a shard straddling the change boundary is replaced whole)
+            rep_first = (min(int(s["first"]) for s in doomed)
+                         if doomed else changed_g)
+            end_g = self.base_samples + int(total_samples)
+            if rep_first < end_g:
+                self._flush["repair"] += 1
+                lo = rep_first - self.base_samples
+                arrays = {k: np.asarray(v)[:, lo:]
+                          for k, v in post.arrays.items()}
+                with self.telem.span("shard_write", kind_of="repair") as sp:
+                    entry = save_shard(self.dir, arrays, rep_first,
+                                       end_g - 1,
+                                       shard_index=self.shard_index,
+                                       repair=self._flush["repair"],
+                                       compress=self.compress)
+                    sp.fields["nbytes"] = entry["nbytes"]
+                keep_shards.append(entry)
+                self.io["bytes"] += entry["nbytes"]
+                self.io["shards_written"] += 1
+            self._carried, self._own = [], keep_shards
+            return self._append_snapshot(f"{end_g:08d}", end_g, state, keys,
+                                         first_bad, meta, self.n_writes)
 
     # -- legacy rotating self-contained layout ------------------------------
 
     def _finish_ck(self, path, partial, state, keys, meta, ordinal) -> None:
-        save_checkpoint(path, partial, state, keys=keys,
-                        keys_impl=self.keys_impl, run_meta=meta,
-                        compress=self.compress)
-        nbytes = int(os.path.getsize(path))
+        with self.telem.span("snapshot_write") as sp:
+            save_checkpoint(path, partial, state, keys=keys,
+                            keys_impl=self.keys_impl, run_meta=meta,
+                            compress=self.compress)
+            sp.fields["nbytes"] = int(os.path.getsize(path))
+        nbytes = sp.fields["nbytes"]
         self.io["bytes"] += nbytes
         self.io["snapshot_bytes"].append(nbytes)
         self._gc()
@@ -1792,7 +1850,7 @@ def resume_run(hM, checkpoint_path: str, *, verbose: int = 0,
                checkpoint_layout: str | None = None,
                allow_legacy_pickle: bool = False, mesh=None,
                chain_axis: str = "chains", species_axis: str = "species",
-               pipeline: bool = True, coordinator=None):
+               pipeline: bool = True, coordinator=None, telemetry=None):
     """Continue an auto-checkpointed ``sample_mcmc`` run to completion.
 
     Locates the newest valid checkpoint under ``checkpoint_path`` (corrupt
@@ -1966,7 +2024,7 @@ def resume_run(hM, checkpoint_path: str, *, verbose: int = 0,
         checkpoint_layout=(meta.get("checkpoint_layout", "append")
                            if checkpoint_layout is None
                            else checkpoint_layout),
-        pipeline=pipeline,
+        pipeline=pipeline, telemetry=telemetry,
         _ckpt_base=base, _transient_base=t_done if base is None else 0,
         # append-layout continuation: the already-flushed shard sequence is
         # carried forward so new manifests reference it instead of the base
@@ -1977,6 +2035,9 @@ def resume_run(hM, checkpoint_path: str, *, verbose: int = 0,
         out = cont
     else:
         out = concat_posteriors(base, cont, align=False)
+        # the continuation's telemetry describes the only segment this
+        # process actually ran — carry it onto the spliced posterior
+        out.telemetry = getattr(cont, "telemetry", None)
     if align and out.spec.nr > 0:
         _bounded_align(out)
     return out

@@ -74,7 +74,10 @@ def _assert_bit_identical(post, ref):
 def test_layout_files_and_manifest_structure(ref_run, model):
     post, d = ref_run
     names = sorted(os.listdir(d))
-    assert names == ["manifest-00000004.json", "manifest-00000008.json",
+    # events-p0.jsonl is the run's telemetry stream (hmsc_tpu.obs): written
+    # alongside the layout but not part of it — rotation/GC never touch it
+    assert names == ["events-p0.jsonl",
+                     "manifest-00000004.json", "manifest-00000008.json",
                      "manifest-t00000004.json", "seg-0-00000000-00000003.npz",
                      "seg-0-00000004-00000007.npz", "state-00000004.npz",
                      "state-00000008.npz", "state-t00000004.npz"]
@@ -324,9 +327,11 @@ def test_gc_reclaims_unreferenced_shards(tmp_path, model, ref_post):
                        checkpoint_path=d, checkpoint_keep=1)
     _assert_bit_identical(post, ref_post)
     # only the final manifest survives — but it references BOTH shards, so
-    # GC must keep them (shards are shared; nothing is ever rewritten)
+    # GC must keep them (shards are shared; nothing is ever rewritten).
+    # The telemetry stream is exempt from rotation/GC entirely.
     assert sorted(os.listdir(d)) == \
-        ["manifest-00000008.json", "seg-0-00000000-00000003.npz",
+        ["events-p0.jsonl", "manifest-00000008.json",
+         "seg-0-00000000-00000003.npz",
          "seg-0-00000004-00000007.npz", "state-00000008.npz"]
 
 

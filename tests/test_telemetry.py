@@ -1,0 +1,512 @@
+"""Run-telemetry suite (ISSUE 5) — fast, tier-1.
+
+Every checkpointed run records a structured, rank-tagged JSONL event
+stream (``events-p<rank>.jsonl``) next to its snapshots: host-loop spans,
+per-segment MCMC health, and (multi-process) committer-recorded cross-rank
+skew.  The bars checked here:
+
+- the stream is schema-stable and ordered (``seq`` strictly increasing,
+  ``run start`` first, a terminal ``run end``/``preempted`` mark);
+- spans nest per thread and their top-level totals sum to within the run's
+  wall time — the timeline is a measurement, not an estimate;
+- telemetry is DRAW-STREAM-INVARIANT: bit-identical posteriors with events
+  on, off, redirected, or at different verbose/checkpoint cadences
+  (it only ever sees host-side copies);
+- multi-process runs aggregate per-rank summaries by riding the existing
+  commit gather: the committer's stream carries ``rank_skew`` metrics with
+  one entry per rank, no extra collective;
+- ``python -m hmsc_tpu report <run_dir>`` renders a recorded run (text,
+  ``--json``, Prometheus textfile), tolerating the torn last line of an
+  in-flight stream;
+- no bare ``print(`` in library code outside the obs module and the CLI
+  entry points (everything routes through ``hmsc_tpu.obs.log``).
+
+The pre-existing ``tests/test_observability.py`` suite is all ``slow``;
+this one must not be, so it runs on the worker-scale model with the
+persistent XLA cache.
+"""
+
+import json
+import os
+import re
+import time
+
+import numpy as np
+import pytest
+
+from hmsc_tpu import sample_mcmc
+from hmsc_tpu.obs import (RunTelemetry, RunningDiagnostics, compact_summary,
+                          events_path, rhat_ess)
+from hmsc_tpu.obs.report import (build_report, prometheus_textfile,
+                                 render_report, report_main)
+from hmsc_tpu.testing.multiproc import build_worker_model, spawn_workers
+
+pytestmark = pytest.mark.telemetry
+
+RUN_KW = dict(samples=8, transient=4, thin=1, n_chains=2, seed=11,
+              nf_cap=2, align_post=False)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return build_worker_model()
+
+
+@pytest.fixture(scope="module")
+def recorded_run(model, tmp_path_factory):
+    """One checkpointed run with telemetry on (the default): the shared
+    fixture for the schema, nesting, report-CLI, and io_stats tests."""
+    d = os.fspath(tmp_path_factory.mktemp("telemetry-run"))
+    t0 = time.perf_counter()
+    post = sample_mcmc(model, checkpoint_every=4, checkpoint_path=d,
+                       verbose=4, **RUN_KW)
+    wall = time.perf_counter() - t0
+    with open(events_path(d, 0)) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    return {"dir": d, "post": post, "events": events, "wall": wall}
+
+
+def _assert_same_arrays(a, b):
+    assert set(a.arrays) == set(b.arrays)
+    for k in a.arrays:
+        np.testing.assert_array_equal(np.asarray(a.arrays[k]),
+                                      np.asarray(b.arrays[k]), err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# event-stream schema + ordering
+# ---------------------------------------------------------------------------
+
+def test_event_stream_schema_and_ordering(recorded_run):
+    events = recorded_run["events"]
+    assert events, "no events recorded"
+    for ev in events:
+        assert {"seq", "t", "wall", "proc", "kind", "name"} <= set(ev), ev
+        assert ev["proc"] == 0
+        assert ev["kind"] in ("run", "span", "metric", "log"), ev
+    seqs = [ev["seq"] for ev in events]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    # lifecycle: starts with run/start (carrying schema + config), ends
+    # with a terminal mark
+    assert events[0]["kind"] == "run" and events[0]["name"] == "start"
+    assert events[0]["schema"] == 1
+    assert events[0]["samples"] == RUN_KW["samples"]
+    assert events[0]["n_chains"] == RUN_KW["n_chains"]
+    runs = [e["name"] for e in events if e["kind"] == "run"]
+    assert runs[-1] in ("end", "preempted")
+    # every span carries its identity + window
+    spans = [e for e in events if e["kind"] == "span"]
+    assert spans
+    for sp in spans:
+        assert {"sid", "parent", "depth", "thread", "t0", "dur_s"} <= set(sp)
+        assert sp["dur_s"] >= 0 and sp["t0"] >= 0
+    names = {sp["name"] for sp in spans}
+    # the host-loop stages the tentpole names (single-process run); the
+    # first segment is "compile" when its static config is new to the
+    # process and "dispatch" when another module already warmed the
+    # runner cache, so accept either label for the compute stage
+    assert {"fetch", "shard_write", "state_write",
+            "manifest_commit", "gc"} <= names
+    assert names & {"compile", "dispatch"}
+    # per-segment health metrics with the running diagnostics
+    health = [e for e in events if e["kind"] == "metric"
+              and e["name"] == "segment_health"]
+    assert len(health) == 2                      # samples=8, cadence 4
+    assert health[-1]["samples_done"] == RUN_KW["samples"]
+    for h in health:
+        assert {"draws_per_s", "diverged_chains", "n_draws",
+                "monitored"} <= set(h)
+    # verbose lines are mirrored as log events
+    logs = [e for e in events if e["kind"] == "log"]
+    assert any("iteration" in e.get("text", "") for e in logs)
+
+
+def test_spans_nest_and_sum_to_wall(recorded_run):
+    events = recorded_run["events"]
+    spans = [e for e in events if e["kind"] == "span"]
+    by_sid = {sp["sid"]: sp for sp in spans}
+    eps = 5e-3
+    for sp in spans:
+        if sp["parent"] is not None:
+            par = by_sid[sp["parent"]]
+            assert par["thread"] == sp["thread"]
+            assert sp["depth"] == par["depth"] + 1
+            # the child's window lies inside its parent's
+            assert sp["t0"] >= par["t0"] - eps
+            assert sp["t0"] + sp["dur_s"] <= par["t0"] + par["dur_s"] + eps
+    # top-level spans on each thread are disjoint stages of one loop:
+    # their totals must sum to within the run's wall time
+    wall = recorded_run["wall"]
+    for thread in {sp["thread"] for sp in spans}:
+        tot = sum(sp["dur_s"] for sp in spans
+                  if sp["thread"] == thread and sp["parent"] is None)
+        assert tot <= wall * 1.05 + eps, (thread, tot, wall)
+
+
+def test_span_nesting_unit():
+    """RunTelemetry.span tracks parent/depth per thread and aggregates."""
+    t = RunTelemetry(proc=3)
+    with t.span("outer"):
+        with t.span("inner"):
+            pass
+        with t.span("inner"):
+            pass
+    tot = t.totals()
+    assert tot["outer"]["count"] == 1 and tot["inner"]["count"] == 2
+    # events are buffered in seq order: inner closes before outer
+    buf = t._buffer
+    inner = [e for e in buf if e["name"] == "inner"]
+    outer = [e for e in buf if e["name"] == "outer"]
+    assert len(inner) == 2 and len(outer) == 1
+    assert all(e["parent"] == outer[0]["sid"] for e in inner)
+    assert all(e["depth"] == 1 for e in inner) and outer[0]["depth"] == 0
+    assert outer[0]["dur_s"] >= sum(e["dur_s"] for e in inner) - 1e-6
+    assert all(e["proc"] == 3 for e in buf)
+
+
+# ---------------------------------------------------------------------------
+# draw-stream invariance
+# ---------------------------------------------------------------------------
+
+def test_bit_identity_on_off_and_cadences(model, tmp_path):
+    """Telemetry on / off / redirected / finer verbose cadence: the draw
+    stream must be bit-identical in every configuration."""
+    ref = sample_mcmc(model, **RUN_KW)                       # no checkpoint
+    variants = {
+        "telemetry_false": dict(telemetry=False),
+        "telemetry_dir": dict(telemetry=os.fspath(tmp_path / "tel")),
+        "ck_on": dict(checkpoint_every=4,
+                      checkpoint_path=os.fspath(tmp_path / "ck1")),
+        "ck_off": dict(checkpoint_every=4, telemetry=False,
+                       checkpoint_path=os.fspath(tmp_path / "ck2")),
+        "ck_fine_verbose": dict(checkpoint_every=4, verbose=2,
+                                checkpoint_path=os.fspath(tmp_path / "ck3")),
+    }
+    for name, extra in variants.items():
+        post = sample_mcmc(model, **RUN_KW, **extra)
+        try:
+            _assert_same_arrays(ref, post)
+        except AssertionError as e:
+            raise AssertionError(f"variant {name}: {e}") from e
+    # the explicit-path variant recorded a stream without checkpointing
+    assert os.path.exists(events_path(tmp_path / "tel", 0))
+    # telemetry=False recorded nothing
+    assert not os.path.exists(events_path(tmp_path / "ck2", 0))
+
+
+def test_profile_segments_window_runs(model, tmp_path):
+    """profile_segments must narrow the capture to its window — the
+    whole-run trace stands down (two live profiles would crash jax), the
+    window captures EXACTLY once (it must not re-open on the segments
+    after it closes), and the run completes with the marks recorded."""
+    d = os.fspath(tmp_path / "trace")
+    tel = os.fspath(tmp_path / "tel")
+    post = sample_mcmc(model, profile_dir=d, profile_segments=(0, 0),
+                       verbose=4, telemetry=tel, **RUN_KW)
+    assert np.isfinite(post.pooled("Beta")).all()
+    with open(events_path(tel, 0)) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    caps = [(e["seg"], e["action"]) for e in events
+            if e.get("name") == "profile_capture"]
+    assert caps == [(0, "start"), (0, "stop")], caps
+    assert os.path.isdir(d)                   # the trace was written
+
+
+def test_profile_window_stopped_on_preemption(model, tmp_path):
+    """An unwind inside the capture window (SIGTERM → PreemptedRun) must
+    stop the profiler — a dangling trace would poison the next
+    start_trace in this process."""
+    from hmsc_tpu import PreemptedRun
+    from hmsc_tpu.testing.faults import sigterm_after
+
+    d = os.fspath(tmp_path / "ck")
+    with pytest.raises(PreemptedRun):
+        sample_mcmc(model, checkpoint_every=4,
+                    checkpoint_path=d, profile_dir=os.fspath(tmp_path / "tr"),
+                    profile_segments=(0, 99),
+                    progress_callback=sigterm_after(4), **RUN_KW)
+    # the profiler is free again: a fresh capture must start cleanly
+    import jax
+    jax.profiler.start_trace(os.fspath(tmp_path / "tr2"))
+    jax.profiler.stop_trace()
+    # the abort was recorded in the stream
+    with open(events_path(d, 0)) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    caps = [e for e in events if e.get("name") == "profile_capture"]
+    assert caps and caps[-1]["action"] == "abort"
+
+
+def test_report_ignores_prestart_log_events(recorded_run, tmp_path):
+    """Messages logged before the run-start mark (updater gates fire
+    before the sampler emits `start`) must fold into the first epoch, not
+    split off a phantom resume."""
+    d = os.fspath(tmp_path / "prestart")
+    os.makedirs(d)
+    pre = {"seq": 0, "t": 0.001, "wall": 0.0, "proc": 0, "kind": "log",
+           "name": "info", "text": "Setting updater$Gamma2=FALSE: gated"}
+    with open(events_path(d, 0), "w") as f:
+        f.write(json.dumps(pre) + "\n")
+        for ev in recorded_run["events"]:
+            f.write(json.dumps(ev) + "\n")
+    r = build_report(d)["per_rank"][0]
+    assert r["resumes"] == 0
+    assert r["status"] == "end"
+
+
+def test_report_retires_ranks_beyond_current_process_count(recorded_run,
+                                                           tmp_path):
+    """Resuming a preempted multi-rank run on fewer ranks appends epochs
+    only to the surviving ranks' streams; the vanished ranks' streams end
+    in `preempted` forever.  The report must mark them retired (the
+    committer's newest start carries the current process_count) and keep
+    them out of the overall verdict."""
+    d = os.fspath(tmp_path / "downsized")
+    os.makedirs(d)
+    # rank 0: completed continuation (process_count=1 in its last start)
+    with open(events_path(d, 0), "w") as f:
+        for ev in recorded_run["events"]:
+            f.write(json.dumps(ev) + "\n")
+    # rank 1: stream frozen at the first run's preemption
+    old = [dict(e) for e in recorded_run["events"]
+           if not (e["kind"] == "run" and e["name"] == "end")]
+    old[0]["process_count"] = 2
+    old.append({"seq": old[-1]["seq"] + 1, "t": old[-1]["t"] + 0.01,
+                "wall": 0.0, "proc": 1, "kind": "run", "name": "preempted"})
+    with open(events_path(d, 1), "w") as f:
+        for ev in old:
+            f.write(json.dumps(ev) + "\n")
+    rep = build_report(d)
+    assert rep["status"] == "end"
+    assert rep["per_rank"][0]["status"] == "end"
+    assert rep["per_rank"][1]["status"] == "retired (preempted)"
+
+
+def test_fresh_run_sweeps_stale_event_streams(model, tmp_path):
+    """A fresh run owns its checkpoint directory: stale events-p<r>.jsonl
+    from a previous (possibly wider) run must be removed, or `report`
+    would merge dead ranks into the new run."""
+    d = os.fspath(tmp_path / "ck")
+    os.makedirs(d)
+    with open(events_path(d, 7), "w") as f:
+        f.write(json.dumps({"seq": 0, "t": 0.0, "wall": 0.0, "proc": 7,
+                            "kind": "run", "name": "start"}) + "\n")
+    sample_mcmc(model, checkpoint_every=4, checkpoint_path=d, **RUN_KW)
+    assert not os.path.exists(events_path(d, 7))
+    assert os.path.exists(events_path(d, 0))
+    assert build_report(d)["ranks"] == [0]
+
+
+def test_telemetry_arg_validation(model):
+    with pytest.raises(ValueError, match="telemetry must be"):
+        sample_mcmc(model, telemetry=42, **RUN_KW)
+    # an explicit request to record must not silently record nowhere
+    with pytest.raises(ValueError, match="telemetry=True needs somewhere"):
+        sample_mcmc(model, telemetry=True, **RUN_KW)
+    with pytest.raises(ValueError, match="profile_segments requires"):
+        sample_mcmc(model, profile_segments=(0, 1), **RUN_KW)
+    with pytest.raises(ValueError, match="profile_segments must be"):
+        sample_mcmc(model, profile_segments=(3, 1),
+                    profile_dir="/tmp/unused", **RUN_KW)
+
+
+def test_io_stats_backcompat_view(recorded_run):
+    """The flat io_stats dict survives as a view derived from the span
+    aggregates — old callers keep their keys."""
+    post = recorded_run["post"]
+    io = post.io_stats
+    for k in ("writer_busy_s", "barrier_wait_s", "manifest_commit_s",
+              "process_count", "process_index", "telemetry_events",
+              "bytes_written", "shards_written"):
+        assert k in io, k
+    assert io["telemetry_events"] > 0
+    # and the new first-class summary mirrors the same aggregates
+    tel = post.telemetry
+    assert tel["spans"]["manifest_commit"]["count"] == 3   # 2 sample + 1 t
+    assert abs(tel["spans"]["manifest_commit"]["total_s"]
+               - io["manifest_commit_s"]) < 1e-6
+    digest = compact_summary(tel)
+    assert digest["events"] == tel["events"]
+    compute = (digest["spans_s"].get("compile", 0.0)
+               + digest["spans_s"].get("dispatch", 0.0))
+    assert compute > 0
+
+
+# ---------------------------------------------------------------------------
+# incremental health diagnostics
+# ---------------------------------------------------------------------------
+
+def test_running_diagnostics_matches_posthoc():
+    """Segment-wise accumulation must reproduce the one-shot R-hat/ESS over
+    the concatenated draws (same estimator, incremental feeding)."""
+    rng = np.random.default_rng(0)
+    chains, n, shape = 4, 40, (3, 2)
+    draws = rng.standard_normal((chains, n) + shape)
+    rd = RunningDiagnostics(monitor=("Beta",), max_entries=6)
+    for lo in range(0, n, 8):
+        rd.update({"Beta": draws[:, lo:lo + 8]})
+    assert rd.n_samples == n
+    s = rd.summary()
+    assert s["n_draws"] == n and s["monitored"] == 6
+    flat = draws.reshape(chains, n, -1)
+    idx = np.unique(np.linspace(0, flat.shape[2] - 1, 6).astype(int))
+    ref = rhat_ess(flat[:, :, idx])
+    assert abs(s["rhat_max"] - float(np.nanmax(ref["rhat"]))) < 1e-3
+    assert abs(s["ess_min"] - float(ref["ess"].min())) < 0.11
+
+
+def test_running_diagnostics_few_draws_degrades():
+    rd = RunningDiagnostics()
+    rd.update({"Beta": np.zeros((2, 2, 3))})
+    s = rd.summary()
+    assert s["n_draws"] == 2 and s["rhat_max"] is None
+
+
+# ---------------------------------------------------------------------------
+# report CLI
+# ---------------------------------------------------------------------------
+
+def test_report_cli_smoke(recorded_run, tmp_path, capsys):
+    prom = os.fspath(tmp_path / "hmsc.prom")
+    rc = report_main([recorded_run["dir"], "--prom", prom])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "phase timeline" in out
+    assert "throughput curve" in out
+    assert "health (latest)" in out
+    assert "checkpoint I/O breakdown" in out
+    assert re.search(r"rank 0 \(end", out)
+    with open(prom) as f:
+        text = f.read()
+    assert 'hmsc_tpu_span_seconds_total{span="state_write",proc="0"}' in text
+    assert "hmsc_tpu_samples_done" in text
+    # --json emits the structured report
+    rc = report_main([recorded_run["dir"], "--json"])
+    rep = json.loads(capsys.readouterr().out)
+    assert rc == 0 and rep["status"] == "end" and rep["ranks"] == [0]
+
+
+def test_report_tolerates_inflight_stream(recorded_run, tmp_path):
+    """A torn last line (in-flight run) must be skipped, not fatal, and the
+    run reported as in-flight."""
+    d = os.fspath(tmp_path / "inflight")
+    os.makedirs(d)
+    events = [e for e in recorded_run["events"]
+              if not (e["kind"] == "run" and e["name"] == "end")]
+    with open(events_path(d, 0), "w") as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+        f.write('{"seq": 9999, "t": 1.0, "wall"')       # torn tail
+    rep = build_report(d)
+    assert rep["status"] == "in-flight"
+    assert rep["per_rank"][0]["events"] == len(events)
+    assert "in-flight" in render_report(rep)
+
+
+def test_report_empty_dir(tmp_path):
+    assert report_main([os.fspath(tmp_path)]) == 1
+
+
+def test_report_resumed_run_epochs(recorded_run, tmp_path):
+    """A resumed run APPENDS its continuation with a fresh monotonic clock:
+    the report must re-base each epoch (wall sums, timeline t monotone),
+    take status from the FINAL epoch (an earlier `preempted` must not mask
+    the continuation's `end`), and count the resumes."""
+    d = os.fspath(tmp_path / "resumed")
+    os.makedirs(d)
+    base = recorded_run["events"]
+    epoch1 = [dict(e) for e in base
+              if not (e["kind"] == "run" and e["name"] == "end")]
+    epoch1.append({"seq": epoch1[-1]["seq"] + 1,
+                   "t": epoch1[-1]["t"] + 0.01, "wall": 0.0, "proc": 0,
+                   "kind": "run", "name": "preempted", "samples_done": 4})
+    with open(events_path(d, 0), "w") as f:
+        for ev in epoch1 + base:                 # continuation appended
+            f.write(json.dumps(ev) + "\n")
+    rep = build_report(d)
+    r = rep["per_rank"][0]
+    assert rep["status"] == "end" and r["status"] == "end"
+    assert r["resumes"] == 1
+    wall1 = max(e["t"] for e in epoch1)
+    wall2 = max(e["t"] for e in base)
+    assert abs(r["wall_s"] - (wall1 + wall2)) < 1e-3
+    ts = [p["t"] for p in r["throughput"]]
+    assert ts == sorted(ts)                      # re-based, monotone
+    # span totals across both epochs fit inside the summed wall
+    assert sum(v["total_s"] for v in r["spans"].values()) <= 2 * r["wall_s"]
+    assert "1 resume(s)" in render_report(rep)
+
+
+# ---------------------------------------------------------------------------
+# multi-process rank aggregation (rides the commit gather)
+# ---------------------------------------------------------------------------
+
+def test_two_proc_rank_aggregation(model, tmp_path):
+    ck = os.fspath(tmp_path / "ck")
+    recs = spawn_workers(
+        2, ckpt_dir=ck, coord_dir=os.fspath(tmp_path / "coord"),
+        run_kw=dict(samples=8, transient=4, thin=1, n_chains=4, seed=11,
+                    verbose=0, checkpoint_every=4),
+        out_dir=os.fspath(tmp_path), timeout_s=300, wall_timeout_s=560)
+    bad = [r for r in recs if r["returncode"] != 0]
+    assert not bad, "\n".join(
+        f"rank {r['rank']} rc={r['returncode']}\n{r['stderr'][-2000:]}"
+        for r in bad)
+    # each rank wrote its own stream
+    assert os.path.exists(events_path(ck, 0))
+    assert os.path.exists(events_path(ck, 1))
+    # the committer recorded cross-rank skew at every commit mark, derived
+    # from the per-rank deltas the gather carried (no extra collective)
+    rep = build_report(ck)
+    assert rep["ranks"] == [0, 1]
+    assert rep["skew"], "committer recorded no rank_skew metrics"
+    for s in rep["skew"]:
+        assert len(s["segment_s"]) == 2
+        assert len(s["barrier_wait_s"]) == 2
+        assert s["skew_s"] >= 0
+    # both ranks traced barrier waits (the release barrier at each commit)
+    for proc in (0, 1):
+        assert "barrier_wait" in rep["per_rank"][proc]["spans"]
+    # the per-worker posterior carried its telemetry summary out
+    for r in recs:
+        tel = r["result"]["telemetry"]
+        assert tel["proc"] == r["rank"]
+        assert tel["spans"]["barrier_wait"]["count"] > 0
+    # rendering the multi-rank report covers the skew section
+    text = render_report(rep)
+    assert "cross-rank stall / skew" in text
+    prom = prometheus_textfile(rep)
+    assert "hmsc_tpu_rank_skew_seconds" in prom
+
+
+# ---------------------------------------------------------------------------
+# no bare print( in library code (everything routes through hmsc_tpu.obs)
+# ---------------------------------------------------------------------------
+
+def test_no_bare_print_in_library():
+    """Library-side progress output must go through the obs logger; bare
+    ``print(`` is allowed only in the obs module itself and the CLI entry
+    points (``__main__``, ``bench_cli``)."""
+    root = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "hmsc_tpu")
+    allowed = {os.path.join(root, "__main__.py"),
+               os.path.join(root, "bench_cli.py")}
+    bare = re.compile(r"(?<![\w.])print\(")
+    offenders = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", "obs")]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            if path in allowed:
+                continue
+            with open(path) as f:
+                for i, line in enumerate(f, 1):
+                    if line.lstrip().startswith("#"):
+                        continue
+                    if bare.search(line):
+                        offenders.append(f"{path}:{i}: {line.strip()}")
+    assert not offenders, (
+        "bare print( in library code (route through hmsc_tpu.obs.log):\n"
+        + "\n".join(offenders))
