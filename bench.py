@@ -175,6 +175,27 @@ def _probe_device(timeout_s: int):
     return r.stdout.strip()
 
 
+def _lint_summary():
+    """Finding counts from the static-correctness suite (`hmsc_tpu lint`),
+    run in a subprocess pinned to the CPU backend: the trajectory records
+    lint drift alongside throughput, and the audit's abstract tracing must
+    never touch (or wait on) the accelerator the bench is probing."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "hmsc_tpu", "lint", "--json"],
+            capture_output=True, text=True, timeout=600, env=env)
+        doc = json.loads(r.stdout)
+        return {k: doc[k] for k in ("errors", "warnings", "suppressed",
+                                    "baselined")}
+    except Exception as e:                   # noqa: BLE001 — bench must emit
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _skip(reason: str):
     """Emit a parseable skip record instead of a bare nonzero exit: the
     bench trajectory must distinguish "chip unreachable this round" from "a
@@ -191,6 +212,8 @@ def _skip(reason: str):
         "process_count": None,
         "skipped": True,
         "reason": reason,
+        # lint runs on CPU, so the trajectory still records static health
+        "lint_findings": _lint_summary(),
     }))
     raise SystemExit(0)
 
@@ -334,6 +357,8 @@ def main():
         # window (hmsc_tpu.obs): the trajectory records WHERE the wall
         # went, not only how long it was
         "telemetry": compact_summary(tel_big),
+        # static-correctness drift (`hmsc_tpu lint` finding counts)
+        "lint_findings": _lint_summary(),
     }))
 
 

@@ -2,11 +2,13 @@
 
 Subcommands: ``bench`` (default; the throughput probe, same entry as the
 ``hmsc-tpu-bench`` console script), ``run`` (checkpointed, preemption-safe
-long-run driver with ``--resume``), and ``report`` (render a run's
+long-run driver with ``--resume``), ``report`` (render a run's
 telemetry — phase timeline, throughput, cross-rank skew, checkpoint I/O
 and MCMC health — from its ``events-p<rank>.jsonl`` streams; ``--prom``
-exports Prometheus textfile gauges).  Bare arguments keep the historical
-bench behaviour: ``python -m hmsc_tpu --ns 50`` still works.
+exports Prometheus textfile gauges), and ``lint`` (the static correctness
+suite: AST lint + jaxpr audits, see ``ANALYSIS.md``; exit 1 on any active
+severity=error finding).  Bare arguments keep the historical bench
+behaviour: ``python -m hmsc_tpu --ns 50`` still works.
 """
 
 import sys
@@ -22,6 +24,9 @@ def main(argv=None):
     if argv[:1] == ["report"]:
         from .obs.report import report_main
         return report_main(argv[1:])
+    if argv[:1] == ["lint"]:
+        from .analysis.cli import lint_main
+        return lint_main(argv[1:])
     if argv[:1] == ["bench"]:
         argv = argv[1:]
     return bench_main(argv)

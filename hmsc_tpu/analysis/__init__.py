@@ -1,0 +1,38 @@
+"""Static correctness suite for the hmsc_tpu runtime stack.
+
+The sampler's performance rests on invariants that runtime tests can only
+catch *after* they are violated, and never localise: bit-identical draw
+streams (RNG key discipline), no host sync inside the jitted hot loop,
+a single dtype policy (no silent f64 upcasts), and strict lock discipline
+between the driver thread and the background segment writer.  This package
+turns those invariants into machine-checked rules that fail fast with a
+``file:line``:
+
+- **Layer 1 — AST lint** (:mod:`.ast_rules`): pure-syntax rules over every
+  module in ``hmsc_tpu/`` — RNG key reuse, host-RNG misuse, host-sync and
+  ``numpy`` hazards inside traced code, mutable dataclass defaults, bare
+  ``print``, and declared-lock discipline for writer-shared state.
+- **Layer 2 — jaxpr audits** (:mod:`.jaxpr_rules`): abstract-eval every
+  registered updater and the jitted segment runner on a canonical small
+  spec and assert properties of the *traced program*: no f64 leaks, no
+  host callbacks, donation aliasing actually established, no large baked
+  constants, bounded shape specialisation, and a committed structural
+  fingerprint per program (``fingerprints.json``) so any change to the
+  compiled surface shows up in review.
+
+Findings carry a rule id, severity, and ``file:line``; inline
+``# hmsc: ignore[rule-id]`` comments suppress single findings, and a
+committed JSON baseline grandfathers pre-existing ones.  The whole suite
+runs as ``python -m hmsc_tpu lint`` and as the tier-1 ``test_lint_clean``
+gate.  The rule catalog lives in ``ANALYSIS.md`` at the repo root.
+"""
+
+from .findings import (Finding, Baseline, load_baseline, save_baseline,
+                       parse_suppressions, RULES, RuleInfo, rule)
+from .runner import run_analysis, findings_to_json, analysis_summary
+from .cli import lint_main
+
+__all__ = ["Finding", "Baseline", "load_baseline", "save_baseline",
+           "parse_suppressions", "RULES", "RuleInfo", "rule",
+           "run_analysis", "findings_to_json", "analysis_summary",
+           "lint_main"]

@@ -1,0 +1,694 @@
+"""Layer 1 — AST lint rules over ``hmsc_tpu/``.
+
+Pure-syntax checks; no imports of the checked modules, so a module with a
+latent import-time bug still gets linted.  Each rule receives a
+:class:`ModuleContext` and yields :class:`~.findings.Finding`.
+
+Traced-scope heuristic (used by the in-jit rules): a function is
+considered *traced* when it (a) is decorated with ``jax.jit`` (directly or
+via ``functools.partial``), (b) has its name passed to ``jax.jit`` /
+``jax.vmap`` / ``jax.lax.scan`` / ``jax.lax.cond`` somewhere in the same
+module, (c) lives in one of the sweep-level modules
+(``mcmc/{sweep,updaters,updaters_sel,updaters_marginal,spatial}.py``) and
+takes a ``state``/``carry``/``key`` parameter, or (d) is nested inside a
+traced function.  Host-side gate helpers (no state/key parameter) in those
+modules are deliberately out of scope — the heuristic is documented in
+``ANALYSIS.md`` and tuned to zero false positives on the shipped tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+
+from .findings import RULES, rule
+
+__all__ = ["ModuleContext", "run_ast_rules", "SWEEP_MODULES"]
+
+SWEEP_MODULES = ("mcmc/sweep.py", "mcmc/updaters.py", "mcmc/updaters_sel.py",
+                 "mcmc/updaters_marginal.py", "mcmc/spatial.py")
+
+# expression roots treated as trace-time-static inside traced scopes: the
+# hashable ModelSpec/LevelSpec objects the sweep closes over
+STATIC_ROOTS = {"spec", "spec_x", "spec0", "ls"}
+
+GUARD_RE = re.compile(
+    r"#\s*hmsc:\s*guarded-by\[([A-Za-z_][A-Za-z0-9_]*)\]:\s*([A-Za-z0-9_,\s]+)")
+HOLDS_RE = re.compile(r"#\s*hmsc:\s*holds\[([A-Za-z_][A-Za-z0-9_]*)\]")
+
+
+@dataclasses.dataclass
+class ModuleContext:
+    path: str                     # repo-relative, forward slashes
+    source: str
+    tree: ast.Module
+    lines: list[str]
+
+    @classmethod
+    def parse(cls, path: str, source: str) -> "ModuleContext":
+        return cls(path=path, source=source,
+                   tree=ast.parse(source, filename=path),
+                   lines=source.splitlines())
+
+
+def run_ast_rules(ctx: ModuleContext):
+    """All registered layer-1 rules over one parsed module."""
+    for info in RULES.values():
+        if info.layer != "ast":
+            continue
+        yield from info.checker(ctx)
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+def dotted_name(node) -> str | None:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def expr_roots(node) -> set[str]:
+    """Root ``Name`` ids reachable in an expression (the base of every
+    attribute/subscript chain plus bare names)."""
+    roots: set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            roots.add(n.id)
+    return roots
+
+
+def _is_static_expr(node) -> bool:
+    """True when every root of the expression is a trace-time constant."""
+    if isinstance(node, ast.Constant):
+        return True
+    return expr_roots(node) <= (STATIC_ROOTS | {"len", "np", "jnp", "int",
+                                                "float", "min", "max", "sum"})
+
+
+def _functions(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _param_names(fn) -> set[str]:
+    a = fn.args
+    names = [p.arg for p in
+             (a.posonlyargs + a.args + a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+def _jit_decorated(fn) -> bool:
+    for dec in fn.decorator_list:
+        d = dotted_name(dec)
+        if d in ("jax.jit", "jit"):
+            return True
+        if isinstance(dec, ast.Call):
+            d = dotted_name(dec.func)
+            if d in ("jax.jit", "jit"):
+                return True
+            if d in ("functools.partial", "partial") and dec.args:
+                inner = dotted_name(dec.args[0])
+                if inner in ("jax.jit", "jit"):
+                    return True
+    return False
+
+
+_TRANSFORM_FNS = {"jax.jit", "jit", "jax.vmap", "vmap", "jax.lax.scan",
+                  "lax.scan", "jax.lax.cond", "lax.cond", "jax.lax.while_loop",
+                  "lax.while_loop", "jax.checkpoint", "jax.remat",
+                  "jax.grad", "jax.pmap", "shard_map"}
+
+
+def traced_functions(ctx: ModuleContext) -> set[ast.AST]:
+    """Function-def nodes considered traced (see module docstring)."""
+    # names handed to jax transforms anywhere in the module
+    transformed_names: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            d = dotted_name(node.func)
+            if d in _TRANSFORM_FNS:
+                for arg in node.args:
+                    for r in ast.walk(arg):
+                        if isinstance(r, ast.Name):
+                            transformed_names.add(r.id)
+
+    in_sweep_module = ctx.path.replace("\\", "/").endswith(SWEEP_MODULES)
+    traced: set[ast.AST] = set()
+    for fn in _functions(ctx.tree):
+        params = _param_names(fn)
+        if (_jit_decorated(fn)
+                or fn.name in transformed_names
+                or (in_sweep_module
+                    and params & {"state", "carry", "key"})):
+            traced.add(fn)
+    # nested defs inherit traced-ness
+    changed = True
+    while changed:
+        changed = False
+        for fn in list(traced):
+            for inner in ast.walk(fn):
+                if (isinstance(inner, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))
+                        and inner is not fn and inner not in traced):
+                    traced.add(inner)
+                    changed = True
+    return traced
+
+
+def _own_statements(fn):
+    """Nodes of a function body excluding nested function bodies."""
+    skip: set[ast.AST] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not fn:
+            for sub in ast.walk(node):
+                skip.add(sub)
+            skip.discard(node)
+    for node in ast.walk(fn):
+        if node is fn or node in skip:
+            continue
+        yield node
+
+
+# ---------------------------------------------------------------------------
+# rule: rng-key-reuse
+# ---------------------------------------------------------------------------
+
+_KEY_SOURCE_FNS = {"split", "key", "PRNGKey", "fold_in", "wrap_key_data",
+                   "clone"}
+# second-arg-varying derivation: safe to call repeatedly on the same key
+_KEY_DERIVE_FNS = {"fold_in"}
+
+
+def _jax_random_call(d: str | None) -> bool:
+    """Any dotted call into the jax.random namespace (or a common alias).
+    ``np.random.*`` deliberately does not match — numpy Generators are
+    stateful and reusable."""
+    if d is None:
+        return False
+    return d.startswith(("jax.random.", "jr.", "jrandom.", "random."))
+
+
+def _is_random_fn(d: str | None) -> bool:
+    if d is None:
+        return False
+    parts = d.split(".")
+    return _jax_random_call(d) and parts[-1] in _KEY_SOURCE_FNS
+
+
+@rule("rng-key-reuse", "error", "ast",
+      "a jax.random key is consumed at most once per scope; reuse "
+      "correlates draw streams silently")
+def check_rng_key_reuse(ctx: ModuleContext):
+    findings = []
+
+    def fn_scopes(tree):
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+    for fn in fn_scopes(ctx.tree):
+        findings.extend(_scan_key_scope(ctx, fn))
+    return findings
+
+
+def _assigned_names(target) -> list[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out = []
+        for el in target.elts:
+            out.extend(_assigned_names(el))
+        return out
+    return []
+
+
+def _terminates(body: list) -> bool:
+    return bool(body) and isinstance(
+        body[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+
+def _scan_key_scope(ctx: ModuleContext, fn):
+    """Track key-typed names through one function's straight-line flow.
+
+    state: name -> "fresh" | "consumed".  Consuming a fresh key marks it;
+    consuming a consumed key is a finding.  ``fold_in`` never consumes
+    (it derives with explicit data).  Loop bodies additionally flag keys
+    from the enclosing scope that are consumed per-iteration without being
+    rebound inside the body."""
+    findings: list = []
+    keys: dict[str, str] = {}
+    # a param named `key`/`*_key` is tracked only with *evidence* it is a
+    # PRNG key: the module is a sweep-level module (where key params are
+    # PRNG keys by convention), or the function hands the name to a
+    # jax.random.* call somewhere.  (`ShardBackedArrays.__getitem__(self,
+    # key)`-style dict keys must not be tracked; np.random.Generator
+    # params are stateful and *meant* to be reused, so `rng` never is.)
+    in_sweep = ctx.path.replace("\\", "/").endswith(SWEEP_MODULES)
+    evidence = in_sweep or any(
+        isinstance(n, ast.Call) and _jax_random_call(dotted_name(n.func))
+        for n in ast.walk(fn))
+    if evidence:
+        for p in _param_names(fn):
+            if p == "key" or p.endswith("_key"):
+                keys[p] = "fresh"
+
+    def handle_call(node, keys, loop_outer, loop_consumed):
+        d = dotted_name(node.func)
+        derive = d is not None and d.split(".")[-1] in _KEY_DERIVE_FNS
+        if derive:
+            return
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Name) and arg.id in keys:
+                name = arg.id
+                if keys[name] == "consumed":
+                    findings.append(RULES["rng-key-reuse"].finding(
+                        ctx.path, node.lineno,
+                        f"key `{name}` consumed again without an "
+                        f"intervening split (same scope)"))
+                keys[name] = "consumed"
+                if loop_outer is not None and name in loop_outer:
+                    loop_consumed.add(name)
+
+    def handle_assign(node, keys):
+        value = node.value
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        names = []
+        for t in targets:
+            names.extend(_assigned_names(t))
+        is_key_src = (isinstance(value, ast.Call)
+                      and _is_random_fn(dotted_name(value.func)))
+        for name in names:
+            if is_key_src:
+                keys[name] = "fresh"
+            elif name in keys:
+                del keys[name]       # rebound to something non-key
+
+    def scan_stmts(stmts, keys, loop_outer=None, loop_consumed=None):
+        for stmt in stmts:
+            scan_stmt(stmt, keys, loop_outer, loop_consumed)
+
+    def handle_comp_call(node, keys):
+        """A call inside a comprehension body runs once per iteration: a
+        tracked key consumed there is reused every iteration (the
+        comprehension cannot rebind it)."""
+        d = dotted_name(node.func)
+        if d is not None and d.split(".")[-1] in _KEY_DERIVE_FNS:
+            return
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Name) and arg.id in keys:
+                findings.append(RULES["rng-key-reuse"].finding(
+                    ctx.path, node.lineno,
+                    f"key `{arg.id}` consumed inside a comprehension — "
+                    f"every iteration reuses the same key"))
+                keys[arg.id] = "consumed"
+
+    def scan_expr_calls(node, keys, loop_outer, loop_consumed):
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return               # nested scopes scanned independently
+        # comprehension bodies iterate: consumption there is per-iteration
+        # reuse.  The FIRST generator's iterable evaluates once, so calls
+        # there are ordinary single consumptions.
+        comp_calls: set = set()
+        once_calls: set = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                ast.GeneratorExp)):
+                for inner in ast.walk(sub.generators[0].iter):
+                    if isinstance(inner, ast.Call):
+                        once_calls.add(inner)
+                for inner in ast.walk(sub):
+                    if isinstance(inner, ast.Call) \
+                            and inner not in once_calls:
+                        comp_calls.add(inner)
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            if sub in comp_calls:
+                handle_comp_call(sub, keys)
+            else:
+                handle_call(sub, keys, loop_outer, loop_consumed)
+
+    def scan_stmt(stmt, keys, loop_outer, loop_consumed):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            if stmt.value is not None:
+                scan_expr_calls(stmt.value, keys, loop_outer, loop_consumed)
+            if not isinstance(stmt, ast.AugAssign):
+                handle_assign(stmt, keys)
+            return
+        if isinstance(stmt, ast.If):
+            scan_expr_calls(stmt.test, keys, loop_outer, loop_consumed)
+            k1, k2 = dict(keys), dict(keys)
+            scan_stmts(stmt.body, k1, loop_outer, loop_consumed)
+            scan_stmts(stmt.orelse, k2, loop_outer, loop_consumed)
+            # a branch ending in return/raise/break/continue never reaches
+            # the fallthrough: its consumptions don't merge (the common
+            # `if fast_path: return f(key)` + `return g(key)` shape is one
+            # consumption per execution, not two)
+            merged = [k for k, body in ((k1, stmt.body), (k2, stmt.orelse))
+                      if not _terminates(body)]
+            if not merged:
+                merged = [dict(keys)]
+            for name in {n for k in merged for n in k} | set(keys):
+                states = [k.get(name) for k in merged]
+                if all(s is None for s in states):
+                    keys.pop(name, None)
+                elif "consumed" in states:
+                    keys[name] = "consumed"
+                else:
+                    keys[name] = "fresh"
+            return
+        if isinstance(stmt, (ast.For, ast.While)):
+            if isinstance(stmt, ast.For):
+                scan_expr_calls(stmt.iter, keys, loop_outer, loop_consumed)
+                for name in _assigned_names(stmt.target):
+                    keys.pop(name, None)
+            else:
+                scan_expr_calls(stmt.test, keys, loop_outer, loop_consumed)
+            outer = set(keys)
+            consumed_in_body: set[str] = set()
+            rebound = {n for s in ast.walk(stmt)
+                       if isinstance(s, ast.Assign)
+                       for t in s.targets for n in _assigned_names(t)}
+            scan_stmts(stmt.body, keys, outer, consumed_in_body)
+            for name in consumed_in_body - rebound:
+                findings.append(RULES["rng-key-reuse"].finding(
+                    ctx.path, stmt.lineno,
+                    f"key `{name}` from the enclosing scope is consumed "
+                    f"inside a loop body without being rebound — every "
+                    f"iteration reuses the same key"))
+            scan_stmts(stmt.orelse, keys, loop_outer, loop_consumed)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                scan_expr_calls(item.context_expr, keys, loop_outer,
+                                loop_consumed)
+            scan_stmts(stmt.body, keys, loop_outer, loop_consumed)
+            return
+        if isinstance(stmt, ast.Try):
+            scan_stmts(stmt.body, keys, loop_outer, loop_consumed)
+            for h in stmt.handlers:
+                scan_stmts(h.body, dict(keys), loop_outer, loop_consumed)
+            scan_stmts(stmt.orelse, keys, loop_outer, loop_consumed)
+            scan_stmts(stmt.finalbody, keys, loop_outer, loop_consumed)
+            return
+        # generic statement: scan expressions for calls
+        scan_expr_calls(stmt, keys, loop_outer, loop_consumed)
+
+    scan_stmts(fn.body, keys)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# rule: py-random
+# ---------------------------------------------------------------------------
+
+_NP_GLOBAL_DRAWS = {"seed", "RandomState", "rand", "randn", "randint",
+                    "random", "normal", "uniform", "choice", "permutation",
+                    "shuffle", "standard_normal", "gamma", "beta", "poisson",
+                    "binomial", "exponential"}
+
+
+@rule("py-random", "error", "ast",
+      "all draws are reproducible: device randomness uses jax.random, host "
+      "randomness uses an explicitly seeded np.random.Generator")
+def check_py_random(ctx: ModuleContext):
+    findings = []
+    info = RULES["py-random"]
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "random":
+                    findings.append(info.finding(
+                        ctx.path, node.lineno,
+                        "stdlib `random` imported in library code (use "
+                        "jax.random on device, seeded np.random.Generator "
+                        "on host)"))
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "random":
+                findings.append(info.finding(
+                    ctx.path, node.lineno,
+                    "stdlib `random` imported in library code"))
+        elif isinstance(node, ast.Call):
+            d = dotted_name(node.func)
+            if d is None:
+                continue
+            parts = d.split(".")
+            if parts[:2] in (["np", "random"], ["numpy", "random"]) \
+                    and len(parts) == 3:
+                if parts[2] in _NP_GLOBAL_DRAWS:
+                    findings.append(info.finding(
+                        ctx.path, node.lineno,
+                        f"global-state numpy RNG `{d}(...)` — "
+                        f"unreproducible; use a seeded "
+                        f"np.random.default_rng(seed)"))
+                elif parts[2] == "default_rng" and not node.args \
+                        and not node.keywords:
+                    findings.append(info.finding(
+                        ctx.path, node.lineno,
+                        "unseeded np.random.default_rng() — draws are not "
+                        "reproducible; thread a seed or a Generator through"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# rules: host-sync-in-jit / numpy-in-jit
+# ---------------------------------------------------------------------------
+
+@rule("host-sync-in-jit", "error", "ast",
+      "the jitted hot loop never blocks on device→host sync (.item(), "
+      "float()/int()/bool() on traced values)")
+def check_host_sync(ctx: ModuleContext):
+    findings = []
+    info = RULES["host-sync-in-jit"]
+    for fn in traced_functions(ctx):
+        for node in _own_statements(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item":
+                findings.append(info.finding(
+                    ctx.path, node.lineno,
+                    ".item() inside traced code forces a device→host sync "
+                    "(and fails under jit on abstract values)"))
+            d = dotted_name(node.func)
+            if d in ("float", "int", "bool") and node.args \
+                    and not _is_static_expr(node.args[0]):
+                findings.append(info.finding(
+                    ctx.path, node.lineno,
+                    f"{d}() on a traced value inside traced code — host "
+                    f"sync / ConcretizationTypeError hazard"))
+    return findings
+
+
+@rule("numpy-in-jit", "error", "ast",
+      "traced code computes with jnp, never np: numpy on traced values "
+      "either crashes under jit or silently constant-folds a draw")
+def check_numpy_in_jit(ctx: ModuleContext):
+    findings = []
+    info = RULES["numpy-in-jit"]
+    for fn in traced_functions(ctx):
+        for node in _own_statements(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted_name(node.func)
+            if d is None or not (d.startswith("np.")
+                                 or d.startswith("numpy.")):
+                continue
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            if all(_is_static_expr(a) for a in args):
+                continue             # static shape/prior arithmetic is fine
+            findings.append(info.finding(
+                ctx.path, node.lineno,
+                f"`{d}(...)` on a non-static value inside traced code "
+                f"(use jnp, or hoist to trace-time constants)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# rule: mutable-default
+# ---------------------------------------------------------------------------
+
+def _is_mutable_literal(node) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+        return True
+    if isinstance(node, ast.Call):
+        d = dotted_name(node.func)
+        return d in ("list", "dict", "set")
+    return False
+
+
+def _is_dataclass_like(node: ast.ClassDef) -> bool:
+    for dec in node.decorator_list:
+        d = dotted_name(dec if not isinstance(dec, ast.Call) else dec.func)
+        if d in ("dataclasses.dataclass", "dataclass", "struct.dataclass"):
+            return True
+    for base in node.bases:
+        d = dotted_name(base)
+        if d in ("struct.PyTreeNode", "PyTreeNode"):
+            return True
+    return False
+
+
+@rule("mutable-default", "error", "ast",
+      "spec/struct dataclasses and function signatures never share mutable "
+      "default instances across calls")
+def check_mutable_default(ctx: ModuleContext):
+    findings = []
+    info = RULES["mutable-default"]
+    for fn in _functions(ctx.tree):
+        for default in list(fn.args.defaults) + \
+                [d for d in fn.args.kw_defaults if d is not None]:
+            if _is_mutable_literal(default):
+                findings.append(info.finding(
+                    ctx.path, default.lineno,
+                    f"mutable default argument in `{fn.name}(...)` is "
+                    f"shared across calls (use None + init inside)"))
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ClassDef) and _is_dataclass_like(node):
+            for stmt in node.body:
+                value = None
+                if isinstance(stmt, ast.AnnAssign):
+                    value = stmt.value
+                elif isinstance(stmt, ast.Assign):
+                    value = stmt.value
+                if value is not None and _is_mutable_literal(value):
+                    findings.append(info.finding(
+                        ctx.path, value.lineno,
+                        f"mutable class-level default in dataclass "
+                        f"`{node.name}` is shared across instances "
+                        f"(use dataclasses.field(default_factory=...))"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# rule: bare-print (migrated from tests/test_telemetry.py)
+# ---------------------------------------------------------------------------
+
+_PRINT_EXEMPT = ("obs/", "__main__.py", "bench_cli.py", "analysis/cli.py")
+
+
+@rule("bare-print", "error", "ast",
+      "library progress output routes through hmsc_tpu.obs.log (rank-"
+      "prefixed, telemetry-recorded); bare print is reserved for the CLI "
+      "entry points")
+def check_bare_print(ctx: ModuleContext):
+    p = ctx.path.replace("\\", "/")
+    rel = p.split("hmsc_tpu/", 1)[-1]
+    if rel.startswith(_PRINT_EXEMPT) or rel.endswith(_PRINT_EXEMPT):
+        return []
+    findings = []
+    info = RULES["bare-print"]
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id == "print":
+            findings.append(info.finding(
+                ctx.path, node.lineno,
+                "bare print( in library code — route through "
+                "hmsc_tpu.obs.log"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# rule: lock-discipline
+# ---------------------------------------------------------------------------
+
+@rule("lock-discipline", "error", "ast",
+      "attributes declared `# hmsc: guarded-by[<lock>]: a, b` are only "
+      "touched under that lock (driver vs background-writer thread safety)")
+def check_lock_discipline(ctx: ModuleContext):
+    findings = []
+    info = RULES["lock-discipline"]
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        guarded: dict[str, str] = {}   # attr -> lock attr
+        end = getattr(cls, "end_lineno", cls.lineno)
+        for line in ctx.lines[cls.lineno - 1:end]:
+            m = GUARD_RE.search(line)
+            if m:
+                lock = m.group(1)
+                for attr in m.group(2).split(","):
+                    attr = attr.strip()
+                    if attr:
+                        guarded[attr] = lock
+        if not guarded:
+            continue
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if meth.name in ("__init__", "__del__", "__repr__"):
+                continue
+            held: set[str] = set()
+            if meth.name.endswith("_locked"):
+                held = set(guarded.values())
+            # `# hmsc: holds[_lock]` on the def line or the line above
+            for ln in (meth.lineno - 1, meth.lineno):
+                if 1 <= ln <= len(ctx.lines):
+                    hm = HOLDS_RE.search(ctx.lines[ln - 1])
+                    if hm:
+                        held.add(hm.group(1))
+            out: list = []
+            _walk_locked(ctx, info, meth, guarded, held, False, out)
+            findings.extend(out)
+    return findings
+
+
+def _walk_locked(ctx, info, node, guarded, held, in_nested, out):
+    """Recursive visitor; ``held`` is the set of lock attrs lexically held
+    at this point.  Nested closures reset it — they run later, on an
+    unknown thread, without the enclosing lock."""
+    lock_names = set(guarded.values())
+    if isinstance(node, (ast.With, ast.AsyncWith)):
+        acquired = set(held)
+        for item in node.items:
+            d = dotted_name(item.context_expr)
+            if d is not None and d.startswith("self."):
+                lk = d.split(".", 1)[1]
+                if lk in lock_names:
+                    acquired.add(lk)
+            _walk_locked(ctx, info, item.context_expr, guarded, held,
+                         in_nested, out)
+        for inner in node.body:
+            _walk_locked(ctx, info, inner, guarded, acquired,
+                         in_nested, out)
+        return
+    for sub in ast.iter_child_nodes(node):
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            _walk_locked(ctx, info, sub, guarded, set(), True, out)
+            continue
+        if isinstance(sub, ast.Attribute) \
+                and isinstance(sub.value, ast.Name) \
+                and sub.value.id == "self" and sub.attr in guarded:
+            lock = guarded[sub.attr]
+            if lock not in held:
+                where = ("a nested closure (runs without the enclosing "
+                         "lock)" if in_nested else "this method")
+                out.append(info.finding(
+                    ctx.path, sub.lineno,
+                    f"self.{sub.attr} touched in {where} without holding "
+                    f"self.{lock} (declared guarded-by[{lock}])"))
+            continue
+        _walk_locked(ctx, info, sub, guarded, held, in_nested, out)

@@ -1,0 +1,99 @@
+"""``python -m hmsc_tpu lint`` — the static-correctness gate.
+
+Exit status: 0 when no active severity=error finding remains after
+suppressions and the committed baseline; 1 otherwise.  ``--json`` prints
+the machine-readable report (schema pinned by ``tests/test_analysis.py``),
+``--update-baseline`` rewrites the grandfather file from the current
+findings, ``--update-fingerprints`` re-records the jaxpr structural
+fingerprints after a reviewed change to the compiled surface.
+
+The jaxpr layer traces on whatever JAX platform is configured; the CLI
+defaults ``JAX_PLATFORMS=cpu`` (abstract evaluation is platform-
+independent, and a lint must never block on an unreachable accelerator).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def lint_main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m hmsc_tpu lint",
+        description="Static correctness suite: AST lint + jaxpr audits "
+                    "over hmsc_tpu/.")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable report on stdout")
+    parser.add_argument("--layer", choices=("ast", "jaxpr", "all"),
+                        default="all",
+                        help="run only one analysis layer (default: all)")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the committed baseline from the "
+                             "current findings and exit 0")
+    parser.add_argument("--update-fingerprints", action="store_true",
+                        help="re-record jaxpr structural fingerprints "
+                             "(after reviewing the diff) and exit 0")
+    parser.add_argument("--baseline", default=None,
+                        help="override the baseline file path")
+    parser.add_argument("--root", default=None,
+                        help="lint a different package root (fixture "
+                             "trees in tests; default: the installed "
+                             "hmsc_tpu package)")
+    parser.add_argument("--fingerprints", default=None,
+                        help="override the fingerprints file path")
+    args = parser.parse_args(argv)
+
+    # lint must never block on an unreachable accelerator: abstract eval
+    # is platform-independent, so trace on CPU unless told otherwise
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from .findings import load_baseline
+    from .runner import BASELINE_PATH, run_analysis, findings_to_json
+    from . import jaxpr_rules
+
+    layers = ("ast", "jaxpr") if args.layer == "all" else (args.layer,)
+    baseline_path = args.baseline or BASELINE_PATH
+    fp_path = args.fingerprints or jaxpr_rules.FINGERPRINTS_PATH
+
+    audit = None
+    if args.update_fingerprints:
+        audit = jaxpr_rules.build_audit_context()
+        fps = jaxpr_rules.current_fingerprints(audit)
+        jaxpr_rules.save_fingerprints(fps, fp_path)
+        print(f"wrote {fp_path} "
+              f"({len(audit.programs)} audited programs)")
+        if not args.update_baseline:
+            return 0
+        # fall through to the baseline rewrite, reusing the audit we just
+        # traced (against the fingerprints we just wrote)
+        audit.expected_fingerprints = fps
+
+    result = run_analysis(root=args.root, layers=layers,
+                          baseline=load_baseline(baseline_path),
+                          expected_fingerprints=fp_path,
+                          audit=audit if "jaxpr" in layers else None)
+
+    if args.update_baseline:
+        from .findings import save_baseline
+        save_baseline(baseline_path, result["all_findings"])
+        print(f"wrote {baseline_path} "
+              f"({len(result['all_findings'])} grandfathered findings)")
+        return 0
+
+    if args.json:
+        print(json.dumps(findings_to_json(result), indent=1))
+    else:
+        for f in result["findings"]:
+            print(f.render())
+        print(f"hmsc_tpu lint: {result['errors']} error(s), "
+              f"{result['warnings']} warning(s) "
+              f"({result['suppressed']} suppressed, "
+              f"{result['baselined']} baselined)", file=sys.stderr)
+    return 1 if result["errors"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(lint_main())

@@ -1,0 +1,176 @@
+"""Finding/rule framework shared by both analysis layers.
+
+A *rule* is a registered checker with a stable kebab-case id, a severity,
+and a one-line statement of the invariant it protects (rendered into the
+CLI output and ``ANALYSIS.md``).  A *finding* is one violation, pinned to
+a ``file:line``.
+
+Suppression: a ``# hmsc: ignore[rule-id]`` comment on the offending line
+(or the line directly above it) suppresses findings of that rule on that
+line; ``# hmsc: ignore[rule-a,rule-b]`` lists several, ``# hmsc: ignore``
+suppresses every rule.  Suppressions should carry a justification in the
+trailing text — the lint is a reviewer aid, not an oracle.
+
+Baseline: a committed JSON file of grandfathered findings.  Matching is by
+``(rule, path, message)`` — line numbers drift with unrelated edits, so
+they are recorded for display but ignored when matching.  Regenerate with
+``python -m hmsc_tpu lint --update-baseline``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+
+__all__ = ["Finding", "RuleInfo", "RULES", "rule", "Baseline",
+           "load_baseline", "save_baseline", "parse_suppressions",
+           "SUPPRESS_RE"]
+
+SEVERITIES = ("error", "warning")
+
+# `# hmsc: ignore` / `# hmsc: ignore[rule-a, rule-b] -- justification`
+SUPPRESS_RE = re.compile(r"#\s*hmsc:\s*ignore(?:\[([a-z0-9_,\s-]*)\])?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: str                 # "error" | "warning"
+    path: str                     # repo-relative, forward slashes
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.severity} [{self.rule}] " \
+               f"{self.message}"
+
+    def match_key(self) -> tuple:
+        """Baseline identity — line numbers excluded (they drift)."""
+        return (self.rule, self.path, self.message)
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "severity": self.severity,
+                "path": self.path, "line": self.line,
+                "message": self.message}
+
+
+@dataclasses.dataclass(frozen=True)
+class RuleInfo:
+    id: str
+    severity: str
+    layer: str                    # "ast" | "jaxpr"
+    protects: str                 # the invariant, one line
+    checker: object               # callable; signature depends on layer
+
+    def finding(self, path: str, line: int, message: str) -> Finding:
+        return Finding(self.id, self.severity, path, int(line), message)
+
+
+RULES: dict[str, RuleInfo] = {}
+
+
+def rule(id: str, severity: str, layer: str, protects: str):
+    """Register a checker under a stable rule id.
+
+    AST checkers are called as ``checker(ctx)`` with a
+    :class:`~hmsc_tpu.analysis.ast_rules.ModuleContext` and yield findings
+    for one parsed module; jaxpr checkers are called once with the audit
+    context (see :mod:`~hmsc_tpu.analysis.jaxpr_rules`)."""
+    if severity not in SEVERITIES:
+        raise ValueError(f"severity must be one of {SEVERITIES}: {severity}")
+
+    def deco(fn):
+        if id in RULES:
+            raise ValueError(f"duplicate rule id: {id}")
+        RULES[id] = RuleInfo(id=id, severity=severity, layer=layer,
+                             protects=protects, checker=fn)
+        return fn
+    return deco
+
+
+def parse_suppressions(source: str) -> dict[int, set[str] | None]:
+    """``{line_no: suppressed-rule-ids or None (= all rules)}``.
+
+    A trailing comment covers its own line; a comment-only line covers the
+    line below it (so both styles work without a trailing suppression
+    accidentally bleeding onto the next statement).  Only real COMMENT
+    tokens count — the marker inside a string literal or docstring (e.g.
+    a lint rule's own help text) must never suppress anything."""
+    import io
+    import tokenize
+
+    out: dict[int, set[str] | None] = {}
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return out                   # unparseable files produce no findings
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        ids = m.group(1)
+        val = (None if ids is None
+               else {s.strip() for s in ids.split(",") if s.strip()})
+        row, col = tok.start
+        comment_only = not tok.line[:col].strip()
+        for ln in ((row + 1,) if comment_only else (row,)):
+            prev = out.get(ln, set())
+            if val is None or prev is None:
+                out[ln] = None
+            else:
+                out[ln] = set(prev) | val
+    return out
+
+
+def is_suppressed(finding: Finding,
+                  suppressions: dict[int, set[str] | None]) -> bool:
+    sup = suppressions.get(finding.line)
+    if sup is None and finding.line in suppressions:
+        return True
+    return bool(sup) and finding.rule in sup
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+BASELINE_VERSION = 1
+
+
+class Baseline:
+    """Committed grandfathered findings; ``known`` matches by
+    ``(rule, path, message)``."""
+
+    def __init__(self, findings: list[Finding] | None = None):
+        self.findings = list(findings or [])
+        self._keys = {f.match_key() for f in self.findings}
+
+    def known(self, finding: Finding) -> bool:
+        return finding.match_key() in self._keys
+
+    def to_json(self) -> dict:
+        return {"version": BASELINE_VERSION,
+                "findings": [f.to_json() for f in sorted(
+                    self.findings,
+                    key=lambda f: (f.path, f.line, f.rule))]}
+
+
+def load_baseline(path) -> Baseline:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        return Baseline()
+    if doc.get("version") != BASELINE_VERSION:
+        raise ValueError(f"{path}: unsupported baseline version "
+                         f"{doc.get('version')!r}")
+    return Baseline([Finding(**f) for f in doc.get("findings", [])])
+
+
+def save_baseline(path, findings: list[Finding]) -> None:
+    with open(path, "w") as f:
+        json.dump(Baseline(findings).to_json(), f, indent=1, sort_keys=True)
+        f.write("\n")

@@ -1,0 +1,541 @@
+"""Layer 2 — jaxpr audits of the traced sampling programs.
+
+Abstract-evals every registered updater (``hmsc_tpu.mcmc.registry``), the
+assembled sweep, and the jitted segment runner on canonical small specs,
+then audits the *programs* rather than the source:
+
+- ``jaxpr-f64``: no float64/complex128 anywhere in the traced program.
+  Tracing runs under ``jax.experimental.enable_x64`` with f32 inputs, so
+  any op that fails to derive its dtype from its inputs (a bare
+  ``jnp.ones(n)``, an np-computed constant) surfaces as a leak — under
+  the production x64-off config the same site silently downcasts, which
+  is why no runtime test can pin it.
+- ``jaxpr-host-callback``: no ``pure_callback``/``io_callback``/
+  ``debug_callback`` primitives in the sweep or the segment runner — the
+  hot loop never re-enters Python.
+- ``jaxpr-large-const``: no constant baked into a jaxpr above a size
+  threshold (model data rides in as arguments; a large closed-over
+  constant is duplicated per executable and bloats HBM).
+- ``jaxpr-donation``: the segment runner's lowering actually establishes
+  input→output aliasing for every carry leaf (donation configured but
+  not established doubles steady-state HBM).
+- ``jaxpr-recompile``: the sweep's *shape-blind* structure is identical
+  across a small shape sweep — a program whose structure varies with
+  array dims recompiles per shape in production.
+- ``jaxpr-fingerprint``: each audited program's structural fingerprint
+  matches the committed ``fingerprints.json``; any change to the compiled
+  surface therefore shows up in review as a one-line diff.  Regenerate
+  with ``python -m hmsc_tpu lint --update-fingerprints``.
+- ``jaxpr-registry-coverage``: every registered updater is exercised by
+  at least one canonical spec (the audit cannot silently skip one).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+
+from .findings import RULES, rule
+
+__all__ = ["run_jaxpr_rules", "build_audit_context", "JaxprAudit",
+           "fingerprint_jaxpr", "FINGERPRINTS_PATH", "load_fingerprints",
+           "save_fingerprints", "LARGE_CONST_BYTES"]
+
+FINGERPRINTS_PATH = os.path.join(os.path.dirname(__file__),
+                                 "fingerprints.json")
+FINGERPRINTS_VERSION = 1
+
+# constants above this baked into a traced program are HBM bloat: model
+# data arrays must ride in as arguments, not closure constants
+LARGE_CONST_BYTES = 256 * 1024
+
+# host-callback primitives that would re-enter Python from the hot loop
+_CALLBACK_PRIMS = {"pure_callback", "io_callback", "debug_callback",
+                   "callback", "host_callback_call", "outside_call"}
+
+
+@dataclasses.dataclass
+class AuditProgram:
+    name: str                     # e.g. "updater:BetaLambda", "sweep@base"
+    path: str                     # repo-relative module the program lives in
+    closed: object                # ClosedJaxpr (production trace)
+    closed_x64: object            # ClosedJaxpr traced under enable_x64
+    x64_error: str | None = None  # x64 trace failure (itself an f64 leak:
+    #                               a scan carry changed dtype mid-sweep)
+
+
+@dataclasses.dataclass
+class JaxprAudit:
+    programs: list
+    runner_text: str              # segment-runner lowering (StableHLO text)
+    runner_n_carry_leaves: int
+    sweep_shape_variants: dict    # shape-blind fp -> [size labels]
+    expected_fingerprints: dict | None
+    missing_updaters: list
+
+
+# ---------------------------------------------------------------------------
+# canonical specs
+# ---------------------------------------------------------------------------
+
+def _canonical_models():
+    """Small deterministic models that, together, exercise every
+    registered updater: ``base`` (probit + traits + phylo + one
+    unstructured level), ``spatial`` (Full GP level), ``rrr`` and ``sel``
+    (reduced-rank / spike-and-slab designs)."""
+    import numpy as np
+    import pandas as pd
+
+    from ..model import Hmsc, XSelect
+    from ..random_level import HmscRandomLevel, set_priors_random_level
+
+    def _design(rng, ny, nc):
+        return np.column_stack([np.ones(ny),
+                                rng.standard_normal((ny, nc - 1))])
+
+    def _units(rng, ny, n_units):
+        units = [f"u{i:02d}" for i in rng.integers(0, n_units, ny)]
+        for i in range(n_units):
+            units[i % ny] = f"u{i:02d}"
+        return units
+
+    models = {}
+
+    def base(ny=12, ns=4):
+        rng = np.random.default_rng(11)
+        X = _design(rng, ny, 2)
+        Y = (rng.standard_normal((ny, ns)) > 0).astype(float)
+        study = pd.DataFrame({"lvl": _units(rng, ny, 5)})
+        rl = HmscRandomLevel(units=study["lvl"])
+        set_priors_random_level(rl, nf_max=2, nf_min=2)
+        from ..data.td import random_coalescent_corr
+        Tr = np.column_stack([np.ones(ns), rng.standard_normal(ns)])
+        return Hmsc(Y=Y, X=X, distr="probit", study_design=study,
+                    ran_levels={"lvl": rl}, Tr=Tr,
+                    C=random_coalescent_corr(ns, rng))
+
+    models["base"] = base
+
+    def spatial():
+        rng = np.random.default_rng(12)
+        ny, ns, n_units = 12, 3, 6
+        X = _design(rng, ny, 2)
+        Y = rng.standard_normal((ny, ns))
+        units = _units(rng, ny, n_units)
+        study = pd.DataFrame({"lvl": units})
+        s_df = pd.DataFrame(rng.uniform(size=(n_units, 2)),
+                            index=sorted(set(units)), columns=["x", "y"])
+        rl = HmscRandomLevel(s_data=s_df, s_method="Full")
+        set_priors_random_level(rl, nf_max=2, nf_min=2)
+        return Hmsc(Y=Y, X=X, distr="normal", study_design=study,
+                    ran_levels={"lvl": rl})
+
+    models["spatial"] = spatial
+
+    def rrr():
+        rng = np.random.default_rng(13)
+        ny, ns = 12, 3
+        X = _design(rng, ny, 2)
+        XRRR = rng.standard_normal((ny, 2))
+        Y = rng.standard_normal((ny, ns))
+        return Hmsc(Y=Y, X=X, XRRR=XRRR, nc_rrr=1, distr="normal")
+
+    models["rrr"] = rrr
+
+    def sel():
+        rng = np.random.default_rng(14)
+        ny, ns = 12, 4
+        X = _design(rng, ny, 2)
+        Y = (rng.standard_normal((ny, ns)) > 0).astype(float)
+        s = XSelect(cov_group=[1], sp_group=[0, 0, 1, 1], q=[0.5, 0.5])
+        return Hmsc(Y=Y, X=X, x_select=[s], distr="probit")
+
+    models["sel"] = sel
+    return models
+
+
+def _build(hM, nf_cap=2, seed=0):
+    from ..precompute import compute_data_parameters
+    from ..mcmc.structs import build_model_data, build_spec, build_state
+    spec = build_spec(hM, nf_cap)
+    data = build_model_data(hM, compute_data_parameters(hM), spec)
+    state = build_state(hM, spec, seed)
+    return spec, data, state
+
+
+# ---------------------------------------------------------------------------
+# fingerprinting
+# ---------------------------------------------------------------------------
+
+def _aval_sig(v, shape_blind: bool) -> str:
+    aval = v.aval
+    shape = "r%d" % len(aval.shape) if shape_blind else list(aval.shape)
+    return f"{aval.dtype}{shape}"
+
+
+def _serialize(jaxpr, depth, lines, shape_blind):
+    import jax.core as jcore
+    for eqn in jaxpr.eqns:
+        ins = ",".join(
+            ("lit" if isinstance(v, jcore.Literal) else "") +
+            _aval_sig(v, shape_blind) for v in eqn.invars)
+        outs = ",".join(_aval_sig(v, shape_blind) for v in eqn.outvars)
+        lines.append(f"{depth}:{eqn.primitive.name}({ins})->({outs})")
+        for sub in _sub_jaxprs(eqn):
+            _serialize(sub, depth + 1, lines, shape_blind)
+
+
+def _sub_jaxprs(eqn):
+    """Nested jaxprs inside an eqn's params (scan/cond/pjit/...)."""
+    import jax.core as jcore
+    out = []
+
+    def visit(v):
+        if isinstance(v, jcore.ClosedJaxpr):
+            out.append(v.jaxpr)
+        elif isinstance(v, jcore.Jaxpr):
+            out.append(v)
+        elif isinstance(v, (tuple, list)):
+            for x in v:
+                visit(x)
+
+    for v in eqn.params.values():
+        visit(v)
+    return out
+
+
+def fingerprint_jaxpr(closed, shape_blind: bool = False) -> dict:
+    """Stable structural fingerprint of a ClosedJaxpr: primitive sequence
+    with in/out dtypes+shapes (ranks only when ``shape_blind``), hashed.
+    Variable names and constant *values* are excluded, so the fingerprint
+    moves exactly when the compiled surface does."""
+    lines: list[str] = []
+    _serialize(closed.jaxpr, 0, lines, shape_blind)
+    blob = "\n".join(lines).encode()
+    prims: dict[str, int] = {}
+    for ln in lines:
+        p = ln.split(":", 1)[1].split("(", 1)[0]
+        prims[p] = prims.get(p, 0) + 1
+    return {"sha256": hashlib.sha256(blob).hexdigest()[:16],
+            "n_eqns": len(lines),
+            "prims": dict(sorted(prims.items()))}
+
+
+def load_fingerprints(path=FINGERPRINTS_PATH) -> dict | None:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        return None
+    if doc.get("version") != FINGERPRINTS_VERSION:
+        return None
+    return doc.get("programs", {})
+
+
+def save_fingerprints(programs: dict, path=FINGERPRINTS_PATH) -> None:
+    with open(path, "w") as f:
+        json.dump({"version": FINGERPRINTS_VERSION,
+                   "jax": __import__("jax").__version__,
+                   "programs": dict(sorted(programs.items()))},
+                  f, indent=1, sort_keys=False)
+        f.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# audit-context construction (the tracing pass)
+# ---------------------------------------------------------------------------
+
+_MOD_PATHS = {
+    "updaters": "hmsc_tpu/mcmc/updaters.py",
+    "updaters_sel": "hmsc_tpu/mcmc/updaters_sel.py",
+    "updaters_marginal": "hmsc_tpu/mcmc/updaters_marginal.py",
+    "spatial": "hmsc_tpu/mcmc/spatial.py",
+}
+
+
+def build_audit_context(expected_fingerprints=None) -> JaxprAudit:
+    """Trace every registered updater + sweep + segment runner on the
+    canonical specs.  Pure abstract evaluation — nothing compiles except
+    the segment runner's (StableHLO-only) lowering."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import enable_x64
+
+    from ..mcmc.registry import UPDATER_REGISTRY
+    from ..mcmc.sweep import make_sweep
+
+    models = _canonical_models()
+    built = {name: _build(fn()) for name, fn in models.items()}
+
+    # fresh exemplar key per trace (abstract eval never draws, but the
+    # audited code must still see a key-typed input of the production impl)
+    def _k():
+        return jax.random.key(0, impl="threefry2x32")
+
+    def _trace_pair(f, *args):
+        closed = jax.make_jaxpr(f)(*args)
+        try:
+            with enable_x64():
+                closed_x64 = jax.make_jaxpr(f)(*args)
+        except Exception as e:     # noqa: BLE001 — surfaced as a finding
+            return closed, None, f"{type(e).__name__}: {str(e)[:300]}"
+        return closed, closed_x64, None
+
+    programs: list[AuditProgram] = []
+    covered: set[str] = set()
+
+    for entry in UPDATER_REGISTRY:
+        for mname, (spec, data, state) in built.items():
+            if not entry.applies(spec, data):
+                continue
+            wrapped = (lambda e, s: lambda d, st, k: e.fn(s, d, st, k))(
+                entry, spec)
+            closed, closed_x64, err = _trace_pair(wrapped, data, state,
+                                                  _k())
+            programs.append(AuditProgram(
+                name=f"updater:{entry.name}",
+                path=_MOD_PATHS.get(entry.module,
+                                    "hmsc_tpu/mcmc/updaters.py"),
+                closed=closed, closed_x64=closed_x64, x64_error=err))
+            covered.add(entry.name)
+            break                  # first applicable canonical spec
+
+    missing = [e.name for e in UPDATER_REGISTRY if e.name not in covered]
+
+    # the assembled sweep, per canonical spec
+    for mname, (spec, data, state) in built.items():
+        sweep = make_sweep(spec, None, tuple(0 for _ in range(spec.nr)))
+        closed, closed_x64, err = _trace_pair(sweep, data, state, _k())
+        programs.append(AuditProgram(
+            name=f"sweep@{mname}", path="hmsc_tpu/mcmc/sweep.py",
+            closed=closed, closed_x64=closed_x64, x64_error=err))
+
+    # segment runner: traced jaxpr + lowering (donation aliasing lives in
+    # the lowering, not the jaxpr)
+    from ..mcmc import sampler as sampler_mod
+    from ..mcmc import spatial as spatial_mod
+    spec, data, state = built["base"]
+    states = jax.tree.map(lambda x: jnp.stack([x, x]), state)
+    keys = jax.vmap(
+        lambda s: jax.random.key(s, impl="threefry2x32"))(jnp.arange(2))
+    bad = jnp.full((2,), -1, jnp.int32)
+    fn = sampler_mod._compiled_runner(
+        spec, None, tuple(0 for _ in range(spec.nr)), 2, 1, 1, False, None,
+        spatial_mod._NNGP_DENSE_MAX)
+    runner_closed, runner_closed_x64, err = _trace_pair(fn, data, states,
+                                                        keys, bad)
+    programs.append(AuditProgram(
+        name="segment_runner@base", path="hmsc_tpu/mcmc/sampler.py",
+        closed=runner_closed, closed_x64=runner_closed_x64, x64_error=err))
+    runner_text = fn.lower(data, states, keys, bad).as_text()
+    n_carry = len(jax.tree_util.tree_leaves(states))
+
+    # shape sweep: the sweep's shape-blind structure must not vary
+    variants: dict[str, list] = {}
+    for ny, ns in ((12, 4), (16, 5), (20, 6)):
+        spec_i, data_i, state_i = _build(models["base"](ny=ny, ns=ns))
+        sweep_i = make_sweep(spec_i, None,
+                             tuple(0 for _ in range(spec_i.nr)))
+        closed_i = jax.make_jaxpr(sweep_i)(data_i, state_i, _k())
+        fp = fingerprint_jaxpr(closed_i, shape_blind=True)["sha256"]
+        variants.setdefault(fp, []).append(f"ny={ny},ns={ns}")
+
+    return JaxprAudit(
+        programs=programs, runner_text=runner_text,
+        runner_n_carry_leaves=n_carry, sweep_shape_variants=variants,
+        expected_fingerprints=expected_fingerprints,
+        missing_updaters=missing)
+
+
+def run_jaxpr_rules(audit: JaxprAudit):
+    for info in RULES.values():
+        if info.layer != "jaxpr":
+            continue
+        yield from info.checker(audit)
+
+
+def current_fingerprints(audit: JaxprAudit) -> dict:
+    return {p.name: fingerprint_jaxpr(p.closed) for p in audit.programs}
+
+
+# ---------------------------------------------------------------------------
+# the audit rules
+# ---------------------------------------------------------------------------
+
+def _all_vars(jaxpr):
+    import jax.core as jcore
+    stack = [jaxpr]
+    while stack:
+        j = stack.pop()
+        for v in list(j.invars) + list(j.constvars):
+            yield v
+        for eqn in j.eqns:
+            for v in eqn.outvars:
+                yield v
+            for v in eqn.invars:
+                if isinstance(v, jcore.Literal):
+                    yield v
+            stack.extend(_sub_jaxprs(eqn))
+
+
+def _all_prims(jaxpr):
+    stack = [jaxpr]
+    while stack:
+        j = stack.pop()
+        for eqn in j.eqns:
+            yield eqn
+            stack.extend(_sub_jaxprs(eqn))
+
+
+@rule("jaxpr-f64", "error", "jaxpr",
+      "dtype policy: no float64/complex128 in any traced program — every "
+      "op derives its dtype from its inputs (audited under enable_x64, "
+      "where an unpinned dtype surfaces instead of silently downcasting)")
+def check_f64(audit: JaxprAudit):
+    findings = []
+    info = RULES["jaxpr-f64"]
+    for p in audit.programs:
+        if p.closed_x64 is None:
+            findings.append(info.finding(
+                p.path, 1,
+                f"{p.name}: trace under enable_x64 failed — an op inside "
+                f"does not derive its dtype from its inputs "
+                f"({p.x64_error})"))
+            continue
+        bad: dict[str, int] = {}
+        for v in _all_vars(p.closed_x64.jaxpr):
+            dt = str(getattr(v.aval, "dtype", ""))
+            # weak-typed f64 (a bare Python-float literal) never
+            # materialises: it promotes to its operand's dtype
+            if dt in ("float64", "complex128") \
+                    and not getattr(v.aval, "weak_type", False):
+                bad[dt] = bad.get(dt, 0) + 1
+        if bad:
+            findings.append(info.finding(
+                p.path, 1,
+                f"{p.name}: {sum(bad.values())} {'/'.join(sorted(bad))} "
+                f"values in the x64 trace — some op does not derive its "
+                f"dtype from its inputs"))
+    return findings
+
+
+@rule("jaxpr-host-callback", "error", "jaxpr",
+      "the hot loop never re-enters Python: no pure_callback/io_callback/"
+      "debug_callback primitives in the sweep or segment runner")
+def check_host_callback(audit: JaxprAudit):
+    findings = []
+    info = RULES["jaxpr-host-callback"]
+    for p in audit.programs:
+        hits: dict[str, int] = {}
+        for eqn in _all_prims(p.closed.jaxpr):
+            if eqn.primitive.name in _CALLBACK_PRIMS:
+                hits[eqn.primitive.name] = hits.get(eqn.primitive.name,
+                                                    0) + 1
+        for prim, n in sorted(hits.items()):
+            findings.append(info.finding(
+                p.path, 1, f"{p.name}: {n}x `{prim}` primitive in the "
+                           f"traced program"))
+    return findings
+
+
+@rule("jaxpr-large-const", "error", "jaxpr",
+      "model data rides in as arguments: no constant larger than "
+      f"{LARGE_CONST_BYTES // 1024} KiB baked into a traced program "
+      "(per-executable HBM bloat)")
+def check_large_const(audit: JaxprAudit):
+    findings = []
+    info = RULES["jaxpr-large-const"]
+    for p in audit.programs:
+        for c in p.closed.consts:
+            nbytes = int(getattr(c, "nbytes", 0))
+            if nbytes > LARGE_CONST_BYTES:
+                shape = getattr(c, "shape", ())
+                findings.append(info.finding(
+                    p.path, 1,
+                    f"{p.name}: baked-in constant of {nbytes} bytes "
+                    f"(shape {tuple(shape)}) — pass it as an argument"))
+    return findings
+
+
+@rule("jaxpr-donation", "error", "jaxpr",
+      "the segment runner's carry donation is actually established in the "
+      "lowering (one carry copy in HBM, not two)")
+def check_donation(audit: JaxprAudit):
+    info = RULES["jaxpr-donation"]
+    # + 2: the key array and the divergence tracker are donated alongside
+    # the state pytree (sampler._compiled_runner donate_argnums=(1, 2, 3))
+    want = audit.runner_n_carry_leaves + 2
+    got = audit.runner_text.count("tf.aliasing_output")
+    if got < want:
+        return [info.finding(
+            "hmsc_tpu/mcmc/sampler.py", 1,
+            f"segment runner lowering establishes only {got} input→output "
+            f"aliases; expected ≥ {want} (state leaves + keys + "
+            f"divergence tracker)")]
+    return []
+
+
+@rule("jaxpr-recompile", "error", "jaxpr",
+      "bounded shape specialisation: the sweep's shape-blind structure is "
+      "identical across a shape sweep (structure varying with dims means "
+      "one recompile per shape in production)")
+def check_recompile(audit: JaxprAudit):
+    info = RULES["jaxpr-recompile"]
+    if len(audit.sweep_shape_variants) <= 1:
+        return []
+    desc = "; ".join(f"{fp[:8]}…: {sizes}" for fp, sizes
+                     in sorted(audit.sweep_shape_variants.items()))
+    return [info.finding(
+        "hmsc_tpu/mcmc/sweep.py", 1,
+        f"{len(audit.sweep_shape_variants)} distinct shape-blind sweep "
+        f"structures across the shape sweep ({desc})")]
+
+
+@rule("jaxpr-registry-coverage", "error", "jaxpr",
+      "every registered updater is exercised by at least one canonical "
+      "audit spec")
+def check_coverage(audit: JaxprAudit):
+    info = RULES["jaxpr-registry-coverage"]
+    return [info.finding(
+        "hmsc_tpu/mcmc/registry.py", 1,
+        f"updater `{name}` has no applicable canonical spec — extend "
+        f"_canonical_models() so the audit covers it")
+        for name in audit.missing_updaters]
+
+
+@rule("jaxpr-fingerprint", "error", "jaxpr",
+      "each audited program's structural fingerprint matches the committed "
+      "fingerprints.json (changes to the compiled surface are review-"
+      "visible; regenerate with --update-fingerprints)")
+def check_fingerprint(audit: JaxprAudit):
+    findings = []
+    info = RULES["jaxpr-fingerprint"]
+    expected = audit.expected_fingerprints
+    if expected is None:
+        return [info.finding(
+            "hmsc_tpu/analysis/fingerprints.json", 1,
+            "fingerprints.json missing or unreadable — run "
+            "`python -m hmsc_tpu lint --update-fingerprints`")]
+    current = current_fingerprints(audit)
+    for name, fp in sorted(current.items()):
+        exp = expected.get(name)
+        if exp is None:
+            findings.append(info.finding(
+                "hmsc_tpu/analysis/fingerprints.json", 1,
+                f"{name}: no committed fingerprint — run "
+                f"--update-fingerprints"))
+        elif exp.get("sha256") != fp["sha256"]:
+            findings.append(info.finding(
+                "hmsc_tpu/analysis/fingerprints.json", 1,
+                f"{name}: traced structure changed "
+                f"({exp.get('sha256')} → {fp['sha256']}, "
+                f"{exp.get('n_eqns')} → {fp['n_eqns']} eqns) — review, "
+                f"then --update-fingerprints"))
+    for name in sorted(set(expected) - set(current)):
+        findings.append(info.finding(
+            "hmsc_tpu/analysis/fingerprints.json", 1,
+            f"{name}: committed fingerprint has no audited program "
+            f"(stale entry) — run --update-fingerprints"))
+    return findings

@@ -1,0 +1,126 @@
+"""Analysis driver: walks the package, runs both layers, applies
+suppressions + baseline, and shapes the result for the CLI/tests/bench."""
+
+from __future__ import annotations
+
+import os
+
+from . import ast_rules  # noqa: F401 — registers the layer-1 rules
+from . import jaxpr_rules  # noqa: F401 — registers the layer-2 rules
+from .findings import (RULES, Baseline, Finding, is_suppressed,
+                       load_baseline, parse_suppressions)
+
+__all__ = ["package_root", "repo_root", "iter_module_contexts",
+           "run_analysis", "findings_to_json", "analysis_summary",
+           "BASELINE_PATH"]
+
+BASELINE_PATH = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def repo_root() -> str:
+    return os.path.dirname(package_root())
+
+
+def iter_module_contexts(root: str | None = None):
+    """Parse every library module under ``hmsc_tpu/`` (repo-relative
+    paths, deterministic order)."""
+    root = root or package_root()
+    base = os.path.dirname(root)
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, base).replace(os.sep, "/")
+            with open(path) as f:
+                source = f.read()
+            yield ast_rules.ModuleContext.parse(rel, source), path
+
+
+def run_analysis(root: str | None = None,
+                 layers: tuple = ("ast", "jaxpr"),
+                 baseline: Baseline | None = None,
+                 expected_fingerprints: dict | str | None = "auto",
+                 audit=None) -> dict:
+    """Run the suite.  Returns::
+
+        {"findings": [Finding...],      # active (not suppressed/baselined)
+         "errors": int, "warnings": int,
+         "suppressed": int, "baselined": int,
+         "all_findings": [...],         # pre-filter, for --update-baseline
+         "audit": JaxprAudit | None}
+    """
+    if baseline is None:
+        baseline = load_baseline(BASELINE_PATH)
+
+    raw: list[Finding] = []
+    suppressed = 0
+
+    if "ast" in layers:
+        for ctx, _path in iter_module_contexts(root):
+            sup = parse_suppressions(ctx.source)
+            for f in ast_rules.run_ast_rules(ctx):
+                if is_suppressed(f, sup):
+                    suppressed += 1
+                else:
+                    raw.append(f)
+
+    if "jaxpr" not in layers:
+        audit = None
+    elif audit is None:              # a prebuilt audit skips the retrace
+        exp = expected_fingerprints
+        if exp == "auto":
+            exp = jaxpr_rules.load_fingerprints()
+        elif isinstance(exp, str):
+            exp = jaxpr_rules.load_fingerprints(exp)
+        audit = jaxpr_rules.build_audit_context(expected_fingerprints=exp)
+    if audit is not None:
+        raw.extend(jaxpr_rules.run_jaxpr_rules(audit))
+
+    active, baselined = [], 0
+    for f in raw:
+        if baseline.known(f):
+            baselined += 1
+        else:
+            active.append(f)
+    active.sort(key=lambda f: (f.path, f.line, f.rule))
+    return {
+        "findings": active,
+        "errors": sum(1 for f in active if f.severity == "error"),
+        "warnings": sum(1 for f in active if f.severity == "warning"),
+        "suppressed": suppressed,
+        "baselined": baselined,
+        "all_findings": raw,
+        "audit": audit,
+    }
+
+
+def findings_to_json(result: dict) -> dict:
+    """The ``--json`` output schema (version-stamped; tests pin it)."""
+    per_rule: dict[str, int] = {}
+    for f in result["findings"]:
+        per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+    return {
+        "version": 1,
+        "errors": result["errors"],
+        "warnings": result["warnings"],
+        "suppressed": result["suppressed"],
+        "baselined": result["baselined"],
+        "findings": [f.to_json() for f in result["findings"]],
+        "rules": {rid: {"severity": info.severity, "layer": info.layer,
+                        "protects": info.protects,
+                        "count": per_rule.get(rid, 0)}
+                  for rid, info in sorted(RULES.items())},
+    }
+
+
+def analysis_summary(layers: tuple = ("ast", "jaxpr")) -> dict:
+    """Small digest for bench records: finding counts only."""
+    r = run_analysis(layers=layers)
+    return {"errors": r["errors"], "warnings": r["warnings"],
+            "suppressed": r["suppressed"], "baselined": r["baselined"]}

@@ -1,0 +1,135 @@
+"""Registry of Gibbs-block updaters for static audit and tooling.
+
+The sweep (:mod:`.sweep`) assembles these blocks positionally at trace
+time; nothing at runtime needs a registry.  The static-analysis layer
+(:mod:`hmsc_tpu.analysis.jaxpr_rules`) does: it abstract-evals *every
+registered updater* on canonical small specs and audits the traced
+programs (dtype policy, host callbacks, baked constants, structural
+fingerprints).  Each entry wraps one updater into the uniform signature
+``fn(spec, data, state, key) -> state-pytree`` with the same auxiliary
+inputs (residual ``S``, total random-level loading) the sweep computes,
+and declares via ``applies(spec, data)`` which model classes exercise it.
+
+Adding a Gibbs block without registering it here fails the analyzer's
+coverage check (``jaxpr-registry-coverage``), so the registry cannot
+silently go stale.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from . import updaters as U
+from . import updaters_sel as USel
+from .spatial import update_alpha, update_eta_spatial
+
+__all__ = ["UpdaterEntry", "UPDATER_REGISTRY", "applicable_updaters"]
+
+
+@dataclasses.dataclass(frozen=True)
+class UpdaterEntry:
+    name: str                  # the sweep's toggle name (updater={...} key)
+    fn: object                 # (spec, data, state, key) -> pytree
+    applies: object            # (spec, data) -> bool
+    module: str                # implementation home, for the audit report
+
+
+def _eta_residual(spec, data, state):
+    """The residual the sweep hands update_eta for level 0: Z minus the
+    fixed part and every *other* level's loading."""
+    S = state.Z - U.linear_fixed(spec, data, state.Beta)
+    for q in range(spec.nr):
+        if q != 0:
+            S = S - U.level_loading(data.levels[q], state.levels[q])
+    return S
+
+
+def _lran_total(spec, data, state):
+    if spec.nr == 0:
+        import jax.numpy as jnp
+        return jnp.zeros_like(state.Z)
+    return sum(U.level_loading(data.levels[r], state.levels[r])
+               for r in range(spec.nr))
+
+
+def _gamma_eta_ok(which):
+    def applies(spec, data):
+        from .updaters_marginal import gamma_eta_gates
+        return not gamma_eta_gates(spec, data.mGamma)[which]
+    return applies
+
+
+_R = []
+
+
+def _register(name, fn, applies=lambda spec, data: True, module="updaters"):
+    _R.append(UpdaterEntry(name=name, fn=fn, applies=applies, module=module))
+
+
+# the collapsed updaters import lazily inside their wrappers (matching the
+# sweep's deferred import, so merely listing the registry never pays the
+# module import)
+def _gamma2(s, d, st, k):
+    from .updaters_marginal import update_gamma2
+    return update_gamma2(s, d, st, k)
+
+
+def _gamma_eta(s, d, st, k):
+    from .updaters_marginal import update_gamma_eta
+    return update_gamma_eta(s, d, st, 0, k)
+
+
+_register("Z", lambda s, d, st, k: U.update_z(s, d, st, k))
+_register("BetaLambda", lambda s, d, st, k: U.update_beta_lambda(s, d, st, k))
+_register("GammaV", lambda s, d, st, k: U.update_gamma_v(s, d, st, k))
+_register("Rho", lambda s, d, st, k: U.update_rho(s, d, st, k),
+          applies=lambda s, d: s.has_phylo)
+_register("LambdaPriors",
+          lambda s, d, st, k: U.update_lambda_priors(s, d, st, k))
+_register("InvSigma", lambda s, d, st, k: U.update_inv_sigma(s, d, st, k))
+_register("Eta",
+          lambda s, d, st, k: U.update_eta_nonspatial(
+              s, d, st, 0, k, _eta_residual(s, d, st)),
+          applies=lambda s, d: s.nr > 0 and s.levels[0].spatial is None)
+_register("EtaSpatial",
+          lambda s, d, st, k: update_eta_spatial(
+              s, d, st, 0, k, _eta_residual(s, d, st)),
+          applies=lambda s, d: s.nr > 0 and s.levels[0].spatial is not None,
+          module="spatial")
+_register("Alpha", lambda s, d, st, k: update_alpha(s, d, st, 0, k),
+          applies=lambda s, d: s.nr > 0 and s.levels[0].spatial is not None,
+          module="spatial")
+_register("Nf", lambda s, d, st, k: U.update_nf(s, d, st, 0, k),
+          applies=lambda s, d: s.nr > 0)
+_register("Interweave", lambda s, d, st, k: U.interweave_scale(s, d, st, k),
+          applies=lambda s, d: s.nr > 0)
+_register("InterweaveLocation",
+          lambda s, d, st, k: U.interweave_location(s, d, st, k),
+          applies=lambda s, d: s.nr > 0 and d.x_ones_ind is not None)
+_register("InterweaveDA",
+          lambda s, d, st, k: U.interweave_da_intercept(s, d, st, k),
+          applies=lambda s, d: (s.any_probit and not s.x_is_list
+                                and d.x_ones_ind is not None))
+_register("wRRR",
+          lambda s, d, st, k: USel.update_w_rrr(
+              s, d, st, k, _lran_total(s, d, st)),
+          applies=lambda s, d: s.nc_rrr > 0, module="updaters_sel")
+_register("wRRRPriors",
+          lambda s, d, st, k: USel.update_w_rrr_priors(s, d, st, k),
+          applies=lambda s, d: s.nc_rrr > 0, module="updaters_sel")
+_register("BetaSel",
+          lambda s, d, st, k: USel.update_beta_sel(
+              s, d, st, k, _lran_total(s, d, st)),
+          applies=lambda s, d: s.ncsel > 0, module="updaters_sel")
+_register("Gamma2", _gamma2, applies=_gamma_eta_ok("Gamma2"),
+          module="updaters_marginal")
+_register("GammaEta", _gamma_eta, applies=_gamma_eta_ok("GammaEta"),
+          module="updaters_marginal")
+
+UPDATER_REGISTRY: tuple[UpdaterEntry, ...] = tuple(_R)
+del _R
+
+
+def applicable_updaters(spec, data) -> list[UpdaterEntry]:
+    """Registry entries the given model class exercises."""
+    return [e for e in UPDATER_REGISTRY if e.applies(spec, data)]

@@ -71,6 +71,10 @@ class _SegmentWriter:
     point before :class:`~hmsc_tpu.utils.checkpoint.PreemptedRun` unwinds
     and before the run returns."""
 
+    # the captured failure crosses threads (set by the worker, swapped out
+    # by the driver); `hmsc_tpu lint` enforces the declaration below
+    # hmsc: guarded-by[_err_lock]: _err
+
     def __init__(self, depth: int = 2):
         import queue
         import threading
@@ -78,6 +82,7 @@ class _SegmentWriter:
             raise ValueError(f"pipeline_depth must be >= 1, got {depth}")
         self._q = queue.Queue(maxsize=int(depth))
         self._err = None
+        self._err_lock = threading.Lock()
         self.max_depth_seen = 0
         self.busy_s = 0.0
         self._thread = threading.Thread(
@@ -91,18 +96,22 @@ class _SegmentWriter:
             try:
                 if item is None:
                     return
-                if self._err is None:      # skip work after a failure
+                with self._err_lock:       # skip work after a failure
+                    failed = self._err is not None
+                if not failed:
                     t0 = time.perf_counter()
                     item()
                     self.busy_s += time.perf_counter() - t0
             except BaseException as e:     # noqa: BLE001 — delivered to driver
-                self._err = e
+                with self._err_lock:
+                    self._err = e
             finally:
                 self._q.task_done()
 
     def _check(self):
-        if self._err is not None:
+        with self._err_lock:
             err, self._err = self._err, None
+        if err is not None:
             raise err
 
     def submit(self, fn):

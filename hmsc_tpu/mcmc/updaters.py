@@ -83,7 +83,9 @@ def _stacked_lambda_prior(spec: ModelSpec, state: GibbsState) -> jnp.ndarray:
         pr = lv.Psi * tau[:, None, :]            # (nf, ns, ncr)
         rows.append(jnp.transpose(pr, (2, 0, 1)).reshape(-1, spec.ns))
     if not rows:
-        return jnp.zeros((0, spec.ns))
+        # dtype pinned: an unpinned empty block would promote the whole
+        # joint BetaLambda precision to f64 under an x64 config
+        return jnp.zeros((0, spec.ns), dtype=state.Z.dtype)
     return jnp.concatenate(rows, axis=0)
 
 
@@ -511,7 +513,9 @@ def interweave_scale(spec: ModelSpec, data: ModelData, state: GibbsState,
         tau = jnp.cumprod(delta, axis=0)                  # (nf, ncr)
         B = (lv.Psi * tau[:, None, :] * lv.Lambda ** 2).sum(axis=(1, 2))
         k_exp = ls.n_units - spec.ns * ls.ncr
-        sigma = 2.38 / np.sqrt(2.0 * (ls.n_units + spec.ns * ls.ncr))
+        # float(): a bare np.float64 scalar is strong-typed and would
+        # upcast the whole proposal under an x64 config
+        sigma = float(2.38 / np.sqrt(2.0 * (ls.n_units + spec.ns * ls.ncr)))
         u = sigma * jax.random.normal(kr1, (ls.nf_max,), dtype=A.dtype)
         c = jnp.exp(u)
         log_acc = (-0.5 * A * (c ** 2 - 1.0)
@@ -710,7 +714,8 @@ def update_nf(spec: ModelSpec, data: ModelData, state: GibbsState, r: int,
     ku, kadd = jax.random.split(jax.random.fold_in(key, r))
     k_eta, k_psi, k_del = jax.random.split(kadd, 3)
     it = state.it.astype(lv.Eta.dtype)
-    adapt = jax.random.uniform(ku) < 1.0 / jnp.exp(1.0 + 5e-4 * it)
+    adapt = jax.random.uniform(ku, dtype=it.dtype) \
+        < 1.0 / jnp.exp(1.0 + 5e-4 * it)
 
     mask = lv.nf_mask
     nf = mask.sum()

@@ -134,7 +134,8 @@ def _factor_prior_precision(ls, lvd, lv):
     spatial grids."""
     nf, npr = ls.nf_max, ls.n_units
     if ls.spatial is None:
-        return jnp.broadcast_to(jnp.eye(npr), (nf, npr, npr))
+        return jnp.broadcast_to(jnp.eye(npr, dtype=lv.Eta.dtype),
+                                (nf, npr, npr))
     if ls.spatial == "Full":
         return lvd.iWg[lv.alpha_idx]                     # (nf, np, np)
     if ls.spatial == "NNGP":
@@ -142,18 +143,18 @@ def _factor_prior_precision(ls, lvd, lv):
         coef = lvd.nn_coef[lv.alpha_idx]                 # (nf, np, k)
         D = lvd.nn_D[lv.alpha_idx]                       # (nf, np)
         k = coef.shape[-1]
-        A = jnp.zeros((nf, npr, npr))
+        A = jnp.zeros((nf, npr, npr), dtype=coef.dtype)
         rows = jnp.broadcast_to(jnp.arange(npr)[None, :, None], (nf, npr, k))
         cols = jnp.broadcast_to(lvd.nn_idx[None], (nf, npr, k))
         A = A.at[jnp.arange(nf)[:, None, None], rows, cols].add(coef)
-        B = jnp.eye(npr)[None] - A
+        B = jnp.eye(npr, dtype=coef.dtype)[None] - A
         return jnp.einsum("fqp,fq,fqr->fpr", B, 1.0 / D, B)
     # GPP: K = W12 iW22 W21 + diag(dD); Woodbury with stored F = W22 + W21 idD W12
     idD = lvd.idDg[lv.alpha_idx]                         # (nf, np)
     idDW12 = lvd.idDW12g[lv.alpha_idx]                   # (nf, np, nK)
     iF = lvd.iFg[lv.alpha_idx]                           # (nf, nK, nK)
     corr = jnp.einsum("fpk,fkl,fql->fpq", idDW12, iF, idDW12)
-    return jnp.eye(npr)[None] * idD[:, :, None] - corr
+    return jnp.eye(npr, dtype=idD.dtype)[None] * idD[:, :, None] - corr
 
 
 def _w_solve_blocks(G, counts, V):
@@ -161,7 +162,8 @@ def _w_solve_blocks(G, counts, V):
     factor-major vec ordering [f*np + p]; V is (np*nf, m)."""
     npr = counts.shape[0]
     nf = G.shape[0]
-    W = jnp.eye(nf)[None] + counts[:, None, None] * G[None]   # (np, nf, nf)
+    W = jnp.eye(nf, dtype=G.dtype)[None] \
+        + counts[:, None, None] * G[None]                     # (np, nf, nf)
     L = chol_spd(W)
     Vr = V.reshape(nf, npr, -1).transpose(1, 0, 2)            # (np, nf, m)
     X = cho_solve((L, True), Vr)

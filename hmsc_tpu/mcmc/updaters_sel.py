@@ -178,7 +178,7 @@ def update_beta_sel(spec: ModelSpec, data: ModelData, state: GibbsState,
             q = data.sel_q[i][g]
             pridif = jnp.where(cur, jnp.log1p(-q) - jnp.log(q),
                                jnp.log(q) - jnp.log1p(-q))
-            u = jax.random.uniform(keys[g])
+            u = jax.random.uniform(keys[g], dtype=E.dtype)
             accept = jnp.log(u) < lldif + pridif
             bs = bs.at[g].set(jnp.where(accept, ~cur, cur))
             E = jnp.where(accept, Enew, E)

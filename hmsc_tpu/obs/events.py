@@ -46,7 +46,7 @@ import threading
 import time
 
 __all__ = ["RunTelemetry", "SCHEMA_VERSION", "EVENTS_FILE_RE", "events_path",
-           "compact_summary"]
+           "compact_summary", "GATHER_SPAN_SCHEMA"]
 
 SCHEMA_VERSION = 1
 
@@ -59,6 +59,20 @@ EVENTS_FILE_RE = _re.compile(r"events-p(\d+)\.jsonl")
 # in-memory safety cap for sink-less runs: events beyond this are counted
 # (``dropped_events``) but not retained
 _MAX_BUFFER = 100_000
+
+# The CLOSED set of span names the commit-gather payload carries
+# (:meth:`RunTelemetry.mark_delta`).  The gather rides every multi-process
+# commit, so its payload must be fixed-size: an open span-name set would
+# grow the serialized payload with every new instrumentation site (the
+# ROADMAP known gap on real pods).  Spans outside this schema aggregate
+# into ``"other"``; extending the schema is a deliberate, review-visible
+# edit here (tests pin the schema, and ``CheckpointWriter._record_skew``
+# reads only names from it).
+GATHER_SPAN_SCHEMA = (
+    "compile", "dispatch", "fetch", "submit_wait", "barrier_wait",
+    "shard_write", "state_write", "manifest_commit", "snapshot_write",
+    "gc", "splice_rewrite", "warm_restart_find",
+)
 
 
 def events_path(dirpath: str, proc: int = 0) -> str:
@@ -115,6 +129,10 @@ class RunTelemetry:
     backward-compatible ``Posterior.io_stats`` view and the multi-process
     rank-skew gather are derived from — so disabling telemetry only stops
     event *retention and JSONL writing*, never the cheap accounting."""
+
+    # shared between the driver thread and the background segment writer;
+    # `hmsc_tpu lint` (lock-discipline) enforces the declaration below
+    # hmsc: guarded-by[_lock]: _buffer, _spans, _counters, _last, _mark, _seq, _sid, n_events, dropped_events
 
     def __init__(self, proc: int = 0, enabled: bool = True):
         self.proc = int(proc)
@@ -258,12 +276,21 @@ class RunTelemetry:
     def mark_delta(self) -> dict:
         """Per-span total seconds since the previous mark (the payload each
         rank contributes to the commit gather — the committer derives
-        cross-rank skew from these without any extra collective)."""
+        cross-rank skew from these without any extra collective).
+
+        The returned ``spans`` dict has the FIXED key set
+        ``GATHER_SPAN_SCHEMA + ("other",)`` regardless of which spans have
+        fired: the gather payload must not grow with the span-name set
+        (new instrumentation would otherwise silently inflate every
+        commit's collective on a real pod)."""
         with self._lock:
             cur = {k: v["total_s"] for k, v in self._spans.items()}
             prev, self._mark = self._mark, cur
-            return {"spans": {k: round(v - prev.get(k, 0.0), 6)
-                              for k, v in cur.items()}}
+            delta = {k: cur[k] - prev.get(k, 0.0) for k in cur}
+            spans = {k: round(delta.pop(k, 0.0), 6)
+                     for k in GATHER_SPAN_SCHEMA}
+            spans["other"] = round(sum(delta.values()), 6)
+            return {"spans": spans}
 
     def summary(self, wall_s: float | None = None) -> dict:
         """JSON-safe roll-up attached to ``Posterior.telemetry`` and

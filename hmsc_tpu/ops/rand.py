@@ -41,7 +41,8 @@ def truncated_normal(key, lower, upper, mean=0.0, std=1.0, *, _u=None):
     # _u: test hook to inject the uniform draw (the s==1.0 rounding overflow
     # below is backend-dependent — TPU's non-FMA schedule hits it, CPU's FMA
     # does not — so the regression test injects the adversarial u directly)
-    u = (jax.random.uniform(key, shape, minval=_TINY, maxval=1.0)
+    u = (jax.random.uniform(key, shape, dtype=a.dtype, minval=_TINY,
+                            maxval=1.0)
          if _u is None else jnp.broadcast_to(_u, shape))
 
     # right-tail intervals: work with survival probs S(x) = Phi(-x)
@@ -102,7 +103,8 @@ def truncated_normal_onesided(key, bound, is_lower, mean=0.0, std=1.0, *,
     # X < b  <=>  -X > -b, with X standardized to W = (X - mean)/std
     t = (jnp.broadcast_to(bound, shape) - mean) / std
     t = jnp.where(is_lower, t, -t)
-    u = (jax.random.uniform(key, shape, minval=_TINY, maxval=1.0)
+    u = (jax.random.uniform(key, shape, dtype=t.dtype, minval=_TINY,
+                            maxval=1.0)
          if _u is None else jnp.broadcast_to(_u, shape))
 
     sa = ndtr(-t)                          # P(W > t)
@@ -139,7 +141,7 @@ def standard_gamma(key, a, shape=None, n_rounds: int = 8):
     if shape is None:
         shape = a.shape
     dtype = a.dtype if jnp.issubdtype(a.dtype, jnp.floating) \
-        else jnp.result_type(float)
+        else jnp.result_type(a.dtype, jnp.float32)
     a = jnp.broadcast_to(a, shape).astype(dtype)
 
     boost = a < 1.0
@@ -193,7 +195,7 @@ def polya_gamma(key, h, z, n_terms: int = 0):
     PG(h,z) = (1/(2 pi^2)) sum_k g_k / ((k-1/2)^2 + z^2/(4 pi^2)), g_k~Ga(h,1).
     """
     if n_terms > 0:
-        ks = jnp.arange(1, n_terms + 1, dtype=jnp.result_type(float))
+        ks = jnp.arange(1, n_terms + 1, dtype=jnp.result_type(h, z))
         denom = (ks - 0.5) ** 2 + (jnp.asarray(z)[..., None] / (2 * jnp.pi)) ** 2
         g = standard_gamma(key, jnp.asarray(h)[..., None] * jnp.ones_like(denom))
         draw = (g / denom).sum(-1) / (2 * jnp.pi**2)
@@ -202,7 +204,8 @@ def polya_gamma(key, h, z, n_terms: int = 0):
         mean_trunc = (jnp.asarray(h)[..., None] / denom).sum(-1) / (2 * jnp.pi**2)
         return draw + (mean - mean_trunc)
     mean, var = _pg_moments(h, z)
-    eps = jax.random.normal(key, jnp.broadcast_shapes(jnp.shape(h), jnp.shape(z)))
+    eps = jax.random.normal(key, jnp.broadcast_shapes(jnp.shape(h), jnp.shape(z)),
+                            dtype=jnp.result_type(h, z))
     return jnp.maximum(mean + jnp.sqrt(var) * eps, _TINY)
 
 

@@ -23,7 +23,9 @@ def create_partition(hM, nfolds: int = 10, column=None,
                      rng: np.random.Generator | None = None) -> np.ndarray:
     """Random fold assignment per sampling unit, optionally grouping rows by
     a study-design column so a unit's rows share a fold."""
-    rng = rng or np.random.default_rng()
+    # deliberately unseeded: omitting `rng` is the caller's explicit opt-out
+    # of determinism; pass a Generator to reproduce runs
+    rng = rng or np.random.default_rng()  # hmsc: ignore[py-random]
     if column is not None:
         if hM.nr == 0 and not hasattr(hM, "study_design"):
             raise ValueError("HMSC.createPartition: nfolds cannot exceed the number of units in the specified random level")

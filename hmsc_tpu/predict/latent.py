@@ -70,7 +70,9 @@ def predict_latent_factor(units_pred, units, post_eta, post_alpha, rL,
     """
     if predict_mean and predict_mean_field:
         raise ValueError("Hmsc.predictLatentFactor: predictMean and predictMeanField arguments cannot be simultaneously TRUE")
-    rng = rng or np.random.default_rng()
+    # deliberately unseeded: omitting `rng` is the caller's explicit opt-out
+    # of determinism; pass a Generator to reproduce runs
+    rng = rng or np.random.default_rng()  # hmsc: ignore[py-random]
     post_eta = np.asarray(post_eta)
     n_draws, np_old, nf = post_eta.shape
     units = [str(u) for u in units]

@@ -1422,10 +1422,27 @@ class CheckpointWriter:
                            (_SHARD_RE.fullmatch(s["file"])
                             for s in self._carried) if m), default=0)}
         self.n_writes = 0
-        self.abort_agreed = False
+        import threading
+        self._abort_lock = threading.Lock()
+        self._abort_agreed = False
         self.io = {"bytes": 0, "snapshot_bytes": [], "shards_written": 0}
 
     # -- shared helpers ----------------------------------------------------
+
+    # the ONE cross-thread attribute of this otherwise writer-thread-
+    # confined object: the commit gather's abort verdict is set on the
+    # background writer and polled by the driver loop at marks.
+    # hmsc: guarded-by[_abort_lock]: _abort_agreed
+
+    @property
+    def abort_agreed(self) -> bool:
+        """True once any rank's preemption flag rode a commit gather."""
+        with self._abort_lock:
+            return self._abort_agreed
+
+    def _set_abort_agreed(self) -> None:
+        with self._abort_lock:
+            self._abort_agreed = True
 
     def _span_total(self, name: str) -> float:
         return self.telem.totals().get(name, {}).get("total_s", 0.0)
@@ -1624,7 +1641,7 @@ class CheckpointWriter:
         with self.telem.span("barrier_wait", tag=tag, what="commit-gather"):
             parts = coord.all_gather(payload, tag=f"ck-{tag}")
         if any(p["preempt"] for p in parts):
-            self.abort_agreed = True
+            self._set_abort_agreed()
         if coord.is_coordinator:
             # stitch: per-process new shards regrouped into sample windows
             # (process order within a window); the carried prefix is the

@@ -1,0 +1,405 @@
+"""Static-analysis suite tests: one triggering fixture per rule,
+suppression comments, baseline round-trip, --json schema, fingerprint
+round-trip, and the tier-1 gate that the shipped tree lints clean.
+
+Fast tier-1 (`lint` marker).  The AST-rule fixtures run pure-syntax (no
+JAX); the jaxpr-layer tests trace the canonical specs once per module via
+the session-scoped ``audit`` fixture.
+"""
+
+import json
+import os
+
+import pytest
+
+from hmsc_tpu.analysis import (Baseline, lint_main, load_baseline,
+                               parse_suppressions, run_analysis,
+                               save_baseline, findings_to_json, RULES)
+from hmsc_tpu.analysis.ast_rules import ModuleContext
+from hmsc_tpu.analysis.findings import Finding, is_suppressed
+
+pytestmark = pytest.mark.lint
+
+MCMC_PATH = "hmsc_tpu/mcmc/updaters.py"     # traced-module path for fixtures
+
+
+def run_rule(rule_id, source, path=MCMC_PATH):
+    ctx = ModuleContext.parse(path, source)
+    return list(RULES[rule_id].checker(ctx))
+
+
+# ---------------------------------------------------------------------------
+# layer 1: one triggering fixture per rule (+ the must-not-trigger twins)
+# ---------------------------------------------------------------------------
+
+def test_rng_key_reuse_triggers():
+    src = (
+        "import jax\n"
+        "def f(key):\n"
+        "    a = jax.random.normal(key, (3,))\n"
+        "    b = jax.random.normal(key, (3,))\n"
+        "    return a + b\n")
+    f = run_rule("rng-key-reuse", src)
+    assert len(f) == 1 and f[0].line == 4 and f[0].severity == "error"
+
+
+def test_rng_key_reuse_split_rebind_ok():
+    src = (
+        "import jax\n"
+        "def f(key):\n"
+        "    key, sub = jax.random.split(key)\n"
+        "    a = jax.random.normal(sub, (3,))\n"
+        "    key, sub = jax.random.split(key)\n"
+        "    return a + jax.random.normal(sub, (3,))\n")
+    assert run_rule("rng-key-reuse", src) == []
+
+
+def test_rng_key_reuse_branch_returns_ok():
+    # `if fast: return f(key)` + `return g(key)` is one consumption per
+    # execution — the terminating branch must not merge into the fallthrough
+    src = (
+        "import jax\n"
+        "def f(key, fast):\n"
+        "    if fast:\n"
+        "        return jax.random.normal(key, (2,))\n"
+        "    return jax.random.uniform(key, (2,))\n")
+    assert run_rule("rng-key-reuse", src) == []
+
+
+def test_rng_key_reuse_loop_triggers_and_fold_in_exempt():
+    bad = (
+        "import jax\n"
+        "def f(key):\n"
+        "    out = []\n"
+        "    for i in range(4):\n"
+        "        out.append(jax.random.normal(key, (2,)))\n"
+        "    return out\n")
+    f = run_rule("rng-key-reuse", bad)
+    assert len(f) == 1 and "loop" in f[0].message
+    ok = bad.replace("jax.random.normal(key, (2,))",
+                     "jax.random.normal(jax.random.fold_in(key, i), (2,))")
+    assert run_rule("rng-key-reuse", ok) == []
+
+
+def test_rng_key_reuse_comprehension_triggers():
+    # a comprehension body iterates like a loop: consuming the same key
+    # per element is reuse; deriving via fold_in (or consuming only in
+    # the first generator's iterable, which evaluates once) is not
+    bad = (
+        "import jax\n"
+        "def f(key, n):\n"
+        "    return [jax.random.normal(key, (2,)) for _ in range(n)]\n")
+    f = run_rule("rng-key-reuse", bad)
+    assert len(f) == 1 and "comprehension" in f[0].message
+    ok = (
+        "import jax\n"
+        "def f(key, n):\n"
+        "    return [jax.random.normal(jax.random.fold_in(key, i), (2,))\n"
+        "            for i in range(n)]\n")
+    assert run_rule("rng-key-reuse", ok) == []
+    once = (
+        "import jax\n"
+        "def f(key, n):\n"
+        "    return [k for k in jax.random.split(key, n)]\n")
+    assert run_rule("rng-key-reuse", once) == []
+
+
+def test_rng_key_reuse_needs_evidence_outside_sweep_modules():
+    # `key` params in non-sweep modules are only tracked when the function
+    # visibly handles jax.random keys (dict-key params must not trip it)
+    src = (
+        "def __getitem__(self, key):\n"
+        "    a = self._data.get(key)\n"
+        "    b = self._lazy.get(key)\n"
+        "    return a or b\n")
+    assert run_rule("rng-key-reuse", src,
+                    path="hmsc_tpu/utils/checkpoint.py") == []
+
+
+def test_py_random_triggers():
+    src = (
+        "import random\n"
+        "import numpy as np\n"
+        "def f():\n"
+        "    np.random.seed(0)\n"
+        "    rng = np.random.default_rng()\n"
+        "    return random.random()\n")
+    f = run_rule("py-random", src)
+    assert {x.line for x in f} == {1, 4, 5}
+    ok = "import numpy as np\ndef f(seed):\n    return np.random.default_rng(seed)\n"
+    assert run_rule("py-random", ok) == []
+
+
+def test_host_sync_in_jit_triggers():
+    src = (
+        "import numpy as np\n"
+        "def update_x(spec, data, state, key):\n"
+        "    v = float(state.it)\n"
+        "    w = state.Z.item()\n"
+        "    return v + w\n")
+    f = run_rule("host-sync-in-jit", src)
+    assert {x.line for x in f} == {3, 4}
+    # float() on static spec arithmetic is fine
+    ok = ("def update_x(spec, data, state, key):\n"
+          "    n = float(spec.ny * spec.ns)\n"
+          "    return n\n")
+    assert run_rule("host-sync-in-jit", ok) == []
+
+
+def test_numpy_in_jit_triggers():
+    src = (
+        "import numpy as np\n"
+        "def update_x(spec, data, state, key):\n"
+        "    return np.asarray(state.Z).sum()\n")
+    f = run_rule("numpy-in-jit", src)
+    assert len(f) == 1 and f[0].line == 3
+    # static prior arithmetic through np is allowed
+    ok = ("import numpy as np\n"
+          "def update_x(spec, data, state, key):\n"
+          "    s = 2.38 / np.sqrt(2.0 * spec.ns)\n"
+          "    return state.Z * s\n")
+    assert run_rule("numpy-in-jit", ok) == []
+    # host-side gate helpers (no state/key param) are out of scope
+    gate = ("import numpy as np\n"
+            "def gates(spec, mGamma=None):\n"
+            "    return np.any(np.asarray(mGamma) > 0)\n")
+    assert run_rule("numpy-in-jit", gate) == []
+
+
+def test_mutable_default_triggers():
+    src = (
+        "import dataclasses\n"
+        "def f(x, acc=[]):\n"
+        "    return acc\n"
+        "@dataclasses.dataclass\n"
+        "class Spec:\n"
+        "    items: list = []\n")
+    f = run_rule("mutable-default", src)
+    assert len(f) == 2
+    assert any("Spec" in x.message for x in f)
+
+
+def test_bare_print_triggers_and_exemptions():
+    src = "def f():\n    print('hi')\n"
+    f = run_rule("bare-print", src)
+    assert len(f) == 1 and f[0].line == 2
+    assert run_rule("bare-print", src, path="hmsc_tpu/obs/log.py") == []
+    assert run_rule("bare-print", src, path="hmsc_tpu/bench_cli.py") == []
+
+
+LOCK_SRC = (
+    "import threading\n"
+    "class W:\n"
+    "    # hmsc: guarded-by[_lock]: _buf, n_events\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._buf = []\n"
+    "        self.n_events = 0\n"
+    "    def good(self, ev):\n"
+    "        with self._lock:\n"
+    "            self._buf.append(ev)\n"
+    "            self.n_events += 1\n"
+    "    def nested_with(self):\n"
+    "        with self._sink:\n"
+    "            with self._lock:\n"
+    "                return list(self._buf)\n"
+    "    def _drain_locked(self):\n"
+    "        return self._buf\n"
+    "    def bad(self):\n"
+    "        return len(self._buf)\n"
+    "    def bad_closure(self):\n"
+    "        with self._lock:\n"
+    "            return lambda: self._buf.pop()\n")
+
+
+def test_lock_discipline_triggers():
+    f = run_rule("lock-discipline", LOCK_SRC, path="hmsc_tpu/obs/events.py")
+    lines = sorted(x.line for x in f)
+    # `bad` reads outside the lock; the closure in `bad_closure` runs later
+    # without it.  good/nested_with/_drain_locked/__init__ all pass.
+    assert lines == [19, 22]
+    assert any("closure" in x.message for x in f if x.line == 22)
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+def test_suppression_same_line_and_line_above():
+    src = (
+        "def f():\n"
+        "    print('a')  # hmsc: ignore[bare-print] -- CLI surface\n"
+        "    # hmsc: ignore[bare-print]\n"
+        "    print('b')\n"
+        "    print('c')  # hmsc: ignore\n"
+        "    print('d')  # hmsc: ignore[some-other-rule]\n"
+        "    print('e')\n")
+    ctx = ModuleContext.parse(MCMC_PATH, src)
+    sup = parse_suppressions(ctx.source)
+    f = [x for x in RULES["bare-print"].checker(ctx)
+         if not is_suppressed(x, sup)]
+    assert {x.line for x in f} == {6, 7}
+
+
+def test_suppression_marker_in_string_literal_is_inert():
+    # the marker inside a string/docstring (e.g. a rule's own help text)
+    # must never suppress anything — only real COMMENT tokens count
+    src = (
+        "MSG = 'add # hmsc: ignore[bare-print] to suppress'\n"
+        "print('x')\n"
+        "def f():\n"
+        '    "docs mention # hmsc: ignore too"\n'
+        "    print('y')\n")
+    assert parse_suppressions(src) == {}
+
+
+# ---------------------------------------------------------------------------
+# fixture tree: full pipeline (baseline round-trip, CLI exit codes, --json)
+# ---------------------------------------------------------------------------
+
+BAD_TREE = {
+    "bad_rng.py": ("import jax\n"
+                   "def f(key):\n"
+                   "    a = jax.random.normal(key, (2,))\n"
+                   "    return a + jax.random.normal(key, (2,))\n"),
+    "bad_print.py": "def g():\n    print('x')\n",
+}
+
+
+@pytest.fixture()
+def fixture_root(tmp_path):
+    root = tmp_path / "hmsc_tpu"
+    root.mkdir()
+    for name, src in BAD_TREE.items():
+        (root / name).write_text(src)
+    return root
+
+
+def test_run_analysis_on_fixture_tree(fixture_root):
+    r = run_analysis(root=str(fixture_root), layers=("ast",),
+                     baseline=Baseline())
+    assert r["errors"] == 2
+    rules = {f.rule for f in r["findings"]}
+    assert rules == {"rng-key-reuse", "bare-print"}
+    # findings carry file:line
+    assert all(f.path.endswith(".py") and f.line > 0 for f in r["findings"])
+
+
+def test_cli_exit_codes_and_json(fixture_root, tmp_path, capsys):
+    baseline = tmp_path / "baseline.json"
+    save_baseline(baseline, [])
+    rc = lint_main(["--layer", "ast", "--root", str(fixture_root),
+                    "--baseline", str(baseline), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["version"] == 1 and out["errors"] == 2
+    assert {"errors", "warnings", "suppressed", "baselined", "findings",
+            "rules"} <= set(out)
+    for f in out["findings"]:
+        assert {"rule", "severity", "path", "line", "message"} == set(f)
+    for rid, meta in out["rules"].items():
+        assert meta["severity"] in ("error", "warning")
+        assert meta["layer"] in ("ast", "jaxpr")
+        assert isinstance(meta["count"], int) and meta["protects"]
+
+
+def test_baseline_round_trip(fixture_root, tmp_path, capsys):
+    baseline = tmp_path / "baseline.json"
+    rc = lint_main(["--layer", "ast", "--root", str(fixture_root),
+                    "--baseline", str(baseline), "--update-baseline"])
+    capsys.readouterr()
+    assert rc == 0 and baseline.exists()
+    doc = json.loads(baseline.read_text())
+    assert doc["version"] == 1 and len(doc["findings"]) == 2
+    # grandfathered: the same tree now lints clean against its baseline
+    rc = lint_main(["--layer", "ast", "--root", str(fixture_root),
+                    "--baseline", str(baseline)])
+    capsys.readouterr()
+    assert rc == 0
+    # baseline matching survives line drift (match is rule+path+message)
+    bl = load_baseline(baseline)
+    f0 = bl.findings[0]
+    assert bl.known(Finding(f0.rule, f0.severity, f0.path, f0.line + 7,
+                            f0.message))
+
+
+# ---------------------------------------------------------------------------
+# layer 2: jaxpr audits (one canonical build per test module)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def audit():
+    from hmsc_tpu.analysis import jaxpr_rules
+    return jaxpr_rules.build_audit_context(
+        expected_fingerprints=jaxpr_rules.load_fingerprints())
+
+
+def test_jaxpr_audit_covers_every_registered_updater(audit):
+    from hmsc_tpu.mcmc.registry import UPDATER_REGISTRY
+    assert audit.missing_updaters == []
+    audited = {p.name for p in audit.programs}
+    for e in UPDATER_REGISTRY:
+        assert f"updater:{e.name}" in audited
+    assert "segment_runner@base" in audited
+
+
+def test_jaxpr_rules_clean_on_shipped_tree(audit):
+    from hmsc_tpu.analysis.jaxpr_rules import run_jaxpr_rules
+    findings = list(run_jaxpr_rules(audit))
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_fingerprints_committed_and_current(audit):
+    """The committed fingerprints.json matches the traced programs — any
+    change to the compiled surface must re-record it (review-visible)."""
+    from hmsc_tpu.analysis.jaxpr_rules import (current_fingerprints,
+                                               load_fingerprints)
+    expected = load_fingerprints()
+    assert expected is not None, "fingerprints.json missing"
+    cur = current_fingerprints(audit)
+    assert set(cur) == set(expected)
+    for name, fp in cur.items():
+        assert fp["sha256"] == expected[name]["sha256"], name
+
+
+def test_fingerprint_shape_blind_is_stable_across_sizes(audit):
+    # the recompile rule's foundation: identical shape-blind structure
+    assert len(audit.sweep_shape_variants) == 1
+
+
+def test_f64_probe_actually_detects_a_leak():
+    """The x64 audit must FAIL on a deliberately unpinned dtype — guards
+    against the probe silently going vacuous."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import enable_x64
+    from hmsc_tpu.analysis.jaxpr_rules import _all_vars
+
+    def leaky(x):
+        return x + jnp.ones(x.shape[0])     # unpinned dtype
+
+    with enable_x64():
+        closed = jax.make_jaxpr(leaky)(jnp.ones(3, jnp.float32))
+    strong = [v for v in _all_vars(closed.jaxpr)
+              if str(getattr(v.aval, "dtype", "")) == "float64"
+              and not getattr(v.aval, "weak_type", False)]
+    assert strong
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: the shipped tree is clean end to end
+# ---------------------------------------------------------------------------
+
+def test_lint_clean(audit):
+    """`python -m hmsc_tpu lint` contract on the shipped tree: zero active
+    errors with the committed (near-empty) baseline."""
+    from hmsc_tpu.analysis import jaxpr_rules
+    r = run_analysis(layers=("ast",))
+    r["findings"].extend(jaxpr_rules.run_jaxpr_rules(audit))
+    errors = [f for f in r["findings"] if f.severity == "error"]
+    assert errors == [], "\n".join(f.render() for f in errors)
+    # the committed baseline stays near-empty (nothing grandfathered)
+    assert len(load_baseline(
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))),
+            "hmsc_tpu", "analysis", "baseline.json")).findings) == 0
