@@ -196,6 +196,31 @@ def _lint_summary():
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _serving_summary():
+    """The serving-layer digest (`benchmarks/bench_serving.py`): p50/p99
+    latency, micro-batched throughput and the zero-recompile counter for
+    the bucketed posterior serving engine, run in a CPU-pinned subprocess —
+    the serving gates are CPU-CI-enforceable by design, so the trajectory
+    records them even on rounds where the accelerator is unreachable (and
+    the bench's own accelerator run is never perturbed by a second JAX
+    backend in-process)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        r = subprocess.run(
+            [sys.executable, "benchmarks/bench_serving.py", "--reps", "100"],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        digest = json.loads(r.stdout.splitlines()[0])
+        digest["gates_ok"] = r.returncode == 0
+        return digest
+    except Exception as e:                   # noqa: BLE001 — bench must emit
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _skip(reason: str):
     """Emit a parseable skip record instead of a bare nonzero exit: the
     bench trajectory must distinguish "chip unreachable this round" from "a
@@ -212,8 +237,10 @@ def _skip(reason: str):
         "process_count": None,
         "skipped": True,
         "reason": reason,
-        # lint runs on CPU, so the trajectory still records static health
+        # lint + the serving digest run on CPU, so the trajectory still
+        # records static health and the serving-layer gates
         "lint_findings": _lint_summary(),
+        "serving": _serving_summary(),
     }))
     raise SystemExit(0)
 
@@ -359,6 +386,10 @@ def main():
         "telemetry": compact_summary(tel_big),
         # static-correctness drift (`hmsc_tpu lint` finding counts)
         "lint_findings": _lint_summary(),
+        # serving-layer digest (CPU subprocess): p50/p99 latency,
+        # micro-batched q/s, zero-recompile gate — the prediction side of
+        # the trajectory (benchmarks/bench_serving.py)
+        "serving": _serving_summary(),
     }))
 
 

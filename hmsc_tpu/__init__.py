@@ -28,6 +28,8 @@ from .utils.checkpoint import (save_checkpoint, load_checkpoint,
                                CheckpointCorruptError,
                                CheckpointSpecMismatchError, PreemptedRun)
 from .utils.mesh import make_mesh
+from .serve import (ServingEngine, ServingArtifact, compact_posterior,
+                    load_artifact)
 from .obs import (RunTelemetry, RunningDiagnostics, get_logger, rhat_ess)
 from .utils.phylo import parse_newick, phylo_corr, vcv_from_newick
 from .plots import (plot_beta, plot_gamma, plot_gradient,

@@ -5,10 +5,13 @@ Subcommands: ``bench`` (default; the throughput probe, same entry as the
 long-run driver with ``--resume``), ``report`` (render a run's
 telemetry — phase timeline, throughput, cross-rank skew, checkpoint I/O
 and MCMC health — from its ``events-p<rank>.jsonl`` streams; ``--prom``
-exports Prometheus textfile gauges), and ``lint`` (the static correctness
+exports Prometheus textfile gauges), ``lint`` (the static correctness
 suite: AST lint + jaxpr audits, see ``ANALYSIS.md``; exit 1 on any active
-severity=error finding).  Bare arguments keep the historical bench
-behaviour: ``python -m hmsc_tpu --ns 50`` still works.
+severity=error finding), ``compact`` (thin + re-shard a fitted run into a
+serving-optimised artifact, optionally bf16), and ``serve`` (long-lived
+HTTP posterior-serving engine: compile-cached bucketed predict kernels +
+micro-batching, see README "Serving").  Bare arguments keep the
+historical bench behaviour: ``python -m hmsc_tpu --ns 50`` still works.
 """
 
 import sys
@@ -27,6 +30,12 @@ def main(argv=None):
     if argv[:1] == ["lint"]:
         from .analysis.cli import lint_main
         return lint_main(argv[1:])
+    if argv[:1] == ["compact"]:
+        from .serve.artifact import compact_main
+        return compact_main(argv[1:])
+    if argv[:1] == ["serve"]:
+        from .serve.http import serve_main
+        return serve_main(argv[1:])
     if argv[:1] == ["bench"]:
         argv = argv[1:]
     return bench_main(argv)

@@ -1,7 +1,9 @@
-"""Layer 2 — jaxpr audits of the traced sampling programs.
+"""Layer 2 — jaxpr audits of the traced sampling AND serving programs.
 
 Abstract-evals every registered updater (``hmsc_tpu.mcmc.registry``), the
-assembled sweep, and the jitted segment runner on canonical small specs,
+assembled sweep, the jitted segment runner, and the serving kernels
+(``hmsc_tpu.serve.kernels.audit_kernels`` — the predict / conditional
+programs the serving engine compile-caches) on canonical small specs,
 then audits the *programs* rather than the source:
 
 - ``jaxpr-f64``: no float64/complex128 anywhere in the traced program.
@@ -331,6 +333,18 @@ def build_audit_context(expected_fingerprints=None) -> JaxprAudit:
         closed=runner_closed, closed_x64=runner_closed_x64, x64_error=err))
     runner_text = fn.lower(data, states, keys, bad).as_text()
     n_carry = len(jax.tree_util.tree_leaves(states))
+
+    # serving kernels (hmsc_tpu/serve/kernels.py): the prediction programs
+    # the serving engine compiles and caches — audited exactly like the
+    # updaters (f64-leak probe, host callbacks, baked constants, committed
+    # structural fingerprints), so the query path cannot silently regress
+    # its dtype policy or grow a Python re-entry
+    from ..serve.kernels import audit_kernels
+    for sname, sfn, sargs in audit_kernels():
+        closed, closed_x64, err = _trace_pair(sfn, *sargs)
+        programs.append(AuditProgram(
+            name=sname, path="hmsc_tpu/serve/kernels.py",
+            closed=closed, closed_x64=closed_x64, x64_error=err))
 
     # shape sweep: the sweep's shape-blind structure must not vary
     variants: dict[str, list] = {}

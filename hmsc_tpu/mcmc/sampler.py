@@ -1324,6 +1324,19 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
             telem.emit("metric", "profile_capture", seg=len(plan) - 1,
                        action="stop")
         writer.barrier()              # all fetches + snapshots complete
+        if n_procs > 1 and ckw is None:
+            # checkpoint-free mesh run: no commit gather ever carried the
+            # per-rank telemetry deltas, so without this the run records
+            # per-rank streams but no committer skew marks (the ROADMAP
+            # observability gap).  One end-of-run gather closes it: every
+            # multi-process run reports at least a final `rank_skew`.
+            from ..obs.events import record_rank_skew
+            with telem.span("barrier_wait", what="end-skew-gather"):
+                parts = coord.all_gather({"telemetry": telem.mark_delta()},
+                                         tag="end-skew")
+            if coord.is_coordinator:
+                record_rank_skew(telem, "end",
+                                 [p.get("telemetry") for p in parts])
         telem.emit("run", "end", samples_done=base_samples + done)
         _merge_segs()
         recs = host_segs[0]

@@ -46,7 +46,7 @@ import threading
 import time
 
 __all__ = ["RunTelemetry", "SCHEMA_VERSION", "EVENTS_FILE_RE", "events_path",
-           "compact_summary", "GATHER_SPAN_SCHEMA"]
+           "compact_summary", "GATHER_SPAN_SCHEMA", "record_rank_skew"]
 
 SCHEMA_VERSION = 1
 
@@ -96,6 +96,30 @@ def compact_summary(summary: dict | None) -> dict | None:
         "ess_min": health.get("ess_min"),
         "events": summary.get("events"),
     }
+
+
+def record_rank_skew(telem: "RunTelemetry", tag: str, deltas: list) -> None:
+    """Record one cross-rank skew mark from gathered per-rank
+    :meth:`RunTelemetry.mark_delta` payloads (rank order).
+
+    Called by the committer at every multi-process commit mark, and by the
+    sampler's end-of-run gather on checkpoint-free mesh runs — so EVERY
+    multi-process run reports skew, not only checkpointed ones (the
+    ROADMAP observability gap).  Per-rank segment time is compile +
+    dispatch + device→host fetch since the previous mark; ``skew_s`` is
+    max−min segment time — the quantity that, left unchecked, accumulates
+    into gather stalls (the PR 4 A/B measured 27% overhead without
+    per-mark pacing)."""
+    tels = [d or {} for d in deltas]
+    seg = [round(sum(t.get("spans", {}).get(n, 0.0)
+                     for n in ("compile", "dispatch", "fetch")), 6)
+           for t in tels]
+    bar = [round(t.get("spans", {}).get("barrier_wait", 0.0), 6)
+           for t in tels]
+    skew = round(max(seg) - min(seg), 6) if seg else 0.0
+    telem.emit("metric", "rank_skew", tag=tag, segment_s=seg,
+               barrier_wait_s=bar, skew_s=skew)
+    telem.count("rank_skew_s", skew)
 
 
 class _Span:
@@ -182,6 +206,27 @@ class RunTelemetry:
         """Accumulate a named counter (surfaced in :meth:`summary`)."""
         with self._lock:
             self._counters[name] = self._counters.get(name, 0.0) + float(value)
+
+    def observe(self, name: str, dur_s: float, **fields) -> None:
+        """Record a pre-measured duration as a span: updates the span
+        aggregates and emits a ``kind="span"`` event, for stages whose
+        start and end live on different threads (e.g. the serving engine's
+        per-request ``queue_wait``, measured submit→dequeue) where a
+        ``with span(...)`` block cannot bracket the interval."""
+        dur_s = float(dur_s)
+        with self._lock:
+            agg = self._spans.setdefault(
+                name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+            agg["count"] += 1
+            agg["total_s"] += dur_s
+            agg["max_s"] = max(agg["max_s"], dur_s)
+            fields = dict(fields)
+            fields.update(sid=self._sid, parent=None, depth=0,
+                          thread=threading.get_ident(),
+                          t0=round(self._now() - dur_s, 6),
+                          dur_s=round(dur_s, 6))
+            self._sid += 1
+            self._append_locked("span", name, fields)
 
     # -- spans -------------------------------------------------------------
 

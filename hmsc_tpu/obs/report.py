@@ -24,7 +24,8 @@ import json
 import os
 
 __all__ = ["load_run_events", "build_report", "render_report",
-           "prometheus_textfile", "report_main"]
+           "prometheus_textfile", "serving_prometheus_textfile",
+           "report_main"]
 
 
 def load_run_events(run_dir: str) -> dict:
@@ -294,6 +295,42 @@ def prometheus_textfile(report: dict) -> str:
         out.append("# TYPE hmsc_tpu_rank_skew_seconds gauge")
         out.append(f"hmsc_tpu_rank_skew_seconds "
                    f"{report['skew'][-1].get('skew_s', 0.0)}")
+    return "\n".join(out) + "\n"
+
+
+def serving_prometheus_textfile(stats: dict) -> str:
+    """Prometheus textfile-collector export of a serving engine's
+    :meth:`~hmsc_tpu.serve.ServingEngine.stats` — the serving counterpart
+    of :func:`prometheus_textfile` (same span-gauge naming, ``proc="serve"``
+    label), written by ``python -m hmsc_tpu serve --prom`` and returned
+    live on the server's ``GET /metrics``."""
+    out = ["# HELP hmsc_tpu_span_seconds_total serving span time by stage",
+           "# TYPE hmsc_tpu_span_seconds_total gauge",
+           "# TYPE hmsc_tpu_span_seconds_max gauge",
+           "# TYPE hmsc_tpu_span_count gauge"]
+    for name, agg in sorted(stats.get("spans", {}).items()):
+        lbl = f'{{span="{name}",proc="serve"}}'
+        out.append(f"hmsc_tpu_span_seconds_total{lbl} "
+                   f"{agg['total_s']:.6f}")
+        out.append(f"hmsc_tpu_span_seconds_max{lbl} {agg['max_s']:.6f}")
+        out.append(f"hmsc_tpu_span_count{lbl} {agg['count']}")
+    cache = stats.get("cache", {})
+    gauges = [
+        ("hmsc_tpu_serve_requests_total", stats.get("requests", 0)),
+        ("hmsc_tpu_serve_batches_total", stats.get("batches", 0)),
+        ("hmsc_tpu_serve_device_calls_total",
+         stats.get("device_calls", 0)),
+        ("hmsc_tpu_serve_rows_served_total", stats.get("rows_served", 0)),
+        ("hmsc_tpu_serve_rows_padded_total", stats.get("rows_padded", 0)),
+        ("hmsc_tpu_serve_kernel_cache_hits_total", cache.get("hits", 0)),
+        ("hmsc_tpu_serve_kernel_cache_misses_total",
+         cache.get("misses", 0)),
+        ("hmsc_tpu_serve_kernel_cache_size", cache.get("size", 0)),
+        ("hmsc_tpu_serve_posterior_draws", stats.get("n_draws", 0)),
+    ]
+    for name, v in gauges:
+        out.append(f"# TYPE {name} gauge")
+        out.append(f"{name} {v}")
     return "\n".join(out) + "\n"
 
 

@@ -110,15 +110,27 @@ class Posterior:
                              for r, v in self.nf_saturation.items()}
         return sub
 
-    def pooled(self, name: str) -> np.ndarray:
+    def pooled(self, name: str, thin: int = 1) -> np.ndarray:
         """(chains*samples, ...) flattened view (poolMcmcChains); chains whose
         carry went non-finite (``chain_health``) are excluded so one diverged
-        chain cannot silently poison every pooled summary."""
+        chain cannot silently poison every pooled summary.
+
+        ``thin`` keeps every ``thin``-th recorded sample *per chain* (the
+        ``subset(thin=)`` window) and applies BEFORE the flatten: on an
+        mmap-backed posterior (``load_manifest_checkpoint(mmap=True)``) the
+        sample-axis slice is windowed, so only the kept rows are ever
+        copied into host RAM — which is what lets serving compaction thin
+        a multi-GB draw history without materialising it first."""
         if name not in self.arrays:
             raise KeyError(
                 f"{name!r} was not recorded in this run — re-sample without "
                 "the sample_mcmc(record=...) restriction, or include it")
         a = self.arrays[name]
+        thin = int(thin)
+        if thin < 1:
+            raise ValueError(f"pooled: thin must be >= 1, got {thin}")
+        if thin > 1:
+            a = a[:, ::thin]
         good = self.good_chain_mask()
         if not good.all():
             a = a[good]

@@ -205,31 +205,19 @@ def predict(post, x_data=None, X=None, xrrr_data=None, XRRR=None,
 
 def _lin_pred(hM, spec, Xn, x_is_list, XRRR, post, Beta, eta_pred, pi_new,
               x_row_new) -> np.ndarray:
-    """(n_draws, ny_new, ns) linear predictor, one batched einsum per term."""
-    import jax.numpy as jnp
+    """(n_draws, ny_new, ns) linear predictor as ONE jitted program (the
+    shared serving kernel, :func:`hmsc_tpu.serve.kernels.linear_predictor`
+    — offline prediction and the serving engine compile the same code;
+    repeated predict() calls at one query shape reuse the executable
+    instead of re-dispatching each einsum from Python)."""
+    from ..serve.kernels import linear_predictor
 
+    lams = [post.pooled(f"Lambda_{r}") for r in range(hM.nr)]
+    kw = {}
     if hM.nc_rrr > 0:
-        wRRR = post.pooled("wRRR")                      # (n, nc_rrr, nc_orrr)
-        XB = jnp.einsum("yo,nro->nyr", XRRR, wRRR)      # (n, ny, nc_rrr)
-        if x_is_list:
-            base = jnp.einsum("jyc,ncj->nyj", Xn, Beta[:, :hM.nc_nrrr])
-            L = base + jnp.einsum("nyr,nrj->nyj", XB, Beta[:, hM.nc_nrrr:])
-        else:
-            L = (jnp.einsum("yc,ncj->nyj", Xn, Beta[:, :hM.nc_nrrr])
-                 + jnp.einsum("nyr,nrj->nyj", XB, Beta[:, hM.nc_nrrr:]))
-    elif x_is_list:
-        L = jnp.einsum("jyc,ncj->nyj", Xn, Beta)
-    else:
-        L = jnp.einsum("yc,ncj->nyj", Xn, Beta)
-
-    for r in range(hM.nr):
-        lam = post.pooled(f"Lambda_{r}")                # (n, nf, ns[, ncr])
-        rows = eta_pred[r][:, pi_new[r], :]             # (n, ny, nf)
-        if lam.ndim == 3:
-            L = L + jnp.einsum("nyf,nfj->nyj", rows, lam)
-        else:
-            L = L + jnp.einsum("nyf,yk,nfjk->nyj", rows,
-                               jnp.asarray(x_row_new[r]), lam)
+        kw = dict(nc_nrrr=hM.nc_nrrr, XRRR=XRRR, wRRR=post.pooled("wRRR"))
+    L = linear_predictor(Xn, x_is_list, Beta, etas=eta_pred, pis=pi_new,
+                         xrows=x_row_new, lams=lams, **kw)
     return np.asarray(L)
 
 

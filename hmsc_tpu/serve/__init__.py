@@ -1,0 +1,31 @@
+"""Posterior serving: compile-cached predict kernels, shape-bucketed
+micro-batching, and compacted serving artifacts.
+
+The fitting side of hmsc_tpu writes an append-only posterior; this package
+is the reading side at serving scale — a long-lived engine that opens a
+fitted run once and answers batched ``predict`` / gradient / conditional
+queries at low latency:
+
+- :mod:`.kernels` — jitted serving kernels (shared with the offline
+  ``predict`` path), audited by the static jaxpr suite;
+- :mod:`.artifact` — ``python -m hmsc_tpu compact``: thin + re-shard the
+  posterior into one contiguous draw-major block per parameter (optional
+  bf16 with recorded cast tolerance);
+- :mod:`.engine` — :class:`ServingEngine`: shape buckets, LRU compile
+  cache, bounded-window micro-batching, per-request telemetry spans;
+- :mod:`.http` — ``python -m hmsc_tpu serve``: stdlib HTTP + JSON front
+  end with ``/metrics`` Prometheus export.
+"""
+
+from .artifact import (ServingArtifact, compact_posterior, load_artifact,
+                       load_run_posterior)
+from .engine import DEFAULT_BUCKETS, ServingEngine
+from .kernels import (linear_predictor, make_conditional_kernel,
+                      make_predict_kernel)
+
+__all__ = [
+    "ServingEngine", "DEFAULT_BUCKETS",
+    "ServingArtifact", "compact_posterior", "load_artifact",
+    "load_run_posterior",
+    "linear_predictor", "make_predict_kernel", "make_conditional_kernel",
+]

@@ -1,0 +1,336 @@
+"""Compacted serving artifacts: the posterior, re-shaped for serving.
+
+The append-only checkpoint layout is optimised for *writing* (immutable
+per-segment shards, one stream per process): a parameter's draw history is
+scattered over many files, split by chain, and always carries every draw.
+A serving process wants the opposite — one contiguous, draw-major block
+per parameter, already pooled over (good) chains, optionally thinned, so
+the engine mmaps it and streams it to the device once.
+
+``compact_posterior`` writes that layout: one ``param-<name>.npy`` per
+served parameter (pooled ``(n_draws, ...)``, C-contiguous) plus a
+``serving.json`` manifest (per-payload crc32, the model-spec fingerprint,
+and everything the engine needs to answer raw-X queries without the
+original ``Hmsc`` object: family codes, Y scaling, per-level unit names).
+``dtype="bfloat16"`` halves the artifact: draws are round-to-nearest cast
+to bf16 and stored as their raw uint16 bit patterns (portable — no bf16
+numpy dependency at load time), and the manifest records the measured
+per-parameter max absolute/relative cast error so a consumer can judge
+the trade-off against its own tolerance (``tests/test_serve.py`` asserts
+predictions stay within it).
+
+``python -m hmsc_tpu compact <run_dir> <out_dir>`` compacts a run
+directory produced by ``python -m hmsc_tpu run`` (the model is rebuilt
+from the ``model.json`` the run driver persists).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from ..utils.checkpoint import (CheckpointCorruptError, CheckpointError,
+                                _atomic_write, _crc)
+
+__all__ = ["compact_posterior", "load_artifact", "ServingArtifact",
+           "ARTIFACT_VERSION", "compact_main"]
+
+ARTIFACT_VERSION = 1
+_MANIFEST_NAME = "serving.json"
+
+
+def _bf16_encode(a: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Round-to-nearest-even bf16 cast of an f32 array, returned as the
+    raw uint16 bit patterns plus the f32 values they decode back to."""
+    import jax.numpy as jnp
+
+    bits = np.asarray(jnp.asarray(np.asarray(a, dtype=np.float32),
+                                  jnp.bfloat16)).view(np.uint16)
+    return bits, _bf16_decode(bits)
+
+
+def _bf16_decode(bits: np.ndarray) -> np.ndarray:
+    """bf16 bit patterns -> f32, with plain numpy (no ml_dtypes needed)."""
+    return (np.asarray(bits, dtype=np.uint32) << 16).view(np.float32)
+
+
+def compact_posterior(post, out_dir: str, *, thin: int = 1,
+                      dtype: str = "float32", params=None) -> dict:
+    """Write a serving-optimised artifact for a fitted posterior.
+
+    ``params`` defaults to what the serving engine reads: Beta, sigma and
+    every level's Eta/Lambda (+ wRRR on reduced-rank models).  ``thin``
+    keeps every ``thin``-th recorded draw per chain (applied before the
+    pool, so an mmap'd history only ever copies the kept rows).  ``dtype``
+    is ``"float32"`` (bit-exact) or ``"bfloat16"`` (half the bytes;
+    measured cast error recorded per parameter).  Returns the written
+    manifest."""
+    thin = int(thin)
+    if thin < 1:
+        raise ValueError(f"compact_posterior: thin must be >= 1, got {thin}")
+    if dtype not in ("float32", "bfloat16"):
+        raise ValueError("compact_posterior: dtype must be 'float32' or "
+                         f"'bfloat16', got {dtype!r}")
+    hM, spec = post.hM, post.spec
+    if params is None:
+        params = ["Beta", "sigma"]
+        for r in range(spec.nr):
+            params += [f"Eta_{r}", f"Lambda_{r}"]
+        if "wRRR" in post.arrays:
+            params.append("wRRR")
+    missing = [p for p in params if p not in post.arrays]
+    if missing:
+        raise KeyError(
+            f"compact_posterior: {missing} not recorded in this posterior "
+            "(re-sample without the record= restriction, or drop them from "
+            "params=)")
+
+    os.makedirs(out_dir, exist_ok=True)
+    from ..utils.checkpoint import spec_fingerprint
+
+    entries = {}
+    n_draws = None
+    for name in params:
+        a = np.ascontiguousarray(post.pooled(name, thin=thin))
+        n_draws = a.shape[0] if n_draws is None else n_draws
+        if a.shape[0] != n_draws:
+            raise ValueError(
+                f"compact_posterior: {name} carries {a.shape[0]} pooled "
+                f"draws, expected {n_draws}")
+        entry = {"shape": list(a.shape), "dtype": str(a.dtype)}
+        if dtype == "bfloat16" and np.issubdtype(a.dtype, np.floating):
+            a32 = np.asarray(a, dtype=np.float32)
+            bits, back = _bf16_encode(a32)
+            err = np.abs(back - a32)
+            scale = np.maximum(np.abs(a32), 1e-30)
+            entry.update(
+                dtype="float32", stored_dtype="bfloat16_bits",
+                cast={"max_abs_err": float(err.max(initial=0.0)),
+                      "max_rel_err": float((err / scale).max(initial=0.0))})
+            a = bits
+        fname = f"param-{name}.npy"
+        path = os.path.join(out_dir, fname)
+        _atomic_write(path, lambda f, _a=a: np.lib.format.write_array(
+            f, _a, allow_pickle=False))
+        entry.update(file=fname, crc32=_crc(a),
+                     nbytes=int(os.path.getsize(path)))
+        entries[name] = entry
+
+    m, s = hM.y_scale_par
+    good = post.good_chain_mask()
+    manifest = {
+        "format": "hmsc_tpu-serving-artifact",
+        "version": ARTIFACT_VERSION,
+        "n_draws": int(n_draws or 0),
+        "thin": thin,
+        "dtype": dtype,
+        "spec_sha256": spec_fingerprint(spec),
+        "source": {"samples": int(post.samples),
+                   "transient": int(post.transient),
+                   "thin": int(post.thin),
+                   "n_chains": int(post.n_chains),
+                   "good_chains": int(good.sum())},
+        "model": {"ns": int(hM.ns), "nc": int(hM.nc),
+                  "nc_nrrr": int(hM.nc_nrrr), "nc_rrr": int(hM.nc_rrr),
+                  "x_is_list": bool(hM.x_is_list),
+                  "distr": [int(v) for v in hM.distr[:, 0]],
+                  "y_scale_m": [float(v) for v in np.asarray(m)],
+                  "y_scale_s": [float(v) for v in np.asarray(s)]},
+        "levels": [{"name": hM.rl_names[r],
+                    "units": [str(u) for u in hM.pi_names[r]],
+                    "x_dim": int(spec.levels[r].x_dim),
+                    "nf": int(spec.levels[r].nf_max)}
+                   for r in range(spec.nr)],
+        "params": entries,
+    }
+    _atomic_write(os.path.join(out_dir, _MANIFEST_NAME),
+                  lambda f: f.write(json.dumps(manifest,
+                                               sort_keys=True).encode()))
+    return manifest
+
+
+class ServingArtifact:
+    """Read side of a compacted artifact: lazily materialised, optionally
+    memory-mapped, parameters plus the manifest metadata the engine reads.
+
+    ``pooled(name)`` mirrors ``Posterior.pooled`` — one ``(n_draws, ...)``
+    f32 array per parameter.  f32 artifacts come back as zero-copy
+    ``np.memmap`` views with ``mmap=True``; bf16-stored parameters decode
+    to f32 on first access (one copy, cached — the artifact's win is disk
+    and transfer bytes, not resident RAM)."""
+
+    def __init__(self, dirpath: str, *, mmap: bool = True,
+                 verify: bool = True):
+        self.dir = os.fspath(dirpath)
+        mpath = os.path.join(self.dir, _MANIFEST_NAME)
+        try:
+            with open(mpath, "rb") as f:
+                man = json.loads(f.read().decode())
+        except (OSError, ValueError, UnicodeDecodeError) as e:
+            raise CheckpointCorruptError(
+                f"{mpath}: unreadable serving manifest "
+                f"({type(e).__name__}: {e})") from e
+        if (not isinstance(man, dict)
+                or man.get("format") != "hmsc_tpu-serving-artifact"):
+            raise CheckpointCorruptError(
+                f"{mpath}: not an hmsc_tpu serving artifact")
+        if int(man.get("version", 0)) > ARTIFACT_VERSION:
+            raise CheckpointError(
+                f"{mpath}: artifact version {man['version']} is newer than "
+                f"this package reads (<= {ARTIFACT_VERSION}) — upgrade "
+                "hmsc_tpu to serve it")
+        self.meta = man
+        self.n_draws = int(man["n_draws"])
+        self._mmap = bool(mmap)
+        self._verify = bool(verify)
+        self._cache: dict = {}
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.meta["params"]
+
+    def params(self) -> list[str]:
+        return list(self.meta["params"])
+
+    def pooled(self, name: str) -> np.ndarray:
+        if name in self._cache:
+            return self._cache[name]
+        entry = self.meta["params"].get(name)
+        if entry is None:
+            raise KeyError(
+                f"{name!r} is not in this serving artifact (has: "
+                f"{sorted(self.meta['params'])}) — re-run compaction with "
+                "params= including it")
+        path = os.path.join(self.dir, entry["file"])
+        decode = entry.get("stored_dtype") == "bfloat16_bits"
+        try:
+            # decoding reads every byte anyway; mmap only helps raw f32
+            a = np.load(path, allow_pickle=False,
+                        mmap_mode="r" if (self._mmap and not decode)
+                        else None)
+        except (OSError, ValueError) as e:
+            raise CheckpointCorruptError(
+                f"{path}: unreadable artifact parameter "
+                f"({type(e).__name__}: {e})") from e
+        if self._verify:
+            # verified even when memory-mapped: the crc streams the pages
+            # without materialising a copy, and a serving engine reads
+            # every byte at staging time anyway — so unlike the shard
+            # mmap fast path, artifact verification costs ~nothing extra
+            got = _crc(a)
+            if got != entry["crc32"]:
+                raise CheckpointCorruptError(
+                    f"{path}: parameter {name!r} failed its integrity "
+                    f"checksum (crc32 {got} != {entry['crc32']}) — the "
+                    "artifact is corrupt; re-run compaction")
+        if decode:
+            a = _bf16_decode(np.asarray(a))
+        want = tuple(entry["shape"])
+        if a.shape != want:
+            raise CheckpointCorruptError(
+                f"{path}: parameter {name!r} has shape {a.shape}, manifest "
+                f"claims {want}")
+        self._cache[name] = a
+        return a
+
+    def cast_tolerance(self, name: str) -> dict | None:
+        """The recorded bf16 cast error for a parameter (``None`` for
+        bit-exact f32 storage)."""
+        return self.meta["params"][name].get("cast")
+
+
+def load_artifact(dirpath: str, *, mmap: bool = True,
+                  verify: bool = True) -> ServingArtifact:
+    """Open a compacted serving artifact directory."""
+    return ServingArtifact(dirpath, mmap=mmap, verify=verify)
+
+
+def _rebuild_run_model(run_dir: str):
+    """Rebuild the synthetic-run model from the ``model.json`` the run
+    driver (``python -m hmsc_tpu run``) persists next to its snapshots."""
+    mpath = os.path.join(run_dir, "model.json")
+    if not os.path.exists(mpath):
+        raise CheckpointError(
+            f"{run_dir}: no model.json — `compact`/`serve` can rebuild the "
+            "model only for run directories written by `python -m hmsc_tpu "
+            "run`; for your own models call "
+            "hmsc_tpu.serve.compact_posterior / ServingEngine directly")
+    with open(mpath) as f:
+        margs = json.load(f)
+    from ..bench_cli import _model
+    return _model(margs["ny"], margs["ns"], margs["nf"], seed=66)
+
+
+def load_run_posterior(run_dir: str, hM=None, *, mmap: bool = True):
+    """The newest valid posterior under a run directory, rebuilding the
+    model from ``model.json`` when ``hM`` is not given.  Append-layout
+    manifests load as lazily materialised mmap views by default (the
+    serving engine streams each parameter to the device exactly once);
+    corrupt slots fall back like ``latest_valid_checkpoint``.  Returns
+    ``(posterior, hM)``."""
+    import warnings
+
+    from ..utils.checkpoint import (checkpoint_files, load_checkpoint_full,
+                                    load_manifest_checkpoint)
+
+    if hM is None:
+        hM = _rebuild_run_model(run_dir)
+    cands = checkpoint_files(run_dir)
+    if not cands:
+        raise CheckpointError(f"no checkpoints found under {run_dir!r}")
+    failures = []
+    for p in cands:
+        try:
+            if p.endswith(".json"):
+                return load_manifest_checkpoint(p, hM, mmap=mmap).post, hM
+            return load_checkpoint_full(p, hM).post, hM
+        except CheckpointCorruptError as e:
+            warnings.warn(
+                f"skipping corrupt checkpoint {p} ({e}); falling back to "
+                "the previous slot", RuntimeWarning, stacklevel=2)
+            failures.append(f"{p}: {e}")
+    raise CheckpointError(
+        "every candidate checkpoint failed to load:\n  "
+        + "\n  ".join(failures))
+
+
+def compact_main(argv=None) -> int:
+    """``python -m hmsc_tpu compact`` — thin + re-shard a run directory
+    into a serving artifact."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m hmsc_tpu compact",
+        description="compact a fitted run's append-only posterior into a "
+                    "serving-optimised artifact (one contiguous draw-major "
+                    "block per parameter)")
+    ap.add_argument("run_dir", help="checkpoint directory of a completed "
+                                    "`python -m hmsc_tpu run`")
+    ap.add_argument("out_dir", help="artifact output directory")
+    ap.add_argument("--thin", type=int, default=1,
+                    help="keep every Nth pooled draw (default 1)")
+    ap.add_argument("--dtype", choices=("float32", "bfloat16"),
+                    default="float32",
+                    help="draw storage: float32 (bit-exact) or bfloat16 "
+                         "(half the bytes; cast error recorded in the "
+                         "manifest)")
+    ap.add_argument("--params", default=None,
+                    help="comma-separated parameter names (default: what "
+                         "the serving engine reads)")
+    args = ap.parse_args(argv)
+
+    post, _ = load_run_posterior(args.run_dir)
+    man = compact_posterior(
+        post, args.out_dir, thin=args.thin, dtype=args.dtype,
+        params=args.params.split(",") if args.params else None)
+    total = sum(e["nbytes"] for e in man["params"].values())
+    # hmsc: ignore[bare-print] — CLI contract: one JSON record on stdout
+    print(json.dumps({
+        "out_dir": args.out_dir, "n_draws": man["n_draws"],
+        "dtype": man["dtype"], "params": sorted(man["params"]),
+        "total_bytes": total,
+        "max_abs_err": max((e.get("cast", {}).get("max_abs_err", 0.0)
+                            for e in man["params"].values()), default=0.0),
+    }))
+    return 0

@@ -1,0 +1,551 @@
+"""Long-lived posterior serving engine: open a fitted run once, answer
+batched prediction queries at low latency.
+
+The scaling story (ROADMAP: "predictions as a product"): a fitted
+posterior is loaded exactly once — an mmap'd append-layout manifest or a
+compacted :mod:`~hmsc_tpu.serve.artifact` — staged to the device as one
+stacked (n_draws, ...) batch, and every query is answered by a
+precompiled jitted kernel (:mod:`~hmsc_tpu.serve.kernels`).  Three
+mechanisms keep the device-call count low and the compile count bounded:
+
+- **Shape buckets.**  Query row counts are padded up to a small fixed set
+  of bucket sizes, so arbitrary query sizes map onto a handful of
+  compiled programs and steady-state traffic NEVER triggers a recompile
+  (asserted by ``benchmarks/bench_serving.py`` via the engine's
+  compile-cache hit counters).
+- **An LRU compile cache.**  Kernels are keyed by (kind, bucket, static
+  config); entries beyond ``cache_size`` evict least-recently-used.
+  ``stats()["cache"]`` exposes hits/misses — the zero-recompile gate.
+- **Micro-batching.**  Concurrent queries are coalesced within a bounded
+  window (``coalesce_ms``, or until the largest bucket fills) into ONE
+  device call per bucket; results are split back per request.  At 64
+  concurrent single-site queries this is one kernel dispatch instead of
+  64 (gated ≥5x the serial ``predict()`` path).
+
+Per-request telemetry rides the same :class:`~hmsc_tpu.obs.RunTelemetry`
+machinery as the sampler: ``queue_wait`` / ``pad`` / ``dispatch`` /
+``fetch`` spans per batch, request/row counters, and an optional JSONL
+sink next to the artifact — ``python -m hmsc_tpu report`` renders it, and
+``serve --prom`` exports Prometheus gauges through the report machinery.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue as _queue
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from ..obs import RunTelemetry, events_path
+from .artifact import ServingArtifact, load_artifact, load_run_posterior
+from .kernels import make_conditional_kernel, make_predict_kernel
+
+__all__ = ["ServingEngine", "DEFAULT_BUCKETS"]
+
+DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+_STOP = object()
+
+
+class _Request:
+    __slots__ = ("config", "n_rows", "arrays", "future", "t_submit")
+
+    def __init__(self, config, n_rows, arrays, future):
+        self.config = config          # kernel config key (kind + statics)
+        self.n_rows = n_rows
+        self.arrays = arrays          # dict of per-row host arrays
+        self.future = future
+        self.t_submit = time.perf_counter()
+
+
+class ServingEngine:
+    """Serve predictions from a fitted posterior (see module docstring).
+
+    ``source`` is a :class:`~hmsc_tpu.post.Posterior`, a
+    :class:`~hmsc_tpu.serve.artifact.ServingArtifact`, or a path (a
+    compacted artifact directory, or a run directory written by
+    ``python -m hmsc_tpu run``).  ``hM`` is required only when ``source``
+    does not carry the model itself (a run-directory path rebuilds it from
+    ``model.json``; an artifact is self-contained for raw-X queries).
+
+    Serving scope (v1): shared-design models (``x_is_list=False``) without
+    a reduced-rank term, random levels with unit loadings
+    (``x_dim == 0``).  Queries at *training* units gather their posterior
+    Eta rows; unknown/new units use the mean-field zero row (the
+    ``predict_eta_mean`` semantics).  Richer structures fall back to the
+    offline :func:`hmsc_tpu.predict` path.
+    """
+
+    # the submit path (any caller thread) and the coalescing worker share
+    # the compile cache and the counters; `hmsc_tpu lint` (lock-discipline)
+    # enforces the declaration below
+    # hmsc: guarded-by[_lock]: _cache, _hits, _misses, _n_requests, _n_batches, _n_device_calls, _rows_served, _rows_padded
+
+    def __init__(self, source, hM=None, *, buckets=DEFAULT_BUCKETS,
+                 coalesce_ms: float = 2.0, cache_size: int = 32,
+                 draw_thin: int = 1, telemetry=None, seed: int = 0):
+        self.buckets = tuple(sorted(int(b) for b in buckets))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError(f"buckets must be positive ints, got {buckets}")
+        self.max_bucket = self.buckets[-1]
+        self.coalesce_s = float(coalesce_ms) / 1e3
+        self.cache_size = int(cache_size)
+        self._rng = np.random.default_rng(seed)
+
+        # telemetry follows the sample_mcmc convention: falsy = aggregates
+        # only (no event retention), True = in-memory events, a directory
+        # = events + JSONL sink
+        self.telem = RunTelemetry(proc=0, enabled=bool(telemetry))
+        if telemetry and not isinstance(telemetry, bool):
+            self.telem.attach_sink(events_path(telemetry, 0), truncate=True)
+            self.telem.emit("run", "serve_start", buckets=list(self.buckets),
+                            coalesce_ms=float(coalesce_ms))
+
+        self._stage(source, hM, int(draw_thin))
+
+        self._lock = threading.Lock()
+        self._cache: collections.OrderedDict = collections.OrderedDict()
+        self._hits = 0
+        self._misses = 0
+        self._n_requests = 0
+        self._n_batches = 0
+        self._n_device_calls = 0
+        self._rows_served = 0
+        self._rows_padded = 0
+
+        self._queue: _queue.Queue = _queue.Queue()
+        self._closed = False
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="hmsc-serve-batcher")
+        self._worker.start()
+
+    # ------------------------------------------------------------------
+    # posterior staging
+    # ------------------------------------------------------------------
+
+    def _stage(self, source, hM, draw_thin) -> None:
+        import jax.numpy as jnp
+
+        if isinstance(source, str) or hasattr(source, "__fspath__"):
+            import os
+            p = os.fspath(source)
+            if os.path.exists(os.path.join(p, "serving.json")):
+                source = load_artifact(p)
+            else:
+                source, hM = load_run_posterior(p, hM)
+        self.hM = hM
+
+        if isinstance(source, ServingArtifact):
+            meta = source.meta["model"]
+            if meta["nc_rrr"] > 0 or meta["x_is_list"]:
+                raise NotImplementedError(
+                    "serving engine v1: reduced-rank terms and "
+                    "species-specific designs are not servable — use "
+                    "hmsc_tpu.predict on the loaded posterior")
+            levels = source.meta["levels"]
+            if any(lv["x_dim"] > 0 for lv in levels):
+                raise NotImplementedError(
+                    "serving engine v1: covariate-dependent random levels "
+                    "(xDim > 0) are not servable — use hmsc_tpu.predict")
+            pooled = {name: source.pooled(name)[::draw_thin]
+                      for name in (["Beta", "sigma"]
+                                   + [f"Eta_{r}" for r in range(len(levels))]
+                                   + [f"Lambda_{r}"
+                                      for r in range(len(levels))])}
+            self.ns = int(meta["ns"])
+            self.nc = int(meta["nc"])
+            self.fam = np.asarray(meta["distr"], dtype=np.int32)
+            ym = np.asarray(meta["y_scale_m"], dtype=np.float32)
+            ys = np.asarray(meta["y_scale_s"], dtype=np.float32)
+            self.level_names = [lv["name"] for lv in levels]
+            unit_lists = [lv["units"] for lv in levels]
+            self.artifact = source
+        else:                               # a Posterior
+            post = source
+            hM = self.hM = post.hM if hM is None else hM
+            spec = post.spec
+            if hM.nc_rrr > 0 or hM.x_is_list:
+                raise NotImplementedError(
+                    "serving engine v1: reduced-rank terms and "
+                    "species-specific designs are not servable — use "
+                    "hmsc_tpu.predict on the posterior")
+            if any(spec.levels[r].x_dim > 0 for r in range(spec.nr)):
+                raise NotImplementedError(
+                    "serving engine v1: covariate-dependent random levels "
+                    "(xDim > 0) are not servable — use hmsc_tpu.predict")
+            # per-chain thinning rides Posterior.pooled so an mmap-backed
+            # history copies only the kept rows
+            pooled = {"Beta": post.pooled("Beta", thin=draw_thin),
+                      "sigma": post.pooled("sigma", thin=draw_thin)}
+            for r in range(spec.nr):
+                pooled[f"Eta_{r}"] = post.pooled(f"Eta_{r}",
+                                                 thin=draw_thin)
+                # the x_dim==0 ndim-4 trim happens once, in the shared
+                # staging loop below
+                pooled[f"Lambda_{r}"] = post.pooled(f"Lambda_{r}",
+                                                    thin=draw_thin)
+            self.ns = int(hM.ns)
+            self.nc = int(hM.nc)
+            self.fam = np.asarray(hM.distr[:, 0], dtype=np.int32)
+            m, s = hM.y_scale_par
+            ym = np.asarray(m, dtype=np.float32)
+            ys = np.asarray(s, dtype=np.float32)
+            self.level_names = list(hM.rl_names)
+            unit_lists = [list(hM.pi_names[r]) for r in range(spec.nr)]
+            self.artifact = None
+
+        self.nr = len(self.level_names)
+        self.n_draws = int(pooled["Beta"].shape[0])
+        self.any_probit = bool((self.fam == 2).any())
+        self.any_normal = bool((self.fam == 1).any())
+        self.any_poisson = bool((self.fam == 3).any())
+        self._ym_host, self._ys_host = ym, ys
+        # unit label -> Eta row; unknown labels get the appended zero row
+        # (index np_r): the mean-field new-unit semantics
+        self._unit_lut = [{str(u): i for i, u in enumerate(us)}
+                          for us in unit_lists]
+        self._new_unit = [len(us) for us in unit_lists]
+
+        with self.telem.span("stage", n_draws=self.n_draws):
+            f32 = jnp.float32
+            self._Beta = jnp.asarray(pooled["Beta"], f32)
+            self._sigma = jnp.asarray(pooled["sigma"], f32)
+            lams, etas = [], []
+            for r in range(self.nr):
+                lam = pooled[f"Lambda_{r}"]
+                if lam.ndim == 4:
+                    lam = lam[..., 0]
+                lams.append(jnp.asarray(lam, f32))
+                eta = np.asarray(pooled[f"Eta_{r}"], dtype=np.float32)
+                zero = np.zeros((eta.shape[0], 1, eta.shape[2]),
+                                dtype=np.float32)
+                etas.append(jnp.asarray(np.concatenate([eta, zero],
+                                                       axis=1)))
+            self._lams = tuple(lams)
+            self._etas = tuple(etas)
+            self._fam = jnp.asarray(self.fam)
+            self._ym = jnp.asarray(ym)
+            self._ys = jnp.asarray(ys)
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def submit(self, X, *, units=None, Yc=None, expected: bool = True,
+               mcmc_step: int = 1) -> Future:
+        """Enqueue one prediction query; returns a Future resolving to
+        ``{"mean": (q, ns), "sd": (q, ns)}``.
+
+        ``X`` is the (q, nc) design block (model scale, intercept
+        included).  ``units`` optionally maps level name -> q unit labels
+        (training labels gather their posterior Eta rows; unknown labels
+        serve mean-field).  ``Yc`` (q, ns) with NaN for unobserved cells
+        switches to conditional prediction refined by ``mcmc_step`` Gibbs
+        iterations.  ``expected=False`` samples responses instead of
+        returning the location parameter."""
+        if self._closed:
+            raise RuntimeError("ServingEngine is closed")
+        X = np.atleast_2d(np.asarray(X, dtype=np.float32))
+        q = X.shape[0]
+        if X.shape[1] != self.nc:
+            raise ValueError(
+                f"query X has {X.shape[1]} columns, the model has "
+                f"nc={self.nc} covariates (intercept included)")
+        uidx = np.empty((self.nr, q), dtype=np.int32)
+        for r in range(self.nr):
+            lut, new = self._unit_lut[r], self._new_unit[r]
+            if units is None or self.level_names[r] not in units:
+                uidx[r] = new
+            else:
+                labels = units[self.level_names[r]]
+                if len(labels) != q:
+                    raise ValueError(
+                        f"units[{self.level_names[r]!r}] has {len(labels)} "
+                        f"labels for {q} query rows")
+                uidx[r] = [lut.get(str(u), new) for u in labels]
+        arrays = {"X": X, "uidx": uidx}
+        if Yc is not None:
+            Yc = np.atleast_2d(np.asarray(Yc, dtype=np.float32))
+            if Yc.shape != (q, self.ns):
+                raise ValueError(
+                    f"Yc has shape {Yc.shape}, expected ({q}, {self.ns})")
+            if self.any_poisson:
+                raise NotImplementedError(
+                    "serving engine v1: conditional prediction conditions "
+                    "on probit/normal cells only — Poisson models fall "
+                    "back to hmsc_tpu.predict(Yc=...)")
+            # to the model's (y-scaled) Z scale, NaNs masked out
+            Ycs = (Yc - self._ym_host[None, :]) / self._ys_host[None, :]
+            mask = (~np.isnan(Ycs)).astype(np.float32)
+            arrays["Yc"] = np.nan_to_num(Ycs, nan=0.0).astype(np.float32)
+            arrays["mask"] = mask
+            config = ("cond", bool(expected), int(mcmc_step))
+        else:
+            config = ("predict", bool(expected))
+        req = _Request(config, q, arrays, Future())
+        with self._lock:
+            self._n_requests += 1
+        self._queue.put(req)
+        return req.future
+
+    def predict(self, X, **kw) -> dict:
+        """Synchronous :meth:`submit`."""
+        return self.submit(X, **kw).result()
+
+    def gradient(self, focal_variable: str, non_focal_variables=None,
+                 ngrid: int = 20, expected: bool = True) -> dict:
+        """Serve an environmental-gradient query: the
+        :func:`~hmsc_tpu.predict.construct_gradient` design for
+        ``focal_variable``, answered through the bucketed predict kernels
+        (new gradient units serve mean-field).  Returns
+        ``{"grid", "mean", "sd"}``."""
+        if self.hM is None:
+            raise ValueError(
+                "gradient queries need the fitted Hmsc model (formula + "
+                "training covariates); construct the engine with hM=")
+        from ..predict.gradient import construct_gradient
+        from ..utils.formula import design_matrix
+
+        grad = construct_gradient(self.hM, focal_variable,
+                                  non_focal_variables, ngrid=ngrid)
+        Xn, _ = design_matrix(self.hM.x_formula, grad["XDataNew"])
+        out = self.predict(np.asarray(Xn, dtype=np.float32),
+                           expected=expected)
+        out["grid"] = np.asarray(grad["XDataNew"][focal_variable])
+        return out
+
+    def warmup(self, *, expected: bool = True, conditional: bool = False,
+               mcmc_step: int = 1) -> int:
+        """Precompile one kernel per bucket for the given config (and the
+        conditional variant when asked), so first-query latency is a
+        dispatch, not a compile.  Returns the number of kernels built."""
+        import jax.numpy as jnp
+
+        built = 0
+        configs = [("predict", bool(expected))]
+        if conditional:
+            configs.append(("cond", bool(expected), int(mcmc_step)))
+        for config in configs:
+            for b in self.buckets:
+                with self._lock:
+                    fresh = (config, b) not in self._cache
+                fn = self._kernel(config, b)
+                if fresh:
+                    built += 1
+                    args = self._device_args(
+                        config, np.zeros((b, self.nc), np.float32),
+                        np.full((self.nr, b), 0, np.int32),
+                        np.zeros((b, self.ns), np.float32),
+                        np.zeros((b, self.ns), np.float32))
+                    # force the compile now (block on the result)
+                    jnp.asarray(fn(*args)[0]).block_until_ready()
+        return built
+
+    def stats(self) -> dict:
+        """Serving counters + compile-cache stats + span aggregates."""
+        with self._lock:
+            cache = {"hits": self._hits, "misses": self._misses,
+                     "size": len(self._cache),
+                     "capacity": self.cache_size}
+            counts = {"requests": self._n_requests,
+                      "batches": self._n_batches,
+                      "device_calls": self._n_device_calls,
+                      "rows_served": self._rows_served,
+                      "rows_padded": self._rows_padded}
+        return {"n_draws": self.n_draws, "ns": self.ns,
+                "buckets": list(self.buckets),
+                "coalesce_ms": self.coalesce_s * 1e3,
+                "cache": cache, **counts,
+                "spans": self.telem.totals()}
+
+    def close(self) -> None:
+        """Stop the batching worker (pending requests fail)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._queue.put(_STOP)
+        self._worker.join(timeout=10.0)
+        # fail anything that raced past the _closed check in submit() and
+        # landed behind the sentinel — a Future must never hang forever
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except _queue.Empty:
+                break
+            if item is not _STOP and not item.future.done():
+                item.future.set_exception(
+                    RuntimeError("ServingEngine closed"))
+        self.telem.flush()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+    # ------------------------------------------------------------------
+    # compile cache
+    # ------------------------------------------------------------------
+
+    def _kernel(self, config, bucket: int):
+        import jax
+
+        key = (config, int(bucket))
+        with self._lock:
+            fn = self._cache.get(key)
+            if fn is not None:
+                self._hits += 1
+                self._cache.move_to_end(key)
+                return fn
+            self._misses += 1
+        # build outside the lock (tracing/compiling can be slow); a racing
+        # duplicate build is harmless — last one in wins the cache slot
+        if config[0] == "predict":
+            raw = make_predict_kernel(
+                nr=self.nr, expected=config[1],
+                any_probit=self.any_probit, any_poisson=self.any_poisson)
+        else:
+            raw = make_conditional_kernel(
+                nr=self.nr, mcmc_step=config[2], expected=config[1],
+                any_probit=self.any_probit, any_normal=self.any_normal)
+        fn = jax.jit(raw)
+        self.telem.emit("metric", "kernel_build", config=list(map(str, config)),
+                        bucket=int(bucket))
+        with self._lock:
+            self._cache[key] = fn
+            self._cache.move_to_end(key)
+            while len(self._cache) > self.cache_size:
+                self._cache.popitem(last=False)
+        return fn
+
+    def _bucket_for(self, rows: int) -> int:
+        for b in self.buckets:
+            if b >= rows:
+                return b
+        return self.max_bucket
+
+    def _device_args(self, config, Xpad, uidx, Yc=None, mask=None):
+        import jax
+
+        key = jax.random.key(int(self._rng.integers(0, 2**31 - 1)))
+        base = (self._Beta, self._sigma, self._lams, self._etas, self._fam,
+                self._ym, self._ys, Xpad, uidx)
+        if config[0] == "predict":
+            return base + (key,)
+        return base + (Yc, mask, key)
+
+    # ------------------------------------------------------------------
+    # coalescing worker
+    # ------------------------------------------------------------------
+
+    def _run(self) -> None:
+        pending: collections.deque = collections.deque()
+        while True:
+            if pending:
+                item = pending.popleft()
+            else:
+                item = self._queue.get()
+            if item is _STOP:
+                break
+            batch, rows = [item], item.n_rows
+            deadline = time.perf_counter() + self.coalesce_s
+            stop = False
+            while rows < self.max_bucket:
+                wait = deadline - time.perf_counter()
+                if wait <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=wait)
+                except _queue.Empty:
+                    break
+                if nxt is _STOP:
+                    stop = True
+                    break
+                if nxt.config == item.config:
+                    batch.append(nxt)
+                    rows += nxt.n_rows
+                else:
+                    pending.append(nxt)
+                    break            # dispatch what we have; regroup next
+            try:
+                self._dispatch(batch)
+            except Exception as e:   # noqa: BLE001 — a query must fail its
+                # futures, never kill the serving loop
+                for req in batch:
+                    if not req.future.done():
+                        req.future.set_exception(e)
+            if stop:
+                break
+        while pending:
+            req = pending.popleft()
+            req.future.set_exception(RuntimeError("ServingEngine closed"))
+
+    def _dispatch(self, batch: list) -> None:
+        import jax.numpy as jnp
+
+        config = batch[0].config
+        now = time.perf_counter()
+        for req in batch:
+            self.telem.observe("queue_wait", now - req.t_submit,
+                               rows=req.n_rows)
+        total = sum(req.n_rows for req in batch)
+        conditional = config[0] == "cond"
+
+        with self.telem.span("pad", rows=total) as sp:
+            X = np.concatenate([req.arrays["X"] for req in batch], axis=0)
+            uidx = np.concatenate([req.arrays["uidx"] for req in batch],
+                                  axis=1)
+            Yc = mask = None
+            if conditional:
+                Yc = np.concatenate([req.arrays["Yc"] for req in batch],
+                                    axis=0)
+                mask = np.concatenate([req.arrays["mask"] for req in batch],
+                                      axis=0)
+            calls, padded = [], 0
+            for c0 in range(0, total, self.max_bucket):
+                n = min(self.max_bucket, total - c0)
+                b = self._bucket_for(n)
+                padded += b - n
+                Xp = np.zeros((b, self.nc), dtype=np.float32)
+                Xp[:n] = X[c0:c0 + n]
+                up = np.empty((self.nr, b), dtype=np.int32)
+                up[:] = np.asarray(self._new_unit,
+                                   dtype=np.int32).reshape(-1, 1) \
+                    if self.nr else 0
+                up[:, :n] = uidx[:, c0:c0 + n]
+                Ycp = maskp = None
+                if conditional:
+                    Ycp = np.zeros((b, self.ns), dtype=np.float32)
+                    Ycp[:n] = Yc[c0:c0 + n]
+                    maskp = np.zeros((b, self.ns), dtype=np.float32)
+                    maskp[:n] = mask[c0:c0 + n]
+                calls.append((n, b, Xp, up, Ycp, maskp))
+            sp.fields["padded"] = padded
+
+        outs = []
+        for n, b, Xp, up, Ycp, maskp in calls:
+            fn = self._kernel(config, b)
+            with self.telem.span("dispatch", bucket=b, rows=n):
+                mean_d, sd_d = fn(*self._device_args(config, Xp, up, Ycp,
+                                                     maskp))
+            with self.telem.span("fetch", bucket=b):
+                outs.append((np.asarray(mean_d)[:n], np.asarray(sd_d)[:n]))
+        mean = np.concatenate([m for m, _ in outs], axis=0)
+        sd = np.concatenate([s for _, s in outs], axis=0)
+
+        with self._lock:
+            self._n_batches += 1
+            self._n_device_calls += len(calls)
+            self._rows_served += total
+            self._rows_padded += sum(b - n for n, b, *_ in calls)
+        off = 0
+        for req in batch:
+            req.future.set_result({"mean": mean[off:off + req.n_rows],
+                                   "sd": sd[off:off + req.n_rows]})
+            off += req.n_rows
+        if self.telem.has_sink:
+            self.telem.flush()

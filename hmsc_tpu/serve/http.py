@@ -1,0 +1,199 @@
+"""``python -m hmsc_tpu serve`` — stdlib HTTP + JSON front end over
+:class:`~hmsc_tpu.serve.engine.ServingEngine`.
+
+A deliberately dependency-free server: ``ThreadingHTTPServer`` handles
+each connection on its own thread, every handler thread funnels its query
+through ``engine.submit`` — so concurrent HTTP requests micro-batch into
+shared device calls exactly like in-process callers.
+
+Endpoints::
+
+    POST /predict   {"X": [[...]], "units": {level: [...]}?, "Yc": ...?,
+                     "expected": true?, "mcmc_step": 1?}
+                    -> {"mean": [[...]], "sd": [[...]], "n_draws": N}
+    POST /gradient  {"focal": "x1", "ngrid": 20?, "expected": true?}
+    GET  /healthz   liveness + posterior shape
+    GET  /statz     engine stats (counters, cache, span aggregates)
+    GET  /metrics   Prometheus textfile export (obs.report machinery)
+
+``serve <dir>`` accepts a compacted artifact directory (self-contained)
+or a run directory written by ``python -m hmsc_tpu run`` (the model is
+rebuilt from its ``model.json``).  ``--prom FILE`` additionally writes
+the Prometheus textfile on shutdown for node-exporter collection.
+"""
+
+from __future__ import annotations
+
+import json
+
+__all__ = ["make_server", "serve_main"]
+
+
+def _json_body(handler):
+    n = int(handler.headers.get("Content-Length") or 0)
+    raw = handler.rfile.read(n) if n else b"{}"
+    try:
+        doc = json.loads(raw.decode() or "{}")
+    except ValueError as e:
+        raise ValueError(f"request body is not valid JSON: {e}") from e
+    if not isinstance(doc, dict):
+        raise ValueError("request body must be a JSON object")
+    return doc
+
+
+def make_server(engine, host: str = "127.0.0.1", port: int = 0):
+    """A ready-to-run ``ThreadingHTTPServer`` bound to ``engine`` (port 0
+    picks a free port; read it back from ``server.server_address``)."""
+    import http.server
+
+    import numpy as np
+
+    from ..obs.report import serving_prometheus_textfile
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        # route access logging through the library logger, not stderr
+        def log_message(self, fmt, *args):  # noqa: ARG002 — BaseHTTP API
+            pass
+
+        def _send(self, code: int, payload, content_type="application/json"):
+            body = (payload if isinstance(payload, bytes)
+                    else json.dumps(payload).encode())
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — BaseHTTP API
+            if self.path == "/healthz":
+                self._send(200, {"ok": True, "n_draws": engine.n_draws,
+                                 "ns": engine.ns, "nc": engine.nc,
+                                 "buckets": list(engine.buckets)})
+            elif self.path == "/statz":
+                self._send(200, engine.stats())
+            elif self.path == "/metrics":
+                self._send(200,
+                           serving_prometheus_textfile(
+                               engine.stats()).encode(),
+                           content_type="text/plain; version=0.0.4")
+            else:
+                self._send(404, {"error": f"unknown path {self.path!r}"})
+
+        def do_POST(self):  # noqa: N802 — BaseHTTP API
+            try:
+                doc = _json_body(self)
+                if self.path == "/predict":
+                    X = np.asarray(doc["X"], dtype=np.float32)
+                    Yc = doc.get("Yc")
+                    if Yc is not None:
+                        # JSON has no NaN literal: null marks unobserved
+                        Yc = np.asarray(
+                            [[np.nan if v is None else float(v) for v in row]
+                             for row in Yc], dtype=np.float32)
+                    out = engine.predict(
+                        X, units=doc.get("units"), Yc=Yc,
+                        expected=bool(doc.get("expected", True)),
+                        mcmc_step=int(doc.get("mcmc_step", 1)))
+                elif self.path == "/gradient":
+                    out = engine.gradient(
+                        doc["focal"],
+                        non_focal_variables=doc.get("non_focal"),
+                        ngrid=int(doc.get("ngrid", 20)),
+                        expected=bool(doc.get("expected", True)))
+                    out["grid"] = np.asarray(out["grid"])
+                else:
+                    self._send(404,
+                               {"error": f"unknown path {self.path!r}"})
+                    return
+                self._send(200, {
+                    "mean": np.asarray(out["mean"]).tolist(),
+                    "sd": np.asarray(out["sd"]).tolist(),
+                    **({"grid": out["grid"].tolist()}
+                       if "grid" in out else {}),
+                    "n_draws": engine.n_draws,
+                })
+            except (KeyError, ValueError, NotImplementedError) as e:
+                self._send(400, {"error": f"{type(e).__name__}: {e}"})
+            except Exception as e:   # noqa: BLE001 — a failed query must
+                # answer 500, never take down the server loop
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+    return http.server.ThreadingHTTPServer((host, int(port)), Handler)
+
+
+def serve_main(argv=None) -> int:
+    """``python -m hmsc_tpu serve`` — long-lived posterior serving."""
+    import argparse
+
+    from ..obs import get_logger
+    from .engine import DEFAULT_BUCKETS, ServingEngine
+
+    ap = argparse.ArgumentParser(
+        prog="python -m hmsc_tpu serve",
+        description="serve batched posterior predictions over HTTP from a "
+                    "fitted run directory or a compacted serving artifact")
+    ap.add_argument("source",
+                    help="compacted artifact directory (`hmsc_tpu "
+                         "compact`), or a run directory written by "
+                         "`python -m hmsc_tpu run`")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--buckets",
+                    default=",".join(str(b) for b in DEFAULT_BUCKETS),
+                    help="comma-separated padded query-row buckets")
+    ap.add_argument("--coalesce-ms", type=float, default=2.0,
+                    help="micro-batch coalescing window (milliseconds)")
+    ap.add_argument("--draw-thin", type=int, default=1,
+                    help="serve every Nth pooled draw")
+    ap.add_argument("--telemetry-dir", default=None,
+                    help="write the serving event stream "
+                         "(events-p0.jsonl) here")
+    ap.add_argument("--prom", metavar="FILE", default=None,
+                    help="write a Prometheus textfile export of the final "
+                         "serving gauges on shutdown (live scrape: "
+                         "GET /metrics)")
+    ap.add_argument("--no-warmup", action="store_true",
+                    help="skip precompiling one predict kernel per bucket "
+                         "at startup")
+    args = ap.parse_args(argv)
+
+    log = get_logger()
+    engine = ServingEngine(
+        args.source,
+        buckets=tuple(int(b) for b in args.buckets.split(",")),
+        coalesce_ms=args.coalesce_ms, draw_thin=args.draw_thin,
+        telemetry=args.telemetry_dir)
+    if not args.no_warmup:
+        n = engine.warmup()
+        log.info(f"serve: precompiled {n} predict kernels "
+                 f"(buckets {list(engine.buckets)})")
+    server = make_server(engine, args.host, args.port)
+    host, port = server.server_address[:2]
+    log.info(f"serve: {engine.n_draws} draws x {engine.ns} species ready "
+             f"on http://{host}:{port} (POST /predict, /gradient; "
+             f"GET /healthz, /statz, /metrics)")
+    # SIGTERM unwinds like Ctrl-C: the --prom export and the telemetry
+    # flush must survive an orchestrator's ordinary stop signal, same as
+    # the sampler's preemption-safe shutdown
+    import signal
+
+    def _term(signum, frame):  # noqa: ARG001 — signal API
+        raise KeyboardInterrupt
+    old_term = signal.signal(signal.SIGTERM, _term)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        log.info("serve: interrupted, shutting down")
+    finally:
+        signal.signal(signal.SIGTERM, old_term)
+        server.server_close()
+        engine.close()
+        if args.prom:
+            from ..obs.report import serving_prometheus_textfile
+            with open(args.prom, "w") as f:
+                f.write(serving_prometheus_textfile(engine.stats()))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(serve_main())

@@ -1,0 +1,302 @@
+"""Jit-compiled posterior serving kernels.
+
+The prediction path used to run as an unoptimised host computation over the
+pooled draws (``predict/predict.py``: eager ``jnp`` einsums + numpy/scipy
+link transforms, re-dispatched from Python on every call).  This module is
+the compiled core the serving layer (and ``predict`` itself) dispatches
+into: the whole posterior is one stacked (n_draws, ...) batch, and a query
+is answered by ONE jitted program — linear predictor, link transform,
+response sampling and the draw-axis reduction fused by XLA.
+
+Three kernel families, each built by a ``make_*`` factory whose arguments
+are the *static* program structure (number of random levels, observation
+families present, expected-vs-sampled, conditional refinement steps) so a
+built kernel is shape-polymorphic only in the ways the serving engine
+controls (the query-row bucket):
+
+- :func:`linear_predictor` — the shared (n_draws, ny, ns) linear-predictor
+  program (fixed effects, reduced-rank term, per-level latent loadings),
+  jit-cached on its structural key; ``predict._lin_pred`` routes through
+  it, so offline prediction and the serving engine compile the same code.
+- :func:`make_predict_kernel` — marginal prediction for a padded query
+  block: gather Eta rows per query unit (a reserved zero row serves
+  mean-field "new unit" queries, the ``predict_eta_mean`` semantics),
+  linear predictor, link/response transform, posterior mean + sd over
+  draws on device.
+- :func:`make_conditional_kernel` — conditional prediction: each query row
+  is its own unit whose latent factors are refreshed by ``mcmc_step``
+  Gibbs iterations of (updateEta, updateZ) against the observed cells of
+  ``Yc`` (reference ``predict.R:181-198``), vmapped over draws with the
+  unstructured N(0,1) prior (exact for non-spatial levels).
+
+Every kernel keeps the posterior's f32 end to end and derives every dtype
+from its inputs — the static jaxpr audit (``hmsc_tpu lint``, analysis
+layer 2) traces :func:`audit_kernels` under the ``enable_x64`` probe and
+pins the structural fingerprints, exactly like the sampler's updaters.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+__all__ = ["linear_predictor", "make_predict_kernel",
+           "make_conditional_kernel", "audit_kernels"]
+
+
+# ---------------------------------------------------------------------------
+# shared linear predictor (offline predict() and the serving engine)
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=64)
+def _lin_pred_jit(x_is_list: bool, nc_nrrr, lam_dims: tuple):
+    """One compiled linear-predictor program per structural key:
+    species-specific-design flag, reduced-rank split point (``None`` when
+    the model has no RRR term), and each level's Lambda rank (3 =
+    unit loadings, 4 = covariate-dependent)."""
+    import jax
+    import jax.numpy as jnp
+
+    has_rrr = nc_nrrr is not None
+
+    def fn(Xn, Beta, XRRR, wRRR, etas, pis, xrows, lams):
+        if has_rrr:
+            XB = jnp.einsum("yo,nro->nyr", XRRR, wRRR)
+            if x_is_list:
+                L = jnp.einsum("jyc,ncj->nyj", Xn, Beta[:, :nc_nrrr])
+            else:
+                L = jnp.einsum("yc,ncj->nyj", Xn, Beta[:, :nc_nrrr])
+            L = L + jnp.einsum("nyr,nrj->nyj", XB, Beta[:, nc_nrrr:])
+        elif x_is_list:
+            L = jnp.einsum("jyc,ncj->nyj", Xn, Beta)
+        else:
+            L = jnp.einsum("yc,ncj->nyj", Xn, Beta)
+        for r, nd in enumerate(lam_dims):
+            rows = etas[r][:, pis[r], :]                # (n, ny, nf)
+            if nd == 3:
+                L = L + jnp.einsum("nyf,nfj->nyj", rows, lams[r])
+            else:
+                L = L + jnp.einsum("nyf,yk,nfjk->nyj", rows, xrows[r],
+                                   lams[r])
+        return L
+
+    return jax.jit(fn)
+
+
+def linear_predictor(Xn, x_is_list, Beta, *, nc_nrrr=None, XRRR=None,
+                     wRRR=None, etas=(), pis=(), xrows=(), lams=()):
+    """(n_draws, ny, ns) linear predictor as one jitted program.
+
+    ``nc_nrrr`` (with ``XRRR``/``wRRR``) enables the reduced-rank term;
+    ``etas``/``pis``/``xrows``/``lams`` carry one entry per random level
+    (the Eta row gather happens on device).  Repeated calls with the same
+    structure reuse the compiled program — arbitrary shapes retrace but
+    the structural cache is what ``predict`` loops over draws used to pay
+    per call."""
+    lam_dims = tuple(int(np.ndim(l)) for l in lams)
+    fn = _lin_pred_jit(bool(x_is_list),
+                       None if XRRR is None else int(nc_nrrr), lam_dims)
+    return fn(Xn, Beta, XRRR, wRRR, tuple(etas), tuple(pis),
+              tuple(xrows), tuple(lams))
+
+
+# ---------------------------------------------------------------------------
+# serving kernels
+# ---------------------------------------------------------------------------
+
+def _apply_link_expected(L, sigma, fam, any_probit, any_poisson):
+    import jax.numpy as jnp
+    from jax.scipy.special import ndtr
+
+    out = L
+    if any_probit:
+        out = jnp.where(fam[None, None, :] == 2, ndtr(L), out)
+    if any_poisson:
+        out = jnp.where(fam[None, None, :] == 3,
+                        jnp.exp(L + sigma[:, None, :] / 2), out)
+    return out
+
+
+def _apply_link_sampled(L, sigma, fam, key, any_probit, any_poisson):
+    import jax
+    import jax.numpy as jnp
+
+    k_eps, k_pois = jax.random.split(key)
+    eps = jax.random.normal(k_eps, L.shape, dtype=L.dtype)
+    Z = L + jnp.sqrt(sigma)[:, None, :] * eps
+    out = Z
+    if any_probit:
+        out = jnp.where(fam[None, None, :] == 2, (Z > 0).astype(Z.dtype),
+                        out)
+    if any_poisson:
+        lam_p = jnp.exp(jnp.clip(Z, None, 30.0))
+        pois = jax.random.poisson(k_pois, lam_p).astype(Z.dtype)
+        out = jnp.where(fam[None, None, :] == 3, pois, out)
+    return out
+
+
+def make_predict_kernel(*, nr: int, expected: bool, any_probit: bool,
+                        any_poisson: bool):
+    """Marginal-prediction kernel for one padded query block.
+
+    Returns ``fn(Beta, sigma, lams, etas, fam, ym, ys, X, unit_idx, key)
+    -> (mean, sd)`` with shapes ``Beta (n, nc, ns)``, ``sigma (n, ns)``,
+    ``lams[r] (n, nf_r, ns)``, ``etas[r] (n, np_r + 1, nf_r)`` — the LAST
+    Eta row is all-zero and serves "new unit" (mean-field) queries —
+    ``X (B, nc)``, ``unit_idx (nr, B)`` int32 rows into each level's Eta,
+    and ``key`` consumed only when ``expected=False``.  Outputs are the
+    (B, ns) posterior mean and sd over draws, back-scaled to the response
+    scale.  The caller jits the returned function (the serving engine owns
+    the compile cache and its hit counters)."""
+    import jax.numpy as jnp
+
+    def kernel(Beta, sigma, lams, etas, fam, ym, ys, X, unit_idx, key):
+        L = jnp.einsum("yc,ncj->nyj", X, Beta)
+        for r in range(nr):
+            rows = etas[r][:, unit_idx[r], :]           # (n, B, nf)
+            L = L + jnp.einsum("nyf,nfj->nyj", rows, lams[r])
+        if expected:
+            out = _apply_link_expected(L, sigma, fam, any_probit,
+                                       any_poisson)
+        else:
+            out = _apply_link_sampled(L, sigma, fam, key, any_probit,
+                                      any_poisson)
+        out = out * ys[None, None, :] + ym[None, None, :]
+        return out.mean(axis=0), out.std(axis=0)
+
+    return kernel
+
+
+def make_conditional_kernel(*, nr: int, mcmc_step: int, expected: bool,
+                            any_probit: bool, any_normal: bool):
+    """Conditional-prediction kernel: refine each query row's latent
+    factors against its observed ``Yc`` cells, then predict.
+
+    Signature ``fn(Beta, sigma, lams, etas, fam, ym, ys, X, unit_idx, Yc,
+    mask, key) -> (mean, sd)``; ``Yc (B, ns)`` is already on the model's
+    (y-scaled) Z scale with NaNs zeroed, ``mask (B, ns)`` is 1 on observed
+    cells.  Each query row is treated as its own unit in every level (the
+    serving query model): its Eta rows start from the gathered posterior
+    rows (zeros for new units) and are refreshed by ``mcmc_step``
+    iterations of (updateEta, updateZ) under the unstructured N(0,1) prior
+    — exact for non-spatial levels (reference ``predict.R:181-198``).
+    Probit and normal observed cells condition; other families contribute
+    no likelihood weight."""
+    import jax
+    import jax.numpy as jnp
+    from jax.scipy.linalg import cho_solve, solve_triangular
+
+    from ..ops.rand import truncated_normal_onesided
+
+    def kernel(Beta, sigma, lams, etas, fam, ym, ys, X, unit_idx, Yc, mask,
+               key):
+        n_draws = Beta.shape[0]
+        rows0 = tuple(etas[r][:, unit_idx[r], :] for r in range(nr))
+
+        def z_given_yc(E, isig, k):
+            std = isig[None, :] ** -0.5
+            z = E + std * jax.random.normal(k, E.shape, dtype=E.dtype)
+            if any_normal:
+                z = jnp.where((fam[None, :] == 1) & (mask > 0), Yc, z)
+            if any_probit:
+                kz = jax.random.fold_in(k, 1)
+                ztn = truncated_normal_onesided(kz, 0.0, Yc > 0.5, E, std)
+                z = jnp.where((fam[None, :] == 2) & (mask > 0), ztn, z)
+            return z
+
+        def one_draw(beta, sig, lams_n, rows_n, k):
+            LFix = X @ beta                              # (B, ns)
+            isig = 1.0 / sig
+            # step-invariant per level: each row's nf x nf likelihood gram
+            # and its cholesky factor (prior precision is the identity)
+            chol_n = []
+            for r in range(nr):
+                lam = lams_n[r]
+                U = jnp.einsum("fj,gj,j,yj->yfg", lam, lam, isig, mask)
+                P = U + jnp.eye(lam.shape[0], dtype=lam.dtype)[None]
+                chol_n.append(jnp.linalg.cholesky(P))
+
+            def loading(rows):
+                return sum(rows[r] @ lams_n[r] for r in range(nr))
+
+            def step(carry, kk):
+                z, rows = carry
+                for r in range(nr):
+                    others = sum(rows[q] @ lams_n[q] for q in range(nr)
+                                 if q != r)
+                    S = z - LFix - (others if nr > 1 else 0.0)
+                    F = (S * isig[None, :] * mask) @ lams_n[r].T
+                    Lc = chol_n[r]
+                    mean = cho_solve((Lc, True), F[..., None])[..., 0]
+                    kr = jax.random.fold_in(kk, 1 + r)
+                    eps = jax.random.normal(kr, mean.shape,
+                                            dtype=mean.dtype)
+                    noise = solve_triangular(
+                        jnp.swapaxes(Lc, -1, -2), eps[..., None],
+                        lower=False)[..., 0]
+                    rows = rows[:r] + (mean + noise,) + rows[r + 1:]
+                E = LFix + loading(rows)
+                z = z_given_yc(E, isig, jax.random.fold_in(kk, 0))
+                return (z, rows), None
+
+            k0, k_scan, k_out = jax.random.split(k, 3)
+            z0 = z_given_yc(LFix + loading(rows_n), isig, k0)
+            (z, rows), _ = jax.lax.scan(step, (z0, rows_n),
+                                        jax.random.split(k_scan, mcmc_step))
+            E = LFix + loading(rows)
+            if expected:
+                out = _apply_link_expected(E[None], sig[None], fam,
+                                           any_probit, False)[0]
+            else:
+                out = _apply_link_sampled(E[None], sig[None], fam, k_out,
+                                          any_probit, False)[0]
+            return out
+
+        keys = jax.random.split(key, n_draws)
+        out = jax.vmap(one_draw)(Beta, sigma, lams, rows0, keys)
+        out = out * ys[None, None, :] + ym[None, None, :]
+        return out.mean(axis=0), out.std(axis=0)
+
+    return kernel
+
+
+# ---------------------------------------------------------------------------
+# static-audit hook (analysis layer 2)
+# ---------------------------------------------------------------------------
+
+def audit_kernels():
+    """Canonical serving-kernel programs for the jaxpr audit: ``(name, fn,
+    example_args)`` triples traced by ``analysis.jaxpr_rules`` under the
+    enable_x64 f64-leak probe and fingerprinted alongside the sampler's
+    updaters (``hmsc_tpu lint --update-fingerprints`` re-records them)."""
+    import jax
+    import jax.numpy as jnp
+
+    n, B, ns, nc, nf, n_units = 3, 4, 5, 2, 2, 6
+    f32 = jnp.float32
+    Beta = jnp.zeros((n, nc, ns), f32)
+    sigma = jnp.ones((n, ns), f32)
+    lam = jnp.zeros((n, nf, ns), f32)
+    eta = jnp.zeros((n, n_units + 1, nf), f32)        # + mean-field zero row
+    fam = jnp.full((ns,), 2, jnp.int32)
+    ym = jnp.zeros((ns,), f32)
+    ys = jnp.ones((ns,), f32)
+    X = jnp.zeros((B, nc), f32)
+    uidx = jnp.zeros((1, B), jnp.int32)
+    Yc = jnp.zeros((B, ns), f32)
+    mask = jnp.zeros((B, ns), f32)
+    key = jax.random.key(0, impl="threefry2x32")
+
+    k_exp = make_predict_kernel(nr=1, expected=True, any_probit=True,
+                                any_poisson=True)
+    k_sam = make_predict_kernel(nr=1, expected=False, any_probit=True,
+                                any_poisson=True)
+    k_cond = make_conditional_kernel(nr=1, mcmc_step=2, expected=True,
+                                     any_probit=True, any_normal=True)
+    base = (Beta, sigma, (lam,), (eta,), fam, ym, ys, X, uidx)
+    return [
+        ("serve:predict_expected", k_exp, base + (key,)),
+        ("serve:predict_sampled", k_sam, base + (key,)),
+        ("serve:conditional", k_cond, base + (Yc, mask, key)),
+    ]

@@ -115,6 +115,10 @@ def worker_main(argv=None) -> int:
                             timeout_s=args.timeout)
     hM = build_worker_model(**json.loads(args.model))
     run_kw = json.loads(args.run)
+    # an explicit checkpoint_path in --run (including null) overrides the
+    # --ckpt-dir default: the checkpoint-FREE mesh path (telemetry-only
+    # runs, end-of-run skew gather) is protocol surface too
+    ckpt_path = run_kw.pop("checkpoint_path", args.ckpt_dir)
 
     import time as _time
     prog = []                         # [perf_counter, process_time,
@@ -145,7 +149,7 @@ def worker_main(argv=None) -> int:
         else:
             from ..mcmc.sampler import sample_mcmc
             post = sample_mcmc(hM, coordinator=coord,
-                               checkpoint_path=args.ckpt_dir,
+                               checkpoint_path=ckpt_path,
                                progress_callback=progress_callback,
                                **run_kw)
     except PreemptedRun as e:

@@ -1684,22 +1684,12 @@ class CheckpointWriter:
 
     def _record_skew(self, tag: str, parts: list) -> None:
         """Committer-side cross-rank skew at one commit mark, derived from
-        the per-rank telemetry deltas the gather carried: per-rank segment
-        time (compile + dispatch + device→host fetch since the previous
-        mark) and per-rank barrier wait.  ``skew_s`` is max−min segment
-        time — the quantity that, left unchecked, accumulates into gather
-        stalls (the PR 4 A/B measured 27% overhead without per-mark
-        pacing)."""
-        tels = [p.get("telemetry") or {} for p in parts]
-        seg = [round(sum(t.get("spans", {}).get(n, 0.0)
-                         for n in ("compile", "dispatch", "fetch")), 6)
-               for t in tels]
-        bar = [round(t.get("spans", {}).get("barrier_wait", 0.0), 6)
-               for t in tels]
-        skew = round(max(seg) - min(seg), 6) if seg else 0.0
-        self.telem.emit("metric", "rank_skew", tag=tag, segment_s=seg,
-                        barrier_wait_s=bar, skew_s=skew)
-        self.telem.count("rank_skew_s", skew)
+        the per-rank telemetry deltas the gather carried (see
+        :func:`hmsc_tpu.obs.events.record_rank_skew` — shared with the
+        sampler's end-of-run gather on checkpoint-free mesh runs)."""
+        from ..obs.events import record_rank_skew
+        record_rank_skew(self.telem, tag,
+                         [p.get("telemetry") for p in parts])
 
     def _maybe_archive(self, man_path: str, man: dict, ordinal: int) -> None:
         if not (self.archive_every and ordinal % self.archive_every == 0):

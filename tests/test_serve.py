@@ -1,0 +1,379 @@
+"""Posterior serving layer (hmsc_tpu/serve): compaction fidelity, the
+bucketed/micro-batched engine, compile-cache behaviour, and the HTTP
+front end.
+
+The compaction contract under test (ISSUE satellite): a compacted-f32
+artifact serves BIT-IDENTICAL predictions to the uncompacted posterior;
+bf16 compaction agrees within the tolerance the manifest records; an
+mmap'd posterior serves identically to an in-memory one.
+"""
+
+import json
+import os
+import threading
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from hmsc_tpu import Hmsc, HmscRandomLevel, predict, sample_mcmc
+from hmsc_tpu.random_level import set_priors_random_level
+from hmsc_tpu.serve import (ServingEngine, compact_posterior, load_artifact,
+                            load_run_posterior)
+from hmsc_tpu.serve.artifact import compact_main
+from hmsc_tpu.serve.http import make_server
+from hmsc_tpu.utils.checkpoint import (CheckpointCorruptError,
+                                       load_manifest_checkpoint,
+                                       checkpoint_files)
+
+from util import small_model
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module")
+def fitted(tmp_path_factory):
+    """One small fitted probit model with an append-layout run directory
+    (so the mmap tests read real manifests), shared by the module."""
+    m = small_model(ny=30, ns=4, nc=2, distr="probit", n_units=6, seed=3)
+    ck = os.fspath(tmp_path_factory.mktemp("serve-run"))
+    post = sample_mcmc(m, samples=8, transient=4, n_chains=2, seed=1,
+                       nf_cap=2, align_post=False, checkpoint_every=4,
+                       checkpoint_path=ck)
+    return m, post, ck
+
+
+@pytest.fixture(scope="module")
+def engine(fitted):
+    _, post, _ = fitted
+    with ServingEngine(post, coalesce_ms=1.0) as eng:
+        yield eng
+
+
+def _query(q=5):
+    return np.column_stack([np.ones(q),
+                            np.linspace(-1.0, 1.0, q)]).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# compaction fidelity
+# ---------------------------------------------------------------------------
+
+def test_compacted_f32_bit_identical(fitted, engine, tmp_path):
+    _, post, _ = fitted
+    man = compact_posterior(post, os.fspath(tmp_path))
+    assert man["dtype"] == "float32"
+    art = load_artifact(os.fspath(tmp_path))
+    assert art.n_draws == engine.n_draws
+    X = _query()
+    with ServingEngine(art, coalesce_ms=1.0) as eng2:
+        a = engine.predict(X)
+        b = eng2.predict(X)
+    np.testing.assert_array_equal(a["mean"], b["mean"])
+    np.testing.assert_array_equal(a["sd"], b["sd"])
+
+
+def test_compacted_bf16_within_recorded_tolerance(fitted, engine, tmp_path):
+    _, post, _ = fitted
+    man = compact_posterior(post, os.fspath(tmp_path), dtype="bfloat16")
+    tols = {k: e.get("cast", {}).get("max_abs_err", 0.0)
+            for k, e in man["params"].items()}
+    # every float param records a tolerance; at least one is a real cast
+    # error (a probit model's sigma is exactly 1.0 — bf16-exact, tol 0)
+    assert all(t >= 0 for t in tols.values()) and max(tols.values()) > 0
+    art = load_artifact(os.fspath(tmp_path))
+    # the artifact decodes to exactly what the cast measured: re-encoding
+    # is the identity, so the recorded tolerance is the true param error
+    for k, t in tols.items():
+        diff = np.abs(np.asarray(art.pooled(k), dtype=np.float32)
+                      - np.asarray(post.pooled(k), dtype=np.float32))
+        assert diff.max() <= t + 1e-12, k
+        assert art.cast_tolerance(k)["max_abs_err"] == t
+    X = _query()
+    with ServingEngine(art, coalesce_ms=1.0) as eng2:
+        a = engine.predict(X)
+        b = eng2.predict(X)
+    # probit means are 1-Lipschitz in the linear predictor scaled by the
+    # normal pdf peak; a loose 10x param-tolerance bound keeps the test
+    # meaningful without modelling the exact propagation
+    tol = 10 * max(tols.values()) + 1e-6
+    assert np.abs(a["mean"] - b["mean"]).max() <= tol
+
+
+def test_compaction_thins_per_chain(fitted, tmp_path):
+    _, post, _ = fitted
+    man = compact_posterior(post, os.fspath(tmp_path), thin=2)
+    art = load_artifact(os.fspath(tmp_path))
+    # per-chain thinning before the pool (Posterior.pooled(thin=)): every
+    # 2nd recorded sample of each chain, flattened in chain order
+    full = post["Beta"]                          # (chains, samples, ...)
+    want = full[:, ::2].reshape((-1,) + full.shape[2:])
+    np.testing.assert_array_equal(art.pooled("Beta"), want)
+    assert man["n_draws"] == want.shape[0]
+    with pytest.raises(ValueError, match="thin"):
+        post.pooled("Beta", thin=0)
+
+
+def test_mmap_vs_inmemory_identical(fitted):
+    m, _, ck = fitted
+    man_path = checkpoint_files(ck)[0]
+    assert man_path.endswith(".json")
+    post_mm = load_manifest_checkpoint(man_path, m, mmap=True).post
+    post_ram = load_manifest_checkpoint(man_path, m, mmap=False).post
+    X = _query()
+    with ServingEngine(post_mm, coalesce_ms=1.0) as e1, \
+            ServingEngine(post_ram, coalesce_ms=1.0) as e2:
+        a = e1.predict(X)
+        b = e2.predict(X)
+    np.testing.assert_array_equal(a["mean"], b["mean"])
+    np.testing.assert_array_equal(a["sd"], b["sd"])
+
+
+def test_artifact_corruption_detected(fitted, tmp_path):
+    _, post, _ = fitted
+    man = compact_posterior(post, os.fspath(tmp_path))
+    path = os.path.join(os.fspath(tmp_path),
+                        man["params"]["Beta"]["file"])
+    data = bytearray(open(path, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+    # the DEFAULT (mmap'd) load verifies too: the crc streams the mapped
+    # pages, so a serving host never silently serves a flipped bit
+    for mmap in (True, False):
+        art = load_artifact(os.fspath(tmp_path), mmap=mmap, verify=True)
+        with pytest.raises(CheckpointCorruptError, match="checksum"):
+            art.pooled("Beta")
+    # and the opt-out still opts out
+    assert load_artifact(os.fspath(tmp_path),
+                         verify=False).pooled("Beta").shape
+
+
+# ---------------------------------------------------------------------------
+# engine semantics
+# ---------------------------------------------------------------------------
+
+def test_engine_matches_offline_predict(fitted, engine):
+    """The served expected-value prediction equals the offline predict()
+    posterior mean at the training design (same draws, same math — only
+    one is a fused jitted kernel)."""
+    m, post, _ = fitted
+    offline = predict(post, expected=True)          # (n, ny, ns)
+    units = {m.rl_names[0]: [str(v) for v in m.df_pi[0]]}
+    out = engine.predict(np.asarray(m.X, dtype=np.float32), units=units)
+    np.testing.assert_allclose(out["mean"], offline.mean(axis=0),
+                               atol=5e-5, rtol=1e-4)
+
+
+def test_unknown_units_serve_mean_field(engine):
+    X = _query(3)
+    base = engine.predict(X)
+    nofx = engine.predict(X, units={"lvl": ["nope1", "nope2", "nope3"]})
+    known = engine.predict(X, units={"lvl": ["u00", "u01", "u02"]})
+    np.testing.assert_array_equal(base["mean"], nofx["mean"])
+    assert np.abs(known["mean"] - base["mean"]).max() > 0
+
+
+def test_conditional_prediction(engine):
+    """Conditioning on observed cells moves the unobserved-species
+    prediction and keeps everything finite; an all-NaN Yc row conditions
+    on nothing."""
+    X = _query(4)
+    marg = engine.predict(X)
+    Yc = np.full((4, engine.ns), np.nan, dtype=np.float32)
+    Yc[:, 0] = 1.0
+    cond = engine.predict(X, Yc=Yc, mcmc_step=2)
+    assert np.isfinite(cond["mean"]).all() and np.isfinite(cond["sd"]).all()
+    assert np.abs(cond["mean"] - marg["mean"]).max() > 0
+    assert (cond["mean"] >= 0).all() and (cond["mean"] <= 1).all()
+
+
+def test_sampled_responses(engine):
+    out = engine.predict(_query(3), expected=False)
+    # probit sampled responses are 0/1 per draw; their mean is a rate
+    assert (out["mean"] >= 0).all() and (out["mean"] <= 1).all()
+
+
+def test_micro_batching_coalesces(fitted):
+    """64 concurrent queries coalesce into far fewer device calls and
+    return the same numbers the serial path returns."""
+    _, post, _ = fitted
+    with ServingEngine(post, coalesce_ms=50.0) as eng:
+        eng.warmup()
+        X = _query(1)
+        serial = eng.predict(X)
+        base = eng.stats()
+        futs = [eng.submit(X) for _ in range(64)]
+        outs = [f.result(timeout=60) for f in futs]
+        stats = eng.stats()
+    for o in outs:
+        np.testing.assert_allclose(o["mean"], serial["mean"], atol=1e-6)
+    n_batches = stats["batches"] - base["batches"]
+    n_calls = stats["device_calls"] - base["device_calls"]
+    assert n_batches < 64 and n_calls < 64, (n_batches, n_calls)
+    assert stats["rows_served"] - base["rows_served"] == 64
+
+
+def test_zero_recompiles_after_warmup(fitted):
+    _, post, _ = fitted
+    rng = np.random.default_rng(0)
+    with ServingEngine(post, coalesce_ms=0.5, buckets=(1, 2, 4, 8)) as eng:
+        n = eng.warmup()
+        assert n == 4
+        base = eng.stats()["cache"]
+        for q in rng.integers(1, 9, size=25):
+            eng.predict(_query(int(q)))
+        cache = eng.stats()["cache"]
+    assert cache["misses"] == base["misses"], \
+        f"recompiles after warmup: {cache} vs {base}"
+    assert cache["hits"] >= base["hits"] + 25
+
+
+def test_compile_cache_lru_bounded(fitted):
+    _, post, _ = fitted
+    with ServingEngine(post, coalesce_ms=0.5, buckets=(1, 2, 4),
+                       cache_size=2) as eng:
+        for q in (1, 2, 4, 1):
+            eng.predict(_query(q))
+        cache = eng.stats()["cache"]
+    assert cache["size"] <= 2
+    # bucket 1 was evicted by (2, 4) and had to rebuild on re-use
+    assert cache["misses"] == 4
+
+
+def test_oversized_query_chunks(fitted):
+    _, post, _ = fitted
+    with ServingEngine(post, coalesce_ms=0.5, buckets=(1, 2, 4)) as eng:
+        out = eng.predict(_query(11))            # > max bucket
+        stats = eng.stats()
+    assert out["mean"].shape == (11, eng.ns)
+    assert stats["device_calls"] == 3            # 4 + 4 + 4(padded)
+    assert np.isfinite(out["mean"]).all()
+
+
+def test_engine_telemetry_and_prometheus(fitted, tmp_path):
+    from hmsc_tpu.obs.report import serving_prometheus_textfile
+
+    _, post, _ = fitted
+    tel = os.fspath(tmp_path / "tel")
+    with ServingEngine(post, coalesce_ms=0.5, telemetry=tel) as eng:
+        eng.predict(_query(2))
+        stats = eng.stats()
+    for span in ("queue_wait", "pad", "dispatch", "fetch", "stage"):
+        assert span in stats["spans"], span
+    assert stats["spans"]["queue_wait"]["count"] == 1
+    events = [json.loads(ln) for ln in
+              open(os.path.join(tel, "events-p0.jsonl"))]
+    assert any(e["kind"] == "span" and e["name"] == "dispatch"
+               for e in events)
+    prom = serving_prometheus_textfile(stats)
+    assert "hmsc_tpu_serve_requests_total 1" in prom
+    assert 'span="dispatch",proc="serve"' in prom
+
+
+# ---------------------------------------------------------------------------
+# gradient serving
+# ---------------------------------------------------------------------------
+
+def test_gradient_query(tmp_path_factory):
+    rng = np.random.default_rng(7)
+    ny, ns = 24, 3
+    xdf = pd.DataFrame({"x1": rng.standard_normal(ny)})
+    Y = (rng.standard_normal((ny, ns)) > 0).astype(float)
+    study = pd.DataFrame({"lvl": [f"u{i % 5}" for i in range(ny)]})
+    rl = HmscRandomLevel(units=study["lvl"])
+    set_priors_random_level(rl, nf_max=2, nf_min=2)
+    m = Hmsc(Y=Y, x_data=xdf, x_formula="~x1", distr="probit",
+             study_design=study, ran_levels={"lvl": rl})
+    post = sample_mcmc(m, samples=4, transient=2, n_chains=2, seed=2,
+                       nf_cap=2, align_post=False)
+    with ServingEngine(post, coalesce_ms=0.5) as eng:
+        out = eng.gradient("x1", ngrid=7)
+    assert out["grid"].shape == (7,)
+    assert out["mean"].shape == (7, ns)
+    assert np.isfinite(out["mean"]).all()
+
+
+# ---------------------------------------------------------------------------
+# run-directory + CLI + HTTP paths
+# ---------------------------------------------------------------------------
+
+def test_load_run_posterior_and_engine_from_path(fitted):
+    m, post, ck = fitted
+    loaded, _ = load_run_posterior(ck, m)
+    with ServingEngine(loaded, coalesce_ms=0.5) as eng:
+        out = eng.predict(_query(2))
+    assert out["mean"].shape == (2, m.ns)
+
+
+def test_compact_cli_roundtrip(tmp_path):
+    """`python -m hmsc_tpu compact <run_dir> <out>` on a driver-written run
+    directory (model rebuilt from model.json), then serve the artifact."""
+    from hmsc_tpu.bench_cli import _model
+
+    margs = {"ny": 16, "ns": 3, "nf": 2}
+    hM = _model(**margs)
+    ck = os.fspath(tmp_path / "run")
+    os.makedirs(ck)
+    with open(os.path.join(ck, "model.json"), "w") as f:
+        json.dump(margs, f)
+    sample_mcmc(hM, samples=4, transient=2, n_chains=2, seed=0, nf_cap=2,
+                align_post=False, checkpoint_every=4, checkpoint_path=ck)
+    out = os.fspath(tmp_path / "art")
+    assert compact_main([ck, out, "--dtype", "bfloat16"]) == 0
+    art = load_artifact(out)
+    assert art.n_draws == 8
+    with ServingEngine(out, coalesce_ms=0.5) as eng:   # path source
+        res = eng.predict(np.ones((1, 2), dtype=np.float32))
+    assert res["mean"].shape == (1, 3)
+
+
+def test_http_server_roundtrip(fitted):
+    _, post, _ = fitted
+    with ServingEngine(post, coalesce_ms=1.0) as eng:
+        server = make_server(eng, "127.0.0.1", 0)
+        host, port = server.server_address[:2]
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        try:
+            base = f"http://{host}:{port}"
+            with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+                health = json.loads(r.read())
+            assert health["ok"] and health["n_draws"] == eng.n_draws
+            X = _query(2)
+            body = json.dumps({"X": X.tolist()}).encode()
+            req = urllib.request.Request(
+                f"{base}/predict", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                out = json.loads(r.read())
+            ref = eng.predict(X)
+            np.testing.assert_allclose(np.asarray(out["mean"]),
+                                       ref["mean"], atol=1e-6)
+            # malformed body answers 400, not a dead connection
+            bad = urllib.request.Request(
+                f"{base}/predict", data=b"{not json",
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(bad, timeout=10)
+                raise AssertionError("malformed body did not 400")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+            with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+                prom = r.read().decode()
+            assert "hmsc_tpu_serve_requests_total" in prom
+        finally:
+            server.shutdown()
+            server.server_close()
+
+
+def test_engine_rejects_unsupported_structures(fitted):
+    _, post, _ = fitted
+    with ServingEngine(post, coalesce_ms=0.5) as eng:
+        with pytest.raises(ValueError, match="columns"):
+            eng.predict(np.ones((2, 5), dtype=np.float32))
+        with pytest.raises(ValueError, match="labels"):
+            eng.predict(_query(2), units={"lvl": ["u00"]})
+        with pytest.raises(RuntimeError):
+            eng.close()
+            eng.predict(_query(1))

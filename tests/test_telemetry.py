@@ -38,8 +38,9 @@ import pytest
 from hmsc_tpu import sample_mcmc
 from hmsc_tpu.obs import (RunTelemetry, RunningDiagnostics, compact_summary,
                           events_path, rhat_ess)
-from hmsc_tpu.obs.report import (build_report, prometheus_textfile,
-                                 render_report, report_main)
+from hmsc_tpu.obs.report import (build_report, load_run_events,
+                                 prometheus_textfile, render_report,
+                                 report_main)
 from hmsc_tpu.testing.multiproc import build_worker_model, spawn_workers
 
 pytestmark = pytest.mark.telemetry
@@ -477,6 +478,39 @@ def test_two_proc_rank_aggregation(model, tmp_path):
     assert "cross-rank stall / skew" in text
     prom = prometheus_textfile(rep)
     assert "hmsc_tpu_rank_skew_seconds" in prom
+
+
+def test_checkpoint_free_mesh_run_records_end_skew(model, tmp_path):
+    """A mesh run WITHOUT checkpointing has no commit gather to ride, so
+    it used to record per-rank streams but no committer skew marks (the
+    ROADMAP observability gap).  The end-of-run gather closes it: every
+    multi-process run reports at least one final ``rank_skew`` mark."""
+    tel = os.fspath(tmp_path / "tel")
+    recs = spawn_workers(
+        2, ckpt_dir=os.fspath(tmp_path / "unused-ck"),
+        coord_dir=os.fspath(tmp_path / "coord"),
+        run_kw=dict(samples=4, transient=2, thin=1, n_chains=2, seed=11,
+                    verbose=0, checkpoint_path=None, telemetry=tel),
+        out_dir=os.fspath(tmp_path), timeout_s=300, wall_timeout_s=560)
+    bad = [r for r in recs if r["returncode"] != 0]
+    assert not bad, "\n".join(
+        f"rank {r['rank']} rc={r['returncode']}\n{r['stderr'][-2000:]}"
+        for r in bad)
+    # no checkpoint layout was written — this really is the gather-free run
+    assert not os.path.exists(os.fspath(tmp_path / "unused-ck"))
+    assert os.path.exists(events_path(tel, 0))
+    assert os.path.exists(events_path(tel, 1))
+    rep = build_report(tel)
+    assert rep["ranks"] == [0, 1]
+    assert rep["skew"], "end-of-run gather recorded no rank_skew mark"
+    final = rep["skew"][-1]
+    assert final["tag"] == "end"
+    assert len(final["segment_s"]) == 2
+    assert final["skew_s"] >= 0
+    # only the coordinator records the mark; it sits in rank 0's stream
+    ev0 = [e for e in load_run_events(tel)[0]
+           if e.get("kind") == "metric" and e.get("name") == "rank_skew"]
+    assert len(ev0) == 1
 
 
 # ---------------------------------------------------------------------------
