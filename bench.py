@@ -196,6 +196,30 @@ def _lint_summary():
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _cost_ledger_summary():
+    """The static cost-ledger digest (`hmsc_tpu profile --static`): sweep
+    flops and peak temp HBM per canonical spec plus drift vs the committed
+    ledger, run in a CPU-pinned subprocess — the trajectory records
+    cost-model drift even on rounds where the accelerator is unreachable,
+    and the bench's own run never waits on the ledger's compiles."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "hmsc_tpu", "profile", "--static",
+             "--json"],
+            capture_output=True, text=True, timeout=900, env=env)
+        doc = json.loads(r.stdout)["static"]
+        return {"digest": doc["digest"],
+                "matches_committed": doc["matches_committed"],
+                "drift": doc["drift"][:20]}
+    except Exception as e:                   # noqa: BLE001 — bench must emit
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _serving_summary():
     """The serving-layer digest (`benchmarks/bench_serving.py`): p50/p99
     latency, micro-batched throughput and the zero-recompile counter for
@@ -237,10 +261,12 @@ def _skip(reason: str):
         "process_count": None,
         "skipped": True,
         "reason": reason,
-        # lint + the serving digest run on CPU, so the trajectory still
-        # records static health and the serving-layer gates
+        # lint + the serving digest + the cost ledger run on CPU, so the
+        # trajectory still records static health, the serving-layer gates,
+        # and cost-model drift
         "lint_findings": _lint_summary(),
         "serving": _serving_summary(),
+        "cost_ledger": _cost_ledger_summary(),
     }))
     raise SystemExit(0)
 
@@ -390,6 +416,11 @@ def main():
         # micro-batched q/s, zero-recompile gate — the prediction side of
         # the trajectory (benchmarks/bench_serving.py)
         "serving": _serving_summary(),
+        # static cost-ledger digest (CPU subprocess): per-spec sweep flops
+        # + peak temp HBM and drift vs the committed cost_ledger.json
+        # (hmsc_tpu/obs/profile.py) — cost-model drift rides the
+        # trajectory alongside measured throughput
+        "cost_ledger": _cost_ledger_summary(),
     }))
 
 

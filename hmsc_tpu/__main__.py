@@ -7,7 +7,10 @@ telemetry — phase timeline, throughput, cross-rank skew, checkpoint I/O
 and MCMC health — from its ``events-p<rank>.jsonl`` streams; ``--prom``
 exports Prometheus textfile gauges), ``lint`` (the static correctness
 suite: AST lint + jaxpr audits, see ``ANALYSIS.md``; exit 1 on any active
-severity=error finding), ``compact`` (thin + re-shard a fitted run into a
+severity=error finding), ``profile`` (sweep-level cost attribution: the
+static per-updater flops/HBM ledger with its committed diffable digest,
+and measured per-updater wall timing — see README "Profiling"),
+``compact`` (thin + re-shard a fitted run into a
 serving-optimised artifact, optionally bf16), and ``serve`` (long-lived
 HTTP posterior-serving engine: compile-cached bucketed predict kernels +
 micro-batching, see README "Serving").  Bare arguments keep the
@@ -30,6 +33,9 @@ def main(argv=None):
     if argv[:1] == ["lint"]:
         from .analysis.cli import lint_main
         return lint_main(argv[1:])
+    if argv[:1] == ["profile"]:
+        from .obs.profile import profile_main
+        return profile_main(argv[1:])
     if argv[:1] == ["compact"]:
         from .serve.artifact import compact_main
         return compact_main(argv[1:])

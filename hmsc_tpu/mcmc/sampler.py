@@ -28,7 +28,7 @@ from .sweep import effective_spec_data, make_sweep, record_sample
 from . import spatial
 from . import updaters as U
 
-__all__ = ["sample_mcmc"]
+__all__ = ["sample_mcmc", "instrumented_sweep"]
 
 
 class _InlineWriter:
@@ -342,6 +342,94 @@ def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin,
                    donate_argnums=(1, 2, 3))
 
 
+# timed repetitions per block in the instrumented (per-updater) sweep; the
+# minimum over reps is reported, so dispatch jitter shrinks with more reps
+_PROFILE_REPS = 3
+
+
+@functools.lru_cache(maxsize=8)
+def _instrumented_steps(spec, updater_items, adapt_nf, vmapped):
+    """Per-block jitted dispatchers for one Gibbs sweep — the NON-fused
+    runner variant behind ``sample_mcmc(profile_updaters=...)`` and
+    ``python -m hmsc_tpu profile --measured``.  Each schedule block
+    (:func:`~hmsc_tpu.mcmc.sweep.make_sweep_schedule`) compiles as its own
+    program so its wall time is observable with ``block_until_ready``; the
+    production fused runner (:func:`_compiled_runner`) never uses these."""
+    from .sweep import make_sweep_schedule
+    updater = dict(updater_items) if updater_items else None
+    steps = make_sweep_schedule(spec, updater, adapt_nf)
+    out = []
+    for name, block in steps:
+        fn = (jax.vmap(block, in_axes=(None, 0, 0)) if vmapped else block)
+        out.append((name, jax.jit(fn)))
+    return tuple(out)
+
+
+def instrumented_sweep(spec, data, state, key, updater: dict | None = None,
+                       adapt_nf=None, vmapped: bool = False,
+                       reps: int = _PROFILE_REPS, time_fused: bool = True):
+    """Run ONE Gibbs sweep with every schedule block dispatched as its own
+    jitted call, timing each with ``block_until_ready`` over ``reps``
+    repetitions (minimum reported).  Returns ``(state_out, profile)``.
+
+    The block sequence, subkey derivation and op order inside each block
+    are identical to the fused sweep (both fold the same
+    ``make_sweep_schedule``), so ``state_out`` is **bit-identical** to one
+    fused sweep pass — ``tests/test_profile.py`` pins this per canonical
+    spec.  The per-block timed calls re-run a block on the same inputs and
+    discard the result, so timing never perturbs the state either.
+
+    ``profile`` carries ``updaters`` (per-block ``wall_s``/``mean_s`` and
+    ``share`` of the per-block total), ``updater_wall_s``, and — with
+    ``time_fused`` — ``fused_wall_s`` plus ``attributed_frac`` = named
+    updater wall over fused wall (bookkeeping steps, named in parentheses,
+    are excluded from the numerator)."""
+    import time
+
+    from .sweep import make_sweep, sweep_prologue
+
+    adapt_nf = tuple(int(a) for a in (adapt_nf
+                                      or tuple(0 for _ in range(spec.nr))))
+    updater_items = tuple(sorted(updater.items())) if updater else None
+    steps = _instrumented_steps(spec, updater_items, adapt_nf, bool(vmapped))
+    prologue = jax.jit(jax.vmap(sweep_prologue) if vmapped
+                       else sweep_prologue)
+
+    def _timed(fn, *args):
+        out = jax.block_until_ready(fn(*args))   # compile + the real result
+        times = []
+        for _ in range(max(1, int(reps))):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            times.append(time.perf_counter() - t0)
+        return out, min(times), sum(times) / len(times)
+
+    state_it, ks = prologue(state, key)
+    carry = (state_it, None, None, None)
+    blocks, named_total, total = [], 0.0, 0.0
+    for name, jfn in steps:
+        carry, wall, mean = _timed(jfn, data, carry, ks)
+        blocks.append({"name": name, "wall_s": wall, "mean_s": mean})
+        total += wall
+        if not name.startswith("("):
+            named_total += wall
+    for b in blocks:
+        b["share"] = round(b["wall_s"] / total, 4) if total > 0 else 0.0
+        b["wall_s"] = round(b["wall_s"], 7)
+        b["mean_s"] = round(b["mean_s"], 7)
+    prof = {"reps": int(reps), "vmapped": bool(vmapped),
+            "updaters": blocks, "updater_wall_s": round(total, 7)}
+    if time_fused:
+        sweep = make_sweep(spec, dict(updater_items) if updater_items
+                           else None, adapt_nf)
+        ffn = jax.jit(jax.vmap(sweep, in_axes=(None, 0, 0)) if vmapped
+                      else sweep)
+        _, fwall, _ = _timed(ffn, data, state, key)
+        prof["fused_wall_s"] = round(fwall, 7)
+        prof["attributed_frac"] = round(named_total / max(fwall, 1e-12), 4)
+    return carry[0], prof
+
+
 def _find_warm_restart(ck_dir, hM, bad, base_samples, samples):
     """Newest manifest in this run's snapshot directory at which every
     chain in ``bad`` was still healthy.  Returns (full carry state at that
@@ -422,6 +510,7 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 pipeline: bool = True, pipeline_depth: int = 2,
                 init_keys=None, coordinator=None,
                 telemetry=None, profile_segments=None,
+                profile_updaters=None,
                 progress_callback=None, _ckpt_base=None,
                 _transient_base: int = 0, _ckpt_shards=None):
     """Run the blocked Gibbs sampler; returns a :class:`~hmsc_tpu.post.Posterior`.
@@ -588,6 +677,17 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
       ``jax.profiler`` trace covering only host segments ``start..stop``
       (inclusive) — the deep-dive window for a stall telemetry located —
       instead of ``profile_dir`` alone's whole-run trace.
+    - ``profile_updaters=N`` records ONE instrumented per-updater profile
+      pass at the first segment boundary at or after sweep ``N``
+      (clamped to the run's last sweep): the live carry is swept once more
+      with every Gibbs block dispatched as its own jitted call and timed
+      (:func:`instrumented_sweep`), and the per-updater wall/share table is
+      emitted as an ``updater_profile`` telemetry metric and surfaced as
+      ``Posterior.updater_profile``.  The pass only *reads* the carry —
+      its re-run of the next sweep is discarded — so the production fused
+      runner's draw stream is bit-identical with profiling on or off
+      (pinned by ``tests/test_profile.py``).  Render with
+      ``python -m hmsc_tpu report`` ("cost attribution" section).
     - ``progress_callback(samples_done, samples_total)`` is invoked on the
       host after every compiled segment (cumulative counts when continuing a
       checkpointed run; burn-in segments report ``samples_done`` still at
@@ -665,6 +765,12 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         if not (0 <= profile_segments[0] <= profile_segments[1]):
             raise ValueError("profile_segments must be (start, stop) with "
                              f"0 <= start <= stop, got {profile_segments}")
+    if profile_updaters is not None:
+        profile_updaters = int(profile_updaters)
+        if profile_updaters < 0:
+            raise ValueError("profile_updaters must be >= 0 (the sweep "
+                             "index at which the instrumented per-updater "
+                             f"pass records), got {profile_updaters}")
 
     adapt_nf_arg = adapt_nf          # pre-resolution value, for retry_diverged
     if adapt_nf is None:
@@ -1181,6 +1287,13 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         n_burn = len(t_cuts)          # leading plan entries are pure burn-in
         prof_on = False
         prof_done = False             # the window captures exactly once
+        # instrumented per-updater pass: fires once, at the first segment
+        # boundary at or after the requested sweep (clamped so an index
+        # past the run still records at the final boundary)
+        prof_upd = None
+        prof_upd_at = (min(profile_updaters,
+                           int(transient) + int(samples) * int(thin))
+                       if profile_updaters is not None else None)
         for si, (trans_seg, seg) in enumerate(plan):
             in_burnin = si < n_burn
             if profile_segments is not None and not prof_on \
@@ -1242,6 +1355,31 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 prof_done = True
                 telem.emit("metric", "profile_capture", seg=si,
                            action="stop")
+            if prof_upd_at is not None and prof_upd is None \
+                    and sweeps_done >= prof_upd_at:
+                # one instrumented per-updater pass on the live carry: pure
+                # reads, synchronous on the driver thread (the next
+                # segment's donation only happens after this returns), so
+                # the production draw stream is untouched.  The pass
+                # profiles exactly the sweep the fused runner would do
+                # next: the same per-chain subkey the scan's one_iter
+                # would derive.
+                # time_fused=False: the fused reference would compile a
+                # standalone vmapped sweep (a program the run never
+                # otherwise builds) on the driver thread mid-run — minutes
+                # at scale, for one denominator.  The per-updater table
+                # stands alone here; the CLI's measured mode carries the
+                # fused comparison.
+                with telem.span("updater_profile", seg=si):
+                    subs = jax.jit(jax.vmap(
+                        lambda k: jax.random.split(k)[1]))(keys)
+                    _, prof_upd = instrumented_sweep(
+                        spec, data, state_cur, subs, updater=updater,
+                        adapt_nf=adapt_nf, vmapped=True, time_fused=False)
+                prof_upd = dict(prof_upd, seg=si,
+                                sweep=it0 + sweeps_done,
+                                n_chains=int(n_batch))
+                telem.emit("metric", "updater_profile", **prof_upd)
             if verbose:
                 it_now = it0 + sweeps_done
                 phase = ("sampling" if it_now > it0 + int(transient)
@@ -1393,6 +1531,7 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
     post.timing = {"setup_s": t1 - t0, "run_s": t2 - t1}
     post.io_stats = io_stats
     post.telemetry = telem.summary(wall_s=t2 - t1)
+    post.updater_profile = prof_upd
 
     # divergence observability + containment: report each poisoned chain's
     # first non-finite sweep and exclude it from pooled summaries (a user

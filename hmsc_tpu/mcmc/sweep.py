@@ -19,7 +19,8 @@ from . import updaters_sel as USel
 from .spatial import update_alpha, update_eta_spatial
 from .structs import GibbsState, ModelData, ModelSpec
 
-__all__ = ["make_sweep", "record_sample", "effective_spec_data"]
+__all__ = ["make_sweep", "make_sweep_schedule", "sweep_prologue",
+           "record_sample", "effective_spec_data"]
 
 
 def effective_spec_data(spec: ModelSpec, data: ModelData, state: GibbsState):
@@ -33,8 +34,32 @@ def effective_spec_data(spec: ModelSpec, data: ModelData, state: GibbsState):
     return spec_x, data.replace(X=Xeff)
 
 
-def make_sweep(spec: ModelSpec, updater: dict | None = None,
-               adapt_nf: tuple | None = None):
+# The sweep as a SCHEDULE of named Gibbs blocks.  ``make_sweep_schedule``
+# returns the ordered ``(name, block)`` list one sweep comprises; the
+# production ``make_sweep`` folds the blocks inline into ONE traced program
+# (the op sequence is identical to the historical monolithic body — the
+# committed jaxpr fingerprints pin this), while the profiling runner
+# (``sampler.instrumented_sweep``) dispatches each block as its own jitted
+# call to attribute wall time per updater, and a future mesh-sharded sweep
+# can annotate blocks with partition specs without re-deriving the order.
+#
+# Block signature: ``block(data, carry, ks) -> carry`` with the carry tuple
+# ``(state, Xeff, LRan_total, E_shared)`` threading everything that
+# crosses block boundaries:
+#
+# - ``Xeff``: the state-dependent effective design (RRR columns appended,
+#   selection zeroing applied); ``None`` on static-X models.
+# - ``LRan_total``: total random-level loading, consumed by wRRR/BetaSel.
+# - ``E_shared``: the current linear predictor, threaded through the sweep
+#   tail (Eta -> InvSigma -> Z) so total_loading's padding-bound small-K
+#   matmuls run once instead of three times per sweep.
+#
+# Names in parentheses ("(design)", "(lran)") are bookkeeping steps, not
+# registered updaters; every other name matches ``mcmc/registry.py``.
+# Every block runs strictly after ``sweep_prologue`` (it+1 + key split).
+
+def make_sweep_schedule(spec: ModelSpec, updater: dict | None = None,
+                        adapt_nf: tuple | None = None):
     updater = updater or {}
     on = lambda name: updater.get(name, True) is not False
     adapt_nf = adapt_nf or tuple(0 for _ in range(spec.nr))
@@ -45,58 +70,112 @@ def make_sweep(spec: ModelSpec, updater: dict | None = None,
     spec_x = (dataclasses.replace(spec, x_is_list=True)
               if spec.ncsel > 0 and not spec.x_is_list else spec)
 
-    def with_eff_x(data, state):
-        if not has_dynamic_x:
-            return data
-        Xeff, _ = USel.effective_design(spec, data, state)
-        return data.replace(X=Xeff)
-
     # collapsed updaters are opt-in (see updaters_marginal module docstring);
     # the sampler validates their structural gates before enabling
     want = lambda name: updater.get(name, False) is True
 
-    def sweep(data: ModelData, state: GibbsState, key) -> GibbsState:
-        state = state.replace(it=state.it + 1)
-        ks = jax.random.split(key, 13)
-        data_x = with_eff_x(data, state)
+    def data_x_of(data, Xeff):
+        return data if Xeff is None else data.replace(X=Xeff)
 
-        if want("Gamma2"):
+    steps: list = []
+
+    def add(name, fn):
+        steps.append((name, fn))
+
+    if has_dynamic_x:
+        def _design(data, carry, ks):
+            state, _, LRan_total, E_shared = carry
+            Xeff, _ = USel.effective_design(spec, data, state)
+            return state, Xeff, LRan_total, E_shared
+        add("(design)", _design)
+
+    if want("Gamma2"):
+        def _gamma2(data, carry, ks):
+            state, Xeff, *rest = carry
             from .updaters_marginal import update_gamma2
-            state = update_gamma2(spec_x, data_x, state, ks[10])
-        if want("GammaEta"):
+            state = update_gamma2(spec_x, data_x_of(data, Xeff), state,
+                                  ks[10])
+            return (state, Xeff, *rest)
+        add("Gamma2", _gamma2)
+
+    if want("GammaEta"):
+        def _gamma_eta(data, carry, ks):
+            state, Xeff, *rest = carry
             from .updaters_marginal import update_gamma_eta
             for r in range(spec.nr):
-                state = update_gamma_eta(spec_x, data_x, state, r,
+                state = update_gamma_eta(spec_x, data_x_of(data, Xeff),
+                                         state, r,
                                          jax.random.fold_in(ks[11], r))
-        if on("BetaLambda"):
-            state = U.update_beta_lambda(spec_x, data_x, state, ks[0])
-        if has_dynamic_x and spec.nr > 0:
-            LRan_total = sum(U.level_loading(data.levels[r], state.levels[r])
-                             for r in range(spec.nr))
-        elif has_dynamic_x:
-            LRan_total = jnp.zeros_like(state.Z)
-        if spec.nc_rrr > 0 and on("wRRR"):
+            return (state, Xeff, *rest)
+        add("GammaEta", _gamma_eta)
+
+    if on("BetaLambda"):
+        def _beta_lambda(data, carry, ks):
+            state, Xeff, *rest = carry
+            state = U.update_beta_lambda(spec_x, data_x_of(data, Xeff),
+                                         state, ks[0])
+            return (state, Xeff, *rest)
+        add("BetaLambda", _beta_lambda)
+
+    if has_dynamic_x:
+        def _lran(data, carry, ks):
+            state, Xeff, _, E_shared = carry
+            if spec.nr > 0:
+                LRan_total = sum(
+                    U.level_loading(data.levels[r], state.levels[r])
+                    for r in range(spec.nr))
+            else:
+                LRan_total = jnp.zeros_like(state.Z)
+            return state, Xeff, LRan_total, E_shared
+        add("(lran)", _lran)
+
+    if spec.nc_rrr > 0 and on("wRRR"):
+        def _w_rrr(data, carry, ks):
+            state, Xeff, LRan_total, E_shared = carry
             state = USel.update_w_rrr(spec, data, state, ks[8], LRan_total)
-            data_x = with_eff_x(data, state)
-        if spec.ncsel > 0 and on("BetaSel"):
-            state = USel.update_beta_sel(spec, data, state, ks[9], LRan_total)
-            data_x = with_eff_x(data, state)
-        if on("GammaV"):
-            state = U.update_gamma_v(spec, data, state, ks[1])
-        if spec.has_phylo and on("Rho"):
-            state = U.update_rho(spec, data, state, ks[2])
-        if on("LambdaPriors"):
-            state = U.update_lambda_priors(spec, data, state, ks[3])
-        if spec.nc_rrr > 0 and on("wRRRPriors"):
+            Xeff, _ = USel.effective_design(spec, data, state)
+            return state, Xeff, LRan_total, E_shared
+        add("wRRR", _w_rrr)
+
+    if spec.ncsel > 0 and on("BetaSel"):
+        def _beta_sel(data, carry, ks):
+            state, Xeff, LRan_total, E_shared = carry
+            state = USel.update_beta_sel(spec, data, state, ks[9],
+                                         LRan_total)
+            Xeff, _ = USel.effective_design(spec, data, state)
+            return state, Xeff, LRan_total, E_shared
+        add("BetaSel", _beta_sel)
+
+    if on("GammaV"):
+        def _gamma_v(data, carry, ks):
+            state, *rest = carry
+            return (U.update_gamma_v(spec, data, state, ks[1]), *rest)
+        add("GammaV", _gamma_v)
+
+    if spec.has_phylo and on("Rho"):
+        def _rho(data, carry, ks):
+            state, *rest = carry
+            return (U.update_rho(spec, data, state, ks[2]), *rest)
+        add("Rho", _rho)
+
+    if on("LambdaPriors"):
+        def _lambda_priors(data, carry, ks):
+            state, *rest = carry
+            return (U.update_lambda_priors(spec, data, state, ks[3]), *rest)
+        add("LambdaPriors", _lambda_priors)
+
+    if spec.nc_rrr > 0 and on("wRRRPriors"):
+        def _w_rrr_priors(data, carry, ks):
+            state, *rest = carry
             state = USel.update_w_rrr_priors(spec, data, state,
                                              jax.random.fold_in(ks[8], 1))
+            return (state, *rest)
+        add("wRRRPriors", _w_rrr_priors)
 
-        # E_shared: the current linear predictor, threaded through the sweep
-        # tail (Eta -> InvSigma -> Z) so total_loading's padding-bound small-K
-        # matmuls run once instead of three times per sweep
-        E_shared = None
-        if on("Eta") and spec.nr > 0:
-            LFix = U.linear_fixed(spec_x, data_x, state.Beta)
+    if on("Eta") and spec.nr > 0:
+        def _eta(data, carry, ks):
+            state, Xeff, LRan_total, _ = carry
+            LFix = U.linear_fixed(spec_x, data_x_of(data, Xeff), state.Beta)
             LRan = [U.level_loading(data.levels[r], state.levels[r])
                     for r in range(spec.nr)]
             for r in range(spec.nr):
@@ -116,8 +195,18 @@ def make_sweep(spec: ModelSpec, updater: dict | None = None,
             E_shared = LFix
             for r in range(spec.nr):
                 E_shared = E_shared + LRan[r]
+            return state, Xeff, LRan_total, E_shared
+        # one block covers every level's update; label it spatial when ANY
+        # level runs the spatial path, so mixed-level models don't book
+        # spatial-Eta cost under a non-spatial name
+        add("EtaSpatial" if any(spec.levels[r].spatial is not None
+                                for r in range(spec.nr)) else "Eta",
+            _eta)
 
-        if on("Alpha"):
+    if on("Alpha") and any(spec.levels[r].spatial is not None
+                           for r in range(spec.nr)):
+        def _alpha(data, carry, ks):
+            state, *rest = carry
             for r in range(spec.nr):
                 if spec.levels[r].spatial is not None:
                     lv = update_alpha(spec, data, state, r,
@@ -125,52 +214,111 @@ def make_sweep(spec: ModelSpec, updater: dict | None = None,
                     levels = list(state.levels)
                     levels[r] = lv
                     state = state.replace(levels=tuple(levels))
+            return (state, *rest)
+        add("Alpha", _alpha)
 
-        # beyond-reference: per-factor (Eta, Lambda) scale interweaving
-        # (measured 2x ESS on association scales) and the per-factor
-        # (Eta, Beta_intercept) location move (measured +10% min / +20%
-        # median Beta ESS at config 2 once the round-5 gate fix made it
-        # actually run — benchmarks/ab_interweave_da.py).  Both default on,
-        # both leave the linear predictor invariant, so E_shared stays
-        # valid.  interweave_location self-gates (location_gate) on models
-        # where its invariance breaks.  Gated on the updaters they perturb:
-        # a frozen Eta/BetaLambda run (debugging, conditional sampling)
-        # must not see drifting Eta/Lambda/Beta
-        iw_ok = spec.nr > 0 and on("Eta") and on("BetaLambda")
-        if iw_ok and (on("Interweave") or on("InterweaveLocation")):
+    # beyond-reference: per-factor (Eta, Lambda) scale interweaving
+    # (measured 2x ESS on association scales) and the per-factor
+    # (Eta, Beta_intercept) location move (measured +10% min / +20%
+    # median Beta ESS at config 2 once the round-5 gate fix made it
+    # actually run — benchmarks/ab_interweave_da.py).  Both default on,
+    # both leave the linear predictor invariant, so E_shared stays
+    # valid.  interweave_location self-gates (location_gate) on models
+    # where its invariance breaks.  Gated on the updaters they perturb:
+    # a frozen Eta/BetaLambda run (debugging, conditional sampling)
+    # must not see drifting Eta/Lambda/Beta
+    iw_ok = spec.nr > 0 and on("Eta") and on("BetaLambda")
+    if iw_ok and (on("Interweave") or on("InterweaveLocation")):
+        # ONE block for both moves: they share the ks[12] split exactly as
+        # the historical monolithic body did, and keeping them in one
+        # compiled program is what makes the instrumented per-block
+        # dispatch bit-identical to the fused sweep (splitting them was
+        # measured to move interweave_location's phylo-path dot by 1 ULP
+        # under XLA's boundary-sensitive fusion)
+        def _interweave(data, carry, ks):
+            state, Xeff, LRan_total, E_shared = carry
             kI1, kI2 = jax.random.split(ks[12])
             if on("Interweave"):
                 state = U.interweave_scale(spec, data, state, kI1)
             if on("InterweaveLocation"):
                 state = U.interweave_location(spec, data, state, kI2)
+            return state, Xeff, LRan_total, E_shared
+        add("Interweave", _interweave)
 
-        if on("InvSigma"):
-            state = U.update_inv_sigma(spec_x, data_x, state, ks[6],
-                                       E=E_shared)
-        if on("Z"):
-            state = U.update_z(spec_x, data_x, state, ks[7], E=E_shared)
+    if on("InvSigma"):
+        def _inv_sigma(data, carry, ks):
+            state, Xeff, LRan_total, E_shared = carry
+            state = U.update_inv_sigma(spec_x, data_x_of(data, Xeff), state,
+                                       ks[6], E=E_shared)
+            return state, Xeff, LRan_total, E_shared
+        add("InvSigma", _inv_sigma)
 
-        # opt-in ASIS flip of the probit augmentation on the intercept row
-        # (updaters.interweave_da_intercept) — placed after updateZ so the
-        # ancillary residual is built from the freshest Z; it changes Beta
-        # and Z jointly, and nothing after it consumes E_shared
-        if want("InterweaveDA") and on("Z") and on("BetaLambda"):
+    if on("Z"):
+        def _z(data, carry, ks):
+            state, Xeff, LRan_total, E_shared = carry
+            state = U.update_z(spec_x, data_x_of(data, Xeff), state, ks[7],
+                               E=E_shared)
+            return state, Xeff, LRan_total, E_shared
+        add("Z", _z)
+
+    # opt-in ASIS flip of the probit augmentation on the intercept row
+    # (updaters.interweave_da_intercept) — placed after updateZ so the
+    # ancillary residual is built from the freshest Z; it changes Beta
+    # and Z jointly, and nothing after it consumes E_shared
+    if want("InterweaveDA") and on("Z") and on("BetaLambda"):
+        def _interweave_da(data, carry, ks):
+            state, *rest = carry
             state = U.interweave_da_intercept(
                 spec, data, state, jax.random.fold_in(ks[7], 1))
+            return (state, *rest)
+        add("InterweaveDA", _interweave_da)
 
-        # factor-count adaptation during burn-in (iter <= adaptNf[r])
-        for r in range(spec.nr):
-            if adapt_nf[r] > 0 and on("Nf"):
-                kr = jax.random.fold_in(ks[5], 1000 + r)
-                lv_new = U.update_nf(spec, data, state, r, kr)
-                gate = (state.it <= adapt_nf[r])
-                lv_old = state.levels[r]
-                lv = jax.tree.map(
-                    lambda a, b: jnp.where(gate, a, b), lv_new, lv_old)
-                levels = list(state.levels)
-                levels[r] = lv
-                state = state.replace(levels=tuple(levels))
-        return state
+    # factor-count adaptation during burn-in (iter <= adaptNf[r])
+    if any(adapt_nf[r] > 0 and on("Nf") for r in range(spec.nr)):
+        def _nf(data, carry, ks):
+            state, *rest = carry
+            for r in range(spec.nr):
+                if adapt_nf[r] > 0 and on("Nf"):
+                    kr = jax.random.fold_in(ks[5], 1000 + r)
+                    lv_new = U.update_nf(spec, data, state, r, kr)
+                    gate = (state.it <= adapt_nf[r])
+                    lv_old = state.levels[r]
+                    lv = jax.tree.map(
+                        lambda a, b: jnp.where(gate, a, b), lv_new, lv_old)
+                    levels = list(state.levels)
+                    levels[r] = lv
+                    state = state.replace(levels=tuple(levels))
+            return (state, *rest)
+        add("Nf", _nf)
+
+    return steps
+
+
+def sweep_prologue(state: GibbsState, key):
+    """The iteration bump + 13-way subkey split every sweep begins with.
+    Shared by the fused sweep and the instrumented per-block runner
+    (``sampler.instrumented_sweep``) so both derive the identical subkey
+    table — the op order here is pinned by the committed fingerprints."""
+    state = state.replace(it=state.it + 1)
+    return state, jax.random.split(key, 13)
+
+
+def make_sweep(spec: ModelSpec, updater: dict | None = None,
+               adapt_nf: tuple | None = None):
+    """The production fused sweep: the schedule's blocks folded inline into
+    one pure ``(data, state, key) -> state`` function (one traced program;
+    XLA fuses across block boundaries exactly as before the schedule
+    existed — the committed jaxpr fingerprints pin the op sequence)."""
+    steps = make_sweep_schedule(spec, updater, adapt_nf)
+
+    def sweep(data: ModelData, state: GibbsState, key) -> GibbsState:
+        state, ks = sweep_prologue(state, key)
+        carry = (state, None, None, None)
+        for _name, block in steps:
+            # blocks receive the full subkey TABLE and statically index
+            # disjoint rows — the fold passes ks through, never consumes it
+            carry = block(data, carry, ks)  # hmsc: ignore[rng-key-reuse]
+        return carry[0]
 
     return sweep
 

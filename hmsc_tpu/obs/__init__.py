@@ -12,6 +12,11 @@ completed or in-flight run from the stream; :mod:`hmsc_tpu.obs.log`
 routes all library progress output (rank-prefixed) in place of bare
 ``print``.
 
+Sweep-level cost attribution lives in :mod:`hmsc_tpu.obs.profile`
+(``python -m hmsc_tpu profile``): a committed static flops/HBM ledger per
+Gibbs block plus measured per-updater wall timing, with the in-run
+``sample_mcmc(profile_updaters=...)`` hook feeding the same event stream.
+
 Telemetry is provably draw-stream-invariant — it only ever sees host-side
 copies — and adds <2% host-loop overhead
 (``benchmarks/bench_host_loop.py`` gates the isolated per-segment

@@ -60,6 +60,16 @@ EVENTS_FILE_RE = _re.compile(r"events-p(\d+)\.jsonl")
 # (``dropped_events``) but not retained
 _MAX_BUFFER = 100_000
 
+# per-segment health points retained for the summary's running series
+# (bounded so a million-segment run cannot grow Posterior.telemetry
+# without bound; the full series always lives in the event stream)
+_MAX_HEALTH = 512
+
+# the per-segment health fields the summary's series keeps (the full
+# segment_health event carries more, e.g. the nf-adaptation trajectory)
+_HEALTH_KEYS = ("seg", "samples_done", "draws_per_s", "diverged_chains",
+                "rhat_max", "ess_min")
+
 # The CLOSED set of span names the commit-gather payload carries
 # (:meth:`RunTelemetry.mark_delta`).  The gather rides every multi-process
 # commit, so its payload must be fixed-size: an open span-name set would
@@ -86,7 +96,8 @@ def compact_summary(summary: dict | None) -> dict | None:
     trajectory carries stall structure, not just wall time."""
     if not summary:
         return None
-    health = summary.get("last", {}).get("segment_health", {})
+    health = (summary.get("health", {}).get("final")
+              or summary.get("last", {}).get("segment_health", {}))
     return {
         "spans_s": {k: v["total_s"]
                     for k, v in summary.get("spans", {}).items()},
@@ -156,7 +167,7 @@ class RunTelemetry:
 
     # shared between the driver thread and the background segment writer;
     # `hmsc_tpu lint` (lock-discipline) enforces the declaration below
-    # hmsc: guarded-by[_lock]: _buffer, _spans, _counters, _last, _mark, _seq, _sid, n_events, dropped_events
+    # hmsc: guarded-by[_lock]: _buffer, _spans, _counters, _last, _mark, _health, _seq, _sid, n_events, dropped_events
 
     def __init__(self, proc: int = 0, enabled: bool = True):
         self.proc = int(proc)
@@ -173,6 +184,7 @@ class RunTelemetry:
         self._counters: dict[str, float] = {}
         self._last: dict[str, dict] = {}         # latest metric per name
         self._mark: dict[str, float] = {}        # span totals at last mark
+        self._health: list[dict] = []            # segment_health series
         self.n_events = 0
         self.dropped_events = 0
 
@@ -186,6 +198,14 @@ class RunTelemetry:
         with self._lock:
             if kind == "metric":
                 self._last[name] = dict(fields)
+                if name == "segment_health":
+                    # the running MCMC-health series rides the summary
+                    # (Posterior.telemetry), not only the event stream —
+                    # bounded, and kept even when event recording is off
+                    self._health.append(
+                        {k: fields.get(k) for k in _HEALTH_KEYS})
+                    if len(self._health) > _MAX_HEALTH:
+                        del self._health[0]
             self._append_locked(kind, name, fields)
 
     def _append_locked(self, kind, name, fields) -> None:
@@ -339,8 +359,10 @@ class RunTelemetry:
 
     def summary(self, wall_s: float | None = None) -> dict:
         """JSON-safe roll-up attached to ``Posterior.telemetry`` and
-        embedded into bench records: span totals, counters, and the latest
-        health / skew metrics."""
+        embedded into bench records: span totals, counters, the latest
+        metric values, and the per-segment MCMC-health series (running
+        R-hat/ESS, divergence counts, throughput — first-class here, not
+        only derivable from the raw event stream)."""
         with self._lock:
             return {
                 "schema": SCHEMA_VERSION,
@@ -356,4 +378,10 @@ class RunTelemetry:
                 "counters": {k: round(v, 6)
                              for k, v in self._counters.items()},
                 "last": {k: dict(v) for k, v in self._last.items()},
+                "health": {
+                    "segments": len(self._health),
+                    "final": (dict(self._health[-1]) if self._health
+                              else None),
+                    "series": [dict(h) for h in self._health],
+                },
             }

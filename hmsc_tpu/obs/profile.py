@@ -1,0 +1,407 @@
+"""Sweep-level cost attribution: the static cost ledger and the measured
+per-updater profile behind ``python -m hmsc_tpu profile``.
+
+The ROADMAP's next runtime bets (within-model sharding of the Gibbs sweep,
+multi-tenant batched fitting) need to know *where* a sweep's time, FLOPs
+and HBM go per Gibbs block — today's telemetry observes the host loop
+only, with the jitted sweep as one opaque span.  This module opens the
+sweep up along the block schedule (:func:`hmsc_tpu.mcmc.sweep.
+make_sweep_schedule`):
+
+- **Static cost ledger** (``--static``): every registered updater
+  (``mcmc/registry.py``), the assembled sweep, and the jitted segment
+  runner are lowered and compiled on the four canonical analysis specs
+  (the same spec/registry plumbing the jaxpr audits use), and XLA's
+  ``cost_analysis()`` (flops, bytes accessed) and ``memory_analysis()``
+  (argument / output / temp / generated-code bytes) are recorded per
+  program.  CPU-CI-runnable — abstract of any accelerator — and committed
+  to ``cost_ledger.json`` next to this module so cost-model drift is a
+  review-visible diff, exactly like the jaxpr fingerprints
+  (re-record deliberately with ``--update-ledger``).
+- **Measured mode** (``--measured``): a real model state is advanced a few
+  fused sweeps, then one sweep runs with every block dispatched as its own
+  jitted call and block-until-ready timed over K repetitions
+  (:func:`hmsc_tpu.mcmc.sampler.instrumented_sweep` — proven bit-identical
+  to the fused sweep), yielding a per-updater wall/share table and the
+  fraction of the fused-sweep wall the named blocks attribute.
+
+Results are emitted as schema-v1 JSONL events through
+:mod:`hmsc_tpu.obs.events` (``--out DIR``), rendered by ``python -m
+hmsc_tpu report`` ("cost attribution" section) and exported through the
+same ``--prom`` path; the in-run counterpart is
+``sample_mcmc(profile_updaters=...)``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+__all__ = ["LEDGER_PATH", "build_cost_ledger", "ledger_digest",
+           "load_ledger", "save_ledger", "diff_ledger",
+           "measure_updaters", "profile_main", "CANONICAL_MODELS"]
+
+LEDGER_PATH = os.path.join(os.path.dirname(__file__), "cost_ledger.json")
+LEDGER_VERSION = 1
+
+# the canonical analysis specs the ledger covers (hmsc_tpu.analysis:
+# together they exercise every registered updater)
+CANONICAL_MODELS = ("base", "spatial", "rrr", "sel")
+
+
+def _built_models(models=None):
+    """(spec, data, state) per canonical model — the analysis layer's
+    spec/registry plumbing, reused verbatim."""
+    from ..analysis.jaxpr_rules import _build, _canonical_models
+    factories = _canonical_models()
+    names = tuple(models) if models else CANONICAL_MODELS
+    unknown = [n for n in names if n not in factories]
+    if unknown:
+        raise ValueError(f"unknown canonical model(s) {unknown}; "
+                         f"valid: {sorted(factories)}")
+    return {name: _build(factories[name]()) for name in names}
+
+
+def _cost_entry(compiled) -> dict:
+    """flops / bytes-accessed / HBM breakdown of one compiled program."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    ma = compiled.memory_analysis()
+    return {
+        "flops": int(ca.get("flops", 0) or 0),
+        "bytes_accessed": int(ca.get("bytes accessed", 0) or 0),
+        "arg_bytes": int(ma.argument_size_in_bytes),
+        "out_bytes": int(ma.output_size_in_bytes),
+        "temp_bytes": int(ma.temp_size_in_bytes),
+        "alias_bytes": int(ma.alias_size_in_bytes),
+        "code_bytes": int(ma.generated_code_size_in_bytes),
+    }
+
+
+def _keep(name: str, only) -> bool:
+    return not only or any(s in name for s in only)
+
+
+def build_cost_ledger(models=None, only=None) -> dict:
+    """Compile and cost-analyse, per canonical spec:
+
+    - ``<model>/block:<name>`` — every block of that spec's sweep schedule
+      (:func:`~hmsc_tpu.mcmc.sweep.make_sweep_schedule` with one
+      adaptation sweep per level, the production program shape), chained
+      so each block is lowered on the real mid-sweep carry it receives —
+      the per-updater flops/HBM table for that spec;
+    - ``<model>/sweep`` — the assembled fused sweep;
+    - ``<model>/segment_runner`` — the jitted 2-chain segment runner
+      (donated carries; the aliasing shows up as ``alias_bytes``);
+
+    plus ``<model>/updater:<name>`` for every ``UPDATER_REGISTRY`` entry on
+    its first applicable spec (the jaxpr audit's union-coverage rule —
+    registry entries take the raw design, which only the first-applicable
+    spec satisfies; this is what guarantees EVERY registered updater
+    appears in the ledger, including the opt-in collapsed blocks the
+    default schedule omits).
+
+    ``only`` filters program names by substring (cheap partial
+    regeneration in tests)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..mcmc import sampler as sampler_mod
+    from ..mcmc import spatial as spatial_mod
+    from ..mcmc.registry import UPDATER_REGISTRY
+    from ..mcmc.sweep import make_sweep, make_sweep_schedule, sweep_prologue
+
+    # fresh exemplar key per lowered program (nothing here ever draws —
+    # every program is lowered, and run only to thread the block carry)
+    def _k():
+        return jax.random.key(0, impl="threefry2x32")
+
+    built = _built_models(models)
+    programs: dict[str, dict] = {}
+    for mname, (spec, data, state) in built.items():
+        ones = tuple(1 for _ in range(spec.nr))
+
+        # schedule blocks, chained on the real mid-sweep carry (each
+        # compiled program also RUNS once, eagerly, to produce the next
+        # block's inputs — tiny specs, so this costs nothing)
+        steps = make_sweep_schedule(spec, None, ones)
+        state_it, ks = jax.jit(sweep_prologue)(state, _k())
+        carry = (state_it, None, None, None)
+        for bname, block in steps:
+            name = f"{mname}/block:{bname}"
+            compiled = jax.jit(block).lower(data, carry, ks).compile()
+            if _keep(name, only):
+                programs[name] = _cost_entry(compiled)
+            carry = compiled(data, carry, ks)
+
+        name = f"{mname}/sweep"
+        if _keep(name, only):
+            sweep = make_sweep(spec, None, ones)
+            programs[name] = _cost_entry(
+                jax.jit(sweep).lower(data, state, _k()).compile())
+
+        name = f"{mname}/segment_runner"
+        if _keep(name, only):
+            states = jax.tree.map(lambda x: jnp.stack([x, x]), state)
+            keys = jax.vmap(lambda s: jax.random.key(
+                s, impl="threefry2x32"))(jnp.arange(2))
+            bad = jnp.full((2,), -1, jnp.int32)
+            fn = sampler_mod._compiled_runner(
+                spec, None, ones, 1, 1, 1, False, None,
+                spatial_mod._NNGP_DENSE_MAX)
+            programs[name] = _cost_entry(
+                fn.lower(data, states, keys, bad).compile())
+
+    # registry union coverage: every entry once, on its first applicable
+    # canonical spec (mirrors analysis.jaxpr_rules.build_audit_context)
+    for entry in UPDATER_REGISTRY:
+        for mname, (spec, data, state) in built.items():
+            if not entry.applies(spec, data):
+                continue
+            name = f"{mname}/updater:{entry.name}"
+            if _keep(name, only):
+                fn = (lambda e, s: lambda d, st, k: e.fn(s, d, st, k))(
+                    entry, spec)
+                programs[name] = _cost_entry(
+                    jax.jit(fn).lower(data, state, _k()).compile())
+            break
+    return {"version": LEDGER_VERSION, "jax": jax.__version__,
+            "programs": dict(sorted(programs.items()))}
+
+
+def ledger_digest(ledger: dict) -> dict:
+    """Per-canonical-spec roll-up for bench records and report rendering:
+    the sweep program's total flops, the peak temp HBM across that spec's
+    programs, and the program count."""
+    out: dict[str, dict] = {}
+    for name, entry in ledger.get("programs", {}).items():
+        mname, _, prog = name.partition("/")
+        d = out.setdefault(mname, {"flops_total": None,
+                                   "temp_bytes_peak": 0, "programs": 0})
+        d["programs"] += 1
+        d["temp_bytes_peak"] = max(d["temp_bytes_peak"],
+                                   entry.get("temp_bytes", 0))
+        if prog == "sweep":
+            d["flops_total"] = entry.get("flops")
+    return out
+
+
+def load_ledger(path: str = LEDGER_PATH) -> dict | None:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (FileNotFoundError, ValueError):
+        return None
+    if doc.get("version") != LEDGER_VERSION:
+        return None
+    return doc
+
+
+def save_ledger(ledger: dict, path: str = LEDGER_PATH) -> None:
+    with open(path, "w") as f:
+        json.dump(ledger, f, indent=1, sort_keys=False)
+        f.write("\n")
+
+
+def diff_ledger(committed: dict | None, current: dict) -> list[str]:
+    """Human-readable drift lines between the committed ledger and a fresh
+    one (restricted to programs present in ``current``, so partial
+    regenerations diff cleanly)."""
+    if committed is None:
+        return ["no committed cost ledger — record one with "
+                "`python -m hmsc_tpu profile --static --update-ledger`"]
+    drift = []
+    old = committed.get("programs", {})
+    for name, entry in current.get("programs", {}).items():
+        prev = old.get(name)
+        if prev is None:
+            drift.append(f"{name}: no committed entry")
+            continue
+        for k in ("flops", "bytes_accessed", "temp_bytes"):
+            if prev.get(k) != entry.get(k):
+                drift.append(f"{name}: {k} {prev.get(k)} -> {entry.get(k)}")
+    return drift
+
+
+def measure_updaters(models=("base",), reps: int = 3, warmup: int = 3,
+                     seed: int = 0) -> dict:
+    """Measured per-updater timing on real model state: advance ``warmup``
+    fused sweeps from the built initial state, then run ONE instrumented
+    per-block pass (``reps`` timed repetitions each, minimum reported) plus
+    a fused-sweep reference timing.  Returns ``{model: profile}`` in the
+    :func:`~hmsc_tpu.mcmc.sampler.instrumented_sweep` profile shape."""
+    import jax
+
+    from ..mcmc.sampler import instrumented_sweep
+    from ..mcmc.sweep import make_sweep
+
+    out = {}
+    for mname, (spec, data, state) in _built_models(models).items():
+        zeros = tuple(0 for _ in range(spec.nr))
+        sweep = jax.jit(make_sweep(spec, None, zeros))
+        key = jax.random.key(seed, impl="threefry2x32")
+        for _ in range(max(0, int(warmup))):
+            key, sub = jax.random.split(key)
+            state = sweep(data, state, sub)
+        jax.block_until_ready(state)
+        key, sub = jax.random.split(key)
+        _, prof = instrumented_sweep(spec, data, state, sub, reps=reps)
+        out[mname] = dict(prof, model=mname, warmup=int(warmup))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _render_static(ledger: dict, digest: dict, drift: list) -> str:
+    lines = ["static cost ledger (XLA cost/memory analysis, "
+             f"jax {ledger.get('jax')})"]
+    cur = None
+    for name, e in ledger["programs"].items():
+        mname, _, prog = name.partition("/")
+        if mname != cur:
+            cur = mname
+            d = digest.get(mname, {})
+            lines.append(f"\n== {mname} (sweep flops "
+                         f"{d.get('flops_total')}, peak temp "
+                         f"{d.get('temp_bytes_peak')} B) ==")
+            lines.append(f"  {'program':<28} {'Mflops':>9} {'MB acc':>8} "
+                         f"{'arg KB':>8} {'temp KB':>8}")
+        lines.append(f"  {prog:<28} {e['flops'] / 1e6:9.3f} "
+                     f"{e['bytes_accessed'] / 1e6:8.2f} "
+                     f"{e['arg_bytes'] / 1e3:8.1f} "
+                     f"{e['temp_bytes'] / 1e3:8.1f}")
+    if drift:
+        lines.append("\ncost-model drift vs committed ledger:")
+        lines += [f"  {d}" for d in drift]
+    else:
+        lines.append("\nledger matches the committed cost_ledger.json")
+    return "\n".join(lines)
+
+
+def _render_measured(measured: dict) -> str:
+    lines = []
+    for mname, prof in measured.items():
+        lines.append(f"== measured per-updater wall, {mname} "
+                     f"(reps={prof['reps']}, fused sweep "
+                     f"{prof.get('fused_wall_s', 0) * 1e3:.3f} ms, "
+                     f"attributed {prof.get('attributed_frac', 0) * 100:.0f}"
+                     f"%) ==")
+        for b in prof["updaters"]:
+            bar = "#" * int(round(b["share"] * 30))
+            lines.append(f"  {b['name']:<20} {b['wall_s'] * 1e3:9.4f} ms "
+                         f"({b['share'] * 100:5.1f}%) {bar}")
+    return "\n".join(lines)
+
+
+def profile_main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m hmsc_tpu profile",
+        description="sweep-level cost attribution: static flops/HBM ledger "
+                    "per Gibbs block (XLA cost analysis, CPU-safe) and "
+                    "measured per-updater wall timing")
+    ap.add_argument("--static", action="store_true",
+                    help="build the static cost ledger (default mode)")
+    ap.add_argument("--measured", action="store_true",
+                    help="timed per-updater profile on real model state")
+    ap.add_argument("--models", default=None,
+                    help="comma-separated canonical specs (default: all "
+                         f"of {','.join(CANONICAL_MODELS)}; measured mode "
+                         "defaults to base)")
+    ap.add_argument("--only", default=None,
+                    help="substring filter on ledger program names "
+                         "(partial regeneration)")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="timed repetitions per block in measured mode")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable result on stdout")
+    ap.add_argument("--update-ledger", action="store_true",
+                    help="re-record the committed cost_ledger.json from "
+                         "the current build (after reviewing the drift)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 when the static ledger drifts from the "
+                         "committed one")
+    ap.add_argument("--ledger", default=None,
+                    help="override the committed ledger path")
+    ap.add_argument("--out", metavar="DIR", default=None,
+                    help="also emit results as schema-v1 telemetry events "
+                         "(events-p0.jsonl under DIR; render with "
+                         "`python -m hmsc_tpu report DIR`)")
+    args = ap.parse_args(argv)
+
+    if not args.static and not args.measured:
+        args.static = True
+    if not args.measured:
+        # static-only, like `hmsc_tpu lint`: the ledger is platform-
+        # abstract, so never block on an unreachable accelerator.  Measured
+        # mode is the opposite contract — it times the backend JAX actually
+        # configures (auto-detected TPU included), so it must NOT be pinned
+        # to CPU behind the user's back.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    models = tuple(args.models.split(",")) if args.models else None
+    only = tuple(args.only.split(",")) if args.only else None
+    ledger_path = args.ledger or LEDGER_PATH
+
+    telem = None
+    if args.out:
+        from .events import SCHEMA_VERSION, RunTelemetry, events_path
+        telem = RunTelemetry(proc=0)
+        telem.attach_sink(events_path(args.out, 0), truncate=True)
+        telem.emit("run", "start", schema=SCHEMA_VERSION, profile=True,
+                   mode=("static+measured" if args.static and args.measured
+                         else "measured" if args.measured else "static"))
+
+    result: dict = {"version": LEDGER_VERSION}
+    drift: list[str] = []
+    if args.static:
+        ledger = build_cost_ledger(models=models, only=only)
+        digest = ledger_digest(ledger)
+        if args.update_ledger:
+            if models or only:
+                print("--update-ledger requires a full build (no --models/"
+                      "--only): the committed ledger covers every program")
+                return 2
+            save_ledger(ledger, ledger_path)
+            print(f"wrote {ledger_path} "
+                  f"({len(ledger['programs'])} programs)")
+        drift = diff_ledger(load_ledger(ledger_path), ledger)
+        result["static"] = {"ledger": ledger, "digest": digest,
+                            "drift": drift,
+                            "matches_committed": not drift}
+        if telem is not None:
+            for mname, d in digest.items():
+                telem.emit("metric", "cost_ledger", model=mname, **d,
+                           programs_detail={
+                               n.split("/", 1)[1]: {
+                                   "flops": e["flops"],
+                                   "temp_bytes": e["temp_bytes"]}
+                               for n, e in ledger["programs"].items()
+                               if n.startswith(mname + "/")})
+    if args.measured:
+        m_models = models or ("base",)
+        measured = measure_updaters(models=m_models, reps=args.reps)
+        result["measured"] = measured
+        if telem is not None:
+            for mname, prof in measured.items():
+                telem.emit("metric", "updater_profile", **prof)
+
+    if telem is not None:
+        telem.emit("run", "end")
+        telem.flush()
+
+    if args.json:
+        print(json.dumps(result, indent=1))
+    else:
+        if args.static:
+            print(_render_static(result["static"]["ledger"],
+                                 result["static"]["digest"], drift))
+        if args.measured:
+            print(_render_measured(result["measured"]))
+    return 1 if (args.check and drift) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(profile_main())

@@ -10,11 +10,29 @@ line is tolerated) and prints:
   barrier waits at every commit mark (multi-process runs);
 - **checkpoint I/O breakdown** — shard/state/manifest write time + bytes;
 - **health summary** — divergence counters, nf-adaptation trajectory, and
-  the latest running R-hat/ESS per rank.
+  the latest running R-hat/ESS per rank;
+- **cost attribution** — the per-updater wall/share table recorded by
+  ``sample_mcmc(profile_updaters=...)`` or ``python -m hmsc_tpu profile
+  --measured``, and the static flops / temp-HBM ledger digest emitted by
+  ``profile --static --out``.
 
 ``--json`` emits the structured report instead of text; ``--prom FILE``
 writes a Prometheus textfile-collector export of the final gauges (point
 the node exporter's ``--collector.textfile.directory`` at it).
+
+Prometheus naming scheme
+------------------------
+Every exporter in this package — run reports (:func:`prometheus_textfile`),
+the serving engine (:func:`serving_prometheus_textfile`), and the profile
+gauges both share — emits ONLY gauge names from the frozen
+:data:`PROM_GAUGES` registry, all under the single ``hmsc_tpu_`` prefix:
+``hmsc_tpu_<noun>_<unit>`` with subsystem-scoped nouns (``serve_*`` for
+the serving engine, ``updater_*``/``ledger_*`` for cost attribution) and
+Prometheus-conventional unit suffixes (``_seconds``/``_bytes``, ``_total``
+for monotone counters exported as gauges).  Renaming or adding a gauge is
+a deliberate, review-visible edit to the registry — the full set is
+pinned by ``tests/test_profile.py`` so dashboards and scrape configs
+never break on a silent rename.
 """
 
 from __future__ import annotations
@@ -25,7 +43,51 @@ import os
 
 __all__ = ["load_run_events", "build_report", "render_report",
            "prometheus_textfile", "serving_prometheus_textfile",
-           "report_main"]
+           "report_main", "PROM_GAUGES"]
+
+# the frozen gauge-name registry (see the module docstring): every
+# *_prometheus_textfile exporter routes through _gauge(), which refuses
+# names outside this set
+PROM_GAUGES = (
+    # run telemetry (prometheus_textfile)
+    "hmsc_tpu_span_seconds_total",
+    "hmsc_tpu_span_seconds_max",
+    "hmsc_tpu_span_count",
+    "hmsc_tpu_run_wall_seconds",
+    "hmsc_tpu_samples_done",
+    "hmsc_tpu_draws_per_second",
+    "hmsc_tpu_diverged_chains",
+    "hmsc_tpu_rhat_max",
+    "hmsc_tpu_ess_min",
+    "hmsc_tpu_rank_skew_seconds",
+    # cost attribution (profile CLI / profile_updaters hook)
+    "hmsc_tpu_updater_wall_seconds",
+    "hmsc_tpu_updater_share",
+    "hmsc_tpu_profile_attributed_fraction",
+    "hmsc_tpu_ledger_flops_total",
+    "hmsc_tpu_ledger_temp_bytes_peak",
+    # serving engine (serving_prometheus_textfile)
+    "hmsc_tpu_serve_requests_total",
+    "hmsc_tpu_serve_batches_total",
+    "hmsc_tpu_serve_device_calls_total",
+    "hmsc_tpu_serve_rows_served_total",
+    "hmsc_tpu_serve_rows_padded_total",
+    "hmsc_tpu_serve_kernel_cache_hits_total",
+    "hmsc_tpu_serve_kernel_cache_misses_total",
+    "hmsc_tpu_serve_kernel_cache_size",
+    "hmsc_tpu_serve_posterior_draws",
+)
+_PROM_SET = frozenset(PROM_GAUGES)
+
+
+def _gauge(out: list, name: str, labels: str, value) -> None:
+    """Append one gauge sample line; ``name`` must be registered in
+    :data:`PROM_GAUGES` (the single naming authority — a new gauge that
+    skips the registry fails loudly here, not in a consumer's dashboard)."""
+    if name not in _PROM_SET:
+        raise ValueError(f"unregistered Prometheus gauge {name!r} — add it "
+                         "to obs.report.PROM_GAUGES (and the pinning test)")
+    out.append(f"{name}{labels} {value}")
 
 
 def load_run_events(run_dir: str) -> dict:
@@ -147,6 +209,19 @@ def build_report(run_dir: str) -> dict:
                     int(e.get("nbytes", 0)) for e in events
                     if e.get("kind") == "span" and e.get("name") == name)
         logs = [e for e in events if e.get("kind") == "log"]
+
+        # cost attribution: instrumented per-updater passes + static-ledger
+        # digests (the profile CLI's --out stream, or the in-run
+        # profile_updaters hook)
+        def _strip(e):
+            return {k: v for k, v in e.items()
+                    if k not in ("seq", "t", "wall", "proc", "kind", "name")}
+        upd_prof = [_strip(e) for e in events if e.get("kind") == "metric"
+                    and e.get("name") == "updater_profile"]
+        ledgers = [_strip(e) for e in events if e.get("kind") == "metric"
+                   and e.get("name") == "cost_ledger"]
+        cost = ({"updater_profile": upd_prof, "ledger": ledgers}
+                if upd_prof or ledgers else None)
         report["per_rank"][proc] = {
             "config": ({k: v for k, v in start.items()
                         if k not in ("seq", "t", "wall", "kind", "name")}
@@ -164,6 +239,7 @@ def build_report(run_dir: str) -> dict:
                            for e in health],
             "health": (health[-1] if health else None),
             "io": io,
+            "cost": cost,
             "log_lines": len(logs),
         }
         if proc == min(streams):          # committer stream carries the skew
@@ -249,6 +325,30 @@ def render_report(report: dict) -> str:
                         if v.get("nbytes") else "")
                 lines.append(f"  {k:<16} {v['total_s']:8.3f}s "
                              f"x{v['count']}{size}")
+        cost = r.get("cost")
+        if cost:
+            lines.append("-- cost attribution --")
+            for prof in cost.get("updater_profile", []):
+                where = (f"model={prof['model']}" if prof.get("model")
+                         else f"sweep={prof.get('sweep')}")
+                att = prof.get("attributed_frac")
+                fw = prof.get("fused_wall_s")
+                lines.append(
+                    f"  per-updater wall ({where}, reps={prof.get('reps')}"
+                    + (f", fused {fw * 1e3:.3f} ms" if fw else "")
+                    + (f", attributed {att * 100:.0f}%" if att else "")
+                    + ")")
+                for b in prof.get("updaters", []):
+                    lines.append(f"    {b['name']:<20} "
+                                 f"{b['wall_s'] * 1e3:9.4f} ms "
+                                 f"{_bar(b.get('share', 0.0))} "
+                                 f"({b.get('share', 0.0) * 100:5.1f}%)")
+            for led in cost.get("ledger", []):
+                lines.append(
+                    f"  static ledger {led.get('model')}: sweep flops "
+                    f"{led.get('flops_total')}, peak temp "
+                    f"{led.get('temp_bytes_peak')} B over "
+                    f"{led.get('programs')} programs")
     if report["skew"]:
         lines.append("")
         lines.append("== cross-rank stall / skew (committer marks) ==")
@@ -262,14 +362,16 @@ def render_report(report: dict) -> str:
 
 
 def prometheus_textfile(report: dict) -> str:
-    """Prometheus textfile-collector export of the report's final gauges."""
+    """Prometheus textfile-collector export of the report's final gauges
+    (every name from :data:`PROM_GAUGES` — see the module docstring)."""
     out = ["# HELP hmsc_tpu_span_seconds_total host-loop span time by stage",
            "# TYPE hmsc_tpu_span_seconds_total gauge"]
     for proc in report["ranks"]:
         r = report["per_rank"][proc]
         for name, agg in sorted(r["spans"].items()):
-            out.append(f'hmsc_tpu_span_seconds_total{{span="{name}",'
-                       f'proc="{proc}"}} {agg["total_s"]:.6f}')
+            _gauge(out, "hmsc_tpu_span_seconds_total",
+                   f'{{span="{name}",proc="{proc}"}}',
+                   f'{agg["total_s"]:.6f}')
     out += ["# TYPE hmsc_tpu_run_wall_seconds gauge",
             "# TYPE hmsc_tpu_samples_done gauge",
             "# TYPE hmsc_tpu_draws_per_second gauge",
@@ -278,8 +380,8 @@ def prometheus_textfile(report: dict) -> str:
             "# TYPE hmsc_tpu_ess_min gauge"]
     for proc in report["ranks"]:
         r = report["per_rank"][proc]
-        out.append(f'hmsc_tpu_run_wall_seconds{{proc="{proc}"}} '
-                   f'{r["wall_s"]:.4f}')
+        _gauge(out, "hmsc_tpu_run_wall_seconds", f'{{proc="{proc}"}}',
+               f'{r["wall_s"]:.4f}')
         h = r["health"]
         if h:
             for key, metric in (("samples_done", "hmsc_tpu_samples_done"),
@@ -290,11 +392,45 @@ def prometheus_textfile(report: dict) -> str:
                                 ("ess_min", "hmsc_tpu_ess_min")):
                 v = h.get(key)
                 if v is not None:
-                    out.append(f'{metric}{{proc="{proc}"}} {v}')
+                    _gauge(out, metric, f'{{proc="{proc}"}}', v)
     if report["skew"]:
         out.append("# TYPE hmsc_tpu_rank_skew_seconds gauge")
-        out.append(f"hmsc_tpu_rank_skew_seconds "
-                   f"{report['skew'][-1].get('skew_s', 0.0)}")
+        _gauge(out, "hmsc_tpu_rank_skew_seconds", "",
+               report["skew"][-1].get("skew_s", 0.0))
+    # cost attribution: the latest per-updater profile and ledger digests
+    typed = ledger_typed = False
+    for proc in report["ranks"]:
+        cost = report["per_rank"][proc].get("cost")
+        if not cost:
+            continue
+        profs = cost.get("updater_profile", [])
+        if profs and not typed:
+            out += ["# TYPE hmsc_tpu_updater_wall_seconds gauge",
+                    "# TYPE hmsc_tpu_updater_share gauge",
+                    "# TYPE hmsc_tpu_profile_attributed_fraction gauge"]
+            typed = True
+        for prof in profs[-1:]:
+            for b in prof.get("updaters", []):
+                lbl = f'{{updater="{b["name"]}",proc="{proc}"}}'
+                _gauge(out, "hmsc_tpu_updater_wall_seconds", lbl,
+                       f'{b["wall_s"]:.7f}')
+                _gauge(out, "hmsc_tpu_updater_share", lbl,
+                       b.get("share", 0.0))
+            if prof.get("attributed_frac") is not None:
+                _gauge(out, "hmsc_tpu_profile_attributed_fraction",
+                       f'{{proc="{proc}"}}', prof["attributed_frac"])
+        leds = cost.get("ledger", [])
+        if leds and not ledger_typed:
+            out += ["# TYPE hmsc_tpu_ledger_flops_total gauge",
+                    "# TYPE hmsc_tpu_ledger_temp_bytes_peak gauge"]
+            ledger_typed = True
+        for led in leds:
+            lbl = f'{{model="{led.get("model")}",proc="{proc}"}}'
+            if led.get("flops_total") is not None:
+                _gauge(out, "hmsc_tpu_ledger_flops_total", lbl,
+                       led["flops_total"])
+            _gauge(out, "hmsc_tpu_ledger_temp_bytes_peak", lbl,
+                   led.get("temp_bytes_peak", 0))
     return "\n".join(out) + "\n"
 
 
@@ -310,10 +446,10 @@ def serving_prometheus_textfile(stats: dict) -> str:
            "# TYPE hmsc_tpu_span_count gauge"]
     for name, agg in sorted(stats.get("spans", {}).items()):
         lbl = f'{{span="{name}",proc="serve"}}'
-        out.append(f"hmsc_tpu_span_seconds_total{lbl} "
-                   f"{agg['total_s']:.6f}")
-        out.append(f"hmsc_tpu_span_seconds_max{lbl} {agg['max_s']:.6f}")
-        out.append(f"hmsc_tpu_span_count{lbl} {agg['count']}")
+        _gauge(out, "hmsc_tpu_span_seconds_total", lbl,
+               f"{agg['total_s']:.6f}")
+        _gauge(out, "hmsc_tpu_span_seconds_max", lbl, f"{agg['max_s']:.6f}")
+        _gauge(out, "hmsc_tpu_span_count", lbl, agg["count"])
     cache = stats.get("cache", {})
     gauges = [
         ("hmsc_tpu_serve_requests_total", stats.get("requests", 0)),
@@ -330,7 +466,7 @@ def serving_prometheus_textfile(stats: dict) -> str:
     ]
     for name, v in gauges:
         out.append(f"# TYPE {name} gauge")
-        out.append(f"{name} {v}")
+        _gauge(out, name, "", v)
     return "\n".join(out) + "\n"
 
 

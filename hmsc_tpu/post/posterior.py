@@ -48,6 +48,9 @@ class Posterior:
         self.telemetry = None       # run-telemetry summary (span totals,
                                     # health, skew) set by sample_mcmc —
                                     # see hmsc_tpu.obs
+        self.updater_profile = None  # per-updater wall/share table when the
+                                    # run recorded an instrumented pass
+                                    # (sample_mcmc(profile_updaters=...))
         # {level: (chains,) int} blocked factor-growth attempts per chain,
         # set by sample_mcmc (empty when unknown, e.g. from_prior/subset-free
         # construction)

@@ -1,0 +1,340 @@
+"""Cost-attribution suite (``hmsc_tpu/obs/profile.py`` + the instrumented
+per-updater runner): instrumented-vs-fused bit-identity per canonical
+spec, the committed static cost-ledger digest, the ``profile`` CLI with
+its event/report/Prometheus rendering, and the ``profile_updaters``
+sampling hook's draw-stream invariance."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from hmsc_tpu.mcmc.sampler import instrumented_sweep
+from hmsc_tpu.mcmc.sweep import make_sweep, make_sweep_schedule
+
+pytestmark = pytest.mark.profile
+
+TINY = dict(ny=16, ns=3, n_units=5, nf=2, distr="probit", seed=3)
+
+
+def _tobytes(x):
+    if hasattr(x, "dtype") and jax.dtypes.issubdtype(x.dtype,
+                                                     jax.dtypes.prng_key):
+        x = jax.random.key_data(x)
+    return np.asarray(x).tobytes()
+
+
+@pytest.fixture(scope="module")
+def build_model():
+    """Lazily-built canonical (spec, data, state) triples, shared across
+    the module so block compiles are paid once per spec."""
+    from hmsc_tpu.analysis.jaxpr_rules import _build, _canonical_models
+    factories = _canonical_models()
+    cache = {}
+
+    def get(name):
+        if name not in cache:
+            cache[name] = _build(factories[name]())
+        return cache[name]
+
+    return get
+
+
+def _assert_instrumented_matches_fused(spec, data, state, adapt_nf=None):
+    key = jax.random.key(7, impl="threefry2x32")
+    adapt_nf = adapt_nf or tuple(0 for _ in range(spec.nr))
+    fused = jax.jit(make_sweep(spec, None, adapt_nf))
+    s_f = jax.block_until_ready(fused(data, state, key))
+    s_i, prof = instrumented_sweep(spec, data, state, key,
+                                   adapt_nf=adapt_nf, reps=1,
+                                   time_fused=False)
+    lf, li = jax.tree.leaves(s_f), jax.tree.leaves(s_i)
+    assert len(lf) == len(li)
+    for a, b in zip(lf, li):
+        # per-updater dispatch must not perturb dtypes or a single bit of
+        # the state (same subkey table, same op order per block)
+        assert a.dtype == b.dtype
+        assert _tobytes(a) == _tobytes(b)
+    return prof
+
+
+@pytest.mark.parametrize("mname", ["base", "rrr"])
+def test_instrumented_pass_bit_identical(build_model, mname):
+    spec, data, state = build_model(mname)
+    prof = _assert_instrumented_matches_fused(spec, data, state)
+    names = [b["name"] for b in prof["updaters"]]
+    assert "BetaLambda" in names and "Z" in names
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mname", ["spatial", "sel"])
+def test_instrumented_pass_bit_identical_full_matrix(build_model, mname):
+    spec, data, state = build_model(mname)
+    _assert_instrumented_matches_fused(spec, data, state)
+
+
+def test_instrumented_pass_covers_nf_adaptation(build_model):
+    # adapt_nf > 0 adds the Nf block; the gated tree-select must survive
+    # per-block dispatch bit-identically too
+    spec, data, state = build_model("base")
+    prof = _assert_instrumented_matches_fused(
+        spec, data, state, adapt_nf=tuple(1 for _ in range(spec.nr)))
+    assert "Nf" in [b["name"] for b in prof["updaters"]]
+
+
+def test_schedule_names_match_registry(build_model):
+    from hmsc_tpu.mcmc.registry import UPDATER_REGISTRY
+    reg = {e.name for e in UPDATER_REGISTRY}
+    for mname in ("base", "rrr"):
+        spec, _, _ = build_model(mname)
+        steps = make_sweep_schedule(spec, None,
+                                    tuple(1 for _ in range(spec.nr)))
+        for name, _fn in steps:
+            if not name.startswith("("):
+                assert name in reg, name
+
+
+def test_measured_profile_attributes_fused_wall(build_model):
+    # acceptance gate: the per-block walls must account for >= 95% of the
+    # fused-sweep wall (per-block dispatch overhead means they normally
+    # sum to MORE; a large shortfall would mean a block went missing)
+    spec, data, state = build_model("base")
+    key = jax.random.key(9, impl="threefry2x32")
+    _, prof = instrumented_sweep(spec, data, state, key, reps=3,
+                                 time_fused=True)
+    assert prof["fused_wall_s"] > 0
+    assert prof["attributed_frac"] >= 0.95
+    shares = sum(b["share"] for b in prof["updaters"])
+    assert 0.99 <= shares <= 1.01
+
+
+# ---------------------------------------------------------------------------
+# static cost ledger
+# ---------------------------------------------------------------------------
+
+def test_cost_ledger_committed_covers_everything():
+    """Pure file check (no compiles): the committed ledger spans all four
+    canonical specs (blocks + sweep + segment runner) and every registered
+    updater."""
+    from hmsc_tpu.mcmc.registry import UPDATER_REGISTRY
+    from hmsc_tpu.obs.profile import (CANONICAL_MODELS, LEDGER_PATH,
+                                      ledger_digest, load_ledger)
+    led = load_ledger()
+    assert led is not None, f"missing committed ledger {LEDGER_PATH}"
+    programs = led["programs"]
+    for m in CANONICAL_MODELS:
+        assert f"{m}/sweep" in programs
+        assert f"{m}/segment_runner" in programs
+        assert any(n.startswith(f"{m}/block:") for n in programs)
+    covered = {n.split("/updater:", 1)[1]
+               for n in programs if "/updater:" in n}
+    assert covered == {e.name for e in UPDATER_REGISTRY}
+    for entry in programs.values():
+        assert entry["flops"] >= 0 and entry["temp_bytes"] >= 0
+    digest = ledger_digest(led)
+    for m in CANONICAL_MODELS:
+        assert digest[m]["flops_total"] is not None
+        assert digest[m]["programs"] > 0
+    # donation is visible in the runner's cost model: the carry aliases
+    # its inputs instead of doubling steady-state HBM
+    assert programs["base/segment_runner"]["alias_bytes"] > 0
+
+
+def test_profile_cli_static_digest_matches_committed(capsys):
+    """Tier-1 regeneration of a cheap slice of the ledger must reproduce
+    the committed numbers exactly (the diffable-digest contract; full
+    regeneration is the CLI's --update-ledger workflow)."""
+    from hmsc_tpu.obs.profile import load_ledger, profile_main
+    rc = profile_main(["--static", "--json", "--models", "base",
+                       "--only", "block:", "--check"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    st = doc["static"]
+    assert st["matches_committed"], st["drift"]
+    committed = load_ledger()["programs"]
+    regen = st["ledger"]["programs"]
+    assert regen, "no base/block:* programs regenerated"
+    for name, entry in regen.items():
+        assert name.startswith("base/block:")
+        assert entry == committed[name]
+
+
+def test_profile_cli_measured_events_report_prom(tmp_path, capsys):
+    """Measured mode end-to-end: CLI -> schema-v1 events -> report cost
+    section -> Prometheus gauges."""
+    from hmsc_tpu.obs.report import (build_report, prometheus_textfile,
+                                     render_report)
+    from hmsc_tpu.obs.profile import profile_main
+    out = os.fspath(tmp_path / "prof")
+    rc = profile_main(["--measured", "--models", "base", "--reps", "1",
+                       "--out", out, "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    prof = doc["measured"]["base"]
+    assert prof["attributed_frac"] >= 0.95
+    assert os.path.exists(os.path.join(out, "events-p0.jsonl"))
+
+    rep = build_report(out)
+    cost = rep["per_rank"][0]["cost"]
+    assert cost and cost["updater_profile"]
+    names = [b["name"] for b in cost["updater_profile"][-1]["updaters"]]
+    assert "BetaLambda" in names
+    text = render_report(rep)
+    assert "cost attribution" in text
+    prom = prometheus_textfile(rep)
+    assert 'hmsc_tpu_updater_wall_seconds{updater="BetaLambda",proc="0"}' \
+        in prom
+    assert "hmsc_tpu_profile_attributed_fraction" in prom
+
+
+# ---------------------------------------------------------------------------
+# the in-run profile_updaters hook
+# ---------------------------------------------------------------------------
+
+def test_profile_updaters_hook_draw_invariant(tmp_path):
+    """One instrumented pass at a chosen sweep index records a per-updater
+    table and telemetry metric without moving a single draw."""
+    from hmsc_tpu.mcmc.sampler import sample_mcmc
+    from tests.util import small_model
+
+    hM = small_model(**TINY)
+    kw = dict(samples=4, transient=2, thin=1, n_chains=2, seed=11,
+              align_post=False, nf_cap=2)
+    base = sample_mcmc(hM, **kw)
+    tel_dir = os.fspath(tmp_path / "tel")
+    prof_run = sample_mcmc(hM, **kw, profile_updaters=3,
+                           telemetry=tel_dir)
+    for k in base.arrays:
+        assert np.asarray(base.arrays[k]).tobytes() \
+            == np.asarray(prof_run.arrays[k]).tobytes(), k
+
+    prof = prof_run.updater_profile
+    assert prof is not None and prof["vmapped"]
+    # the hook never compiles a standalone fused sweep mid-run (the CLI's
+    # measured mode carries the fused reference): table only
+    assert "fused_wall_s" not in prof
+    assert prof["updater_wall_s"] > 0
+    assert {"BetaLambda", "Z"} <= {b["name"] for b in prof["updaters"]}
+    # the clamped sweep index: requested 3 of the 6-sweep run
+    assert prof["sweep"] >= 3
+    assert base.updater_profile is None
+
+    with open(os.path.join(tel_dir, "events-p0.jsonl")) as f:
+        events = [json.loads(ln) for ln in f if ln.strip()]
+    metric = [e for e in events if e.get("name") == "updater_profile"
+              and e.get("kind") == "metric"]
+    assert len(metric) == 1
+    # the instrumented pass itself is a timed span on the driver
+    assert any(e.get("name") == "updater_profile"
+               and e.get("kind") == "span" for e in events)
+
+    # satellite: the telemetry summary surfaces the per-segment health
+    # series first-class, not only span totals
+    health = prof_run.telemetry["health"]
+    assert health["final"] is not None
+    assert health["segments"] == len(health["series"]) >= 1
+    assert "rhat_max" in health["final"]
+
+
+def test_profile_updaters_validation():
+    from hmsc_tpu.mcmc.sampler import sample_mcmc
+    from tests.util import small_model
+    with pytest.raises(ValueError, match="profile_updaters"):
+        sample_mcmc(small_model(**TINY), samples=1, profile_updaters=-1)
+
+
+# ---------------------------------------------------------------------------
+# telemetry summary health series + the pinned Prometheus gauge registry
+# ---------------------------------------------------------------------------
+
+def test_summary_health_series_unit():
+    from hmsc_tpu.obs.events import RunTelemetry, compact_summary
+    telem = RunTelemetry(proc=0, enabled=False)   # aggregates survive off
+    for i in range(3):
+        telem.emit("metric", "segment_health", seg=i, samples_done=4 * i,
+                   draws_per_s=10.0 + i, diverged_chains=0,
+                   rhat_max=1.1 - 0.01 * i, ess_min=5.0 + i,
+                   nf_active={"0": [2]})
+    s = telem.summary(wall_s=1.0)
+    assert s["health"]["segments"] == 3
+    assert [h["samples_done"] for h in s["health"]["series"]] == [0, 4, 8]
+    assert s["health"]["final"]["rhat_max"] == pytest.approx(1.08)
+    assert "nf_active" not in s["health"]["final"]   # bounded subset
+    assert compact_summary(s)["ess_min"] == 7.0
+
+
+def test_prom_gauge_names_pinned():
+    """The full exporter gauge-name set is frozen: a rename or an
+    unregistered addition must fail here, not in a consumer's dashboard."""
+    from hmsc_tpu.obs.report import PROM_GAUGES, _gauge
+    assert set(PROM_GAUGES) == {
+        "hmsc_tpu_span_seconds_total",
+        "hmsc_tpu_span_seconds_max",
+        "hmsc_tpu_span_count",
+        "hmsc_tpu_run_wall_seconds",
+        "hmsc_tpu_samples_done",
+        "hmsc_tpu_draws_per_second",
+        "hmsc_tpu_diverged_chains",
+        "hmsc_tpu_rhat_max",
+        "hmsc_tpu_ess_min",
+        "hmsc_tpu_rank_skew_seconds",
+        "hmsc_tpu_updater_wall_seconds",
+        "hmsc_tpu_updater_share",
+        "hmsc_tpu_profile_attributed_fraction",
+        "hmsc_tpu_ledger_flops_total",
+        "hmsc_tpu_ledger_temp_bytes_peak",
+        "hmsc_tpu_serve_requests_total",
+        "hmsc_tpu_serve_batches_total",
+        "hmsc_tpu_serve_device_calls_total",
+        "hmsc_tpu_serve_rows_served_total",
+        "hmsc_tpu_serve_rows_padded_total",
+        "hmsc_tpu_serve_kernel_cache_hits_total",
+        "hmsc_tpu_serve_kernel_cache_misses_total",
+        "hmsc_tpu_serve_kernel_cache_size",
+        "hmsc_tpu_serve_posterior_draws",
+    }
+    assert all(n.startswith("hmsc_tpu_") for n in PROM_GAUGES)
+    with pytest.raises(ValueError, match="unregistered"):
+        _gauge([], "hmsc_tpu_not_registered", "", 1)
+
+
+def test_exporters_emit_only_registered_gauges():
+    import re
+    from hmsc_tpu.obs.report import (PROM_GAUGES, prometheus_textfile,
+                                     serving_prometheus_textfile)
+    report = {
+        "ranks": [0],
+        "per_rank": {0: {
+            "wall_s": 1.0,
+            "spans": {"dispatch": {"count": 1, "total_s": 0.5,
+                                   "max_s": 0.5}},
+            "health": {"samples_done": 4, "draws_per_s": 8.0,
+                       "diverged_chains": 0, "rhat_max": 1.01,
+                       "ess_min": 9.0},
+            "cost": {
+                "updater_profile": [{
+                    "updaters": [{"name": "Z", "wall_s": 1e-4,
+                                  "share": 1.0}],
+                    "attributed_frac": 1.0}],
+                "ledger": [{"model": "base", "flops_total": 123,
+                            "temp_bytes_peak": 456, "programs": 9}]},
+        }},
+        "skew": [{"skew_s": 0.001}],
+    }
+    stats = {"spans": {"dispatch": {"count": 1, "total_s": 0.1,
+                                    "max_s": 0.1}},
+             "requests": 1, "cache": {"hits": 1, "misses": 1, "size": 1}}
+    names = set()
+    for text in (prometheus_textfile(report),
+                 serving_prometheus_textfile(stats)):
+        for line in text.splitlines():
+            if line.startswith("#") or not line.strip():
+                continue
+            names.add(re.split(r"[{\s]", line, 1)[0])
+    assert names <= set(PROM_GAUGES)
+    # the new cost gauges actually fired in this fixture
+    assert {"hmsc_tpu_updater_wall_seconds", "hmsc_tpu_ledger_flops_total",
+            "hmsc_tpu_profile_attributed_fraction"} <= names
