@@ -245,6 +245,34 @@ def _serving_summary():
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _chaos_summary():
+    """The chaos-harness digest (`benchmarks/bench_chaos.py`): Poisson +
+    armed rank kills against a supervised fleet, gating zero committed
+    draws lost, manifest checksum validity, and bit-consistency with the
+    uninterrupted reference — run reduced-scale in a CPU-pinned subprocess
+    with the throughput gate informational (this shared box's wall is
+    import-dominated at CI scale; the full-size 70% throughput gate is
+    `python benchmarks/bench_chaos.py` standalone)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        r = subprocess.run(
+            [sys.executable, "benchmarks/bench_chaos.py", "--samples", "16",
+             "--transient", "8", "--checkpoint-every", "8", "--chains", "4",
+             "--nprocs", "2", "--kill-rate", "0.03", "--seed", "7",
+             "--no-throughput-gate"],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        digest = json.loads(r.stdout.strip().splitlines()[-1])
+        digest["gates_ok"] = r.returncode == 0
+        return digest
+    except Exception as e:                   # noqa: BLE001 — bench must emit
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _skip(reason: str):
     """Emit a parseable skip record instead of a bare nonzero exit: the
     bench trajectory must distinguish "chip unreachable this round" from "a
@@ -261,11 +289,12 @@ def _skip(reason: str):
         "process_count": None,
         "skipped": True,
         "reason": reason,
-        # lint + the serving digest + the cost ledger run on CPU, so the
-        # trajectory still records static health, the serving-layer gates,
-        # and cost-model drift
+        # lint + the serving/chaos digests + the cost ledger run on CPU, so
+        # the trajectory still records static health, the serving-layer
+        # gates, the fleet chaos gates, and cost-model drift
         "lint_findings": _lint_summary(),
         "serving": _serving_summary(),
+        "chaos": _chaos_summary(),
         "cost_ledger": _cost_ledger_summary(),
     }))
     raise SystemExit(0)
@@ -416,6 +445,11 @@ def main():
         # micro-batched q/s, zero-recompile gate — the prediction side of
         # the trajectory (benchmarks/bench_serving.py)
         "serving": _serving_summary(),
+        # chaos-harness digest (CPU subprocess): supervised-fleet kill
+        # schedule -> zero committed draws lost + bit-consistency gates
+        # (benchmarks/bench_chaos.py) — robustness rides the trajectory
+        # alongside throughput
+        "chaos": _chaos_summary(),
         # static cost-ledger digest (CPU subprocess): per-spec sweep flops
         # + peak temp HBM and drift vs the committed cost_ledger.json
         # (hmsc_tpu/obs/profile.py) — cost-model drift rides the

@@ -11,10 +11,13 @@ severity=error finding), ``profile`` (sweep-level cost attribution: the
 static per-updater flops/HBM ledger with its committed diffable digest,
 and measured per-updater wall timing — see README "Profiling"),
 ``compact`` (thin + re-shard a fitted run into a
-serving-optimised artifact, optionally bf16), and ``serve`` (long-lived
+serving-optimised artifact, optionally bf16), ``serve`` (long-lived
 HTTP posterior-serving engine: compile-cached bucketed predict kernels +
-micro-batching, see README "Serving").  Bare arguments keep the
-historical bench behaviour: ``python -m hmsc_tpu --ns 50`` still works.
+micro-batching, see README "Serving"), and ``fleet`` (elastic fleet
+supervisor: spawn R worker ranks, heartbeat liveness, backoff restarts,
+shrink/grow degradation — see README "Elastic fleet runs").  Bare
+arguments keep the historical bench behaviour: ``python -m hmsc_tpu
+--ns 50`` still works.
 """
 
 import sys
@@ -42,6 +45,9 @@ def main(argv=None):
     if argv[:1] == ["serve"]:
         from .serve.http import serve_main
         return serve_main(argv[1:])
+    if argv[:1] == ["fleet"]:
+        from .fleet.cli import fleet_main
+        return fleet_main(argv[1:])
     if argv[:1] == ["bench"]:
         argv = argv[1:]
     return bench_main(argv)

@@ -585,7 +585,8 @@ def check_mutable_default(ctx: ModuleContext):
 # rule: bare-print (migrated from tests/test_telemetry.py)
 # ---------------------------------------------------------------------------
 
-_PRINT_EXEMPT = ("obs/", "__main__.py", "bench_cli.py", "analysis/cli.py")
+_PRINT_EXEMPT = ("obs/", "__main__.py", "bench_cli.py", "analysis/cli.py",
+                 "fleet/cli.py")
 
 
 @rule("bare-print", "error", "ast",

@@ -12,8 +12,12 @@ reported as ``null`` here.
 run of the same synthetic probit JSDM: auto-snapshots every
 ``--checkpoint-every`` samples into ``--checkpoint-dir`` (pipelined host
 loop: fetches + writes overlap the next segment's compute; ``--no-pipeline``
-serialises for A/B), exits with code 75 (EX_TEMPFAIL) when preempted by
-SIGTERM/SIGINT after writing a resumable snapshot, and ``--resume``
+serialises for A/B), exits with the documented code taxonomy
+(:mod:`hmsc_tpu.exit_codes`): 75 (EX_TEMPFAIL) when preempted by
+SIGTERM/SIGINT after writing a resumable snapshot, 77 when the run
+completed but chains ended diverged and unhealed, 78 when ``--resume``
+found no usable checkpoint, 1 otherwise — so a supervisor or shell script
+can branch on the failure class.  ``--resume``
 continues from the newest valid one (corrupt slots fall back to the
 previous rotation slot; ``--verbose`` / ``--checkpoint-every`` act as
 draw-invariant overrides).  Snapshots use the append-only layout by
@@ -142,8 +146,9 @@ def run_main(argv=None):
 
     import os
 
+    from .exit_codes import EXIT_CKPT_CORRUPT, EXIT_DIVERGED, EXIT_PREEMPTED
     from .mcmc.sampler import sample_mcmc
-    from .utils.checkpoint import PreemptedRun, resume_run
+    from .utils.checkpoint import CheckpointError, PreemptedRun, resume_run
 
     # the spec fingerprint in every checkpoint rejects a resume against a
     # different model, so the model args are persisted next to the snapshots
@@ -206,14 +211,28 @@ def run_main(argv=None):
             "resume": f"python -m hmsc_tpu run --resume --checkpoint-dir "
                       f"{args.checkpoint_dir}",
         }))
-        return 75                      # EX_TEMPFAIL: try again (resume)
+        return EXIT_PREEMPTED          # 75, EX_TEMPFAIL: try again (resume)
+    except CheckpointError as e:
+        # --resume found no usable snapshot (every slot corrupt, or the
+        # directory belongs to a different model): blind retries cannot
+        # help, so the code is distinct from the resumable failures —
+        # a supervisor must stop and surface it
+        print(json.dumps({"error": "checkpoint", "detail": str(e),
+                          "checkpoint_dir": args.checkpoint_dir}))
+        return EXIT_CKPT_CORRUPT       # 78
+    good = np.asarray(post.chain_health["good_chains"])
     print(json.dumps({
         "preempted": False, "samples": int(post.samples),
         "chains": int(post.n_chains),
         "finite": bool(np.isfinite(post["Beta"]).all()),
+        "diverged_chains": int((~good).sum()),
         "checkpoint_dir": args.checkpoint_dir,
     }))
-    return 0
+    # divergence-abort: the run COMPLETED but chains ended non-finite and
+    # no retry healed them — distinct from 0 (healthy) and from the
+    # resumable 75/76 family, because a deterministic blow-up recurs on
+    # restart; branch on 77 to inspect instead of resubmitting
+    return 0 if good.all() else EXIT_DIVERGED
 
 
 if __name__ == "__main__":

@@ -1,0 +1,56 @@
+"""Process exit codes shared by every CLI entry point and the fleet.
+
+A supervised fleet (and any external operator — a k8s restart policy, a
+batch scheduler, a shell script) branches on worker exit codes, so they
+are a contract, not an implementation detail: ``python -m hmsc_tpu run``,
+the multi-process test workers (``hmsc_tpu.testing.multiproc``) and the
+fleet supervisor (``hmsc_tpu.fleet``) all use THIS module's values.
+
+- ``EXIT_OK`` (0) — run completed, posterior healthy.
+- ``EXIT_FAILURE`` (1) — unclassified failure (a traceback).
+- ``EXIT_PREEMPTED`` (75, ``EX_TEMPFAIL``) — preempted by SIGTERM/SIGINT
+  after writing a resumable snapshot: *retry with ``--resume``*.
+- ``EXIT_COORDINATION`` (76) — a multi-process collective failed (a peer
+  died or timed out); committed checkpoints are intact, resumable.
+- ``EXIT_DIVERGED`` (77) — the run completed but one or more chains ended
+  non-finite and no retry healed them: the posterior excludes those
+  chains, and a supervisor should NOT blindly restart (a deterministic
+  blow-up would recur) — inspect, then retry with ``retry_diverged``.
+- ``EXIT_CKPT_CORRUPT`` (78) — a resume found no usable checkpoint (every
+  slot corrupt, or the directory mismatches the model): restarting will
+  not help without operator intervention, so the supervisor treats it as
+  fatal for that run directory.
+"""
+
+from __future__ import annotations
+
+EXIT_OK = 0
+EXIT_FAILURE = 1
+EXIT_PREEMPTED = 75          # EX_TEMPFAIL: resumable, try again
+EXIT_COORDINATION = 76       # a peer died/stalled; checkpoints intact
+EXIT_DIVERGED = 77           # completed with unhealed diverged chains
+EXIT_CKPT_CORRUPT = 78       # no usable checkpoint to resume from
+
+__all__ = ["EXIT_OK", "EXIT_FAILURE", "EXIT_PREEMPTED", "EXIT_COORDINATION",
+           "EXIT_DIVERGED", "EXIT_CKPT_CORRUPT", "describe"]
+
+_NAMES = {
+    EXIT_OK: "ok",
+    EXIT_FAILURE: "failure",
+    EXIT_PREEMPTED: "preempted",
+    EXIT_COORDINATION: "coordination",
+    EXIT_DIVERGED: "diverged",
+    EXIT_CKPT_CORRUPT: "checkpoint-corrupt",
+}
+
+
+def describe(returncode: int) -> str:
+    """Symbolic name for an exit code (negative = killed by that signal)."""
+    rc = int(returncode)
+    if rc < 0:
+        import signal
+        try:
+            return f"signal:{signal.Signals(-rc).name}"
+        except ValueError:
+            return f"signal:{-rc}"
+    return _NAMES.get(rc, f"exit:{rc}")

@@ -1,0 +1,14 @@
+"""Elastic fleet supervision for preemptible capacity.
+
+``python -m hmsc_tpu fleet <config.json>`` runs a
+:class:`~hmsc_tpu.fleet.supervisor.FleetSupervisor`: R worker ranks under
+a ``FileCoordinator``, heartbeat liveness detection, exponential-backoff
+restarts under per-rank budgets, and shrink/grow degradation at committed
+manifest boundaries — zero committed draws lost, ever.  See the
+supervisor module docstring and README "Elastic fleet runs".
+"""
+
+from .config import FleetConfig
+from .supervisor import FleetSupervisor, fleet_events_path
+
+__all__ = ["FleetConfig", "FleetSupervisor", "fleet_events_path"]

@@ -1,0 +1,68 @@
+"""``python -m hmsc_tpu fleet <config.json>`` — run a supervised fleet.
+
+Spawns the configured number of worker ranks, watches exit codes and
+heartbeats, restarts failures with exponential backoff, shrinks/grows the
+fleet at committed manifest boundaries, and prints one JSON summary line.
+See README "Elastic fleet runs" for the config schema
+(:class:`~hmsc_tpu.fleet.config.FleetConfig`) and the degradation policy.
+
+Exit codes follow :mod:`hmsc_tpu.exit_codes`: 0 when the fleet completed
+with a checksum-valid final manifest and zero committed draws lost; 77
+when the run ended diverged; 78 when no usable checkpoint remained; 1 for
+any other supervision failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+from ..exit_codes import EXIT_CKPT_CORRUPT, EXIT_DIVERGED, EXIT_FAILURE
+
+
+def fleet_main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m hmsc_tpu fleet",
+        description="elastic fleet supervisor: spawn R worker ranks, "
+                    "restart failures with backoff, shrink/grow at "
+                    "committed manifest boundaries")
+    ap.add_argument("config", help="JSON fleet config (FleetConfig schema)")
+    ap.add_argument("--nprocs", type=int, default=None,
+                    help="override the config's initial fleet size")
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="override the config's checkpoint directory")
+    ap.add_argument("--work-dir", default=None,
+                    help="override the config's scratch directory "
+                         "(coordination sentinels, heartbeats, worker logs)")
+    ap.add_argument("--chaos-seed", type=int, default=None,
+                    help="arm a seeded Poisson kill schedule against the "
+                         "fleet (chaos drill; see --chaos-rate/horizon)")
+    ap.add_argument("--chaos-rate", type=float, default=0.05,
+                    help="Poisson kill rate per second (with --chaos-seed)")
+    ap.add_argument("--chaos-horizon", type=float, default=120.0,
+                    help="kill-schedule horizon in seconds "
+                         "(with --chaos-seed)")
+    args = ap.parse_args(argv)
+
+    from .config import FleetConfig
+    from .supervisor import FleetSupervisor
+
+    cfg = FleetConfig.from_json(args.config, nprocs=args.nprocs,
+                                ckpt_dir=args.ckpt_dir,
+                                work_dir=args.work_dir)
+    chaos = None
+    if args.chaos_seed is not None:
+        from ..testing.chaos import poisson_schedule
+        chaos = poisson_schedule(args.chaos_seed, args.chaos_rate,
+                                 args.chaos_horizon, cfg.nprocs)
+    summary = FleetSupervisor(cfg, chaos=chaos).run()
+    print(json.dumps(summary))
+    if summary["ok"]:
+        return 0
+    return {"diverged": EXIT_DIVERGED,
+            "checkpoint-corrupt": EXIT_CKPT_CORRUPT}.get(
+        summary["status"], EXIT_FAILURE)
+
+
+if __name__ == "__main__":
+    raise SystemExit(fleet_main())

@@ -1,0 +1,115 @@
+"""Fleet supervisor configuration.
+
+A fleet run is described by one JSON document (the ``python -m hmsc_tpu
+fleet <config.json>`` argument) mapping 1:1 onto :class:`FleetConfig`.
+Everything has a usable default except the two directories, so a minimal
+config is::
+
+    {"ckpt_dir": "/data/run-1/ck", "work_dir": "/data/run-1/fleet",
+     "nprocs": 4,
+     "run_kw": {"samples": 200, "transient": 50, "n_chains": 4,
+                "checkpoint_every": 25, "seed": 7}}
+
+``run_kw``/``model_kw`` are passed verbatim to the worker
+(:mod:`hmsc_tpu.testing.multiproc`), i.e. to ``sample_mcmc`` /
+``build_worker_model`` — the supervisor itself never interprets them
+beyond ``samples`` and ``n_chains``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+__all__ = ["FleetConfig"]
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """Everything the supervisor needs to run and heal one fleet.
+
+    Degradation policy: when any rank slot exhausts ``restart_budget``
+    consecutive failures, the fleet shrinks to the next process count on
+    the :meth:`ladder` (divisors of ``n_chains``, so resume re-shards the
+    chains evenly) at the next restart — resume always continues from the
+    last committed manifest, so no committed draw is ever at risk.  After
+    ``grow_after_attempts`` attempts at reduced size the capacity is
+    considered recovered and the fleet grows one ladder step back, with
+    the re-added slots' budgets refreshed."""
+
+    ckpt_dir: str
+    work_dir: str
+    nprocs: int = 2
+    model_kw: dict = dataclasses.field(default_factory=dict)
+    run_kw: dict = dataclasses.field(default_factory=dict)
+    # liveness
+    heartbeat_interval_s: float = 0.25
+    heartbeat_timeout_s: float = 20.0
+    startup_grace_s: float = 240.0       # import + first compile headroom
+    # restart policy
+    restart_budget: int = 3
+    backoff_base_s: float = 0.25
+    backoff_factor: float = 2.0
+    backoff_max_s: float = 30.0
+    # degradation policy
+    min_procs: int = 1
+    grow_after_attempts: int = 2
+    max_attempts: int = 16
+    # spawn plumbing
+    coord_timeout_s: float = 60.0
+    wall_timeout_s: float = 600.0        # per attempt
+    poll_s: float = 0.1
+    pin_cpus: bool = False
+
+    def __post_init__(self):
+        self.run_kw = dict(self.run_kw or {})
+        self.model_kw = dict(self.model_kw or {})
+        self.run_kw.setdefault("samples", 8)
+        self.run_kw.setdefault("n_chains", max(1, int(self.nprocs)))
+        self.run_kw.setdefault("checkpoint_every",
+                               max(1, int(self.run_kw["samples"]) // 4))
+        if int(self.nprocs) < 1 or int(self.min_procs) < 1:
+            raise ValueError("nprocs and min_procs must be >= 1")
+        if int(self.min_procs) > int(self.nprocs):
+            raise ValueError(f"min_procs ({self.min_procs}) exceeds nprocs "
+                             f"({self.nprocs})")
+        if int(self.restart_budget) < 1:
+            raise ValueError("restart_budget must be >= 1")
+        if not self.ladder():
+            raise ValueError(
+                f"n_chains={self.n_chains} has no divisor between "
+                f"min_procs={self.min_procs} and nprocs={self.nprocs}; "
+                "chains must shard evenly over every fleet size")
+
+    @property
+    def n_chains(self) -> int:
+        return int(self.run_kw["n_chains"])
+
+    @property
+    def samples(self) -> int:
+        return int(self.run_kw["samples"])
+
+    def ladder(self) -> list:
+        """Fleet sizes the degradation policy may run at, descending —
+        every divisor of ``n_chains`` in ``[min_procs, nprocs]`` (resume
+        re-shards chains across process counts, but only even shards)."""
+        return [r for r in range(int(self.nprocs), int(self.min_procs) - 1,
+                                 -1) if self.n_chains % r == 0]
+
+    @classmethod
+    def from_json(cls, path: str, **overrides) -> "FleetConfig":
+        with open(os.fspath(path)) as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict):
+            raise ValueError(f"{path}: fleet config must be a JSON object")
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = sorted(set(doc) - known)
+        if unknown:
+            raise ValueError(f"{path}: unknown fleet config key(s) "
+                             f"{unknown}; valid keys: {sorted(known)}")
+        doc.update({k: v for k, v in overrides.items() if v is not None})
+        return cls(**doc)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)

@@ -1,0 +1,325 @@
+"""Elastic fleet supervisor: spawn, watch, heal, shrink, grow.
+
+The supervisor composes machinery earlier PRs built separately — atomic
+append-layout checkpoints, the coordinated preemption unwind, resume
+re-sharding across process counts, rank-tagged telemetry — into an actual
+operator for preemptible capacity:
+
+- it spawns R worker ranks (the :mod:`hmsc_tpu.testing.multiproc` worker,
+  one subprocess per rank, ``FileCoordinator`` over a per-attempt
+  sentinel directory);
+- it watches **liveness** two ways: process exit codes
+  (:mod:`hmsc_tpu.exit_codes`) and per-rank heartbeat files — a rank that
+  is alive but heartbeat-silent past ``heartbeat_timeout_s`` is presumed
+  wedged and SIGKILLed (its peers then unwind with a clean
+  ``CoordinationError`` at their next collective);
+- any failure ends the attempt; the next attempt **resumes** from the
+  last committed manifest after an exponential backoff (or restarts
+  fresh when the fleet died before its first commit), so the core
+  invariant holds by construction: *zero committed draws lost, ever* —
+  a restart can only re-run the uncommitted tail;
+- **degradation**: each rank slot has a restart budget; when a slot
+  exhausts it, the fleet shrinks to the next divisor of ``n_chains``
+  (resume re-shards the chains), and after ``grow_after_attempts``
+  attempts at reduced size the recovered capacity grows it back;
+- every decision is recorded as a ``kind="fleet"`` event in
+  ``fleet-events.jsonl`` next to the run's ``events-p<rank>.jsonl``
+  streams, rendered by ``python -m hmsc_tpu report`` as the fleet
+  timeline.
+
+Chaos: a :class:`~hmsc_tpu.testing.chaos.ChaosPlan` injects scripted
+faults — armed worker flags (progress-triggered SIGKILL/SIGTERM,
+heartbeat-freeze, disk-full) and wall-clock Poisson kills — which is how
+``benchmarks/bench_chaos.py`` and the ``chaos``-marked tests prove the
+invariant end-to-end.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import time
+
+from ..exit_codes import (EXIT_CKPT_CORRUPT, EXIT_COORDINATION,
+                          EXIT_DIVERGED, EXIT_OK, EXIT_PREEMPTED, describe)
+
+__all__ = ["FleetSupervisor", "fleet_events_path", "FLEET_EVENTS_FILE"]
+
+FLEET_EVENTS_FILE = "fleet-events.jsonl"
+
+
+def fleet_events_path(run_dir: str) -> str:
+    """The supervisor's event stream, next to the run's per-rank streams
+    (``report`` renders it as the fleet timeline)."""
+    return os.path.join(os.fspath(run_dir), FLEET_EVENTS_FILE)
+
+
+class FleetSupervisor:
+    """Run one fleet to completion (see module docstring).
+
+    ``chaos`` is an optional :class:`~hmsc_tpu.testing.chaos.ChaosPlan`;
+    armed events become worker spawn flags, wall-clock events are
+    delivered by the watch loop.  :meth:`run` returns the summary dict the
+    CLI prints; ``attempt_log`` keeps the per-attempt outcomes for tests.
+    """
+
+    def __init__(self, config, *, chaos=None):
+        from ..obs import RunTelemetry
+        self.cfg = config
+        self.chaos = chaos
+        self.telem = RunTelemetry(proc=0)
+        self.attempt_log: list = []
+        self._t0 = time.monotonic()
+
+    # -- event plumbing ----------------------------------------------------
+
+    def _emit(self, name: str, **fields) -> None:
+        self.telem.emit("fleet", name, **fields)
+        self.telem.flush()            # the stream must be tailable live
+
+    # -- spawn / watch one attempt -----------------------------------------
+
+    def _spawn(self, rank: int, nprocs: int, attempt: int, action: str,
+               coord_dir: str, hb_dir: str):
+        from ..testing.multiproc import _pkg_root, worker_cmd, worker_env
+        cfg = self.cfg
+        extra = []
+        if self.chaos is not None:
+            extra += self.chaos.arm_flags(rank, attempt)
+        if cfg.pin_cpus:
+            extra += ["--pin-cpu", str(rank % (os.cpu_count() or 1))]
+        out = os.path.join(cfg.work_dir, f"out-{attempt:03d}-r{rank}.json")
+        cmd = worker_cmd(
+            rank, nprocs, coord_dir=coord_dir, ckpt_dir=cfg.ckpt_dir,
+            model_kw=cfg.model_kw,
+            # resume attempts take the stored run configuration from the
+            # checkpoint — only the first attempt passes run_kw through
+            run_kw=(cfg.run_kw if action == "run" else {}),
+            action=action, timeout_s=cfg.coord_timeout_s, out=out,
+            heartbeat_dir=hb_dir,
+            heartbeat_interval_s=cfg.heartbeat_interval_s,
+            extra_args=extra)
+        log_path = os.path.join(cfg.work_dir,
+                                f"worker-{attempt:03d}-r{rank}.log")
+        # worker output goes to a file, not a pipe: a full pipe would wedge
+        # a healthy worker mid-run while its heartbeat keeps beating
+        logf = open(log_path, "w")
+        p = subprocess.Popen(cmd, cwd=_pkg_root(), env=worker_env(),
+                             stdout=logf, stderr=subprocess.STDOUT)
+        logf.close()                  # the child holds its own descriptor
+        self._emit("spawn", attempt=attempt, rank=rank, pid=p.pid,
+                   nprocs=nprocs, action=action, chaos_flags=extra or None)
+        return p, log_path
+
+    def _log_tail(self, path: str, nbytes: int = 1500) -> str:
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                f.seek(max(0, f.tell() - nbytes))
+                return f.read().decode(errors="replace")
+        except OSError:
+            return ""
+
+    def _attempt(self, attempt: int, nprocs: int, action: str) -> dict:
+        cfg = self.cfg
+        coord_dir = os.path.join(cfg.work_dir, f"coord-{attempt:03d}")
+        hb_dir = os.path.join(cfg.work_dir, "hb")
+        os.makedirs(coord_dir, exist_ok=True)
+        os.makedirs(hb_dir, exist_ok=True)
+        from ..utils.coordination import heartbeat_path, read_heartbeats
+        # a SIGKILLed rank leaves its old heartbeat file behind; spawning
+        # over it would read as instantly-silent — sweep before spawn
+        for r in range(nprocs):
+            try:
+                os.unlink(heartbeat_path(hb_dir, r))
+            except OSError:
+                pass
+        self._emit("attempt_start", attempt=attempt, nprocs=nprocs,
+                   action=action)
+        procs, logs = {}, {}
+        for r in range(nprocs):
+            procs[r], logs[r] = self._spawn(r, nprocs, attempt, action,
+                                            coord_dir, hb_dir)
+
+        t_att = time.monotonic()
+        exits: dict = {}
+        hb_killed: list = []
+        timed_out = False
+        while procs:
+            for r, p in list(procs.items()):
+                rc = p.poll()
+                if rc is not None:
+                    exits[r] = int(rc)
+                    self._emit("exit", attempt=attempt, rank=r, rc=int(rc),
+                               outcome=describe(rc),
+                               log_tail=(self._log_tail(logs[r])
+                                         if rc not in (EXIT_OK,
+                                                       EXIT_PREEMPTED)
+                                         else None))
+                    del procs[r]
+            if not procs:
+                break
+            if self.chaos is not None:
+                for ev in self.chaos.due_signals(time.monotonic() - self._t0):
+                    p = procs.get(ev.rank)
+                    live = p is not None and p.poll() is None
+                    self._emit("chaos", attempt=attempt, action=ev.action,
+                               rank=ev.rank, at_s=ev.at_s, delivered=live)
+                    if live:
+                        os.kill(p.pid, signal.SIGKILL
+                                if ev.action == "sigkill" else signal.SIGTERM)
+            hb = read_heartbeats(hb_dir)
+            elapsed = time.monotonic() - t_att
+            for r, p in list(procs.items()):
+                if r in hb_killed:    # already killed; waiting on the exit
+                    continue
+                rec = hb.get(r)
+                if rec is None:
+                    silent = elapsed > cfg.startup_grace_s
+                    age = None
+                else:
+                    age = rec["age_s"]
+                    silent = age > cfg.heartbeat_timeout_s
+                if silent:
+                    self._emit("heartbeat_silent", attempt=attempt, rank=r,
+                               age_s=age, pid=p.pid)
+                    hb_killed.append(r)
+                    p.kill()          # the exit is collected next poll
+            if elapsed > cfg.wall_timeout_s:
+                timed_out = True
+                self._emit("attempt_timeout", attempt=attempt,
+                           elapsed_s=round(elapsed, 1))
+                for p in procs.values():
+                    p.kill()
+            time.sleep(cfg.poll_s)
+        rec = {"attempt": attempt, "nprocs": nprocs, "action": action,
+               "exits": exits, "hb_killed": hb_killed,
+               "timed_out": timed_out}
+        self.attempt_log.append(rec)
+        return rec
+
+    # -- the supervision loop ----------------------------------------------
+
+    def run(self) -> dict:
+        cfg = self.cfg
+        os.makedirs(cfg.work_dir, exist_ok=True)
+        os.makedirs(cfg.ckpt_dir, exist_ok=True)
+        self.telem.attach_sink(fleet_events_path(cfg.ckpt_dir),
+                               truncate=True)
+        ladder = cfg.ladder()
+        nprocs = ladder[0]
+        budgets = {r: int(cfg.restart_budget) for r in range(ladder[0])}
+        consecutive_fail = 0
+        attempts = restarts = shrinks = grows = 0
+        attempts_at_reduced = 0
+        status = "unknown"
+        self._emit("fleet_start", config=cfg.to_dict(), ladder=ladder,
+                   chaos=(self.chaos.summary()
+                          if self.chaos is not None else None))
+        while True:
+            if attempts >= int(cfg.max_attempts):
+                status = "max-attempts"
+                break
+            # recovered capacity grows the fleet back one ladder step
+            if nprocs < ladder[0] \
+                    and attempts_at_reduced >= int(cfg.grow_after_attempts):
+                bigger = [x for x in ladder if x > nprocs]
+                grown = bigger[-1]    # one step up, not straight to max
+                for r in range(nprocs, grown):
+                    budgets[r] = int(cfg.restart_budget)
+                self._emit("grow", from_procs=nprocs, to_procs=grown)
+                grows += 1
+                nprocs = grown
+                attempts_at_reduced = 0
+            attempts += 1
+            if nprocs < ladder[0]:
+                attempts_at_reduced += 1
+            # resume only when a committed snapshot exists: a fleet killed
+            # before its FIRST commit has nothing to resume (the workers
+            # would abort with exit 78), so the retry is a fresh run — the
+            # zero-loss invariant holds trivially, nothing was committed
+            from ..utils.checkpoint import checkpoint_files
+            action = "resume" if checkpoint_files(cfg.ckpt_dir) else "run"
+            rec = self._attempt(attempts, nprocs, action)
+            exits = rec["exits"]
+            if all(rc == EXIT_OK for rc in exits.values()):
+                status = "ok"
+                break
+            if any(rc == EXIT_DIVERGED for rc in exits.values()):
+                # a deterministic blow-up would recur on restart: stop and
+                # surface it instead of burning the restart budget
+                status = "diverged"
+                break
+            if any(rc == EXIT_CKPT_CORRUPT for rc in exits.values()):
+                status = "checkpoint-corrupt"
+                break
+            consecutive_fail += 1
+            restarts += 1
+            # blame the ranks that actually failed; EXIT_COORDINATION is
+            # collateral (the survivor of a dead peer), EXIT_OK finished
+            culprits = sorted(
+                set(r for r, rc in exits.items()
+                    if rc not in (EXIT_OK, EXIT_COORDINATION))
+                | set(rec["hb_killed"]))
+            for r in culprits:
+                budgets[r] = budgets.get(r, int(cfg.restart_budget)) - 1
+            exhausted = [r for r in range(nprocs) if budgets.get(r, 1) <= 0]
+            if exhausted:
+                smaller = [x for x in ladder if x < nprocs]
+                if not smaller:
+                    status = "budget-exhausted"
+                    self._emit("abort", reason="budget-exhausted",
+                               ranks=exhausted)
+                    break
+                self._emit("shrink", from_procs=nprocs,
+                           to_procs=smaller[0], exhausted_ranks=exhausted)
+                shrinks += 1
+                nprocs = smaller[0]
+                attempts_at_reduced = 0
+                # the shrink IS the response to the exhaustion: the reduced
+                # fleet starts with fresh budgets (a still-zero slot would
+                # otherwise trigger another shrink on the next unrelated
+                # failure)
+                for r in range(nprocs):
+                    budgets[r] = int(cfg.restart_budget)
+            backoff = min(cfg.backoff_base_s
+                          * cfg.backoff_factor ** (consecutive_fail - 1),
+                          cfg.backoff_max_s)
+            self._emit("backoff", seconds=round(backoff, 3),
+                       consecutive_failures=consecutive_fail,
+                       culprits=culprits, budgets=dict(budgets))
+            time.sleep(backoff)
+        ck = self._verify_checkpoint()
+        summary = {
+            "ok": status == "ok" and ck.get("valid", False)
+            and int(ck.get("samples_done", -1)) >= cfg.samples,
+            "status": status,
+            "attempts": attempts, "restarts": restarts,
+            "shrinks": shrinks, "grows": grows,
+            "fleet_size": {"initial": ladder[0], "final": nprocs},
+            "budgets": {str(r): b for r, b in sorted(budgets.items())},
+            "target_samples": cfg.samples,
+            "checkpoint": ck,
+            "draws_lost": (max(0, cfg.samples - int(ck["samples_done"]))
+                           if ck.get("valid") else None),
+            "wall_s": round(time.monotonic() - self._t0, 3),
+        }
+        self._emit("fleet_end", **summary)
+        return summary
+
+    def _verify_checkpoint(self) -> dict:
+        """Load + checksum-verify the newest committed manifest — the
+        zero-committed-draws-lost evidence the summary carries."""
+        from ..testing.multiproc import build_worker_model
+        from ..utils.checkpoint import (CheckpointError,
+                                        latest_valid_checkpoint)
+        try:
+            hM = build_worker_model(**self.cfg.model_kw)
+            ck = latest_valid_checkpoint(self.cfg.ckpt_dir, hM)
+            return {"valid": True,
+                    "manifest": os.path.basename(ck.path),
+                    "samples_done": int(ck.post.samples),
+                    "n_chains": int(ck.post.n_chains)}
+        except (CheckpointError, ValueError, OSError) as e:
+            return {"valid": False, "error": f"{type(e).__name__}: {e}"}

@@ -346,6 +346,12 @@ def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin,
 # minimum over reps is reported, so dispatch jitter shrinks with more reps
 _PROFILE_REPS = 3
 
+# collective timeout while a coordinated divergence repair is in flight:
+# healthy ranks wait out the owning rank's warm re-sample (up to a whole
+# sampling window), so the per-commit timeout — sized for barrier skew,
+# seconds — would falsely declare the fleet dead mid-repair
+_RETRY_REPAIR_TIMEOUT_S = 3600.0
+
 
 @functools.lru_cache(maxsize=8)
 def _instrumented_steps(spec, updater_items, adapt_nf, vmapped):
@@ -653,7 +659,14 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
       :class:`~hmsc_tpu.utils.coordination.FileCoordinator` to run the
       full protocol over a shared filesystem (or in tests, subprocesses).
       Multi-process runs require ``checkpoint_layout="append"``;
-      ``retry_diverged`` and ``from_prior`` are single-process-only.
+      ``from_prior`` is single-process-only.  ``retry_diverged`` on a
+      multi-process mesh runs the COORDINATED splice (and therefore
+      requires checkpointing): an end-of-run health gather agrees on the
+      diverged chain set, every rank identifies the same last-healthy
+      manifest, the owning rank(s) warm-restart their chains
+      single-process from that shared boundary, and the repair shard is
+      committed there by the ordinary coordinated commit — healthy
+      ranks' draws are untouched bit-for-bit.
     - ``telemetry`` controls the run-telemetry subsystem
       (:mod:`hmsc_tpu.obs`): every run keeps in-memory span/health
       aggregates (surfaced as ``Posterior.telemetry`` and the
@@ -730,11 +743,13 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
     n_dup = 1 if (n_procs > 1 and n_local == 1) else 0
     n_batch = n_local + n_dup
     if n_procs > 1:
-        if retry_diverged:
+        if retry_diverged and checkpoint_path is None:
             raise ValueError(
-                "retry_diverged is not supported under a multi-process "
-                "coordinator (the splice re-write has no coordinated "
-                "commit); retry divergences in a single-process resume")
+                "retry_diverged under a multi-process coordinator requires "
+                "append-layout checkpointing (checkpoint_path=...): the "
+                "coordinated splice unwinds every rank to a shared "
+                "last-healthy manifest boundary and commits the repair "
+                "shard there")
         if from_prior:
             raise ValueError("from_prior does not shard over a "
                              "multi-process coordinator")
@@ -1554,7 +1569,7 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
     # opt-in restart: re-run just the poisoned chains with a fresh key
     # stream and splice the replacements in (chains are independent, so the
     # spliced posterior targets the same distribution)
-    if retry_diverged > 0 and (first_bad >= 0).any():
+    if retry_diverged > 0 and n_procs == 1 and (first_bad >= 0).any():
         bad = np.nonzero(first_bad >= 0)[0]
         # the mesh is forwarded when the retry chain count still lays out
         # evenly over its chain axis (so an HBM-bound species-sharded model
@@ -1662,7 +1677,8 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 ckw.rewrite_spliced(
                     splice_from, int(samples), final_state,
                     jnp.array(jax.random.key_data(keys)), first_bad, post,
-                    _run_meta(int(samples)))
+                    dict(_run_meta(int(samples)),
+                         retry_info=post.retry_info))
             else:
                 ckw.rewrite_rotating(int(samples), final_state, keys,
                                      first_bad, post, _run_meta(int(samples)))
@@ -1675,6 +1691,142 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 telemetry_events=int(telem.n_events))
             post.telemetry = telem.summary(wall_s=t2 - t1)
             telem.flush()             # the splice spans landed post-barrier
+
+    # coordinated multi-process retry (closes the carried ROADMAP gap): the
+    # final commit already gathered every rank's divergence health; close
+    # the loop by agreeing on the diverged chain set, unwinding every rank
+    # to the SAME last-healthy manifest, warm-restarting the diverged
+    # chains on their owning rank(s), and re-committing the repair at that
+    # shared boundary — exactly the single-process splice, coordinated.
+    # The gate is rank-invariant (retry_diverged / n_procs / ck_every are
+    # identical everywhere), so the collective sequence stays SPMD even
+    # when only one rank diverged — or none did (the health gather still
+    # runs; the repair protocol only engages on an agreed non-empty set).
+    if retry_diverged > 0 and n_procs > 1 and ck_every:
+        with telem.span("barrier_wait", what="retry-health"):
+            parts = coord.all_gather(
+                {"first_bad": [int(x) for x in first_bad]},
+                tag="retry-health")
+        fb_global = np.asarray([x for p in parts for x in p["first_bad"]])
+        bad_g = np.nonzero(fb_global >= 0)[0]
+        if bad_g.size:
+            # the shared splice boundary: the newest manifest at which
+            # EVERY diverged chain (global index) was still healthy —
+            # found by a deterministic scan of the shared directory, so
+            # all ranks agree without an extra broadcast
+            with telem.span("warm_restart_find"):
+                warm = _find_warm_restart(ck_dir, hM, bad_g, base_samples,
+                                          samples)
+            if warm is not None:
+                warm_state, warm_s0, warm_t_done = warm
+                splice_from = int(warm_s0)
+                rem_t = (max(0, (it0 + int(transient)) - int(warm_t_done))
+                         if warm_s0 == 0 and warm_t_done else 0)
+            else:
+                warm_state, splice_from = None, 0
+                rem_t = int(transient) + it0
+            # per-rank retry seeds drawn from the shared rng stream (its
+            # state is identical on every rank), one slot per rank so two
+            # owning ranks never reuse a seed
+            retry_seeds = rng.integers(0, 2**31 - 1, size=n_procs)
+            own_bad_g = [int(c) for c in bad_g
+                         if chains_lo <= int(c) < chains_lo + n_local]
+            rows = np.asarray([c - chains_lo for c in own_bad_g],
+                              dtype=np.int64)
+            healthy_after = {}
+            if own_bad_g:
+                from ..utils.coordination import SingleProcessCoordinator
+                common = dict(
+                    thin=thin, n_chains=len(own_bad_g),
+                    seed=int(retry_seeds[proc]), updater=updater,
+                    nf_cap=nf_cap, dtype=dtype, data_par=data_par,
+                    align_post=False, verbose=verbose, rng_impl=rng_impl,
+                    record_dtype=record_dtype,
+                    retry_diverged=retry_diverged - 1, record=record,
+                    coordinator=SingleProcessCoordinator(),
+                    return_state=True)
+                if warm_state is not None:
+                    # slice this rank's diverged chains (global indices)
+                    # out of the manifest's stitched GLOBAL carry
+                    sub_init = jax.tree.map(
+                        lambda x: jnp.asarray(np.asarray(x)[own_bad_g]),
+                        warm_state)
+                    sub, sub_state = sample_mcmc(
+                        hM, samples=samples - splice_from, transient=rem_t,
+                        adapt_nf=[int(a) for a in adapt_nf],
+                        init_state=sub_init, **common)
+                else:
+                    # no healthy snapshot survives rotation: cold restart
+                    # covering the original chains' full progress
+                    sub, sub_state = sample_mcmc(
+                        hM, samples=samples, transient=rem_t,
+                        init_par=init_par, adapt_nf=adapt_nf_arg, **common)
+                for k in post.arrays:
+                    a = post.arrays[k]
+                    if not a.flags.writeable:
+                        a = a.copy()
+                    a[rows, splice_from:] = sub.arrays[k]
+                    post.arrays[k] = a
+                sub_fb = np.asarray(sub.chain_health["first_bad_it"])
+                first_bad = first_bad.copy()
+                first_bad[rows] = sub_fb
+                post.set_chain_health(first_bad)
+
+                def _splice_rows(a, b):
+                    a = np.asarray(a).copy()
+                    a[rows] = np.asarray(b)
+                    return jnp.asarray(a)
+                final_state = jax.tree.map(_splice_rows, final_state,
+                                           sub_state)
+                for r in range(spec.nr):
+                    nf_sat_counts[r] = nf_sat_counts[r].copy()
+                    nf_sat_counts[r][rows] = sub.nf_saturation[r]
+                healthy_after = {int(g): bool(b < 0)
+                                 for g, b in zip(own_bad_g, sub_fb)}
+            # Post-repair collectives run under a raised coordination
+            # timeout: a healthy rank legitimately waits out its peers'
+            # warm re-sample here, which can far exceed the per-commit
+            # timeout the run was configured with (the re-run covers up
+            # to the whole sampling window).
+            with coord.timeout_override(_RETRY_REPAIR_TIMEOUT_S):
+                # share the outcome so every rank records identical
+                # retry_info (and the committer can put the global view
+                # in the manifest)
+                with telem.span("barrier_wait", what="retry-outcome"):
+                    out_parts = coord.all_gather(
+                        {"healthy": {str(k): v
+                                     for k, v in healthy_after.items()}},
+                        tag="retry-outcome")
+                healthy_g = {}
+                for p in out_parts:
+                    healthy_g.update({int(k): bool(v)
+                                      for k, v in p["healthy"].items()})
+                retry_info = {
+                    "retried_chains": tuple(int(c) for c in bad_g),
+                    "healthy_after_retry": tuple(
+                        healthy_g.get(int(c), False) for c in bad_g),
+                    "warm_start_samples": (splice_from if warm is not None
+                                           else None),
+                }
+                post.retry_info = retry_info
+                post.nf_saturation = nf_sat_counts
+                # the repair commit: owning ranks supersede their changed
+                # tail with a repair shard; every rank re-saves its
+                # chain-slice state; the committer overwrites the final
+                # manifest over the repaired sequence — all at the same
+                # shared boundary
+                ckw.rewrite_spliced_multi(
+                    splice_from, int(samples), final_state,
+                    jnp.array(jax.random.key_data(keys)), first_bad, post,
+                    dict(_run_meta(int(samples)), retry_info=retry_info),
+                    changed=bool(own_bad_g))
+            post.io_stats.update(
+                bytes_written=ckw.io["bytes"],
+                snapshot_bytes=list(ckw.io["snapshot_bytes"]),
+                shards_written=ckw.io["shards_written"],
+                telemetry_events=int(telem.n_events))
+            post.telemetry = telem.summary(wall_s=t2 - t1)
+            telem.flush()
 
     # factor-cap observability: warn when burn-in adaptation wanted to add
     # factors past the static nf_max cap — the residual associations may be

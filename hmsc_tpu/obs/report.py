@@ -14,7 +14,11 @@ line is tolerated) and prints:
 - **cost attribution** — the per-updater wall/share table recorded by
   ``sample_mcmc(profile_updaters=...)`` or ``python -m hmsc_tpu profile
   --measured``, and the static flops / temp-HBM ledger digest emitted by
-  ``profile --static --out``.
+  ``profile --static --out``;
+- **fleet timeline** — for supervised runs (``python -m hmsc_tpu fleet``),
+  the supervisor's ``fleet-events.jsonl``: per-attempt spawn/exit
+  outcomes, heartbeat kills, chaos injections, backoff/shrink/grow
+  decisions, and the final supervision summary.
 
 ``--json`` emits the structured report instead of text; ``--prom FILE``
 writes a Prometheus textfile-collector export of the final gauges (point
@@ -41,7 +45,8 @@ import argparse
 import json
 import os
 
-__all__ = ["load_run_events", "build_report", "render_report",
+__all__ = ["load_run_events", "load_fleet_events", "build_report",
+           "render_report",
            "prometheus_textfile", "serving_prometheus_textfile",
            "report_main", "PROM_GAUGES"]
 
@@ -90,10 +95,31 @@ def _gauge(out: list, name: str, labels: str, value) -> None:
     out.append(f"{name}{labels} {value}")
 
 
+def _read_jsonl(path: str) -> list | None:
+    """Torn-line-tolerant JSONL reader shared by the per-rank and fleet
+    streams: unparseable lines — e.g. the torn last line of an in-flight
+    run — are skipped, not fatal; an unreadable file returns ``None``."""
+    events = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue                  # torn tail of an in-flight run
+                if isinstance(ev, dict):
+                    events.append(ev)
+    except OSError:
+        return None
+    return events
+
+
 def load_run_events(run_dir: str) -> dict:
     """``{proc: [event, ...]}`` from every ``events-p*.jsonl`` under a run
-    directory (or a single events file path).  Unparseable lines — e.g. the
-    torn last line of an in-flight run — are skipped, not fatal."""
+    directory (or a single events file path)."""
     from .events import EVENTS_FILE_RE
 
     run_dir = os.fspath(run_dir)
@@ -110,22 +136,9 @@ def load_run_events(run_dir: str) -> dict:
                 paths[int(m.group(1))] = os.path.join(run_dir, fn)
     out = {}
     for proc, p in sorted(paths.items()):
-        events = []
-        try:
-            with open(p) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        ev = json.loads(line)
-                    except ValueError:
-                        continue              # torn tail of an in-flight run
-                    if isinstance(ev, dict):
-                        events.append(ev)
-        except OSError:
-            continue
-        out[proc] = events
+        events = _read_jsonl(p)
+        if events is not None:
+            out[proc] = events
     return out
 
 
@@ -162,11 +175,24 @@ def _split_epochs(events) -> list:
     return epochs
 
 
+def load_fleet_events(run_dir: str) -> list:
+    """The supervisor's ``fleet-events.jsonl`` timeline under a run
+    directory (``kind="fleet"`` events, in order); empty when the run was
+    not supervised.  Torn/unparseable lines are skipped like the per-rank
+    streams'."""
+    run_dir = os.fspath(run_dir)
+    if not os.path.isdir(run_dir):
+        return []
+    from ..fleet.supervisor import fleet_events_path
+    return _read_jsonl(fleet_events_path(run_dir)) or []
+
+
 def build_report(run_dir: str) -> dict:
     """Structured report over every rank's event stream."""
     streams = load_run_events(run_dir)
     report = {"run_dir": os.fspath(run_dir),
               "ranks": sorted(streams), "per_rank": {}, "skew": [],
+              "fleet": _fleet_section(load_fleet_events(run_dir)),
               "status": "no-events" if not streams else "unknown"}
     for proc, events in streams.items():
         # per-epoch clock re-basing: ``t`` restarts at ~0 in each appended
@@ -267,6 +293,36 @@ def build_report(run_dir: str) -> dict:
     return report
 
 
+def _fleet_section(events: list) -> dict | None:
+    """Structured fleet timeline from the supervisor's event stream:
+    per-attempt outcomes plus the supervision decisions (restarts with
+    backoff, heartbeat kills, chaos injections, shrink/grow steps)."""
+    if not events:
+        return None
+    attempts: dict = {}
+    decisions = []
+    summary = None
+    for ev in events:
+        name = ev.get("name")
+        att = ev.get("attempt")
+        if name == "attempt_start":
+            attempts[att] = {"attempt": att, "nprocs": ev.get("nprocs"),
+                             "action": ev.get("action"), "exits": {}}
+        elif name == "exit" and att in attempts:
+            attempts[att]["exits"][str(ev.get("rank"))] = {
+                "rc": ev.get("rc"), "outcome": ev.get("outcome")}
+        elif name in ("backoff", "shrink", "grow", "heartbeat_silent",
+                      "chaos", "abort", "attempt_timeout"):
+            decisions.append({k: v for k, v in ev.items()
+                              if k not in ("seq", "wall", "proc", "kind")})
+        elif name == "fleet_end":
+            summary = {k: v for k, v in ev.items()
+                       if k not in ("seq", "t", "wall", "proc", "kind",
+                                    "name")}
+    return {"attempts": [attempts[a] for a in sorted(attempts)],
+            "decisions": decisions, "summary": summary}
+
+
 def _bar(frac: float, width: int = 24) -> str:
     n = max(0, min(width, int(round(frac * width))))
     return "#" * n + "." * (width - n)
@@ -358,6 +414,32 @@ def render_report(report: dict) -> str:
                 f"{s.get('skew_s'):.4f}s  per-rank segment_s="
                 f"{s.get('segment_s')}  barrier_wait_s="
                 f"{s.get('barrier_wait_s')}")
+    fleet = report.get("fleet")
+    if fleet:
+        lines.append("")
+        lines.append("== fleet timeline (supervisor) ==")
+        for a in fleet["attempts"]:
+            exits = ", ".join(
+                f"r{r}:{v['outcome']}"
+                for r, v in sorted(a["exits"].items(), key=lambda kv:
+                                   int(kv[0]))) or "(in flight)"
+            lines.append(f"  attempt {a['attempt']}: {a['action']} "
+                         f"x{a['nprocs']} rank(s) -> {exits}")
+        for d in fleet["decisions"]:
+            name = d.get("name", "?")
+            t = d.get("t")
+            detail = ", ".join(f"{k}={v}" for k, v in d.items()
+                               if v is not None and k not in ("name", "t"))
+            stamp = f" t={t:.2f}s" if isinstance(t, float) else ""
+            lines.append(f"  [{name}]{stamp} {detail}")
+        s = fleet.get("summary")
+        if s:
+            lines.append(
+                f"  outcome: {s.get('status')} after {s.get('attempts')} "
+                f"attempt(s), {s.get('restarts')} restart(s), "
+                f"{s.get('shrinks')} shrink(s), {s.get('grows')} grow(s); "
+                f"fleet {s.get('fleet_size')}, draws lost "
+                f"{s.get('draws_lost')}, wall {s.get('wall_s')}s")
     return "\n".join(lines)
 
 
@@ -494,7 +576,7 @@ def report_main(argv=None) -> int:
     if args.prom:
         with open(args.prom, "w") as f:
             f.write(prometheus_textfile(report))
-    return 0 if report["ranks"] else 1
+    return 0 if (report["ranks"] or report.get("fleet")) else 1
 
 
 if __name__ == "__main__":

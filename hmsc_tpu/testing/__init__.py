@@ -3,14 +3,19 @@
 drill kill → resume recovery against their own models, not just the test
 suite's."""
 
+from .chaos import ChaosEvent, ChaosPlan, poisson_schedule
 from .faults import (InjectedFault, InjectedDeviceLoss, device_loss_after,
                      failing_checkpoint_writes, flip_bytes, inject_nan,
                      sigterm_after, slow_checkpoint_writes)
-from .multiproc import (EXIT_COORDINATION, EXIT_OK, EXIT_PREEMPTED,
-                        build_worker_model, spawn_workers, worker_main)
+from .multiproc import (EXIT_CKPT_CORRUPT, EXIT_COORDINATION, EXIT_DIVERGED,
+                        EXIT_OK, EXIT_PREEMPTED, build_worker_model,
+                        spawn_workers, worker_cmd, worker_env, worker_main)
 
 __all__ = ["InjectedFault", "InjectedDeviceLoss", "device_loss_after",
            "failing_checkpoint_writes", "flip_bytes", "inject_nan",
            "sigterm_after", "slow_checkpoint_writes",
            "build_worker_model", "spawn_workers", "worker_main",
-           "EXIT_OK", "EXIT_PREEMPTED", "EXIT_COORDINATION"]
+           "worker_cmd", "worker_env",
+           "ChaosEvent", "ChaosPlan", "poisson_schedule",
+           "EXIT_OK", "EXIT_PREEMPTED", "EXIT_COORDINATION",
+           "EXIT_DIVERGED", "EXIT_CKPT_CORRUPT"]

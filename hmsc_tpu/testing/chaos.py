@@ -1,0 +1,143 @@
+"""Deterministic chaos schedules for supervised fleet runs.
+
+The fleet supervisor's whole claim — **zero committed draws lost, ever** —
+is only credible if it survives scripted infrastructure abuse.  This
+module describes that abuse as data: a :class:`ChaosEvent` list the
+supervisor (and ``benchmarks/bench_chaos.py``) executes deterministically,
+covering the four characteristic failure modes of preemptible fleet
+capacity:
+
+- ``sigkill`` — a rank vanishes (host preempted without grace);
+- ``sigterm`` — a rank is preempted WITH grace (the coordinated unwind);
+- ``freeze``  — a rank wedges: the process lives but stops heartbeating
+  (armed in the worker via ``--freeze-at``; the supervisor must detect the
+  silence and SIGKILL it);
+- ``disk_full`` — checkpoint writes start failing mid-run (armed via
+  ``--fail-writes-at``, backed by the ``testing.faults`` write hook).
+
+Two trigger styles:
+
+- **armed** events (``at_samples`` + optional ``attempt``) become worker
+  CLI flags at spawn time — they key on the worker's own progress counter,
+  so a test's kill lands mid-segment regardless of CI machine speed;
+- **wall-clock** events (``at_s``, seconds since the supervisor started)
+  are delivered by the supervisor's watch loop — :func:`poisson_schedule`
+  generates these, seeded, for the chaos bench's random-kill gate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+__all__ = ["ChaosEvent", "ChaosPlan", "poisson_schedule",
+           "SIGNAL_ACTIONS", "ARMED_ACTIONS"]
+
+SIGNAL_ACTIONS = ("sigkill", "sigterm")
+ARMED_ACTIONS = ("sigkill", "sigterm", "freeze", "disk_full")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosEvent:
+    """One scripted fault.  Exactly one of ``at_s`` (wall-clock since
+    supervisor start; signal actions only) or ``at_samples`` (worker
+    progress trigger, armed as a spawn flag) must be set.  ``attempt``
+    restricts an armed event to one spawn attempt (1-based; ``None`` arms
+    it on the first attempt that spawns the rank)."""
+
+    action: str
+    rank: int
+    at_s: float | None = None
+    at_samples: int | None = None
+    attempt: int | None = None
+
+    def __post_init__(self):
+        if self.action not in ARMED_ACTIONS:
+            raise ValueError(f"unknown chaos action {self.action!r} "
+                             f"(valid: {ARMED_ACTIONS})")
+        if (self.at_s is None) == (self.at_samples is None):
+            raise ValueError(
+                "a ChaosEvent needs exactly one of at_s / at_samples")
+        if self.at_s is not None and self.action not in SIGNAL_ACTIONS:
+            raise ValueError(
+                f"wall-clock delivery only supports {SIGNAL_ACTIONS}; "
+                f"{self.action!r} must be armed via at_samples")
+
+
+# worker CLI flag per armed action (see testing.multiproc.worker_main)
+_ARM_FLAGS = {"sigkill": "--kill-at", "sigterm": "--sigterm-at",
+              "freeze": "--freeze-at", "disk_full": "--fail-writes-at"}
+
+
+class ChaosPlan:
+    """Executable view over a list of :class:`ChaosEvent` — tracks which
+    events already fired so the supervisor can poll it cheaply."""
+
+    def __init__(self, events):
+        self.events = list(events)
+        self._armed: set = set()
+        self._fired: set = set()
+
+    def arm_flags(self, rank: int, attempt: int) -> list:
+        """Worker CLI flags for the armed events matching this (rank,
+        attempt) spawn.  Each event arms at most once: an event with
+        ``attempt=None`` fires on the first spawn of its rank only (a
+        restarted rank must not be re-poisoned — real faults don't
+        recur on the replacement)."""
+        flags = []
+        for i, ev in enumerate(self.events):
+            if i in self._armed or ev.at_samples is None:
+                continue
+            if ev.rank != int(rank):
+                continue
+            if ev.attempt is not None and ev.attempt != int(attempt):
+                continue
+            flags += [_ARM_FLAGS[ev.action], str(int(ev.at_samples))]
+            self._armed.add(i)
+        return flags
+
+    def due_signals(self, elapsed_s: float) -> list:
+        """Wall-clock events due at ``elapsed_s`` (each returned once)."""
+        due = []
+        for i, ev in enumerate(self.events):
+            if i in self._fired or ev.at_s is None:
+                continue
+            if float(elapsed_s) >= float(ev.at_s):
+                self._fired.add(i)
+                due.append(ev)
+        return due
+
+    def summary(self) -> dict:
+        """Digest for bench records: counts per action + trigger style."""
+        by_action: dict = {}
+        for ev in self.events:
+            by_action[ev.action] = by_action.get(ev.action, 0) + 1
+        return {"events": len(self.events), "by_action": by_action,
+                "armed": sum(1 for e in self.events
+                             if e.at_samples is not None),
+                "wall_clock": sum(1 for e in self.events
+                                  if e.at_s is not None)}
+
+
+def poisson_schedule(seed: int, rate_per_s: float, horizon_s: float,
+                     nprocs: int, actions=SIGNAL_ACTIONS,
+                     min_gap_s: float = 0.0) -> ChaosPlan:
+    """Seeded Poisson kill schedule: exponential inter-arrival gaps at
+    ``rate_per_s`` over ``[0, horizon_s)``, each event striking a uniform
+    random rank with a uniform random action from ``actions``.
+    Deterministic in ``seed`` — the chaos bench's random kills are
+    reproducible bit-for-bit.  ``min_gap_s`` floors the gap between
+    consecutive events so a pathological draw cannot kill the fleet
+    faster than it can possibly restart."""
+    import numpy as np
+
+    rng = np.random.default_rng(int(seed))
+    events, t = [], 0.0
+    while True:
+        t += max(float(rng.exponential(1.0 / float(rate_per_s))),
+                 float(min_gap_s))
+        if t >= float(horizon_s):
+            break
+        events.append(ChaosEvent(
+            action=str(rng.choice(list(actions))),
+            rank=int(rng.integers(int(nprocs))), at_s=round(t, 3)))
+    return ChaosPlan(events)

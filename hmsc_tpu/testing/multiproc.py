@@ -6,7 +6,10 @@ plain CPU subprocess running ``sample_mcmc`` over its chain slice under a
 multi-process checkpoint protocol — barrier-gated manifest commits,
 committer-only GC, kill-one-process timeouts, resume under a different
 process count — runs in tier-1 tests and in
-``benchmarks/bench_multiproc.py`` on any machine.
+``benchmarks/bench_multiproc.py`` on any machine.  The fleet supervisor
+(:mod:`hmsc_tpu.fleet`) spawns the SAME worker via :func:`worker_cmd` /
+:func:`worker_env`, so a supervised fleet exercises exactly the protocol
+the tests pin.
 
 Run one worker by hand::
 
@@ -14,8 +17,10 @@ Run one worker by hand::
         --coord-dir /tmp/coord --ckpt-dir /tmp/ck \
         --run '{"samples": 8, "n_chains": 2, "checkpoint_every": 4}'
 
-Exit codes: 0 success, 75 preempted (resumable — the CLI convention),
-76 coordination failure (a peer died or timed out), 1 anything else.
+Exit codes come from :mod:`hmsc_tpu.exit_codes`: 0 success, 75 preempted
+(resumable — the CLI convention), 76 coordination failure (a peer died or
+timed out), 77 completed-but-diverged, 78 no usable checkpoint on resume,
+1 anything else.
 """
 
 from __future__ import annotations
@@ -25,12 +30,14 @@ import os
 import subprocess
 import sys
 
-EXIT_OK = 0
-EXIT_PREEMPTED = 75
-EXIT_COORDINATION = 76
+from ..exit_codes import (EXIT_CKPT_CORRUPT, EXIT_COORDINATION,
+                          EXIT_DIVERGED, EXIT_FAILURE, EXIT_OK,
+                          EXIT_PREEMPTED)
 
 __all__ = ["build_worker_model", "worker_main", "spawn_workers",
-           "EXIT_OK", "EXIT_PREEMPTED", "EXIT_COORDINATION"]
+           "worker_cmd", "worker_env",
+           "EXIT_OK", "EXIT_PREEMPTED", "EXIT_COORDINATION",
+           "EXIT_DIVERGED", "EXIT_CKPT_CORRUPT", "EXIT_FAILURE"]
 
 
 def _log():
@@ -67,6 +74,7 @@ def build_worker_model(ny: int = 24, ns: int = 3, nc: int = 2,
 
 def worker_main(argv=None) -> int:
     import argparse
+    import contextlib
 
     ap = argparse.ArgumentParser(description="multi-process sampling worker")
     ap.add_argument("--rank", type=int, required=True)
@@ -93,6 +101,26 @@ def worker_main(argv=None) -> int:
                     help="deliver SIGTERM (once) at N recorded samples — "
                          "the preemption rehearsal: EVERY rank must unwind "
                          "with PreemptedRun at the same committed boundary")
+    ap.add_argument("--freeze-at", type=int, default=None,
+                    help="chaos heartbeat-freeze: at N recorded samples "
+                         "stop heartbeating and wedge this worker (sleep "
+                         "forever) — the supervisor must detect the silent "
+                         "rank and SIGKILL it")
+    ap.add_argument("--fail-writes-at", type=int, default=None,
+                    help="chaos disk-full: every checkpoint payload write "
+                         "raises OSError once N recorded samples are done "
+                         "(testing.faults hook armed mid-run)")
+    ap.add_argument("--inject-nan", default=None,
+                    help="JSON {updater, at_iteration, field, disarm_at}: "
+                         "poison the carry at the given sweep via "
+                         "testing.faults.inject_nan, disarming at "
+                         "disarm_at recorded samples (a real blow-up does "
+                         "not recur under a fresh key stream)")
+    ap.add_argument("--heartbeat-dir", default=None,
+                    help="write heartbeat-p<rank>.json here every "
+                         "--heartbeat-interval seconds (liveness beacon "
+                         "for the fleet supervisor)")
+    ap.add_argument("--heartbeat-interval", type=float, default=0.5)
     ap.add_argument("--timeout", type=float, default=120.0,
                     help="coordination timeout (seconds)")
     ap.add_argument("--pin-cpu", type=int, default=None,
@@ -108,17 +136,25 @@ def worker_main(argv=None) -> int:
     if args.pin_cpu is not None and hasattr(os, "sched_setaffinity"):
         os.sched_setaffinity(0, {args.pin_cpu})
 
-    from ..utils.coordination import CoordinationError, FileCoordinator
-    from ..utils.checkpoint import PreemptedRun, resume_run
+    from ..utils.coordination import (CoordinationError, FileCoordinator,
+                                      HeartbeatWriter)
+    from ..utils.checkpoint import (CheckpointError, PreemptedRun,
+                                    resume_run)
 
     coord = FileCoordinator(args.coord_dir, args.rank, args.nprocs,
-                            timeout_s=args.timeout)
+                            timeout_s=args.timeout,
+                            heartbeat_dir=args.heartbeat_dir)
     hM = build_worker_model(**json.loads(args.model))
     run_kw = json.loads(args.run)
     # an explicit checkpoint_path in --run (including null) overrides the
     # --ckpt-dir default: the checkpoint-FREE mesh path (telemetry-only
     # runs, end-of-run skew gather) is protocol surface too
     ckpt_path = run_kw.pop("checkpoint_path", args.ckpt_dir)
+
+    hb = None
+    if args.heartbeat_dir is not None:
+        hb = HeartbeatWriter(args.heartbeat_dir, args.rank,
+                             interval_s=args.heartbeat_interval).start()
 
     import time as _time
     prog = []                         # [perf_counter, process_time,
@@ -128,9 +164,36 @@ def worker_main(argv=None) -> int:
                                       # hypervisor-noise-immune CPU window)
     kill_at, kill_calls = args.kill_at, args.kill_calls
     sigterm_at, sigterm_fired = args.sigterm_at, [False]
+    freeze_at = args.freeze_at
+
+    if args.fail_writes_at is not None:
+        # disk-full chaos, armed mid-run: committed snapshots up to the
+        # trigger stay durable; the first write after it raises on the
+        # background writer and propagates as a clean run failure
+        from ..utils import checkpoint as _ckmod
+        _real_savez = _ckmod._atomic_savez
+        trip = int(args.fail_writes_at)
+
+        def _maybe_failing_savez(path, payload, **kw):
+            done = prog[-1][2] if prog else 0
+            if done >= trip:
+                raise OSError(
+                    f"injected disk-full at {done} recorded samples "
+                    f"(chaos --fail-writes-at {trip}) for {path}")
+            _real_savez(path, payload, **kw)
+        _ckmod._atomic_savez = _maybe_failing_savez
+
+    nan_cm, nan_disarm_at = contextlib.nullcontext(None), None
+    if args.inject_nan is not None:
+        from .faults import inject_nan
+        nan_kw = dict(json.loads(args.inject_nan))
+        nan_disarm_at = nan_kw.pop("disarm_at", None)
+        nan_cm = inject_nan(**nan_kw)
 
     def progress_callback(done, total):
         prog.append([_time.perf_counter(), _time.process_time(), int(done)])
+        if hb is not None:
+            hb.update(samples_done=int(done), samples_total=int(total))
         if (kill_at is not None and done >= kill_at) or \
                 (kill_calls is not None and len(prog) >= kill_calls):
             import signal
@@ -140,26 +203,46 @@ def worker_main(argv=None) -> int:
             sigterm_fired[0] = True
             import signal
             os.kill(os.getpid(), signal.SIGTERM)
+        if freeze_at is not None and done >= freeze_at:
+            if hb is not None:
+                hb.freeze()
+            _log().warn(f"worker {args.rank}: chaos freeze at {done} "
+                        "recorded samples (heartbeat silent, wedged)")
+            while True:               # wedged until the supervisor kills us
+                _time.sleep(3600)
 
     try:
-        if args.action == "resume":
-            post = resume_run(hM, args.ckpt_dir, coordinator=coord,
-                              progress_callback=progress_callback,
-                              **run_kw)
-        else:
-            from ..mcmc.sampler import sample_mcmc
-            post = sample_mcmc(hM, coordinator=coord,
-                               checkpoint_path=ckpt_path,
-                               progress_callback=progress_callback,
-                               **run_kw)
+        with nan_cm as disarm:
+            if disarm is not None and nan_disarm_at is not None:
+                inner = progress_callback
+
+                def progress_callback(done, total):  # noqa: F811
+                    if done >= nan_disarm_at:
+                        disarm()
+                    inner(done, total)
+            if args.action == "resume":
+                post = resume_run(hM, args.ckpt_dir, coordinator=coord,
+                                  progress_callback=progress_callback,
+                                  **run_kw)
+            else:
+                from ..mcmc.sampler import sample_mcmc
+                post = sample_mcmc(hM, coordinator=coord,
+                                   checkpoint_path=ckpt_path,
+                                   progress_callback=progress_callback,
+                                   **run_kw)
     except PreemptedRun as e:
         _log().warn(f"worker {args.rank}: preempted ({e})")
         return EXIT_PREEMPTED
     except CoordinationError as e:
         _log().warn(f"worker {args.rank}: coordination failed ({e})")
         return EXIT_COORDINATION
+    except CheckpointError as e:
+        _log().warn(f"worker {args.rank}: no usable checkpoint ({e})")
+        return EXIT_CKPT_CORRUPT
     finally:
         coord.cleanup()
+        if hb is not None:
+            hb.stop()
 
     if args.out:
         import numpy as np
@@ -171,13 +254,79 @@ def worker_main(argv=None) -> int:
             # a cheap draw digest per parameter for cross-run comparisons
             "digest": {k: float(np.asarray(v, dtype=np.float64).sum())
                        for k, v in post.arrays.items()},
+            "retry_info": {k: list(v) if isinstance(v, tuple) else v
+                           for k, v in post.retry_info.items()},
             "timing": post.timing,
             "telemetry": post.telemetry,
             "prog": prog,
         }
         with open(args.out, "w") as f:
             json.dump(rec, f)
+    import numpy as np
+    if not np.asarray(post.chain_health["good_chains"]).all():
+        _log().warn(f"worker {args.rank}: completed with diverged chain(s) "
+                    f"(first_bad_it={post.chain_health['first_bad_it']})")
+        return EXIT_DIVERGED
     return EXIT_OK
+
+
+def _pkg_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def worker_env(env: dict | None = None) -> dict:
+    """The spawn environment every worker runs under: CPU backend,
+    single-threaded XLA-CPU eigen, the shared persistent compilation cache
+    (each spawned interpreter would otherwise recompile the identical
+    sampling program from scratch), and the package root on PYTHONPATH."""
+    base_env = dict(os.environ)
+    base_env["JAX_PLATFORMS"] = "cpu"
+    flags = base_env.get("XLA_FLAGS", "")
+    if "xla_cpu_multi_thread_eigen" not in flags:
+        flags = (flags + " --xla_cpu_multi_thread_eigen=false").strip()
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags + " --xla_force_host_platform_device_count=1").strip()
+    base_env["XLA_FLAGS"] = flags
+    base_env["PYTHONPATH"] = os.pathsep.join(
+        [_pkg_root()] + ([base_env["PYTHONPATH"]]
+                         if base_env.get("PYTHONPATH") else []))
+    base_env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.environ.get("HMSC_TEST_XLA_CACHE", "/tmp/hmsc_tpu_xla_cache"))
+    base_env.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+    base_env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    base_env.update(env or {})
+    return base_env
+
+
+def worker_cmd(rank: int, nprocs: int, *, coord_dir: str, ckpt_dir: str,
+               model_kw: dict | None = None, run_kw: dict | None = None,
+               action: str = "run", timeout_s: float = 30.0,
+               out: str | None = None, heartbeat_dir: str | None = None,
+               heartbeat_interval_s: float = 0.5,
+               extra_args: list | None = None) -> list:
+    """The argv for one worker subprocess (shared by :func:`spawn_workers`
+    and the fleet supervisor, which spawns ranks individually so it can
+    watch and restart them)."""
+    # -c (not -m): `-m hmsc_tpu.testing.multiproc` imports this module
+    # twice (once as __main__), which runpy warns about since the
+    # testing package re-exports the worker entry points
+    cmd = [sys.executable, "-c",
+           "from hmsc_tpu.testing.multiproc import worker_main; "
+           "raise SystemExit(worker_main())",
+           "--rank", str(int(rank)), "--nprocs", str(int(nprocs)),
+           "--coord-dir", coord_dir, "--ckpt-dir", ckpt_dir,
+           "--model", json.dumps(model_kw or {}),
+           "--run", json.dumps(run_kw or {}),
+           "--action", action, "--timeout", str(timeout_s)]
+    if out is not None:
+        cmd += ["--out", out]
+    if heartbeat_dir is not None:
+        cmd += ["--heartbeat-dir", heartbeat_dir,
+                "--heartbeat-interval", str(heartbeat_interval_s)]
+    cmd += [str(a) for a in (extra_args or [])]
+    return cmd
 
 
 def spawn_workers(nprocs: int, *, ckpt_dir: str, coord_dir: str,
@@ -187,8 +336,8 @@ def spawn_workers(nprocs: int, *, ckpt_dir: str, coord_dir: str,
                   sigterm_at: int | None = None,
                   kill_rank: int | None = None, timeout_s: float = 30.0,
                   wall_timeout_s: float = 600.0, out_dir: str | None = None,
-                  env: dict | None = None,
-                  pin_cpus: bool = False) -> list[dict]:
+                  env: dict | None = None, pin_cpus: bool = False,
+                  extra_rank_args: dict | None = None) -> list:
     """Launch ``nprocs`` workers and wait for all of them.
 
     Returns one record per rank: ``{"rank", "returncode", "stdout",
@@ -203,57 +352,28 @@ def spawn_workers(nprocs: int, *, ckpt_dir: str, coord_dir: str,
     worker over every core, so without pinning R "single-core" workers
     silently share the whole box and a scaling measurement lies (the
     bench pins; protocol tests don't care)."""
-    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    base_env = dict(os.environ)
-    base_env["JAX_PLATFORMS"] = "cpu"
-    flags = base_env.get("XLA_FLAGS", "")
-    if "xla_cpu_multi_thread_eigen" not in flags:
-        flags = (flags + " --xla_cpu_multi_thread_eigen=false").strip()
-    if "xla_force_host_platform_device_count" not in flags:
-        flags = (flags + " --xla_force_host_platform_device_count=1").strip()
-    base_env["XLA_FLAGS"] = flags
-    base_env["PYTHONPATH"] = os.pathsep.join(
-        [pkg_root] + ([base_env["PYTHONPATH"]]
-                      if base_env.get("PYTHONPATH") else []))
-    # share the persistent XLA compilation cache across workers (same dir
-    # the test conftest uses): each spawned interpreter would otherwise
-    # recompile the identical sampling program from scratch
-    base_env.setdefault(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.environ.get("HMSC_TEST_XLA_CACHE", "/tmp/hmsc_tpu_xla_cache"))
-    base_env.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
-    base_env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
-    base_env.update(env or {})
+    base_env = worker_env(env)
 
     procs, outs = [], []
     for r in range(int(nprocs)):
         out = (os.path.join(out_dir, f"worker-{r}.json")
                if out_dir is not None else None)
         outs.append(out)
-        # -c (not -m): `-m hmsc_tpu.testing.multiproc` imports this module
-        # twice (once as __main__), which runpy warns about since the
-        # testing package re-exports the worker entry points
-        cmd = [sys.executable, "-c",
-               "from hmsc_tpu.testing.multiproc import worker_main; "
-               "raise SystemExit(worker_main())",
-               "--rank", str(r), "--nprocs", str(nprocs),
-               "--coord-dir", coord_dir, "--ckpt-dir", ckpt_dir,
-               "--model", json.dumps(model_kw or {}),
-               "--run", json.dumps(run_kw or {}),
-               "--action", action, "--timeout", str(timeout_s)]
-        if out is not None:
-            cmd += ["--out", out]
+        extra = []
         if kill_at is not None and r == (kill_rank or 0):
-            cmd += ["--kill-at", str(kill_at)]
+            extra += ["--kill-at", str(kill_at)]
         if kill_calls is not None and r == (kill_rank or 0):
-            cmd += ["--kill-calls", str(kill_calls)]
+            extra += ["--kill-calls", str(kill_calls)]
         if sigterm_at is not None and r == (kill_rank or 0):
-            cmd += ["--sigterm-at", str(sigterm_at)]
+            extra += ["--sigterm-at", str(sigterm_at)]
         if pin_cpus:
-            cmd += ["--pin-cpu", str(r % (os.cpu_count() or 1))]
+            extra += ["--pin-cpu", str(r % (os.cpu_count() or 1))]
+        extra += [str(a) for a in (extra_rank_args or {}).get(r, [])]
+        cmd = worker_cmd(r, nprocs, coord_dir=coord_dir, ckpt_dir=ckpt_dir,
+                         model_kw=model_kw, run_kw=run_kw, action=action,
+                         timeout_s=timeout_s, out=out, extra_args=extra)
         procs.append(subprocess.Popen(
-            cmd, cwd=pkg_root, env=base_env,
+            cmd, cwd=_pkg_root(), env=base_env,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
 
     records = []

@@ -1046,6 +1046,13 @@ def load_manifest_checkpoint(path: str, hM, *, mmap: bool = False,
         post.set_chain_health(np.asarray(man["first_bad_it"]))
     post.nf_saturation = {int(r): np.asarray(v)
                           for r, v in man.get("nf_saturation", {}).items()}
+    # a splice-repaired run records its retry provenance in the manifest;
+    # surface it on the stitched posterior like sample_mcmc does in-memory
+    ri = (man.get("run") or {}).get("retry_info")
+    if ri:
+        post.retry_info = {
+            k: (tuple(v) if isinstance(v, list) else v)
+            for k, v in ri.items()}
     return LoadedCheckpoint(post=post, state=state, keys=keys,
                             run_meta=dict(man.get("run", {})),
                             header=man, path=path)
@@ -1711,6 +1718,41 @@ class CheckpointWriter:
                 pass
             self._archive_link(src)
 
+    def _replace_changed_tail(self, changed_from: int, total_samples: int,
+                              arrays) -> None:
+        """Supersede THIS process's shards overlapping the changed window
+        ``[changed_from, total_samples)`` with one repair shard cut from
+        ``arrays`` (this process's chain slice).  Shard files are
+        immutable, so the repaired window gets a NEW name (``-r<n>``) and
+        the superseded files are garbage-collected once no manifest
+        references them.  The carried prefix (a resumed run's pre-existing
+        global history) always predates this call's sampling window, so it
+        is never touched."""
+        changed_g = self.base_samples + int(changed_from)
+        keep_shards, doomed = [], []
+        for s in self._own:
+            (keep_shards if int(s["last"]) < changed_g
+             else doomed).append(s)
+        # the repair window opens at the first superseded shard's start
+        # (a shard straddling the change boundary is replaced whole)
+        rep_first = (min(int(s["first"]) for s in doomed)
+                     if doomed else changed_g)
+        end_g = self.base_samples + int(total_samples)
+        if rep_first < end_g:
+            self._flush["repair"] += 1
+            lo = rep_first - self.base_samples
+            out = {k: np.asarray(v)[:, lo:] for k, v in arrays.items()}
+            with self.telem.span("shard_write", kind_of="repair") as sp:
+                entry = save_shard(self.dir, out, rep_first, end_g - 1,
+                                   shard_index=self.shard_index,
+                                   repair=self._flush["repair"],
+                                   compress=self.compress)
+                sp.fields["nbytes"] = entry["nbytes"]
+            keep_shards.append(entry)
+            self.io["bytes"] += entry["nbytes"]
+            self.io["shards_written"] += 1
+        self._own = keep_shards
+
     def rewrite_spliced(self, changed_from: int, total_samples: int,
                         state, keys, first_bad, post, meta: dict) -> str:
         """Post-splice repair of a completed append-layout run (after the
@@ -1722,38 +1764,44 @@ class CheckpointWriter:
         re-writes only the post-snapshot tail."""
         if self._multi:
             raise CheckpointError(
-                "splice repair is single-process only (retry_diverged is "
-                "not supported under a multi-process coordinator)")
+                "rewrite_spliced is the single-process repair; a "
+                "coordinated run repairs through rewrite_spliced_multi")
         with self.telem.span("splice_rewrite",
                              changed_from=int(changed_from)):
-            changed_g = self.base_samples + int(changed_from)
-            keep_shards, doomed = [], []
-            for s in self._carried + self._own:
-                (keep_shards if int(s["last"]) < changed_g
-                 else doomed).append(s)
-            # the repair window opens at the first superseded shard's start
-            # (a shard straddling the change boundary is replaced whole)
-            rep_first = (min(int(s["first"]) for s in doomed)
-                         if doomed else changed_g)
+            self._replace_changed_tail(changed_from, total_samples,
+                                       post.arrays)
             end_g = self.base_samples + int(total_samples)
-            if rep_first < end_g:
-                self._flush["repair"] += 1
-                lo = rep_first - self.base_samples
-                arrays = {k: np.asarray(v)[:, lo:]
-                          for k, v in post.arrays.items()}
-                with self.telem.span("shard_write", kind_of="repair") as sp:
-                    entry = save_shard(self.dir, arrays, rep_first,
-                                       end_g - 1,
-                                       shard_index=self.shard_index,
-                                       repair=self._flush["repair"],
-                                       compress=self.compress)
-                    sp.fields["nbytes"] = entry["nbytes"]
-                keep_shards.append(entry)
-                self.io["bytes"] += entry["nbytes"]
-                self.io["shards_written"] += 1
-            self._carried, self._own = [], keep_shards
             return self._append_snapshot(f"{end_g:08d}", end_g, state, keys,
                                          first_bad, meta, self.n_writes)
+
+    def rewrite_spliced_multi(self, changed_from: int, total_samples: int,
+                              state, keys, first_bad, post, meta: dict, *,
+                              changed: bool) -> str:
+        """Coordinated post-splice repair of a completed multi-process run
+        — the multi-rank counterpart of :meth:`rewrite_spliced`.  EVERY
+        rank calls this (it is a collective); ranks whose chain slice was
+        spliced pass ``changed=True`` and first supersede their changed
+        tail with a repair shard.  All ranks then meet at the shared final
+        boundary through the ordinary coordinated commit
+        (:meth:`_append_snapshot`): each re-saves its (possibly repaired)
+        chain-slice state file, the commit gather certifies every repair
+        durable, the committer alone overwrites the final manifest with
+        the repaired shard sequence plus the gathered post-retry health,
+        and the release barrier holds every rank until the commit is
+        durable.  Healthy ranks' shard files are untouched bit-for-bit —
+        only their state files are (identically) re-written."""
+        if not self._multi:
+            raise CheckpointError(
+                "rewrite_spliced_multi requires a multi-process "
+                "coordinator; single-process repairs use rewrite_spliced")
+        if changed:
+            with self.telem.span("splice_rewrite",
+                                 changed_from=int(changed_from)):
+                self._replace_changed_tail(changed_from, total_samples,
+                                           post.arrays)
+        end_g = self.base_samples + int(total_samples)
+        return self._append_snapshot(f"{end_g:08d}", end_g, state, keys,
+                                     first_bad, meta, self.n_writes)
 
     # -- legacy rotating self-contained layout ------------------------------
 
@@ -2007,11 +2055,11 @@ def resume_run(hM, checkpoint_path: str, *, verbose: int = 0,
         record=tuple(record) if record else None,
         record_dtype=None if rd is None else getattr(jnp, rd),
         rng_impl=meta.get("rng_impl"),
-        # the divergence splice-rewrite is single-process machinery (and
-        # sample_mcmc rejects it under a coordinator): a multi-process
-        # continuation forgoes warm retries rather than failing to resume
-        retry_diverged=(0 if n_procs > 1
-                        else int(meta.get("retry_diverged", 0))),
+        # the divergence splice now has a coordinated multi-process path
+        # (every rank unwinds to the shared last-healthy manifest, the
+        # owning rank warm-restarts, the repair commits at that boundary),
+        # so the stored retry policy survives a re-sharded continuation
+        retry_diverged=int(meta.get("retry_diverged", 0)),
         align_post=False, verbose=verbose, mesh=mesh,
         chain_axis=chain_axis, species_axis=species_axis,
         progress_callback=progress_callback,

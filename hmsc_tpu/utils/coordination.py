@@ -38,12 +38,137 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import time
 
 __all__ = [
     "Coordinator", "SingleProcessCoordinator", "FileCoordinator",
     "DistributedCoordinator", "CoordinationError", "get_coordinator",
+    "HeartbeatWriter", "heartbeat_path", "read_heartbeats",
+    "HEARTBEAT_FILE_RE",
 ]
+
+# heartbeat-p<rank>.json — one liveness file per worker process, updated by
+# a background thread; the fleet supervisor (and FileCoordinator timeout
+# messages) read ages off these
+HEARTBEAT_FILE_RE = re.compile(r"heartbeat-p(\d+)\.json")
+
+
+def heartbeat_path(dirpath: str, rank: int) -> str:
+    """The heartbeat file for worker ``rank`` under a run directory."""
+    return os.path.join(os.fspath(dirpath), f"heartbeat-p{int(rank)}.json")
+
+
+def read_heartbeats(dirpath: str) -> dict:
+    """``{rank: {"age_s", "mtime", **payload}}`` for every heartbeat file
+    under ``dirpath``.  ``age_s`` comes from the file's mtime (robust to a
+    payload written with a skewed clock); an unreadable/mid-rename payload
+    still yields an entry with its age — liveness monitoring must not
+    depend on the JSON being intact."""
+    out: dict = {}
+    try:
+        names = os.listdir(os.fspath(dirpath))
+    except OSError:
+        return out
+    now = time.time()
+    for fn in names:
+        m = HEARTBEAT_FILE_RE.fullmatch(fn)
+        if not m:
+            continue
+        p = os.path.join(os.fspath(dirpath), fn)
+        try:
+            mtime = os.stat(p).st_mtime
+        except OSError:
+            continue
+        rec = {"age_s": max(0.0, now - mtime), "mtime": mtime}
+        try:
+            with open(p) as f:
+                payload = json.loads(f.read())
+            if isinstance(payload, dict):
+                rec.update(payload)
+        except (OSError, ValueError):
+            pass
+        out[int(m.group(1))] = rec
+    return out
+
+
+class HeartbeatWriter:
+    """Per-rank liveness beacon: a daemon thread atomically re-writes
+    ``heartbeat-p<rank>.json`` every ``interval_s`` with a monotonically
+    increasing ``beat`` counter plus whatever progress fields the worker
+    last reported via :meth:`update` (e.g. ``samples_done``).
+
+    The thread is deliberately independent of the sampling loop: it keeps
+    beating through long compiles and compiled segments, so a silent file
+    means the *process* is wedged or gone — exactly the signal the fleet
+    supervisor kills and restarts on.  :meth:`freeze` stops updates without
+    stopping the process (the chaos harness's stuck-rank fault).
+    """
+
+    # the progress payload crosses from the caller's thread to the beat
+    # thread; `hmsc_tpu lint` enforces the declaration below
+    # hmsc: guarded-by[_lock]: _fields, _frozen
+
+    def __init__(self, dirpath: str, rank: int, *, interval_s: float = 0.5):
+        import threading
+        self.path = heartbeat_path(dirpath, rank)
+        self.rank = int(rank)
+        self.interval_s = float(interval_s)
+        self._lock = threading.Lock()
+        self._fields: dict = {}
+        self._frozen = False
+        self._beat = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"hmsc-heartbeat-p{rank}", daemon=True)
+
+    def start(self) -> "HeartbeatWriter":
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._write()                 # visible immediately, not interval_s in
+        self._thread.start()
+        return self
+
+    def update(self, **fields) -> None:
+        """Merge progress fields into the next beats' payload."""
+        with self._lock:
+            self._fields.update(fields)
+
+    def freeze(self) -> None:
+        """Stop beating while the process lives on (chaos: a wedged rank —
+        the supervisor must detect the silence and SIGKILL it)."""
+        with self._lock:
+            self._frozen = True
+
+    def stop(self) -> None:
+        """Stop the beat thread and remove the heartbeat file (a clean
+        exit must not read as a frozen rank)."""
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    def _write(self) -> None:
+        with self._lock:
+            if self._frozen:
+                return
+            payload = dict(self._fields, rank=self.rank, pid=os.getpid(),
+                           beat=self._beat, wall=round(time.time(), 3))
+            self._beat += 1
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                f.write(json.dumps(payload))
+            os.replace(tmp, self.path)
+        except OSError:
+            pass                      # liveness is best-effort; a full disk
+            #                           must not kill the run it monitors
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self._write()
 
 
 class CoordinationError(RuntimeError):
@@ -82,6 +207,16 @@ class Coordinator:
     def all_gather(self, obj, tag: str = "gather") -> list:
         raise NotImplementedError
 
+    def timeout_override(self, timeout_s: float):
+        """Context manager raising this coordinator's collective timeout
+        while a known-slow section runs (the coordinated divergence
+        repair: healthy ranks legitimately wait out a peer's re-sample,
+        which can far exceed the per-commit timeout).  No-op on backends
+        without their own timeout (``jax.distributed`` owns its
+        deadlines)."""
+        import contextlib
+        return contextlib.nullcontext()
+
 
 class SingleProcessCoordinator(Coordinator):
     """R = 1: every collective completes immediately with local data."""
@@ -99,19 +234,25 @@ class FileCoordinator(Coordinator):
     Each collective call ``n`` writes an atomically-renamed
     ``coord-<n>-<rank>.json`` sentinel carrying the (JSON-serialisable)
     payload, then polls until all R sentinels for slot ``n`` exist.  A
-    process may delete its OWN slot-``n-1`` sentinel once its slot-``n``
-    gather completes: every peer writing slot ``n`` has by construction
-    finished READING slot ``n-1`` (collectives are ordered), so the
-    directory holds O(R) live files regardless of run length.
+    process that completes slot ``n`` sweeps EVERY rank's slot-``n-1``
+    sentinels: a peer only writes slot ``n`` after its own slot-``n-1``
+    gather returned (collectives are ordered), so those files are provably
+    dead, and the directory holds exactly the live slot's O(R) files
+    regardless of run length.
 
     ``timeout_s`` bounds every wait: a peer that died mid-protocol turns
     into :class:`CoordinationError` instead of a hang — the
-    kill-one-process-mid-segment story depends on this.  The directory must
-    be empty of another run's sentinels (use a fresh subdirectory per run
-    attempt; ``resume`` attempts get their own)."""
+    kill-one-process-mid-segment story depends on this.  When
+    ``heartbeat_dir`` is set (the fleet supervisor's spawn harness points
+    it at the workers' heartbeat directory), the timeout message also
+    reports the last-heartbeat age of each missing rank, so the operator
+    (or supervisor log) can tell a dead rank from a merely stalled one.
+    The directory must be empty of another run's sentinels (use a fresh
+    subdirectory per run attempt; ``resume`` attempts get their own)."""
 
     def __init__(self, dirpath: str, process_index: int, process_count: int,
-                 *, timeout_s: float = 120.0, poll_s: float = 0.001):
+                 *, timeout_s: float = 120.0, poll_s: float = 0.001,
+                 heartbeat_dir: str | None = None):
         if not (0 <= int(process_index) < int(process_count)):
             raise ValueError(
                 f"process_index {process_index} out of range for "
@@ -121,8 +262,39 @@ class FileCoordinator(Coordinator):
         self._dir = os.fspath(dirpath)
         self._timeout = float(timeout_s)
         self._poll = float(poll_s)
+        self._hb_dir = (os.fspath(heartbeat_dir)
+                        if heartbeat_dir is not None else None)
         self._seq = 0
         os.makedirs(self._dir, exist_ok=True)
+
+    def timeout_override(self, timeout_s: float):
+        """Temporarily replace ``timeout_s`` for the collectives issued
+        inside the ``with`` block (never lowers it below the configured
+        value).  Single-threaded per coordinator instance, like every
+        other use of one."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            prev = self._timeout
+            self._timeout = max(prev, float(timeout_s))
+            try:
+                yield
+            finally:
+                self._timeout = prev
+        return _ctx()
+
+    def _heartbeat_detail(self, pending) -> str:
+        """last-heartbeat ages of the missing ranks, for timeout messages."""
+        if self._hb_dir is None:
+            return ""
+        hb = read_heartbeats(self._hb_dir)
+        bits = []
+        for r in sorted(pending):
+            rec = hb.get(r)
+            bits.append(f"rank {r}: no heartbeat file" if rec is None else
+                        f"rank {r}: last heartbeat {rec['age_s']:.1f}s ago")
+        return f" ({'; '.join(bits)})" if bits else ""
 
     def _path(self, seq: int, rank: int) -> str:
         return os.path.join(self._dir, f"coord-{seq:08d}-{rank}.json")
@@ -168,33 +340,41 @@ class FileCoordinator(Coordinator):
                     raise CoordinationError(
                         f"collective {tag!r} (#{seq}) timed out after "
                         f"{self._timeout:.0f}s waiting for rank(s) "
-                        f"{sorted(pending)} of {self.process_count} — a "
+                        f"{sorted(pending)} of {self.process_count}"
+                        f"{self._heartbeat_detail(pending)} — a "
                         "peer process died or stalled; committed "
                         "checkpoints are intact, resume with resume_run")
                 time.sleep(self._poll)
-        # every peer has started slot `seq`, so all of them finished
-        # reading slot `seq-1`: our previous sentinel is reclaimable
+        # every peer has WRITTEN slot `seq`, which it only does after its
+        # own slot `seq-1` gather returned — so EVERY rank's slot `seq-1`
+        # sentinel is provably dead.  Sweep them all (not just our own, the
+        # former behaviour): a rank that crashes later then strands at most
+        # its final slot, and the directory holds exactly the live slot's
+        # O(R) files instead of leaking one extra slot per rank.  Racing
+        # unlinks of the same file are harmless (OSError ignored).
         if seq > 0:
-            try:
-                os.unlink(self._path(seq - 1, self.process_index))
-            except OSError:
-                pass
+            for r in range(self.process_count):
+                try:
+                    os.unlink(self._path(seq - 1, r))
+                except OSError:
+                    pass
         return out
 
     def cleanup(self) -> None:
-        """Reclaim this rank's stale sentinels at shutdown.
-
-        Only slots every peer provably finished reading (≤ ``_seq - 2``:
-        a peer that completed slot ``n`` has read slot ``n - 1``) are
-        removable — the LAST sentinel must stay, because a slower peer may
-        still be polling it (deleting it would strand that peer until its
-        timeout).  The leftover is O(R) tiny files in a per-attempt
-        directory, reclaimed with the directory itself."""
+        """Reclaim stale sentinels at shutdown — every rank's slots up to
+        ``_seq - 2`` (all provably read by every peer; normally already
+        swept by the per-collective sweep above, this catches files left by
+        a peer that crashed mid-protocol).  The FINAL slot's sentinels must
+        stay: a slower peer may still be polling them (deleting one would
+        strand that peer until its timeout).  The leftover is therefore
+        O(R) tiny files for the last collective only, in a per-attempt
+        directory reclaimed with the directory itself."""
         for seq in range(self._seq - 1):
-            try:
-                os.unlink(self._path(seq, self.process_index))
-            except OSError:
-                pass
+            for r in range(self.process_count):
+                try:
+                    os.unlink(self._path(seq, r))
+                except OSError:
+                    pass
 
 
 class DistributedCoordinator(Coordinator):

@@ -246,6 +246,10 @@ def test_splice_rewrite_covering_everything(tmp_path, material):
 
 
 def test_splice_rewrite_multi_process_refused(tmp_path, material):
+    """The single- and multi-process repairs are distinct protocols:
+    each refuses the other's coordinator shape (the coordinated repair
+    is a collective — calling the single-process one on a mesh would
+    desync the ranks' collective sequences)."""
     m, spec, arrays, state, kd = material
 
     class _FakeCoord:
@@ -253,8 +257,12 @@ def test_splice_rewrite_multi_process_refused(tmp_path, material):
 
     w = CheckpointWriter(os.fspath(tmp_path), "append", spec, hM=m,
                         coordinator=_FakeCoord())
-    with pytest.raises(CheckpointError, match="single-process only"):
+    with pytest.raises(CheckpointError, match="rewrite_spliced_multi"):
         w.rewrite_spliced(0, N, state, kd, _fb(), None, _meta(N))
+    w1 = CheckpointWriter(os.fspath(tmp_path), "append", spec, hM=m)
+    with pytest.raises(CheckpointError, match="multi-process coordinator"):
+        w1.rewrite_spliced_multi(0, N, state, kd, _fb(), None, _meta(N),
+                                 changed=False)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,375 @@
+"""Elastic fleet supervisor suite (ISSUE 10).
+
+The supervisor composes the existing fault-tolerance machinery — atomic
+append-layout checkpoints, coordinated preemption unwind, resume
+re-sharding — into an operator for preemptible capacity.  The bars pinned
+here:
+
+- **zero committed draws lost, ever**: every healed fleet run finishes
+  with a checksum-valid final manifest whose stitched posterior is
+  bit-identical to an uninterrupted run (restarts only ever re-run the
+  uncommitted tail);
+- rank failure -> exponential-backoff restart under a per-rank budget;
+  exhausted budget -> shrink to the next divisor of ``n_chains``;
+  recovered capacity -> grow back (both at committed manifest
+  boundaries, via resume re-sharding);
+- heartbeat liveness: a live-but-silent rank is detected and SIGKILLed;
+  ``FileCoordinator`` timeout errors name the dead rank's last heartbeat
+  age;
+- the exit-code taxonomy (:mod:`hmsc_tpu.exit_codes`) lets the
+  supervisor (and any operator) branch on the failure class.
+
+Fast 1-2 rank variants run in tier-1; heartbeat-freeze / disk-full /
+Poisson chaos matrices are ``slow`` (each fleet attempt costs a worker
+spawn on 1-CPU CI).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from hmsc_tpu import sample_mcmc
+from hmsc_tpu.exit_codes import (EXIT_CKPT_CORRUPT, EXIT_COORDINATION,
+                                 EXIT_DIVERGED, EXIT_OK, describe)
+from hmsc_tpu.fleet import FleetConfig, FleetSupervisor
+from hmsc_tpu.testing.chaos import ChaosEvent, ChaosPlan, poisson_schedule
+from hmsc_tpu.testing.multiproc import build_worker_model, spawn_workers
+from hmsc_tpu.utils.checkpoint import latest_valid_checkpoint
+from hmsc_tpu.utils.coordination import (CoordinationError, FileCoordinator,
+                                         HeartbeatWriter, heartbeat_path,
+                                         read_heartbeats)
+
+pytestmark = pytest.mark.fleet
+
+RUN_KW = dict(samples=8, transient=4, thin=1, n_chains=4, seed=11,
+              checkpoint_every=4)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return build_worker_model()
+
+
+@pytest.fixture(scope="module")
+def ref_post(model):
+    """Uninterrupted in-process reference run of the fleet workers'
+    config — the stream every healed fleet must reproduce bit-exactly
+    (checkpointing cadence never changes draws, so no checkpoint
+    needed)."""
+    kw = {k: v for k, v in RUN_KW.items() if k != "checkpoint_every"}
+    return sample_mcmc(model, align_post=False, **kw)
+
+
+def _cfg(tmp_path, **kw):
+    base = dict(ckpt_dir=os.path.join(os.fspath(tmp_path), "ck"),
+                work_dir=os.path.join(os.fspath(tmp_path), "fleet"),
+                nprocs=2, run_kw=dict(RUN_KW),
+                coord_timeout_s=12, heartbeat_timeout_s=120,
+                backoff_base_s=0.05, backoff_max_s=0.2,
+                wall_timeout_s=540, poll_s=0.05)
+    base.update(kw)
+    return FleetConfig(**base)
+
+
+def _assert_same_arrays(a, b, chains=None):
+    assert set(a.arrays) == set(b.arrays)
+    for k in a.arrays:
+        x, y = np.asarray(a.arrays[k]), np.asarray(b.arrays[k])
+        if chains is not None:
+            x, y = x[chains], y[chains]
+        np.testing.assert_array_equal(x, y, err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# units: config, exit codes, heartbeats, chaos plans (no subprocess)
+# ---------------------------------------------------------------------------
+
+def test_exit_code_describe():
+    assert describe(0) == "ok"
+    assert describe(75) == "preempted"
+    assert describe(77) == "diverged"
+    assert describe(-9) == "signal:SIGKILL"
+    assert describe(42) == "exit:42"
+
+
+def test_fleet_config_ladder_and_validation(tmp_path):
+    cfg = _cfg(tmp_path, nprocs=4, run_kw=dict(RUN_KW, n_chains=4))
+    assert cfg.ladder() == [4, 2, 1]
+    assert _cfg(tmp_path, nprocs=2).ladder() == [2, 1]
+    with pytest.raises(ValueError, match="min_procs"):
+        _cfg(tmp_path, nprocs=2, min_procs=3)
+    with pytest.raises(ValueError, match="divisor"):
+        _cfg(tmp_path, nprocs=2, min_procs=2,
+             run_kw=dict(RUN_KW, n_chains=3))
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps({"ckpt_dir": "a", "work_dir": "b",
+                             "bogus_key": 1}))
+    with pytest.raises(ValueError, match="bogus_key"):
+        FleetConfig.from_json(os.fspath(p))
+
+
+def test_heartbeat_writer_beats_updates_freezes(tmp_path):
+    d = os.fspath(tmp_path)
+    hb = HeartbeatWriter(d, 3, interval_s=0.05).start()
+    try:
+        time.sleep(0.2)
+        rec = read_heartbeats(d)[3]
+        assert rec["rank"] == 3 and rec["pid"] == os.getpid()
+        assert rec["beat"] >= 1 and rec["age_s"] < 5.0
+        hb.update(samples_done=7)
+        time.sleep(0.15)
+        assert read_heartbeats(d)[3]["samples_done"] == 7
+        hb.freeze()                   # chaos: alive but silent
+        time.sleep(0.1)
+        frozen = read_heartbeats(d)[3]["beat"]
+        time.sleep(0.2)
+        assert read_heartbeats(d)[3]["beat"] == frozen
+    finally:
+        hb.stop()
+    assert not os.path.exists(heartbeat_path(d, 3))   # clean exit removes
+
+
+def test_coordinator_timeout_reports_heartbeat_age(tmp_path):
+    hb_dir = os.fspath(tmp_path / "hb")
+    hb = HeartbeatWriter(hb_dir, 1, interval_s=10.0).start()
+    hb.freeze()                       # rank 1: stale file; rank 2: none
+    try:
+        coord = FileCoordinator(os.fspath(tmp_path / "co"), 0, 3,
+                                timeout_s=0.2, poll_s=0.01,
+                                heartbeat_dir=hb_dir)
+        with pytest.raises(CoordinationError) as ei:
+            coord.barrier("lonely")
+        msg = str(ei.value)
+        assert "rank 1: last heartbeat" in msg and "ago" in msg
+        assert "rank 2: no heartbeat file" in msg
+    finally:
+        hb.stop()
+
+
+def test_chaos_event_validation_and_plan():
+    with pytest.raises(ValueError, match="exactly one"):
+        ChaosEvent("sigkill", 0)
+    with pytest.raises(ValueError, match="exactly one"):
+        ChaosEvent("sigkill", 0, at_s=1.0, at_samples=2)
+    with pytest.raises(ValueError, match="unknown chaos action"):
+        ChaosEvent("meteor", 0, at_s=1.0)
+    with pytest.raises(ValueError, match="armed via at_samples"):
+        ChaosEvent("freeze", 0, at_s=1.0)
+    plan = ChaosPlan([ChaosEvent("freeze", 1, at_samples=3, attempt=1),
+                      ChaosEvent("sigkill", 0, at_s=5.0)])
+    assert plan.arm_flags(1, 1) == ["--freeze-at", "3"]
+    assert plan.arm_flags(1, 1) == []           # each event arms once
+    assert plan.arm_flags(0, 1) == []           # wall-clock events don't arm
+    assert plan.due_signals(4.9) == []
+    assert [e.rank for e in plan.due_signals(5.1)] == [0]
+    assert plan.due_signals(6.0) == []          # each fires once
+    s = plan.summary()
+    assert s == {"events": 2, "by_action": {"freeze": 1, "sigkill": 1},
+                 "armed": 1, "wall_clock": 1}
+
+
+def test_poisson_schedule_is_deterministic():
+    a = poisson_schedule(7, 0.5, 60.0, 4)
+    b = poisson_schedule(7, 0.5, 60.0, 4)
+    assert [(e.action, e.rank, e.at_s) for e in a.events] == \
+        [(e.action, e.rank, e.at_s) for e in b.events]
+    assert a.events, "rate 0.5/s over 60s must schedule at least one kill"
+    assert all(e.action in ("sigkill", "sigterm") and 0 <= e.rank < 4
+               for e in a.events)
+    c = poisson_schedule(8, 0.5, 60.0, 4)
+    assert [(e.at_s) for e in c.events] != [(e.at_s) for e in a.events]
+
+
+def test_run_cli_exit_code_checkpoint_corrupt(tmp_path, capsys):
+    """`python -m hmsc_tpu run --resume` against a directory with no
+    usable snapshot exits 78, not a generic traceback — the supervisor
+    treats it as fatal-for-this-run-dir instead of restarting."""
+    from hmsc_tpu.bench_cli import run_main
+    d = tmp_path / "ck"
+    d.mkdir()
+    (d / "manifest-00000004.json").write_text("garbage, not a manifest")
+    rc = run_main(["--checkpoint-dir", os.fspath(d), "--resume",
+                   "--ny", "8", "--ns", "2", "--nf", "2"])
+    assert rc == EXIT_CKPT_CORRUPT
+    out = capsys.readouterr().out
+    assert json.loads(out.strip().splitlines()[-1])["error"] == "checkpoint"
+
+
+# ---------------------------------------------------------------------------
+# worker exit-code taxonomy (one spawn)
+# ---------------------------------------------------------------------------
+
+def test_worker_divergence_exit_code(tmp_path):
+    """A worker whose run completes with unhealed diverged chains exits 77
+    (EXIT_DIVERGED) — distinct from both success and the resumable
+    preempt/coordination family, so the supervisor stops instead of
+    restarting a deterministic blow-up."""
+    td = os.fspath(tmp_path)
+    nan = json.dumps({"updater": "update_beta_lambda", "at_iteration": 5,
+                      "field": "Beta"})
+    recs = spawn_workers(
+        1, ckpt_dir=os.path.join(td, "ck"),
+        coord_dir=os.path.join(td, "co"),
+        run_kw=dict(samples=4, transient=2, thin=1, n_chains=2, seed=3,
+                    checkpoint_every=2),
+        out_dir=td, timeout_s=120, wall_timeout_s=560,
+        extra_rank_args={0: ["--inject-nan", nan]})
+    assert recs[0]["returncode"] == EXIT_DIVERGED, recs[0]["stderr"][-1500:]
+
+
+# ---------------------------------------------------------------------------
+# the supervisor: restart with backoff, then shrink -> grow
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_supervisor_restart_backoff_after_kill(tmp_path, model, ref_post):
+    """A scripted mid-segment SIGKILL of one rank fails the attempt (the
+    survivor unwinds with a clean coordination error); the supervisor
+    restarts the fleet with backoff, the resume re-runs only the
+    uncommitted tail, and the healed run is bit-identical to an
+    uninterrupted one — zero committed draws lost."""
+    cfg = _cfg(tmp_path)
+    plan = ChaosPlan([ChaosEvent("sigkill", 1, at_samples=4, attempt=1)])
+    sup = FleetSupervisor(cfg, chaos=plan)
+    summary = sup.run()
+    assert summary["ok"], summary
+    assert summary["status"] == "ok"
+    assert summary["attempts"] == 2 and summary["restarts"] == 1
+    assert summary["shrinks"] == 0 and summary["grows"] == 0
+    assert summary["draws_lost"] == 0
+    assert summary["checkpoint"]["valid"]
+
+    a1, a2 = sup.attempt_log
+    assert a1["action"] == "run" and a2["action"] == "resume"
+    assert a1["exits"][1] == -9                  # the chaos SIGKILL
+    assert a1["exits"][0] in (EXIT_COORDINATION, EXIT_OK)
+    assert set(a2["exits"].values()) == {EXIT_OK}
+
+    fin = latest_valid_checkpoint(cfg.ckpt_dir, model).post
+    assert int(fin.samples) == RUN_KW["samples"]
+    _assert_same_arrays(fin, ref_post)
+
+    # the supervision timeline is first-class telemetry: report renders it
+    from hmsc_tpu.obs.report import build_report, render_report
+    rep = build_report(cfg.ckpt_dir)
+    fleet = rep["fleet"]
+    assert fleet["summary"]["status"] == "ok"
+    assert [a["action"] for a in fleet["attempts"]] == ["run", "resume"]
+    names = [d["name"] for d in fleet["decisions"]]
+    assert "backoff" in names        # armed (at_samples) chaos rides the
+    # spawn flags, so the timeline records it as the rank's kill outcome
+    assert fleet["attempts"][0]["exits"]["1"]["outcome"] == "signal:SIGKILL"
+    txt = render_report(rep)
+    assert "fleet timeline" in txt and "attempt 2: resume" in txt
+
+
+@pytest.mark.chaos
+def test_supervisor_shrink_then_grow(tmp_path, model):
+    """Degradation end-to-end: rank 1 fails twice (budget 2 exhausted) ->
+    the fleet shrinks 2 -> 1 at the next restart (resume re-shards the
+    chains); one more failure at reduced size, then recovered capacity
+    grows it back 1 -> 2, and the grown fleet finishes the run — final
+    posterior bit-identical to an uninterrupted run, zero draws lost."""
+    run_kw = dict(RUN_KW, samples=12)
+    cfg = _cfg(tmp_path, run_kw=run_kw, restart_budget=2,
+               grow_after_attempts=1)
+    plan = ChaosPlan([
+        ChaosEvent("sigkill", 1, at_samples=4, attempt=1),
+        ChaosEvent("sigkill", 1, at_samples=8, attempt=2),
+        ChaosEvent("sigkill", 0, at_samples=10, attempt=3),
+    ])
+    sup = FleetSupervisor(cfg, chaos=plan)
+    summary = sup.run()
+    assert summary["ok"], summary
+    assert summary["shrinks"] == 1 and summary["grows"] == 1
+    assert summary["fleet_size"] == {"initial": 2, "final": 2}
+    assert summary["draws_lost"] == 0
+
+    sizes = [(a["action"], a["nprocs"]) for a in sup.attempt_log]
+    assert sizes[0] == ("run", 2)
+    assert sizes[1] == ("resume", 2)
+    assert sizes[2] == ("resume", 1)             # shrunk after exhaustion
+    assert sizes[3] == ("resume", 2)             # grown back
+    assert set(sup.attempt_log[-1]["exits"].values()) == {EXIT_OK}
+
+    fin = latest_valid_checkpoint(cfg.ckpt_dir, model).post
+    assert int(fin.samples) == run_kw["samples"]
+    kw = {k: v for k, v in run_kw.items() if k != "checkpoint_every"}
+    ref = sample_mcmc(model, align_post=False, **kw)
+    _assert_same_arrays(fin, ref)
+
+    from hmsc_tpu.obs.report import build_report
+    names = [d["name"] for d in build_report(cfg.ckpt_dir)
+             ["fleet"]["decisions"]]
+    assert "shrink" in names and "grow" in names
+
+
+# ---------------------------------------------------------------------------
+# slow chaos matrix: heartbeat freeze, disk-full, Poisson kills
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_supervisor_kills_heartbeat_silent_rank(tmp_path, model, ref_post):
+    """A wedged rank (alive, heartbeat-silent) is detected and SIGKILLed
+    by the supervisor; the restart completes the run bit-identically."""
+    cfg = _cfg(tmp_path, heartbeat_timeout_s=4.0,
+               heartbeat_interval_s=0.2, coord_timeout_s=25)
+    plan = ChaosPlan([ChaosEvent("freeze", 1, at_samples=4, attempt=1)])
+    sup = FleetSupervisor(cfg, chaos=plan)
+    summary = sup.run()
+    assert summary["ok"], summary
+    assert summary["draws_lost"] == 0
+    assert 1 in sup.attempt_log[0]["hb_killed"]
+    assert sup.attempt_log[0]["exits"][1] == -9  # supervisor's SIGKILL
+    from hmsc_tpu.obs.report import build_report
+    decisions = build_report(cfg.ckpt_dir)["fleet"]["decisions"]
+    silent = [d for d in decisions if d["name"] == "heartbeat_silent"]
+    assert silent and silent[0]["rank"] == 1
+    assert silent[0]["age_s"] is None or silent[0]["age_s"] > 4.0
+    fin = latest_valid_checkpoint(cfg.ckpt_dir, model).post
+    _assert_same_arrays(fin, ref_post)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_supervisor_survives_disk_full_rank(tmp_path, model, ref_post):
+    """Checkpoint writes failing mid-run (disk full) crash the rank with
+    every already-committed snapshot intact; the restart — after the
+    'disk recovers' (the fault arms only once) — completes bit-identically."""
+    cfg = _cfg(tmp_path)
+    plan = ChaosPlan([ChaosEvent("disk_full", 1, at_samples=4, attempt=1)])
+    sup = FleetSupervisor(cfg, chaos=plan)
+    summary = sup.run()
+    assert summary["ok"], summary
+    assert summary["draws_lost"] == 0
+    assert sup.attempt_log[0]["exits"][1] == 1   # the injected OSError
+    fin = latest_valid_checkpoint(cfg.ckpt_dir, model).post
+    _assert_same_arrays(fin, ref_post)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_bench_gate_small():
+    """The chaos bench's deterministic gate at reduced scale: Poisson
+    SIGKILL/SIGTERM kills against a supervised 2-rank fleet finish with
+    zero committed draws lost and a bit-consistent stitched posterior
+    (the full-size run with the >=70% throughput gate lives in
+    benchmarks/bench_chaos.py)."""
+    import subprocess
+    import sys
+    r = subprocess.run(
+        [sys.executable, "benchmarks/bench_chaos.py", "--samples", "16",
+         "--transient", "8", "--checkpoint-every", "8", "--chains", "4",
+         "--nprocs", "2", "--kill-rate", "0.03", "--seed", "7",
+         "--no-throughput-gate"],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=560)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    digest = json.loads(r.stdout.strip().splitlines()[-1])
+    assert digest["draws_lost"] == 0
+    assert digest["bit_consistent"]
+    assert digest["manifest_valid"]

@@ -294,6 +294,127 @@ def test_resume_single_process_dir_on_two_processes(model, ref_run,
 
 
 # ---------------------------------------------------------------------------
+# resume after shrink, then grow (R=4 -> 2 -> 4; fast variant 2 -> 1 -> 2)
+# ---------------------------------------------------------------------------
+
+def _shrink_grow_cycle(model, ref_run, td, sizes, kills):
+    """Kill mid-run at each fleet size, resume at the next size, finish at
+    the last; assert the stitched posterior is bit-identical to the
+    uninterrupted reference.  ``sizes`` like (2, 1, 2); ``kills`` arms a
+    (kill_rank, kill_at) SIGKILL on every stage except the last."""
+    ck = os.path.join(td, "ck")
+    for i, nprocs in enumerate(sizes):
+        action = "run" if i == 0 else "resume"
+        # verbose=1 on resumes: fine-grained progress callbacks so the
+        # armed kill lands mid-run regardless of the committed base
+        run_kw = RUN_KW if i == 0 else {"verbose": 1}
+        kill = kills[i] if i < len(kills) else None
+        recs = spawn_workers(
+            nprocs, ckpt_dir=ck, coord_dir=os.path.join(td, f"c{i}"),
+            run_kw=run_kw, out_dir=td, action=action,
+            kill_rank=(kill[0] if kill else None),
+            kill_at=(kill[1] if kill else None),
+            timeout_s=(12 if kill else 300), wall_timeout_s=560)
+        rcs = {r["rank"]: r["returncode"] for r in recs}
+        if kill:
+            assert rcs[kill[0]] == -9, recs[kill[0]]["stderr"][-1500:]
+        else:
+            assert set(rcs.values()) == {0}, "\n".join(
+                f"rank {r['rank']} rc={r['returncode']}\n"
+                f"{r['stderr'][-1500:]}" for r in recs)
+    fin = latest_valid_checkpoint(ck, model).post
+    assert int(fin.samples) == RUN_KW["samples"]
+    assert int(fin.n_chains) == RUN_KW["n_chains"]
+    _assert_same_arrays(fin, ref_run["post"])
+
+
+def test_resume_shrink_then_grow_fast(model, ref_run, tmp_path_factory):
+    """The elastic degradation cycle at tier-1 scale: a 2-rank run killed
+    mid-segment resumes SHRUNK to 1 rank, is killed again, and GROWS back
+    to 2 ranks to finish — chains re-shard at each committed boundary and
+    the final stitched posterior is bit-identical to the uninterrupted
+    reference (zero committed draws lost across two kills and two
+    re-shardings)."""
+    td = os.fspath(tmp_path_factory.mktemp("mp-sg2"))
+    _shrink_grow_cycle(model, ref_run, td, sizes=(2, 1, 2),
+                       kills=[(1, 4), (0, 6)])
+
+
+@pytest.mark.slow
+def test_resume_shrink_then_grow_full_matrix(model, ref_run,
+                                             tmp_path_factory):
+    """The full R=4 -> 2 -> 4 matrix of the same cycle (single-chain
+    padded batches at R=4, re-sharding through every ladder step)."""
+    td = os.fspath(tmp_path_factory.mktemp("mp-sg4"))
+    _shrink_grow_cycle(model, ref_run, td, sizes=(4, 2, 4),
+                       kills=[(3, 4), (1, 6)])
+
+
+# ---------------------------------------------------------------------------
+# coordinated multi-process retry_diverged (the carried ROADMAP gap)
+# ---------------------------------------------------------------------------
+
+def test_coordinated_multiproc_retry_diverged(model, ref_run,
+                                              tmp_path_factory):
+    """Injected NaN divergence on ONE rank of a 2-process run: the
+    end-of-run health gather agrees on the diverged chains, every rank
+    unwinds to the same last-healthy manifest, the owning rank
+    warm-restarts its chains and the repair shard commits at that shared
+    boundary — the healthy rank's draws (and its shard FILES) untouched
+    bit-for-bit, retry_info recorded on the stitched posterior."""
+    td = os.fspath(tmp_path_factory.mktemp("mp-retry"))
+    ck = os.path.join(td, "ck")
+    # poison sweep 10 (transient 4 + recorded samples 5..8) on rank 1 only,
+    # disarming once it struck — a real blow-up does not recur under the
+    # retry's fresh key stream
+    nan = json.dumps({"updater": "update_beta_lambda", "at_iteration": 10,
+                      "field": "Beta", "disarm_at": 8})
+    recs = spawn_workers(2, ckpt_dir=ck, coord_dir=os.path.join(td, "co"),
+                         run_kw=dict(RUN_KW, retry_diverged=1), out_dir=td,
+                         timeout_s=300, wall_timeout_s=560,
+                         extra_rank_args={1: ["--inject-nan", nan]})
+    assert [r["returncode"] for r in recs] == [0, 0], "\n".join(
+        f"rank {r['rank']} rc={r['returncode']}\n{r['stderr'][-1500:]}"
+        for r in recs)
+
+    post = latest_valid_checkpoint(ck, model).post
+    assert int(post.samples) == RUN_KW["samples"]
+    # retry provenance on the STITCHED posterior (loaded from the manifest)
+    assert post.retry_info["retried_chains"] == (2, 3)
+    assert post.retry_info["healthy_after_retry"] == (True, True)
+    assert post.retry_info["warm_start_samples"] == 4   # manifest-4 reused
+    assert post.chain_health["good_chains"].all()
+    assert np.isfinite(np.asarray(post["Beta"])).all()
+
+    # the healthy rank's chains are untouched bit-for-bit...
+    for k in ref_run["post"].arrays:
+        np.testing.assert_array_equal(
+            np.asarray(post.arrays[k])[:2],
+            np.asarray(ref_run["post"].arrays[k])[:2], err_msg=k)
+    # ...as are the retried chains' draws BEFORE the warm-start point
+    ck4 = load_manifest(os.path.join(ck, "manifest-00000004.json"))
+    assert all(int(x) < 0 for x in ck4["first_bad_it"])
+    # the repair replaced only the owning rank's tail shard; the healthy
+    # rank's shard files survive by NAME (never re-written)
+    man = load_manifest(os.path.join(ck, "manifest-00000008.json"))
+    files = [s["file"] for s in man["shards"]]
+    assert "seg-0-00000004-00000007.npz" in files
+    assert "seg-1-00000004-00000007-r1.npz" in files
+    assert "seg-1-00000004-00000007.npz" not in files
+    # both workers report the same global retry_info on their own slices
+    for r in recs:
+        assert r["result"]["retry_info"]["retried_chains"] == [2, 3]
+
+
+def test_multiproc_retry_requires_checkpointing(model):
+    coord = FileCoordinator.__new__(FileCoordinator)   # no dir side effects
+    coord.process_index, coord.process_count = 0, 2
+    with pytest.raises(ValueError, match="retry_diverged.*checkpoint"):
+        sample_mcmc(model, samples=2, n_chains=4, coordinator=coord,
+                    retry_diverged=1)
+
+
+# ---------------------------------------------------------------------------
 # committer-only GC
 # ---------------------------------------------------------------------------
 
@@ -399,8 +520,10 @@ def test_file_coordinator_collectives(tmp_path):
 
 
 def test_file_coordinator_sentinels_stay_bounded(tmp_path):
-    """Old slots are reclaimed as collectives advance: after many rounds
-    the directory holds O(R) sentinels, not O(rounds)."""
+    """Old slots are reclaimed as collectives advance: every rank's
+    slot-(n-1) sentinels are swept when slot n completes, so after many
+    rounds only the FINAL slot's O(R) files remain — one slot, not one
+    slot per rank (the former per-rank-own-file sweep left up to 2R)."""
     d = os.fspath(tmp_path)
 
     def work(coord):
@@ -410,7 +533,7 @@ def test_file_coordinator_sentinels_stay_bounded(tmp_path):
 
     _, errs = _fan(lambda r: FileCoordinator(d, r, 2, timeout_s=60), 2, work)
     assert errs == [None, None]
-    assert len(os.listdir(d)) <= 4                 # ≤ 2 slots x 2 ranks
+    assert len(os.listdir(d)) <= 2                 # the final slot only
 
 
 def test_file_coordinator_timeout_is_clean_error(tmp_path):
