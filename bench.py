@@ -273,6 +273,34 @@ def _chaos_summary():
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _shard_summary():
+    """The within-model-sharding digest (`benchmarks/bench_shard.py
+    --digest`): 8-shard weak-scaling efficiency, per-device vs replicated
+    state bytes, per-sweep collective counts from the committed comm
+    ledger, and a reduced-scale many-species state-shrink check — run in
+    a CPU-pinned subprocess on the emulated 8-device mesh, so the
+    trajectory records the model-parallel path even on rounds where the
+    accelerator is unreachable."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8"
+                        ).strip()
+    try:
+        r = subprocess.run(
+            [sys.executable, "benchmarks/bench_shard.py", "--digest"],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        digest = json.loads(r.stdout.strip().splitlines()[-1])
+        digest["gates_ok"] = r.returncode == 0
+        return digest
+    except Exception as e:                   # noqa: BLE001 — bench must emit
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _skip(reason: str):
     """Emit a parseable skip record instead of a bare nonzero exit: the
     bench trajectory must distinguish "chip unreachable this round" from "a
@@ -296,6 +324,7 @@ def _skip(reason: str):
         "serving": _serving_summary(),
         "chaos": _chaos_summary(),
         "cost_ledger": _cost_ledger_summary(),
+        "shard": _shard_summary(),
     }))
     raise SystemExit(0)
 
@@ -455,6 +484,11 @@ def main():
         # (hmsc_tpu/obs/profile.py) — cost-model drift rides the
         # trajectory alongside measured throughput
         "cost_ledger": _cost_ledger_summary(),
+        # within-model sharding digest (CPU subprocess, emulated 8-device
+        # mesh): weak-scaling efficiency, per-device state bytes,
+        # per-sweep collective counts (benchmarks/bench_shard.py) — the
+        # model-parallel axis rides the trajectory
+        "shard": _shard_summary(),
     }))
 
 

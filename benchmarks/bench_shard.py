@@ -1,0 +1,296 @@
+"""Within-model sharding bench: weak scaling + per-device memory of the
+species-sharded Gibbs sweep on the emulated 8-device CPU mesh.
+
+Two gates, both CPU-only (``XLA_FLAGS=--xla_force_host_platform_device_
+count=8``; no accelerator needed):
+
+1. **Weak scaling** — for shards k in {1, 2, 4, 8} the model grows with
+   the mesh (``ns = ns0 * k``) and the gate is
+
+       efficiency_k = k * T_repl(ns0) / T_shard(k, k * ns0) >= 0.70
+
+   at the work-dominated default sizes.  This is DEVICE-SECONDS
+   efficiency: the emulated devices serialise onto the host's cores, so
+   wall-clock parallel speedup is unmeasurable here — but the per-device
+   work a real mesh would run in parallel is exactly what the emulation
+   serialises, so ``T_shard / k`` is the real per-device step time and
+   the ratio above is the weak-scaling efficiency a real pod would see
+   (collective latency excluded — that is hardware).  Overhead captured:
+   partitioning, the psum/all_gather collectives, and the full-width RNG
+   draws the draw-equality contract costs (see mcmc/partition.py).
+
+2. **Per-device state** — the sharded carry actually shrinks: per-device
+   placed state bytes <= (1/shards) * replicated + the replicated
+   (non-species) remainder, and the compiled sweep's per-device
+   ``memory_analysis()`` argument bytes shrink accordingly.  The
+   ``--tenk`` mode runs the acceptance gate: a 10k-species probit JSDM
+   builds, runs >= 2 sweeps on the 8-way mesh, and its per-device peak
+   state bytes are <= 1/4 of the replicated layout.
+
+``--digest`` prints one reduced-scale JSON line for bench.py embedding.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# the emulated mesh must exist before JAX initialises its backend
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from hmsc_tpu.mcmc.partition import force_emulated_device_count  # noqa: E402
+
+force_emulated_device_count(8)
+
+import numpy as np  # noqa: E402
+
+
+def _model(ny, ns, nf, seed=66, distr="probit"):
+    import pandas as pd
+
+    from hmsc_tpu.model import Hmsc
+    from hmsc_tpu.random_level import (HmscRandomLevel,
+                                       set_priors_random_level)
+    rng = np.random.default_rng(seed)
+    X = np.column_stack([np.ones(ny), rng.standard_normal(ny)])
+    beta = rng.standard_normal((2, ns)) * 0.5
+    eta = rng.standard_normal((ny, 2))
+    lam = rng.standard_normal((2, ns)) * 0.7
+    L = X @ beta + eta @ lam + rng.standard_normal((ny, ns))
+    Y = (L > 0).astype(float) if distr == "probit" else L
+    study = pd.DataFrame({"sample": [f"s{i:04d}" for i in range(ny)]})
+    rL = HmscRandomLevel(units=study["sample"])
+    set_priors_random_level(rL, nf_max=nf, nf_min=nf)
+    return Hmsc(Y=Y, X=X, study_design=study, ran_levels={"sample": rL},
+                distr=distr, x_scale=False)
+
+
+def _built(hM, nf):
+    from hmsc_tpu.mcmc.structs import (build_model_data, build_spec,
+                                       build_state)
+    from hmsc_tpu.precompute import compute_data_parameters
+    spec = build_spec(hM, nf)
+    data = build_model_data(hM, compute_data_parameters(hM), spec)
+    state = build_state(hM, spec, 0)
+    return spec, data, state
+
+
+def _mesh(shards):
+    import jax
+    from jax.sharding import Mesh
+    return Mesh(np.array(jax.devices()[:shards]).reshape(1, shards),
+                axis_names=("chains", "species"))
+
+
+def _time_sweeps(fn, data, state, key, n_sweeps, reps):
+    """Best-of-reps wall for ``n_sweeps`` chained sweep applications
+    (compile excluded)."""
+    import jax
+
+    def run(state, key):
+        for _ in range(n_sweeps):
+            key, sub = jax.random.split(key)
+            state = fn(data, state, sub)
+        return state
+    runj = jax.jit(run)
+    jax.block_until_ready(runj(state, key))          # compile + warm
+    best = np.inf
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(runj(state, key))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _per_device_state_bytes(state, mesh, spec):
+    """Max per-device bytes of the placed carry (the donated steady-state
+    HBM a real device would hold)."""
+    import jax
+
+    from hmsc_tpu.mcmc.partition import STATE_SPECIES_DIMS, place_on_mesh
+    placed = place_on_mesh(state, mesh, spec, "species", STATE_SPECIES_DIMS)
+    total = 0
+    for leaf in jax.tree.leaves(placed):
+        if hasattr(leaf, "addressable_shards"):
+            total += max(s.data.nbytes for s in leaf.addressable_shards)
+        elif hasattr(leaf, "nbytes"):
+            total += leaf.nbytes
+    return int(total)
+
+
+def run_weak_scaling(ny, ns0, nf, n_sweeps, reps, shard_counts=(1, 2, 4, 8)):
+    import jax
+
+    from hmsc_tpu.mcmc.structs import state_nbytes
+    from hmsc_tpu.mcmc.sweep import make_sharded_sweep, make_sweep
+
+    out = {"ny": ny, "ns0": ns0, "nf": nf, "n_sweeps": n_sweeps}
+    key = jax.random.key(0, impl="threefry2x32")
+
+    spec0, data0, state0 = _built(_model(ny, ns0, nf), nf)
+    ones = tuple(0 for _ in range(spec0.nr))
+    t_base = _time_sweeps(make_sweep(spec0, None, ones), data0, state0, key,
+                          n_sweeps, reps)
+    out["t_repl_ns0_s"] = round(t_base, 4)
+
+    rows = []
+    for k in shard_counts:
+        spec, data, state = _built(_model(ny, ns0 * k, nf), nf)
+        mesh = _mesh(max(k, 1))
+        if k == 1:
+            fn = make_sweep(spec, None, ones)
+            t = _time_sweeps(fn, data, state, key, n_sweeps, reps)
+            per_dev = state_nbytes(state)
+        else:
+            fn = make_sharded_sweep(spec, mesh, None, ones)
+            t = _time_sweeps(fn, data, state, key, n_sweeps, reps)
+            per_dev = _per_device_state_bytes(state, mesh, spec)
+        eff = k * t_base / t
+        rows.append({"shards": k, "ns": ns0 * k,
+                     "t_sweeps_s": round(t, 4),
+                     "efficiency": round(eff, 3),
+                     "state_bytes_per_device": per_dev,
+                     "state_bytes_replicated": state_nbytes(state)})
+    out["rows"] = rows
+    return out
+
+
+def run_tenk(shards=8, ny=256, ns=10240, nf=2, n_sweeps=2):
+    """Acceptance gate: the 10k-species probit JSDM builds, runs
+    ``n_sweeps`` sweeps sharded over the 8-way emulated mesh, and its
+    per-device peak state bytes are <= 1/4 of the replicated layout
+    (measured both from the placed arrays and from the compiled
+    program's per-device memory_analysis)."""
+    import jax
+
+    from hmsc_tpu.mcmc.structs import state_nbytes
+    from hmsc_tpu.mcmc.sweep import make_sharded_sweep
+
+    spec, data, state = _built(_model(ny, ns, nf), nf)
+    mesh = _mesh(shards)
+    ones = tuple(0 for _ in range(spec.nr))
+    fn = make_sharded_sweep(spec, mesh, None, ones)
+
+    from hmsc_tpu.mcmc.partition import (DATA_SPECIES_DIMS,
+                                         STATE_SPECIES_DIMS, place_on_mesh)
+    data_p = place_on_mesh(data, mesh, spec, "species", DATA_SPECIES_DIMS,
+                           x_is_list=spec.x_is_list)
+    state_p = place_on_mesh(state, mesh, spec, "species",
+                            STATE_SPECIES_DIMS)
+    key = jax.random.key(0, impl="threefry2x32")
+    compiled = jax.jit(fn).lower(data_p, state_p, key).compile()
+    ma = compiled.memory_analysis()
+
+    t0 = time.perf_counter()
+    st = state_p
+    for _ in range(n_sweeps):
+        key, sub = jax.random.split(key)
+        st = fn(data_p, st, sub)
+    jax.block_until_ready(st)
+    wall = time.perf_counter() - t0
+
+    repl = state_nbytes(state)
+    per_dev = _per_device_state_bytes(state, mesh, spec)
+    finite = all(bool(np.isfinite(np.asarray(x)).all())
+                 for x in jax.tree.leaves(st)
+                 if np.issubdtype(np.asarray(x).dtype, np.floating))
+    return {"ns": ns, "ny": ny, "nf": nf, "shards": shards,
+            "n_sweeps": n_sweeps, "wall_s": round(wall, 2),
+            "finite": finite,
+            "state_bytes_replicated": repl,
+            "state_bytes_per_device": per_dev,
+            "state_shrink": round(per_dev / repl, 4),
+            "memory_analysis": {
+                "arg_bytes_per_device": int(ma.argument_size_in_bytes),
+                "temp_bytes_per_device": int(ma.temp_size_in_bytes)}}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--ny", type=int, default=24)
+    ap.add_argument("--ns0", type=int, default=64,
+                    help="per-shard species count for weak scaling")
+    ap.add_argument("--nf", type=int, default=14,
+                    help="latent factors (drives the per-species solve "
+                         "work that makes the default work-dominated)")
+    ap.add_argument("--sweeps", type=int, default=6)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--eff-gate", type=float, default=0.70)
+    ap.add_argument("--tenk", action="store_true",
+                    help="also run the 10k-species acceptance gate")
+    ap.add_argument("--tenk-ns", type=int, default=10240)
+    ap.add_argument("--tenk-ny", type=int, default=256)
+    ap.add_argument("--digest", action="store_true",
+                    help="reduced-scale single-line JSON digest for "
+                         "bench.py embedding")
+    args = ap.parse_args(argv)
+
+    import jax
+    if len(jax.devices()) < 8:
+        print(json.dumps({"error": f"need 8 devices, have "
+                                   f"{len(jax.devices())}"}))
+        return 2
+
+    if args.digest:
+        ws = run_weak_scaling(ny=16, ns0=32, nf=args.nf, n_sweeps=4,
+                              reps=2, shard_counts=(1, 8))
+        tk = run_tenk(ny=64, ns=2048, nf=2, n_sweeps=2)
+        row8 = ws["rows"][-1]
+        # per-sweep collective counts from the committed comm ledger
+        from hmsc_tpu.obs.profile import load_ledger
+        led = load_ledger() or {"programs": {}}
+        colls = {m: e.get("collectives")
+                 for m in ("base", "spatial", "rrr", "sel")
+                 for e in [led["programs"].get(f"{m}/shard8:sweep", {})]
+                 if e.get("collectives")}
+        # same gates as the full run, at reduced scale — the digest's
+        # exit code is what bench.py records as gates_ok (sibling
+        # convention: bench_chaos/bench_serving exit nonzero on a miss)
+        ok = (row8["efficiency"] >= args.eff_gate and tk["finite"]
+              and tk["state_shrink"] <= 0.25)
+        print(json.dumps({
+            "efficiency_8shard": row8["efficiency"],
+            "state_bytes_per_device": row8["state_bytes_per_device"],
+            "state_bytes_replicated": row8["state_bytes_replicated"],
+            "collective_counts": colls,
+            "reduced_tenk": {"ns": tk["ns"],
+                             "state_shrink": tk["state_shrink"],
+                             "finite": tk["finite"]},
+        }))
+        return 0 if ok else 1
+
+    ws = run_weak_scaling(args.ny, args.ns0, args.nf, args.sweeps,
+                          args.reps)
+    print(json.dumps(ws, indent=1))
+    ok = True
+    for row in ws["rows"]:
+        if row["shards"] > 1:
+            shrink = (row["state_bytes_per_device"]
+                      / row["state_bytes_replicated"])
+            print(f"shards={row['shards']:2d} ns={row['ns']:6d} "
+                  f"eff={row['efficiency']:.3f} "
+                  f"state/device={shrink:.3f}x replicated")
+            if row["efficiency"] < args.eff_gate:
+                print(f"  GATE FAIL: efficiency {row['efficiency']} < "
+                      f"{args.eff_gate}")
+                ok = False
+    if args.tenk:
+        tk = run_tenk(ny=args.tenk_ny, ns=args.tenk_ns)
+        print(json.dumps(tk, indent=1))
+        if not tk["finite"]:
+            print("  GATE FAIL: non-finite state after sharded sweeps")
+            ok = False
+        if tk["state_shrink"] > 0.25:
+            print(f"  GATE FAIL: per-device state {tk['state_shrink']}x "
+                  "replicated > 0.25")
+            ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,8 +30,11 @@ SWEEP_MODULES = ("mcmc/sweep.py", "mcmc/updaters.py", "mcmc/updaters_sel.py",
                  "mcmc/updaters_marginal.py", "mcmc/spatial.py")
 
 # expression roots treated as trace-time-static inside traced scopes: the
-# hashable ModelSpec/LevelSpec objects the sweep closes over
-STATIC_ROOTS = {"spec", "spec_x", "spec0", "ls"}
+# hashable ModelSpec/LevelSpec objects the sweep closes over, the frozen
+# ShardCtx (static mesh geometry: axis name / shard count / global ns),
+# and the conventional `ns_g` global-species-count scalar derived from
+# them (spec.ns is the LOCAL width inside a sharded trace)
+STATIC_ROOTS = {"spec", "spec_x", "spec0", "ls", "shard", "ns_g"}
 
 GUARD_RE = re.compile(
     r"#\s*hmsc:\s*guarded-by\[([A-Za-z_][A-Za-z0-9_]*)\]:\s*([A-Za-z0-9_,\s]+)")

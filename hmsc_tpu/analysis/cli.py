@@ -49,6 +49,12 @@ def lint_main(argv=None) -> int:
     # lint must never block on an unreachable accelerator: abstract eval
     # is platform-independent, so trace on CPU unless told otherwise
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # the sharded-sweep audits trace shard_map programs over an emulated
+    # 8-device species mesh; force the virtual device count BEFORE the
+    # backend initialises (no-op when the flag — or a backend — already
+    # exists, e.g. under pytest where conftest set it)
+    from ..mcmc.partition import force_emulated_device_count
+    force_emulated_device_count(8)
 
     from .findings import load_baseline
     from .runner import BASELINE_PATH, run_analysis, findings_to_json

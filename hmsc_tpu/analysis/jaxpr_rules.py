@@ -76,6 +76,10 @@ class JaxprAudit:
     sweep_shape_variants: dict    # shape-blind fp -> [size labels]
     expected_fingerprints: dict | None
     missing_updaters: list
+    # True when the sharded-sweep programs could not be traced (fewer than
+    # SHARD_AUDIT_DEVICES devices): committed "sharded_sweep@*" fingerprints
+    # are then exempt from the stale-entry check instead of erroring
+    sharded_skipped: bool = False
 
 
 # ---------------------------------------------------------------------------
@@ -120,9 +124,9 @@ def _canonical_models():
 
     models["base"] = base
 
-    def spatial():
+    def spatial(ny=12, ns=3):
         rng = np.random.default_rng(12)
-        ny, ns, n_units = 12, 3, 6
+        n_units = 6
         X = _design(rng, ny, 2)
         Y = rng.standard_normal((ny, ns))
         units = _units(rng, ny, n_units)
@@ -136,9 +140,8 @@ def _canonical_models():
 
     models["spatial"] = spatial
 
-    def rrr():
+    def rrr(ny=12, ns=3):
         rng = np.random.default_rng(13)
-        ny, ns = 12, 3
         X = _design(rng, ny, 2)
         XRRR = rng.standard_normal((ny, 2))
         Y = rng.standard_normal((ny, ns))
@@ -146,16 +149,32 @@ def _canonical_models():
 
     models["rrr"] = rrr
 
-    def sel():
+    def sel(ny=12, ns=4):
         rng = np.random.default_rng(14)
-        ny, ns = 12, 4
         X = _design(rng, ny, 2)
         Y = (rng.standard_normal((ny, ns)) > 0).astype(float)
-        s = XSelect(cov_group=[1], sp_group=[0, 0, 1, 1], q=[0.5, 0.5])
+        s = XSelect(cov_group=[1],
+                    sp_group=[0] * (ns // 2) + [1] * (ns - ns // 2),
+                    q=[0.5, 0.5])
         return Hmsc(Y=Y, X=X, x_select=[s], distr="probit")
 
     models["sel"] = sel
     return models
+
+
+# species count of the sharded audit/ledger variants: divisible by every
+# emulated shard count the CI mesh uses (1, 2, 4, 8)
+SHARD_AUDIT_NS = 8
+SHARD_AUDIT_DEVICES = 8
+
+
+def _shard_models():
+    """The canonical factories re-sized so ``ns`` divides every emulated
+    shard count — the specs the sharded-sweep audits, the comm-bytes
+    ledger, and ``tests/test_shard.py`` all trace."""
+    base = _canonical_models()
+    return {name: (lambda fn=fn: fn(ns=SHARD_AUDIT_NS))
+            for name, fn in base.items()}
 
 
 def _build(hM, nf_cap=2, seed=0):
@@ -356,11 +375,39 @@ def build_audit_context(expected_fingerprints=None) -> JaxprAudit:
         fp = fingerprint_jaxpr(closed_i, shape_blind=True)["sha256"]
         variants.setdefault(fp, []).append(f"ny={ny},ns={ns}")
 
+    # sharded sweep, per canonical spec at ns=SHARD_AUDIT_NS over an
+    # emulated SHARD_AUDIT_DEVICES-way species mesh: same f64 probe /
+    # callback / const / fingerprint rules, with the collective sequence
+    # (psum / all_gather eqn counts) captured by the fingerprint's
+    # primitive profile.  Skipped (flagged, not failed) when the process
+    # has fewer devices — CI pins XLA_FLAGS=--xla_force_host_platform_
+    # device_count=8 so the tier-1 lint gate always audits them.
+    sharded_skipped = len(jax.devices()) < SHARD_AUDIT_DEVICES
+    if not sharded_skipped:
+        import numpy as _np
+        from jax.sharding import Mesh
+
+        from ..mcmc.sweep import make_sharded_sweep
+        mesh = Mesh(
+            _np.array(jax.devices()[:SHARD_AUDIT_DEVICES]).reshape(
+                1, SHARD_AUDIT_DEVICES),
+            axis_names=("chains", "species"))
+        for mname, fn in _shard_models().items():
+            spec_s, data_s, state_s = _build(fn())
+            sweep_s = make_sharded_sweep(
+                spec_s, mesh, None, tuple(1 for _ in range(spec_s.nr)))
+            closed, closed_x64, err = _trace_pair(sweep_s, data_s, state_s,
+                                                  _k())
+            programs.append(AuditProgram(
+                name=f"sharded_sweep@{mname}@sp{SHARD_AUDIT_DEVICES}",
+                path="hmsc_tpu/mcmc/partition.py",
+                closed=closed, closed_x64=closed_x64, x64_error=err))
+
     return JaxprAudit(
         programs=programs, runner_text=runner_text,
         runner_n_carry_leaves=n_carry, sweep_shape_variants=variants,
         expected_fingerprints=expected_fingerprints,
-        missing_updaters=missing)
+        missing_updaters=missing, sharded_skipped=sharded_skipped)
 
 
 def run_jaxpr_rules(audit: JaxprAudit):
@@ -548,6 +595,8 @@ def check_fingerprint(audit: JaxprAudit):
                 f"{exp.get('n_eqns')} → {fp['n_eqns']} eqns) — review, "
                 f"then --update-fingerprints"))
     for name in sorted(set(expected) - set(current)):
+        if audit.sharded_skipped and name.startswith("sharded_sweep@"):
+            continue              # no mesh this run (devices < 8), not stale
         findings.append(info.finding(
             "hmsc_tpu/analysis/fingerprints.json", 1,
             f"{name}: committed fingerprint has no audited program "

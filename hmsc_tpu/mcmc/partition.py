@@ -1,0 +1,306 @@
+"""Partition-spec tables and the shard context for the species-sharded
+Gibbs sweep (``shard_map`` over a ``(chains, species)`` device mesh).
+
+PR 8's named block schedule made every Gibbs block a seam; this module is
+the committed answer to "which axis does each array live on" when the
+sweep itself is sharded over the mesh's ``species`` axis:
+
+- **Spec tables** (:data:`STATE_SPECIES_DIMS`, :data:`DATA_SPECIES_DIMS`,
+  :data:`RECORD_SPECIES_DIMS`): the species dimension of every carry /
+  model-data / recorded-sample array, by field name.  Anything not listed
+  is **replicated** over the species axis (Eta and every per-unit array is
+  deliberately replicated in v1 — the site axis is the next frontier).
+- :class:`ShardCtx`: the static shard geometry handed to the updaters.
+  Inside the ``shard_map`` body every updater sees a *local* spec
+  (``spec.ns == ns_local``) plus this context for the three operations
+  that must know about the mesh:
+
+  * ``psum`` — the explicit cross-species reductions (the factor grams in
+    updateEta, GammaV's ``B`` products, the rho/phylo quadratics, BetaSel
+    likelihood deltas, divergence tracking);
+  * ``gather_sp`` — all-gathers of *small* (O(ns·k)) per-species vectors
+    where bit-identical replicated compute is cheaper than a psum
+    (InvSigma's gamma shape vector, the DA-interweave truncation bounds);
+  * full-width RNG (``uniform`` / ``normal`` / ``slice_sp`` of a
+    full-width draw) — every random draw with a species dimension is
+    drawn at the GLOBAL width with the replicated key and sliced to the
+    local shard.  This keeps each shard's draws independent (a naive
+    local-shape draw would reuse the same key for different species on
+    every device) AND keeps the sharded draw stream equal to the
+    replicated sweep's, so the two programs are comparable draw-by-draw.
+
+**Tolerance contract** (:data:`SHARD_AGREEMENT_TOL`): the sharded sweep
+targets the replicated sweep's exact draw stream; the only divergence
+sources are the ``psum`` reductions, whose partial-sum order differs from
+the replicated single-dot order by float rounding.  Agreement is
+therefore ULP-level per sweep and drifts slowly with chain length;
+``tests/test_shard.py`` pins all four canonical specs × {1,2,4,8}
+emulated devices to this tolerance after a fixed sweep count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+__all__ = ["ShardCtx", "STATE_SPECIES_DIMS", "DATA_SPECIES_DIMS",
+           "RECORD_SPECIES_DIMS", "SHARD_AGREEMENT_TOL",
+           "shard_unsupported_reason", "tree_pspecs", "record_pspecs",
+           "place_on_mesh", "collective_bytes", "nearest_divisor",
+           "force_emulated_device_count", "COLLECTIVE_PRIMS"]
+
+# tolerance for sharded-vs-replicated state agreement after a few sweeps
+# on the canonical specs (tests/test_shard.py): max ABS error per state
+# leaf, normalised by that leaf's max magnitude (an elementwise relative
+# error would explode on near-zero entries whose absolute psum-rounding
+# error is float-ULP).  Measured: psum-vs-fused-dot rounding is ~1e-7
+# per reduction; a few sweeps of chaotic Gibbs amplification stay well
+# inside 5e-3 (observed ~1e-5 after 5 sweeps).
+SHARD_AGREEMENT_TOL = 5e-3
+
+# species-dimension index per CARRY field (chain axis excluded); fields
+# not listed are replicated over the species mesh axis
+STATE_SPECIES_DIMS = {
+    "Z": 1, "Beta": 1, "iSigma": 0, "Lambda": 1, "Psi": 1,
+}
+
+# species-dimension index per MODEL-DATA field.  Deliberately replicated
+# despite carrying a species dim: Qeig/UTr (the rho-grid and phylo-trait
+# projections are consumed at full width by every shard), y_scale_par
+# (host-side back-transform only).  U is sharded by ROWS: E @ U
+# contractions psum partial products; U.T column blocks serve the local
+# writebacks.  X is sharded only for per-species design lists.
+DATA_SPECIES_DIMS = {
+    "Y": 1, "Ymask": 1, "Tr": 0, "distr_family": 0,
+    "distr_estsig": 0, "sigma_fixed": 0, "aSigma": 0, "bSigma": 0,
+    "U": 0, "sel_spg": 0,
+}
+
+# species-dimension index per RECORDED-SAMPLE key (before the leading
+# (chain, sample) axes the runner adds); per-level names ("Lambda_0")
+# resolve through their base name
+RECORD_SPECIES_DIMS = {
+    "Beta": 1, "sigma": 0, "Lambda": 1, "Psi": 1,
+}
+
+# collective primitives counted by the static comm ledger and recorded in
+# the sharded jaxpr fingerprints
+COLLECTIVE_PRIMS = ("psum", "all_gather", "all_to_all", "ppermute",
+                    "all_gather_invariant", "reduce_scatter")
+
+
+def force_emulated_device_count(n: int = 8) -> None:
+    """Ensure the process sees at least ``n`` emulated CPU devices by
+    appending ``--xla_force_host_platform_device_count=n`` to XLA_FLAGS —
+    but only while the JAX backend is still uninitialised (afterwards the
+    flag is inert, and callers gate on the actual device count instead).
+    One shared helper so the lint CLI, the profile CLI, and any future
+    entry point append the same flag the same way."""
+    import os
+    try:
+        import jax
+        fresh = not jax._src.xla_bridge.backends_are_initialized()  # noqa: SLF001
+    except Exception:             # noqa: BLE001 — private API moved: assume
+        fresh = True              # fresh and let the flag no-op if not
+    if fresh:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+
+
+def nearest_divisor(n: int, k: int) -> int:
+    """The divisor of ``n`` nearest to ``k`` (ties prefer the larger —
+    more parallelism); used by error/warning messages so the user is told
+    a working value, not just that theirs failed."""
+    n, k = int(n), int(k)
+    divs = [d for d in range(1, n + 1) if n % d == 0]
+    return min(divs, key=lambda d: (abs(d - k), -d))
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardCtx:
+    """Static geometry of the species sharding, closed over by the
+    updaters inside the ``shard_map`` body.  ``ns`` is the GLOBAL species
+    count (the local spec's ``spec.ns`` is ``ns // n``)."""
+    axis: str                   # mesh axis name ("species")
+    n: int                      # number of shards
+    ns: int                     # GLOBAL species count
+
+    @property
+    def ns_local(self) -> int:
+        return self.ns // self.n
+
+    # -- traced helpers -------------------------------------------------
+    def offset(self):
+        import jax
+        return jax.lax.axis_index(self.axis) * self.ns_local
+
+    def slice_sp(self, x, dim: int):
+        """This shard's species block of a full-width array."""
+        import jax
+        return jax.lax.dynamic_slice_in_dim(x, self.offset(), self.ns_local,
+                                            axis=dim)
+
+    def psum(self, x):
+        import jax
+        return jax.lax.psum(x, self.axis)
+
+    def gather_sp(self, x, dim: int):
+        """Full-width reassembly of a species-sharded array (tiled
+        all-gather: shard i lands at block i, exactly the replicated
+        layout)."""
+        import jax
+        return jax.lax.all_gather(x, self.axis, axis=dim, tiled=True)
+
+    def all_ok(self, ok):
+        """Cross-shard AND of a boolean (divergence tracking)."""
+        import jax.numpy as jnp
+        bad = jnp.where(ok, 0, 1).astype(jnp.int32)
+        return self.psum(bad) == 0
+
+    # -- full-width RNG, sliced to the local shard ----------------------
+    def uniform(self, key, shape, dtype, dim: int, **kw):
+        import jax
+        return self.slice_sp(jax.random.uniform(key, shape, dtype=dtype,
+                                                **kw), dim)
+
+    def normal(self, key, shape, dtype, dim: int):
+        import jax
+        return self.slice_sp(jax.random.normal(key, shape, dtype=dtype),
+                             dim)
+
+
+def shard_unsupported_reason(spec, updater: dict | None) -> str | None:
+    """Why this model class cannot run the species-sharded sweep, or
+    ``None`` when eligible.  Single source for the sampler's gate and its
+    fallback warning."""
+    updater = updater or {}
+    if spec.has_phylo and (spec.has_na or spec.x_is_list
+                           or not spec.homoskedastic_fixed):
+        return ("the phylogenetic Beta draw falls back to the dense "
+                "(nc*ns)^2 system on NA/per-species-X/heteroskedastic "
+                "models, which has no sharded formulation")
+    for name in ("Gamma2", "GammaEta"):
+        if updater.get(name) is True:
+            return (f"the opt-in collapsed updater {name} has no "
+                    "shard-aware implementation")
+    return None
+
+
+def _leaf_name(path) -> str | None:
+    for p in reversed(path):
+        n = getattr(p, "name", None)
+        if n is None:
+            n = getattr(p, "key", None)
+            n = n if isinstance(n, str) else None
+        if n is not None:
+            return n
+    return None
+
+
+def tree_pspecs(tree, spec, species_axis: str, dims: dict,
+                lead: str | None = None, x_is_list: bool = False):
+    """Per-leaf ``PartitionSpec`` pytree for a state/data tree: optional
+    leading chain axis, species dims from ``dims`` (guarded on the dim
+    actually being ``spec.ns``-sized), everything else replicated."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def one(path, leaf):
+        if not hasattr(leaf, "ndim") or leaf.ndim == 0:
+            return P()
+        ax = [None] * leaf.ndim
+        off = 0
+        if lead is not None:
+            ax[0] = lead
+            off = 1
+        name = _leaf_name(path)
+        d = dims.get(name)
+        if name == "X":
+            d = 0 if x_is_list else None
+        if d is not None and d + off < leaf.ndim \
+                and leaf.shape[d + off] == spec.ns:
+            ax[d + off] = species_axis
+        return P(*ax)
+
+    return jax.tree_util.tree_map_with_path(one, tree)
+
+
+def record_pspecs(chain_axis: str, species_axis: str):
+    """``name, rank -> PartitionSpec`` resolver for the runner's
+    recorded-sample leaves: leading (chain, sample) axes then
+    :data:`RECORD_SPECIES_DIMS` (per-level names like ``Lambda_0``
+    resolve through their base name).  The caller enumerates the record
+    dict's keys/ranks (the runner abstract-evals ``record_sample`` with
+    its ``record=`` filter applied) and maps each through this."""
+    from jax.sharding import PartitionSpec as P
+
+    def spec_for(name, rank):
+        head, _, tail = name.rpartition("_")
+        base = head if tail.isdigit() else name
+        ax = [None] * rank
+        ax[0] = chain_axis
+        d = RECORD_SPECIES_DIMS.get(base)
+        if d is not None:
+            ax[d + 2] = species_axis
+        return P(*ax)
+    return spec_for
+
+
+def place_on_mesh(tree, mesh, spec, species_axis: str, dims: dict,
+                  lead: str | None = None, x_is_list: bool = False):
+    """Device-put a tree onto the mesh according to its spec table (the
+    eager counterpart of the in_specs the sharded runner uses, so the
+    first segment pays no resharding)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    specs = tree_pspecs(tree, spec, species_axis, dims, lead=lead,
+                        x_is_list=x_is_list)
+
+    def put(leaf, ps):
+        if not hasattr(leaf, "ndim"):
+            return leaf
+        return jax.device_put(leaf, NamedSharding(mesh, ps))
+
+    return jax.tree.map(put, tree, specs)
+
+
+def collective_bytes(closed) -> dict:
+    """Static communication ledger of a traced program: per-collective
+    byte counts summed over every collective eqn in the (recursively
+    walked) jaxpr.  Bytes are the per-device operand bytes entering each
+    collective — the quantity a shard pays per sweep on the wire."""
+    import numpy as np
+
+    totals: dict[str, int] = {}
+
+    def walk(jaxpr):
+        from jax import core as jcore
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name in COLLECTIVE_PRIMS:
+                nb = 0
+                for v in eqn.invars:
+                    aval = getattr(v, "aval", None)
+                    if aval is not None and hasattr(aval, "shape"):
+                        nb += int(np.prod(aval.shape, dtype=np.int64)
+                                  * np.dtype(aval.dtype).itemsize)
+                totals[name] = totals.get(name, 0) + nb
+            for v in eqn.params.values():
+                _walk_param(v)
+
+    def _walk_param(v):
+        from jax import core as jcore
+        if isinstance(v, jcore.ClosedJaxpr):
+            walk(v.jaxpr)
+        elif isinstance(v, jcore.Jaxpr):
+            walk(v)
+        elif isinstance(v, (tuple, list)):
+            for x in v:
+                _walk_param(x)
+
+    walk(closed.jaxpr)
+    return {"comm_bytes": int(sum(totals.values())),
+            "collectives": dict(sorted(totals.items()))}

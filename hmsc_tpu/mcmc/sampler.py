@@ -273,7 +273,8 @@ def _keep_record(name: str, record) -> bool:
 
 @functools.lru_cache(maxsize=64)
 def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin,
-                     skip_init_z, record=None, nngp_dense_max=None):
+                     skip_init_z, record=None, nngp_dense_max=None,
+                     mesh=None, chain_axis="chains", species_axis="species"):
     """One jitted chain-vmapped sampling program per static config.
 
     Keyed on the hashable (spec, updater toggles, scan lengths) so repeated
@@ -292,18 +293,47 @@ def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin,
     ``init_state``/``init_keys`` before the first donated call, and
     snapshots the carry on-device before a checkpoint boundary).  A
     ``samples=0`` config is a pure burn-in segment: the sample scan has
-    length 0 and the recorded tree comes back empty along the sample axis."""
+    length 0 and the recorded tree comes back empty along the sample axis.
+
+    ``mesh`` with a ``species_axis`` engages the SPECIES-SHARDED runner:
+    the whole chain-vmapped program is wrapped in ``shard_map`` over the
+    mesh with the in/out PartitionSpecs from :mod:`~hmsc_tpu.mcmc.
+    partition`, each Gibbs block runs on its local species columns with
+    explicit collectives at the cross-species reductions, and the donated
+    carry stays sharded (per-device state ~1/shards).  ``mesh=None`` (or a
+    chains-only mesh) is the historical replicated program, trace-
+    identical to every prior release (the committed fingerprints pin it)."""
     updater = dict(updater_items) if updater_items else None
-    sweep = make_sweep(spec, updater, adapt_nf)
+    shard = None
+    spec_run = spec
+    if mesh is not None and species_axis in getattr(mesh, "axis_names", ()):
+        import dataclasses as _dc
+
+        from .partition import ShardCtx
+        n_sp = int(mesh.shape[species_axis])
+        if n_sp > 1:
+            if spec.ns % n_sp:
+                raise ValueError(
+                    f"ns={spec.ns} is not divisible by the mesh's "
+                    f"'{species_axis}' extent ({n_sp}); the sampler should "
+                    "have fallen back to replication")
+            shard = ShardCtx(axis=species_axis, n=n_sp, ns=spec.ns)
+            spec_run = _dc.replace(spec, ns=spec.ns // n_sp)
+    sweep = make_sweep(spec_run, updater, adapt_nf, shard)
 
     def first_bad_update(state, bad_it):
         """Track the first iteration whose carry went non-finite (divergence
         observability: the reference at best prints "Fail in Poisson Z update",
-        updateZ.R:84-86; here every chain reports its first bad sweep)."""
+        updateZ.R:84-86; here every chain reports its first bad sweep).
+        Sharded: the finiteness verdict is itself a cross-species
+        reduction — a NaN on any shard must mark the chain on every
+        shard, or the replicated bookkeeping would fork."""
         ok = jnp.bool_(True)
         for leaf in jax.tree.leaves(state):
             if jnp.issubdtype(leaf.dtype, jnp.floating):
                 ok = ok & jnp.all(jnp.isfinite(leaf))
+        if shard is not None:
+            ok = shard.all_ok(ok)
         return jnp.where((bad_it < 0) & ~ok, state.it, bad_it)
 
     def run_chain(data, state, key, bad_it):
@@ -312,8 +342,8 @@ def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin,
             # continuation segment keeps its carried Z (and, so that the
             # stream is independent of host-side segmentation, no split)
             key, k0 = jax.random.split(key)
-            spec0, data0 = effective_spec_data(spec, data, state)
-            state = U.update_z(spec0, data0, state, k0)
+            spec0, data0 = effective_spec_data(spec_run, data, state)
+            state = U.update_z(spec0, data0, state, k0, shard=shard)
         bad_it = first_bad_update(state, bad_it)
 
         def one_iter(carry, _):
@@ -329,7 +359,7 @@ def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin,
 
         def sample_step(carry, _):
             carry, _ = jax.lax.scan(one_iter, carry, None, length=thin)
-            rec = record_sample(spec, data, carry[0])
+            rec = record_sample(spec_run, data, carry[0])
             if record is not None:
                 rec = {k: v for k, v in rec.items()
                        if _keep_record(k, record)}
@@ -338,8 +368,45 @@ def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin,
         carry, recs = jax.lax.scan(sample_step, carry, None, length=samples)
         return recs, carry[0], carry[2], carry[1]
 
-    return jax.jit(jax.vmap(run_chain, in_axes=(None, 0, 0, 0)),
-                   donate_argnums=(1, 2, 3))
+    mapped = jax.vmap(run_chain, in_axes=(None, 0, 0, 0))
+    if shard is None:
+        return jax.jit(mapped, donate_argnums=(1, 2, 3))
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from .partition import (DATA_SPECIES_DIMS, STATE_SPECIES_DIMS,
+                            record_pspecs, tree_pspecs)
+    rec_spec_for = record_pspecs(chain_axis, species_axis)
+
+    def fn(data, states, keys, bad):
+        in_specs = (
+            tree_pspecs(data, spec, species_axis, DATA_SPECIES_DIMS,
+                        x_is_list=spec.x_is_list),
+            tree_pspecs(states, spec, species_axis, STATE_SPECIES_DIMS,
+                        lead=chain_axis),
+            P(chain_axis), P(chain_axis))
+        state_out = in_specs[1]
+
+        # the recorded-sample tree's structure is known statically from
+        # record_sample + the record= filter (abstract eval on the GLOBAL
+        # spec — shard_map out_specs need the tree's keys and ranks
+        # before the body traces; +2 ranks for the (chain, sample) axes
+        # the vmap/scan add)
+        one_state = jax.tree.map(lambda x: x[0], states)
+        rec_shapes = jax.eval_shape(
+            lambda d, s: {k: v
+                          for k, v in record_sample(spec, d, s).items()
+                          if record is None or _keep_record(k, record)},
+            data, one_state)
+        rec_specs = {name: rec_spec_for(name, len(sd.shape) + 2)
+                     for name, sd in rec_shapes.items()}
+        out_specs = (rec_specs, state_out, P(chain_axis), P(chain_axis))
+        return shard_map(mapped, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)(
+                             data, states, keys, bad)
+
+    return jax.jit(fn, donate_argnums=(1, 2, 3))
 
 
 # timed repetitions per block in the instrumented (per-updater) sweep; the
@@ -502,7 +569,7 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 nf_cap: int = DEFAULT_NF_CAP, dtype=jnp.float32,
                 data_par=None, from_prior: bool = False,
                 align_post: bool = True, mesh=None, chain_axis: str = "chains",
-                species_axis: str = "species",
+                species_axis: str = "species", shard_sweep=None,
                 return_state: bool = False, verbose: int = 0,
                 init_state=None, profile_dir: str | None = None,
                 rng_impl: str | None = None, record_dtype=None,
@@ -638,6 +705,25 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
     - ``init_keys`` resumes the per-chain RNG key stream from a checkpoint
       (requires ``init_state``); without it a resumed run draws a fresh
       stream seeded from (seed, carried iteration).
+    - ``shard_sweep`` controls WITHIN-model parallelism when ``mesh`` names
+      a species axis of extent > 1.  The default (``None``, auto) wraps
+      the whole Gibbs sweep in ``jax.experimental.shard_map`` over the
+      mesh: every species-dimensioned carry/data array is sharded per the
+      committed PartitionSpec tables in :mod:`hmsc_tpu.mcmc.partition`,
+      per-species blocks (Beta/Lambda/Z/sigma) run fully local, and only
+      the few cross-species reductions (updateEta's factor grams,
+      GammaV's ``B``-products, the rho quadratic, Nf statistics,
+      divergence tracking) are explicit psum/all_gather collectives — so
+      per-device state shrinks ~1/shards and the one-chip ceiling on
+      ``ns`` breaks.  Every species-dimensioned random draw is taken at
+      the global width and sliced, keeping the sharded draw stream equal
+      to the replicated sweep's; agreement is within the documented
+      tolerance (``partition.SHARD_AGREEMENT_TOL``, psum rounding only).
+      Models the sharded sweep cannot express (dense-phylo fallbacks, the
+      opt-in collapsed updaters) auto-fall back to GSPMD placement with a
+      warning; ``True`` makes that an error, ``False`` always uses legacy
+      GSPMD placement.  Resume of a sharded run may re-shard freely — the
+      committed draws are layout-independent within the same tolerance.
     - ``coordinator`` scales chains across a multi-process mesh (the
       reference's SOCK-cluster ``nParallel``, re-architected): ``n_chains``
       is the GLOBAL count, process ``p`` of ``R`` samples the contiguous
@@ -945,14 +1031,36 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
 
     updater_items = (tuple(sorted(updater.items())) if updater else None)
     sharding = None
+    runner_mesh = None                    # engages the shard_map sweep path
+    if shard_sweep not in (None, True, False):
+        raise ValueError(f"shard_sweep must be None (auto), True or False, "
+                         f"got {shard_sweep!r}")
+    if shard_sweep is True and (
+            mesh is None
+            or species_axis not in getattr(mesh, "axis_names", ())
+            or int(mesh.shape[species_axis]) < 2):
+        # strict mode needs something to shard OVER: silently replicating
+        # here would defeat the 1/shards per-device state the caller
+        # explicitly asked for
+        raise ValueError(
+            "shard_sweep=True requires a mesh with a "
+            f"'{species_axis}' axis of extent >= 2 (got "
+            f"{'no mesh' if mesh is None else tuple(mesh.shape.items())}) "
+            "— build one with make_mesh(species_shards=k)")
     if mesh is not None:
         # chains are the data-parallel axis; if the mesh also names a
         # `species_axis`, the species dimension of every site x species array
-        # is sharded over it (model parallelism: per-species updaters run
-        # fully local, the cross-species reductions — E E' in updateGammaV,
-        # the factor grams in updateEta, the rho quadratic — become XLA
-        # collectives riding ICI).  This replaces the reference's
-        # chains-only SOCK parallelism with dp x tp over one mesh.
+        # is sharded over it (model parallelism).  Default (shard_sweep=
+        # None/True): the sweep itself is shard_map'd over the species
+        # axis — per-species blocks run fully local and the few
+        # cross-species reductions (the factor grams in updateEta, E E'
+        # in updateGammaV, the rho quadratic, Nf statistics, divergence
+        # tracking) are explicit psum/all_gather collectives with
+        # committed PartitionSpecs (mcmc/partition.py), so per-device
+        # carry state shrinks ~1/shards.  shard_sweep=False keeps the
+        # legacy GSPMD placement (XLA chooses the collectives).  This
+        # replaces the reference's chains-only SOCK parallelism with
+        # dp x tp over one mesh.
         from jax.sharding import NamedSharding, PartitionSpec as P
         n_chain_devs = int(mesh.shape[chain_axis])
         if n_local % n_chain_devs:
@@ -962,18 +1070,53 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 "lay out evenly over devices")
         sp = species_axis if species_axis in mesh.axis_names else None
         if sp is not None and spec.ns % int(mesh.shape[sp]) != 0:
+            from .partition import nearest_divisor
+            n_sp = int(mesh.shape[sp])
+            msg = (f"mesh names a '{sp}' axis of size {n_sp} but "
+                   f"ns={spec.ns} is not divisible by "
+                   f"species_shards={n_sp}; the nearest valid "
+                   f"species_shards for ns={spec.ns} is "
+                   f"{nearest_divisor(spec.ns, n_sp)} (pad or regroup "
+                   "species to use another)")
+            if shard_sweep is True:
+                # strict mode: an explicit request to shard must not
+                # silently replicate — the whole point was the 1/shards
+                # per-device state
+                raise ValueError(f"shard_sweep=True but {msg}")
             import warnings
             warnings.warn(
-                f"mesh names a '{sp}' axis of size {int(mesh.shape[sp])} but "
-                f"ns={spec.ns} is not divisible by it; species arrays are "
-                "replicated (chains-only parallelism) — pad or regroup "
-                "species to engage model parallelism", RuntimeWarning,
-                stacklevel=2)
+                f"{msg}; species arrays are replicated (chains-only "
+                "parallelism)", RuntimeWarning, stacklevel=2)
             sp = None
+        want_shard = (sp is not None and int(mesh.shape[sp]) > 1
+                      and shard_sweep is not False)
+        if want_shard:
+            from .partition import shard_unsupported_reason
+            reason = shard_unsupported_reason(spec, updater)
+            if reason is not None:
+                if shard_sweep is True:
+                    raise ValueError(
+                        f"shard_sweep=True but the species-sharded sweep "
+                        f"does not support this model: {reason}")
+                import warnings
+                warnings.warn(
+                    f"species-sharded sweep unavailable for this model "
+                    f"({reason}); falling back to GSPMD placement",
+                    RuntimeWarning, stacklevel=2)
+                want_shard = False
         sharding = NamedSharding(mesh, P(chain_axis))
-        state0 = _shard_species(state0, mesh, spec, sp, lead=chain_axis)
-        if sp is not None:
-            data = _shard_species(data, mesh, spec, sp, lead=None)
+        if want_shard:
+            from .partition import (DATA_SPECIES_DIMS, STATE_SPECIES_DIMS,
+                                    place_on_mesh)
+            runner_mesh = mesh
+            state0 = place_on_mesh(state0, mesh, spec, sp,
+                                   STATE_SPECIES_DIMS, lead=chain_axis)
+            data = place_on_mesh(data, mesh, spec, sp, DATA_SPECIES_DIMS,
+                                 x_is_list=spec.x_is_list)
+        else:
+            state0 = _shard_species(state0, mesh, spec, sp, lead=chain_axis)
+            if sp is not None:
+                data = _shard_species(data, mesh, spec, sp, lead=None)
 
     # progress printing and auto-checkpointing both split the sample scan
     # into host-level segments (the reference's per-iteration printout,
@@ -1321,7 +1464,9 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
             miss0 = _compiled_runner.cache_info().misses
             fn = _compiled_runner(spec, updater_items, adapt_nf, seg,
                                   trans_seg, int(thin), skip_z, record,
-                                  spatial._NNGP_DENSE_MAX)
+                                  spatial._NNGP_DENSE_MAX,
+                                  mesh=runner_mesh, chain_axis=chain_axis,
+                                  species_axis=species_axis)
             # a cache miss means this static config is new to the process:
             # the dispatch below pays XLA trace + compile synchronously —
             # name the span for what it spends its time on
@@ -1610,6 +1755,7 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                               verbose=verbose, mesh=sub_mesh,
                               chain_axis=chain_axis,
                               species_axis=species_axis,
+                              shard_sweep=shard_sweep,
                               init_state=sub_init,
                               rng_impl=rng_impl, record_dtype=record_dtype,
                               retry_diverged=retry_diverged - 1,
@@ -1632,6 +1778,7 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                               verbose=verbose,
                               mesh=sub_mesh, chain_axis=chain_axis,
                               species_axis=species_axis,
+                              shard_sweep=shard_sweep,
                               rng_impl=rng_impl, record_dtype=record_dtype,
                               retry_diverged=retry_diverged - 1,
                               record=record, return_state=want_state)

@@ -166,15 +166,16 @@ def _nngp_dense_iW(lvd, alpha_idx, npr):
 
 
 def update_eta_spatial(spec: ModelSpec, data: ModelData, state: GibbsState,
-                       r: int, key, S) -> LevelState:
+                       r: int, key, S, shard=None) -> LevelState:
     lvd, lv, ls = data.levels[r], state.levels[r], spec.levels[r]
     if ls.spatial == "GPP":
-        return _eta_gpp(spec, data, state, r, key, S)
+        return _eta_gpp(spec, data, state, r, key, S, shard)
     npr, nf = ls.n_units, ls.nf_max
     if (ls.spatial == "NNGP" and ls.x_dim == 0
             and npr * nf > _NNGP_DENSE_MAX):
-        return _eta_nngp_cg(spec, data, state, r, key, S)
-    LiSL, F = _masked_level_gram(spec, data, lvd, ls, lv, state.iSigma, S)
+        return _eta_nngp_cg(spec, data, state, r, key, S, shard=shard)
+    LiSL, F = _masked_level_gram(spec, data, lvd, ls, lv, state.iSigma, S,
+                                 shard)
 
     if ls.spatial == "Full":
         iW = _gather_iW(lvd, lv.alpha_idx)        # (nf, np, np)
@@ -199,7 +200,7 @@ def update_eta_spatial(spec: ModelSpec, data: ModelData, state: GibbsState,
 
 
 def _eta_nngp_cg(spec, data, state, r, key, S, tol: float = 1e-5,
-                 maxiter: int = 500):
+                 maxiter: int = 500, shard=None):
     """Matrix-free NNGP Eta draw for large np (see module docstring).
 
     The full-conditional precision is ``P = blkdiag_f(RiW_f' RiW_f) +
@@ -212,7 +213,8 @@ def _eta_nngp_cg(spec, data, state, r, key, S, tol: float = 1e-5,
     """
     lvd, lv, ls = data.levels[r], state.levels[r], spec.levels[r]
     npr, nf = ls.n_units, ls.nf_max
-    LiSL, F = _masked_level_gram(spec, data, lvd, ls, lv, state.iSigma, S)
+    LiSL, F = _masked_level_gram(spec, data, lvd, ls, lv, state.iSigma, S,
+                                 shard)
     lam = lambda_effective(lv)[:, :, 0]               # (nf, ns)
     coef = lvd.nn_coef[lv.alpha_idx]                  # (nf, np, k)
     sqD = jnp.sqrt(lvd.nn_D[lv.alpha_idx])            # (nf, np)
@@ -220,11 +222,16 @@ def _eta_nngp_cg(spec, data, state, r, key, S, tol: float = 1e-5,
 
     k1, k2 = jax.random.split(key)
     eps1 = jax.random.normal(k1, (npr, nf), dtype=F.dtype)
-    xi = jax.random.normal(k2, S.shape, dtype=F.dtype)
+    if shard is None:
+        xi = jax.random.normal(k2, S.shape, dtype=F.dtype)
+    else:
+        xi = shard.normal(k2, (spec.ny, shard.ns), F.dtype, dim=1)
     w = xi * jnp.sqrt(state.iSigma)[None, :]
     if spec.has_na:
         w = w * data.Ymask
     b_like = jax.ops.segment_sum(w @ lam.T, lvd.pi_row, num_segments=npr)
+    if shard is not None:                 # likelihood-noise gram psum
+        b_like = shard.psum(b_like)
     eta, res = vecchia_cg_draw(riw_t, pmv, F, b_like, eps1, x0=lv.Eta,
                                tol=tol, maxiter=maxiter)
     # cg returns its current iterate at maxiter with no signal; a stalled
@@ -236,14 +243,15 @@ def _eta_nngp_cg(spec, data, state, r, key, S, tol: float = 1e-5,
     return lv.replace(Eta=eta)
 
 
-def _eta_gpp(spec, data, state, r, key, S):
+def _eta_gpp(spec, data, state, r, key, S, shard=None):
     """GPP Eta via double Woodbury (reference updateEta.R:148-196):
     precision P = A - M F_blk^{-1} M' with A = per-unit nf x nf blocks
     (factor coupling + diag idD) and M the knot cross terms; sample as
     LiA eps1 + (iA M R_H^{-1}) eps2 which has covariance exactly P^{-1}."""
     lvd, lv, ls = data.levels[r], state.levels[r], spec.levels[r]
     npr, nf, nK = ls.n_units, ls.nf_max, ls.n_knots
-    LiSL, F = _masked_level_gram(spec, data, lvd, ls, lv, state.iSigma, S)
+    LiSL, F = _masked_level_gram(spec, data, lvd, ls, lv, state.iSigma, S,
+                                 shard)
 
     idD = lvd.idDg[lv.alpha_idx]                  # (nf, np)
     alpha0 = (lvd.alphapw[lv.alpha_idx, 0] == 0)  # alpha=0 slots: W=I

@@ -19,8 +19,8 @@ from . import updaters_sel as USel
 from .spatial import update_alpha, update_eta_spatial
 from .structs import GibbsState, ModelData, ModelSpec
 
-__all__ = ["make_sweep", "make_sweep_schedule", "sweep_prologue",
-           "record_sample", "effective_spec_data"]
+__all__ = ["make_sweep", "make_sweep_schedule", "make_sharded_sweep",
+           "sweep_prologue", "record_sample", "effective_spec_data"]
 
 
 def effective_spec_data(spec: ModelSpec, data: ModelData, state: GibbsState):
@@ -59,7 +59,7 @@ def effective_spec_data(spec: ModelSpec, data: ModelData, state: GibbsState):
 # Every block runs strictly after ``sweep_prologue`` (it+1 + key split).
 
 def make_sweep_schedule(spec: ModelSpec, updater: dict | None = None,
-                        adapt_nf: tuple | None = None):
+                        adapt_nf: tuple | None = None, shard=None):
     updater = updater or {}
     on = lambda name: updater.get(name, True) is not False
     adapt_nf = adapt_nf or tuple(0 for _ in range(spec.nr))
@@ -73,6 +73,13 @@ def make_sweep_schedule(spec: ModelSpec, updater: dict | None = None,
     # collapsed updaters are opt-in (see updaters_marginal module docstring);
     # the sampler validates their structural gates before enabling
     want = lambda name: updater.get(name, False) is True
+
+    if shard is not None:
+        from .partition import shard_unsupported_reason
+        reason = shard_unsupported_reason(spec, updater)
+        if reason:
+            raise NotImplementedError(
+                f"species-sharded sweep unsupported for this model: {reason}")
 
     def data_x_of(data, Xeff):
         return data if Xeff is None else data.replace(X=Xeff)
@@ -113,7 +120,7 @@ def make_sweep_schedule(spec: ModelSpec, updater: dict | None = None,
         def _beta_lambda(data, carry, ks):
             state, Xeff, *rest = carry
             state = U.update_beta_lambda(spec_x, data_x_of(data, Xeff),
-                                         state, ks[0])
+                                         state, ks[0], shard=shard)
             return (state, Xeff, *rest)
         add("BetaLambda", _beta_lambda)
 
@@ -132,7 +139,8 @@ def make_sweep_schedule(spec: ModelSpec, updater: dict | None = None,
     if spec.nc_rrr > 0 and on("wRRR"):
         def _w_rrr(data, carry, ks):
             state, Xeff, LRan_total, E_shared = carry
-            state = USel.update_w_rrr(spec, data, state, ks[8], LRan_total)
+            state = USel.update_w_rrr(spec, data, state, ks[8], LRan_total,
+                                      shard=shard)
             Xeff, _ = USel.effective_design(spec, data, state)
             return state, Xeff, LRan_total, E_shared
         add("wRRR", _w_rrr)
@@ -141,7 +149,7 @@ def make_sweep_schedule(spec: ModelSpec, updater: dict | None = None,
         def _beta_sel(data, carry, ks):
             state, Xeff, LRan_total, E_shared = carry
             state = USel.update_beta_sel(spec, data, state, ks[9],
-                                         LRan_total)
+                                         LRan_total, shard=shard)
             Xeff, _ = USel.effective_design(spec, data, state)
             return state, Xeff, LRan_total, E_shared
         add("BetaSel", _beta_sel)
@@ -149,19 +157,22 @@ def make_sweep_schedule(spec: ModelSpec, updater: dict | None = None,
     if on("GammaV"):
         def _gamma_v(data, carry, ks):
             state, *rest = carry
-            return (U.update_gamma_v(spec, data, state, ks[1]), *rest)
+            return (U.update_gamma_v(spec, data, state, ks[1], shard=shard),
+                    *rest)
         add("GammaV", _gamma_v)
 
     if spec.has_phylo and on("Rho"):
         def _rho(data, carry, ks):
             state, *rest = carry
-            return (U.update_rho(spec, data, state, ks[2]), *rest)
+            return (U.update_rho(spec, data, state, ks[2], shard=shard),
+                    *rest)
         add("Rho", _rho)
 
     if on("LambdaPriors"):
         def _lambda_priors(data, carry, ks):
             state, *rest = carry
-            return (U.update_lambda_priors(spec, data, state, ks[3]), *rest)
+            return (U.update_lambda_priors(spec, data, state, ks[3],
+                                           shard=shard), *rest)
         add("LambdaPriors", _lambda_priors)
 
     if spec.nc_rrr > 0 and on("wRRRPriors"):
@@ -185,9 +196,11 @@ def make_sweep_schedule(spec: ModelSpec, updater: dict | None = None,
                         S = S - LRan[q]
                 kr = jax.random.fold_in(ks[4], r)
                 if spec.levels[r].spatial is None:
-                    lv = U.update_eta_nonspatial(spec, data, state, r, kr, S)
+                    lv = U.update_eta_nonspatial(spec, data, state, r, kr, S,
+                                                 shard=shard)
                 else:
-                    lv = update_eta_spatial(spec, data, state, r, kr, S)
+                    lv = update_eta_spatial(spec, data, state, r, kr, S,
+                                            shard=shard)
                 levels = list(state.levels)
                 levels[r] = lv
                 state = state.replace(levels=tuple(levels))
@@ -239,9 +252,11 @@ def make_sweep_schedule(spec: ModelSpec, updater: dict | None = None,
             state, Xeff, LRan_total, E_shared = carry
             kI1, kI2 = jax.random.split(ks[12])
             if on("Interweave"):
-                state = U.interweave_scale(spec, data, state, kI1)
+                state = U.interweave_scale(spec, data, state, kI1,
+                                           shard=shard)
             if on("InterweaveLocation"):
-                state = U.interweave_location(spec, data, state, kI2)
+                state = U.interweave_location(spec, data, state, kI2,
+                                              shard=shard)
             return state, Xeff, LRan_total, E_shared
         add("Interweave", _interweave)
 
@@ -249,7 +264,7 @@ def make_sweep_schedule(spec: ModelSpec, updater: dict | None = None,
         def _inv_sigma(data, carry, ks):
             state, Xeff, LRan_total, E_shared = carry
             state = U.update_inv_sigma(spec_x, data_x_of(data, Xeff), state,
-                                       ks[6], E=E_shared)
+                                       ks[6], E=E_shared, shard=shard)
             return state, Xeff, LRan_total, E_shared
         add("InvSigma", _inv_sigma)
 
@@ -257,7 +272,7 @@ def make_sweep_schedule(spec: ModelSpec, updater: dict | None = None,
         def _z(data, carry, ks):
             state, Xeff, LRan_total, E_shared = carry
             state = U.update_z(spec_x, data_x_of(data, Xeff), state, ks[7],
-                               E=E_shared)
+                               E=E_shared, shard=shard)
             return state, Xeff, LRan_total, E_shared
         add("Z", _z)
 
@@ -269,7 +284,8 @@ def make_sweep_schedule(spec: ModelSpec, updater: dict | None = None,
         def _interweave_da(data, carry, ks):
             state, *rest = carry
             state = U.interweave_da_intercept(
-                spec, data, state, jax.random.fold_in(ks[7], 1))
+                spec, data, state, jax.random.fold_in(ks[7], 1),
+                shard=shard)
             return (state, *rest)
         add("InterweaveDA", _interweave_da)
 
@@ -280,7 +296,8 @@ def make_sweep_schedule(spec: ModelSpec, updater: dict | None = None,
             for r in range(spec.nr):
                 if adapt_nf[r] > 0 and on("Nf"):
                     kr = jax.random.fold_in(ks[5], 1000 + r)
-                    lv_new = U.update_nf(spec, data, state, r, kr)
+                    lv_new = U.update_nf(spec, data, state, r, kr,
+                                         shard=shard)
                     gate = (state.it <= adapt_nf[r])
                     lv_old = state.levels[r]
                     lv = jax.tree.map(
@@ -304,12 +321,12 @@ def sweep_prologue(state: GibbsState, key):
 
 
 def make_sweep(spec: ModelSpec, updater: dict | None = None,
-               adapt_nf: tuple | None = None):
+               adapt_nf: tuple | None = None, shard=None):
     """The production fused sweep: the schedule's blocks folded inline into
     one pure ``(data, state, key) -> state`` function (one traced program;
     XLA fuses across block boundaries exactly as before the schedule
     existed — the committed jaxpr fingerprints pin the op sequence)."""
-    steps = make_sweep_schedule(spec, updater, adapt_nf)
+    steps = make_sweep_schedule(spec, updater, adapt_nf, shard)
 
     def sweep(data: ModelData, state: GibbsState, key) -> GibbsState:
         state, ks = sweep_prologue(state, key)
@@ -321,6 +338,49 @@ def make_sweep(spec: ModelSpec, updater: dict | None = None,
         return carry[0]
 
     return sweep
+
+
+def make_sharded_sweep(spec: ModelSpec, mesh, updater: dict | None = None,
+                       adapt_nf: tuple | None = None,
+                       species_axis: str = "species"):
+    """The species-sharded sweep as a standalone ``shard_map`` program:
+    one pure ``(data, state, key) -> state`` function for a CHAINLESS
+    state, with the in/out PartitionSpecs from :mod:`.partition` made
+    explicit at the boundary.  ``spec`` is the GLOBAL spec; inputs are
+    global arrays placed (or re-placed by jit) per the spec tables.
+
+    This is the program the layer-2 jaxpr audits fingerprint (the
+    collective sequence is part of the committed fingerprint), the
+    comm-bytes ledger walks, and the agreement tests drive; the
+    production segment runner wraps the same body in vmap + scan
+    (``sampler._compiled_runner(mesh=...)``)."""
+    import dataclasses as _dc
+
+    from jax.experimental.shard_map import shard_map
+
+    from .partition import (DATA_SPECIES_DIMS, STATE_SPECIES_DIMS, ShardCtx,
+                            tree_pspecs)
+    from jax.sharding import PartitionSpec as P
+
+    n_sp = int(mesh.shape[species_axis])
+    if spec.ns % n_sp:
+        raise ValueError(f"ns={spec.ns} not divisible by the mesh's "
+                         f"'{species_axis}' extent ({n_sp})")
+    shard = ShardCtx(axis=species_axis, n=n_sp, ns=spec.ns)
+    spec_l = _dc.replace(spec, ns=spec.ns // n_sp)
+    body = make_sweep(spec_l, updater, adapt_nf, shard)
+
+    def sharded(data: ModelData, state: GibbsState, key) -> GibbsState:
+        in_specs = (
+            tree_pspecs(data, spec, species_axis, DATA_SPECIES_DIMS,
+                        x_is_list=spec.x_is_list),
+            tree_pspecs(state, spec, species_axis, STATE_SPECIES_DIMS),
+            P())
+        return shard_map(body, mesh=mesh, in_specs=in_specs,
+                         out_specs=in_specs[1], check_rep=False)(
+                             data, state, key)
+
+    return sharded
 
 
 # ---------------------------------------------------------------------------

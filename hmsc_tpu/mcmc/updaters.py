@@ -111,18 +111,24 @@ def _unstack_lambda(spec: ModelSpec, BL: jnp.ndarray, state: GibbsState):
 # ---------------------------------------------------------------------------
 
 def update_z(spec: ModelSpec, data: ModelData, state: GibbsState, key,
-             E=None) -> GibbsState:
+             E=None, shard=None) -> GibbsState:
     """Latent-response data augmentation: normal copies Y, probit draws
     truncated normals for the whole ny x ns block at once, (lognormal-)Poisson
     uses Polya-Gamma augmentation of the NB(r=1000) limit; NA cells are imputed
     from the linear predictor.  ``E`` may pass in the current linear predictor
     (the sweep shares one total_loading across its tail — the small-K matmuls
-    are MXU-padding-bound, so recomputes are pure waste)."""
+    are MXU-padding-bound, so recomputes are pure waste).
+
+    ``shard`` (a :class:`~hmsc_tpu.mcmc.partition.ShardCtx`) runs the
+    species-sharded variant: all compute is local to the shard's species
+    columns, with every random draw taken at the GLOBAL width and sliced —
+    see the partition module docstring for the draw-equality contract."""
     if E is None:
         E = total_loading(spec, data, state)
     std = state.iSigma[None, :] ** -0.5
     fam = data.distr_family[None, :]
     k_tn, k_pg, k_pg2, k_na = jax.random.split(key, 4)
+    full = (spec.ny, spec.ns if shard is None else shard.ns)
 
     Z = state.Z
     if spec.any_normal:
@@ -130,21 +136,46 @@ def update_z(spec: ModelSpec, data: ModelData, state: GibbsState, key,
     if spec.any_probit:
         # probit truncation is always one-sided (Y=1 -> Z>0, Y=0 -> Z<0), so
         # the specialised op spends 1 ndtr + 1 ndtri per cell instead of 2+1
-        z_tn = truncated_normal_onesided(k_tn, 0.0, data.Y > 0.5, E, std)
+        from ..ops.rand import _TINY
+        if shard is None:
+            z_tn = truncated_normal_onesided(k_tn, 0.0, data.Y > 0.5, E, std)
+        else:
+            u = shard.uniform(k_tn, full, E.dtype, dim=1, minval=_TINY,
+                              maxval=1.0)
+            # _u pre-drawn from k_tn above; the op only transforms it
+            # hmsc: ignore[rng-key-reuse]
+            z_tn = truncated_normal_onesided(k_tn, 0.0, data.Y > 0.5, E,
+                                             std, _u=u)
         Z = jnp.where(fam == 2, z_tn, Z)
     if spec.any_poisson:
         logr = jnp.log(_NB_R)
-        w = polya_gamma(k_pg, data.Y + _NB_R, state.Z - logr)
+        if shard is None:
+            w = polya_gamma(k_pg, data.Y + _NB_R, state.Z - logr)
+        else:
+            eps_pg = shard.normal(k_pg, full, E.dtype, dim=1)
+            # _eps pre-drawn from k_pg above; the op only transforms it
+            # hmsc: ignore[rng-key-reuse]
+            w = polya_gamma(k_pg, data.Y + _NB_R, state.Z - logr,
+                            _eps=eps_pg)
         prec = state.iSigma[None, :]
         s2 = 1.0 / (prec + w)
         mu = s2 * ((data.Y - _NB_R) / 2.0 + prec * (E - logr)) + logr
-        z_p = mu + jnp.sqrt(s2) * jax.random.normal(k_pg2, mu.shape, dtype=mu.dtype)
+        if shard is None:
+            z_p = mu + jnp.sqrt(s2) * jax.random.normal(k_pg2, mu.shape,
+                                                        dtype=mu.dtype)
+        else:
+            z_p = mu + jnp.sqrt(s2) * shard.normal(k_pg2, full, mu.dtype,
+                                                   dim=1)
         # NaN guard: keep the previous Z for any non-finite cell (reference
         # prints "Fail in Poisson Z update" and aborts the cell, updateZ.R:84-86)
         z_p = jnp.where(jnp.isfinite(z_p), z_p, state.Z)
         Z = jnp.where(fam == 3, z_p, Z)
     if spec.has_na:
-        z_na = E + std * jax.random.normal(k_na, E.shape, dtype=E.dtype)
+        if shard is None:
+            eps_na = jax.random.normal(k_na, E.shape, dtype=E.dtype)
+        else:
+            eps_na = shard.normal(k_na, full, E.dtype, dim=1)
+        z_na = E + std * eps_na
         Z = jnp.where(data.Ymask > 0, Z, z_na)
     return state.replace(Z=Z)
 
@@ -154,7 +185,7 @@ def update_z(spec: ModelSpec, data: ModelData, state: GibbsState, key,
 # ---------------------------------------------------------------------------
 
 def update_beta_lambda(spec: ModelSpec, data: ModelData, state: GibbsState,
-                       key) -> GibbsState:
+                       key, shard=None) -> GibbsState:
     """Joint (Beta, Lambda) draw.
 
     No phylogeny: the reference's per-species (nc+K)^2 cholesky loop becomes one
@@ -168,10 +199,10 @@ def update_beta_lambda(spec: ModelSpec, data: ModelData, state: GibbsState,
     distribution, TPU-sized factorisations.
     """
     if not spec.has_phylo:
-        return _beta_lambda_joint(spec, data, state, key)
+        return _beta_lambda_joint(spec, data, state, key, shard)
     k1, k2 = jax.random.split(key)
-    state = _lambda_given_beta(spec, data, state, k1)
-    state = _beta_given_lambda_phylo(spec, data, state, k2)
+    state = _lambda_given_beta(spec, data, state, k1, shard)
+    state = _beta_given_lambda_phylo(spec, data, state, k2, shard)
     return state
 
 
@@ -190,7 +221,7 @@ def _per_species_design_gram(spec, data, XE, mask):
     return jnp.broadcast_to(G, (spec.ns,) + G.shape)
 
 
-def _beta_lambda_joint(spec, data, state, key):
+def _beta_lambda_joint(spec, data, state, key, shard=None):
     P = spec.nc + spec.nf_total
     XE_factor = eta_star(spec, data, state)
     if spec.x_is_list:
@@ -229,13 +260,16 @@ def _beta_lambda_joint(spec, data, state, key):
         [Mu_beta, jnp.zeros((spec.nf_total, spec.ns), dtype=G.dtype)], axis=0)  # (P, ns)
     rhs = jnp.einsum("jpq,qj->jp", P0, mu0) + state.iSigma[:, None] * rhs_lik
 
-    eps = jax.random.normal(key, (spec.ns, P), dtype=G.dtype)
+    if shard is None:
+        eps = jax.random.normal(key, (spec.ns, P), dtype=G.dtype)
+    else:
+        eps = shard.normal(key, (shard.ns, P), G.dtype, dim=0)
     BL = sample_mvn_prec_batched(prec, rhs, eps)          # (ns, P)
     Beta, levels = _unstack_lambda(spec, BL.T, state)
     return state.replace(Beta=Beta, levels=levels)
 
 
-def _lambda_given_beta(spec, data, state, key):
+def _lambda_given_beta(spec, data, state, key, shard=None):
     """Lambda | Beta, Z: per-species batched K x K solves."""
     K = spec.nf_total
     if K == 0:
@@ -254,20 +288,29 @@ def _lambda_given_beta(spec, data, state, key):
     prec = state.iSigma[:, None, None] * G \
         + jnp.eye(K, dtype=G.dtype)[None] * prior_lam.T[:, :, None]
     rhs = state.iSigma[:, None] * rhs_lik
-    eps = jax.random.normal(key, (spec.ns, K), dtype=G.dtype)
+    if shard is None:
+        eps = jax.random.normal(key, (spec.ns, K), dtype=G.dtype)
+    else:
+        eps = shard.normal(key, (shard.ns, K), G.dtype, dim=0)
     Lam = sample_mvn_prec_batched(prec, rhs, eps)         # (ns, K)
     _, levels = _unstack_lambda(
         spec, jnp.concatenate([state.Beta, Lam.T], axis=0), state)
     return state.replace(levels=levels)
 
 
-def _beta_given_lambda_phylo(spec, data, state, key):
+def _beta_given_lambda_phylo(spec, data, state, key, shard=None):
     """Beta | Lambda, Z under the matrix-normal prior MN(Gamma Tr', V, Q(rho)).
 
     Fast path (homoskedastic fixed sigma, no NAs, shared X): simultaneous
     diagonalisation — iQ = U diag(1/e) U' (precomputed eigenbasis) and a
     generalised nc x nc eigensolve of (X'X, iV) decouple every coefficient;
     the draw is elementwise (SURVEY.md §7 point 3).
+
+    Sharded: ``data.U`` is row-sharded, so the eigenbasis projection
+    ``(XW' R0) @ U`` is a partial product psum'd to the full (nc, ns)
+    coefficient table (replicated draw), and the back-projection
+    ``Gt @ U.T`` lands directly on the local species columns.  The dense
+    general path has no sharded formulation (the sampler gates it).
     """
     S = state.Z - sum(level_loading(data.levels[r], state.levels[r])
                       for r in range(spec.nr)) if spec.nr else state.Z
@@ -285,6 +328,8 @@ def _beta_given_lambda_phylo(spec, data, state, key):
         XW = data.X @ Wm
         R0 = S - data.X @ M
         T = (XW.T @ R0) @ data.U                          # (nc, ns)
+        if shard is not None:
+            T = shard.psum(T)
         prec = 1.0 / e[None, :] + isig * g[:, None]
         mean = (isig * T) / prec
         eps = jax.random.normal(key, mean.shape, dtype=mean.dtype)
@@ -293,6 +338,10 @@ def _beta_given_lambda_phylo(spec, data, state, key):
         return state.replace(Beta=Beta)
 
     # general dense (nc*ns) system, species-major vec ordering
+    if shard is not None:
+        raise NotImplementedError(
+            "the dense phylogenetic Beta path has no sharded formulation "
+            "(the sampler's shard gate should have caught this model class)")
     nc, ns = spec.nc, spec.ns
     iQ = (data.U / e[None, :]) @ data.U.T                 # (ns, ns)
     if spec.x_is_list:
@@ -321,9 +370,12 @@ def _beta_given_lambda_phylo(spec, data, state, key):
 # updateGammaV / updateRho (reference R/updateGammaV.R, R/updateRho.R)
 # ---------------------------------------------------------------------------
 
-def _phylo_trq(spec, data, state):
+def _phylo_trq(spec, data, state, shard=None):
     """(TrQ = iQ Tr, TtQT = Tr' iQ Tr) in the phylo eigenbasis (identity
-    weights without phylogeny)."""
+    weights without phylogeny).  Sharded: ``data.UTr``/``Qeig`` ride in at
+    full width (replicated), so ``TtQT`` is replicated compute; ``TrQ``'s
+    rows land local through the row-sharded ``data.U``; the non-phylo
+    ``Tr' Tr`` gram is a psum."""
     if spec.has_phylo:
         e = data.Qeig[state.rho_idx]
         se = jnp.sqrt(e)
@@ -333,17 +385,22 @@ def _phylo_trq(spec, data, state):
     else:
         TrQ = data.Tr
         TtQT = data.Tr.T @ data.Tr
+        if shard is not None:
+            TtQT = shard.psum(TtQT)
     return TrQ, TtQT
 
 
 def gamma_given_beta(spec: ModelSpec, data: ModelData, state: GibbsState,
-                     key) -> GibbsState:
+                     key, shard=None) -> GibbsState:
     """Gamma | Beta, iV: Gaussian full conditional with precision
     iUGamma + kron(Tr' iQ Tr, iV) (reference updateGammaV.R:30-32)."""
-    TrQ, TtQT = _phylo_trq(spec, data, state)
+    TrQ, TtQT = _phylo_trq(spec, data, state, shard)
     prec = data.iUGamma + jnp.kron(TtQT, state.iV)
-    rhs = data.iUGamma @ data.mGamma \
-        + ((state.iV @ state.Beta) @ TrQ).T.reshape(-1)
+    rhs0 = data.iUGamma @ data.mGamma     # (trace order matches the
+    t2 = (state.iV @ state.Beta) @ TrQ    # historical one-liner)
+    if shard is not None:                 # cross-species contraction
+        t2 = shard.psum(t2)
+    rhs = rhs0 + t2.T.reshape(-1)
     L = chol_spd(prec)
     eps = jax.random.normal(key, rhs.shape, dtype=rhs.dtype)
     gvec = sample_mvn_prec(L, rhs, eps)
@@ -351,10 +408,12 @@ def gamma_given_beta(spec: ModelSpec, data: ModelData, state: GibbsState,
 
 
 def update_gamma_v(spec: ModelSpec, data: ModelData, state: GibbsState,
-                   key) -> GibbsState:
+                   key, shard=None) -> GibbsState:
     """Conjugate draws: iV ~ Wishart(f0+ns, (E iQ E' + V0)^{-1}), then Gamma
     from its Gaussian full conditional with precision iUGamma +
-    kron(Tr' iQ Tr, iV)."""
+    kron(Tr' iQ Tr, iV).  Sharded: the ``B``-products (E iQ E', the
+    classic cross-species reduction) psum to a replicated (nc, nc) gram;
+    the Wishart/Gaussian draws then run replicated on every shard."""
     kv, kg = jax.random.split(key)
     E = state.Beta - state.Gamma @ data.Tr.T
     if spec.has_phylo:
@@ -362,24 +421,34 @@ def update_gamma_v(spec: ModelSpec, data: ModelData, state: GibbsState,
         se = jnp.sqrt(e)
         # sqrt-split the 1/e weights so f32 intermediates stay ~1/sqrt(e_min)
         # and the Gram products are exactly symmetric PSD
-        Et = (E @ data.U) / se[None, :]
+        if shard is None:
+            Et = (E @ data.U) / se[None, :]
+        else:
+            Et = shard.psum(E @ data.U) / se[None, :]
         A = Et @ Et.T
     else:
         A = E @ E.T
+        if shard is not None:
+            A = shard.psum(A)
 
+    ns_g = spec.ns if shard is None else shard.ns
     Lw = chol_spd(A + data.V0)
     T = solve_triangular(Lw.T,
                          jnp.eye(spec.nc, dtype=A.dtype), lower=False)  # T T' = (A+V0)^{-1}
-    iV = wishart(kv, spec.f0 + spec.ns, T)
-    return gamma_given_beta(spec, data, state.replace(iV=iV), kg)
+    iV = wishart(kv, spec.f0 + ns_g, T)
+    return gamma_given_beta(spec, data, state.replace(iV=iV), kg, shard)
 
 
 def update_rho(spec: ModelSpec, data: ModelData, state: GibbsState,
-               key) -> GibbsState:
+               key, shard=None) -> GibbsState:
     """Discrete-grid draw of the phylogenetic mixing rho: quadratic forms of
-    E in C's eigenbasis make all 101 grid evaluations one matvec."""
+    E in C's eigenbasis make all 101 grid evaluations one matvec.  Sharded:
+    one psum completes the eigenbasis projection; the grid scan then runs
+    replicated at full width (``Qeig`` is replicated data)."""
     E = state.Beta - state.Gamma @ data.Tr.T
     Et = E @ data.U                                        # (nc, ns)
+    if shard is not None:
+        Et = shard.psum(Et)
     q = jnp.einsum("cj,cd,dj->j", Et, state.iV, Et)        # (ns,)
     v = (q[None, :] / data.Qeig).sum(axis=1)               # (G,)
     loglike = jnp.log(data.rhopw[:, 1]) - 0.5 * spec.nc * data.logdetQ - 0.5 * v
@@ -392,10 +461,14 @@ def update_rho(spec: ModelSpec, data: ModelData, state: GibbsState,
 # ---------------------------------------------------------------------------
 
 def update_lambda_priors(spec: ModelSpec, data: ModelData, state: GibbsState,
-                         key) -> GibbsState:
+                         key, shard=None) -> GibbsState:
     """Multiplicative-gamma shrinkage: psi elementwise conjugate gamma, delta
     sequential over factor index with tau recomputed per step
-    (Bhattacharya-Dunson).  Inactive slots stay neutral (delta=1)."""
+    (Bhattacharya-Dunson).  Inactive slots stay neutral (delta=1).
+    Sharded: the psi gamma noise is species-free-parameterised, so it is
+    drawn full-width and sliced; the delta tail sums psum; delta itself
+    stays replicated."""
+    ns_g = spec.ns if shard is None else shard.ns
     new_levels = []
     for r in range(spec.nr):
         lvd, lv = data.levels[r], state.levels[r]
@@ -408,20 +481,28 @@ def update_lambda_priors(spec: ModelSpec, data: ModelData, state: GibbsState,
 
         a_psi = lvd.nu[None, None, :] / 2 + 0.5
         b_psi = lvd.nu[None, None, :] / 2 + 0.5 * lam2 * tau[:, None, :]
-        psi = standard_gamma(kpsi, jnp.broadcast_to(a_psi, lam2.shape)) / b_psi
+        if shard is None:
+            psi = standard_gamma(
+                kpsi, jnp.broadcast_to(a_psi, lam2.shape)) / b_psi
+        else:
+            g_full = standard_gamma(kpsi, jnp.broadcast_to(
+                a_psi, (ls.nf_max, ns_g, ls.ncr)))
+            psi = shard.slice_sp(g_full, 1) / b_psi
 
         M = psi * lam2                                      # (nf, ns, ncr)
         Msum = M.sum(axis=1)                                # (nf, ncr)
+        if shard is not None:
+            Msum = shard.psum(Msum)
         nf_act = mask.sum()
         n_geq = jnp.cumsum(mask[::-1])[::-1]                # active factors >= h
         keys = jax.random.split(kdel, ls.nf_max)
         for h in range(ls.nf_max):
             tau = jnp.cumprod(delta, axis=0)
             if h == 0:
-                ad = lvd.a1 + 0.5 * spec.ns * nf_act
+                ad = lvd.a1 + 0.5 * ns_g * nf_act
                 b0 = lvd.b1
             else:
-                ad = lvd.a2 + 0.5 * spec.ns * n_geq[h]
+                ad = lvd.a2 + 0.5 * ns_g * n_geq[h]
                 b0 = lvd.b2
             tail = (tau[h:] * Msum[h:] * mask[h:, None]).sum(axis=0)
             bd = b0 + 0.5 * tail / delta[h]
@@ -435,21 +516,30 @@ def update_lambda_priors(spec: ModelSpec, data: ModelData, state: GibbsState,
 # updateEta, non-spatial (reference R/updateEta.R:44-109)
 # ---------------------------------------------------------------------------
 
-def _masked_level_gram(spec, data, lvd, ls, lv, iSigma, S):
+def _masked_level_gram(spec, data, lvd, ls, lv, iSigma, S, shard=None):
     """Per-unit factor precision contributions and RHS:
-    returns (LiSL (np, nf, nf), F (np, nf))."""
+    returns (LiSL (np, nf, nf), F (np, nf)).  Sharded: both are
+    cross-species reductions (the factor grams), completed by explicit
+    psums; the (np, nf)-shaped outputs are then replicated on every
+    shard — exactly what the per-unit Eta solves need."""
     npr, nf = ls.n_units, ls.nf_max
     if ls.x_dim == 0:
         lam = lambda_effective(lv)[:, :, 0]                # (nf, ns)
         if spec.has_na:
             rows = jnp.einsum("fj,gj,j,ij->ifg", lam, lam, iSigma, data.Ymask)
             LiSL = jax.ops.segment_sum(rows, lvd.pi_row, num_segments=npr)
+            if shard is not None:
+                LiSL = shard.psum(LiSL)
             Fr = (S * iSigma[None, :] * data.Ymask) @ lam.T
         else:
             shared = (lam * iSigma[None, :]) @ lam.T
+            if shard is not None:
+                shared = shard.psum(shared)
             LiSL = lvd.unit_count[:, None, None] * shared[None]
             Fr = (S * iSigma[None, :]) @ lam.T
         F = jax.ops.segment_sum(Fr, lvd.pi_row, num_segments=npr)
+        if shard is not None:
+            F = shard.psum(F)
         return LiSL, F
     lam = lambda_effective(lv)                              # (nf, ns, ncr)
     lam_u = jnp.einsum("fjk,uk->ufj", lam, lvd.x_unit)      # (np, nf, ns)
@@ -458,14 +548,20 @@ def _masked_level_gram(spec, data, lvd, ls, lv, iSigma, S):
     T = jax.ops.segment_sum(S * iSigma[None, :] * data.Ymask, lvd.pi_row,
                             num_segments=npr)
     F = jnp.einsum("uj,ufj->uf", T, lam_u)
+    if shard is not None:
+        LiSL = shard.psum(LiSL)
+        F = shard.psum(F)
     return LiSL, F
 
 
-def update_eta_nonspatial(spec, data, state, r: int, key, S):
+def update_eta_nonspatial(spec, data, state, r: int, key, S, shard=None):
     """Eta_r | rest for one unstructured level: per-unit nf x nf batched
-    cholesky; inactive factors fall back to their N(0,1) prior."""
+    cholesky; inactive factors fall back to their N(0,1) prior.  Sharded:
+    the grams psum; the (np, nf) draw is species-free, so it runs
+    replicated on every shard."""
     lvd, lv, ls = data.levels[r], state.levels[r], spec.levels[r]
-    LiSL, F = _masked_level_gram(spec, data, lvd, ls, lv, state.iSigma, S)
+    LiSL, F = _masked_level_gram(spec, data, lvd, ls, lv, state.iSigma, S,
+                                 shard)
     prec = LiSL + jnp.eye(ls.nf_max, dtype=F.dtype)[None]
     eps = jax.random.normal(key, F.shape, dtype=F.dtype)
     eta = sample_mvn_prec_batched(prec, F, eps)             # (np, nf)
@@ -490,7 +586,7 @@ def _eta_prior_quad(lvd, lv, ls) -> jnp.ndarray:
 
 
 def interweave_scale(spec: ModelSpec, data: ModelData, state: GibbsState,
-                     key) -> GibbsState:
+                     key, shard=None) -> GibbsState:
     """Per-factor scale move (Eta_h, Lambda_h) -> (c Eta_h, Lambda_h / c).
 
     The likelihood depends only on the product, so the Metropolis target is
@@ -512,10 +608,13 @@ def interweave_scale(spec: ModelSpec, data: ModelData, state: GibbsState,
         delta = jnp.where(mask[:, None] > 0, lv.Delta, 1.0)
         tau = jnp.cumprod(delta, axis=0)                  # (nf, ncr)
         B = (lv.Psi * tau[:, None, :] * lv.Lambda ** 2).sum(axis=(1, 2))
-        k_exp = ls.n_units - spec.ns * ls.ncr
+        if shard is not None:             # cross-species prior-mass sum
+            B = shard.psum(B)
+        ns_g = spec.ns if shard is None else shard.ns
+        k_exp = ls.n_units - ns_g * ls.ncr
         # float(): a bare np.float64 scalar is strong-typed and would
         # upcast the whole proposal under an x64 config
-        sigma = float(2.38 / np.sqrt(2.0 * (ls.n_units + spec.ns * ls.ncr)))
+        sigma = float(2.38 / np.sqrt(2.0 * (ls.n_units + ns_g * ls.ncr)))
         u = sigma * jax.random.normal(kr1, (ls.nf_max,), dtype=A.dtype)
         c = jnp.exp(u)
         log_acc = (-0.5 * A * (c ** 2 - 1.0)
@@ -544,7 +643,7 @@ def location_gate(spec: ModelSpec, has_intercept: bool) -> str | None:
 
 
 def interweave_location(spec: ModelSpec, data: ModelData, state: GibbsState,
-                        key) -> GibbsState:
+                        key, shard=None) -> GibbsState:
     """Per-factor location move (Eta_h, Beta_int) -> (Eta_h + c_h 1,
     Beta_int,j - c_h Lambda_hj): exact Gibbs along the likelihood-invariant
     translation orbit (generalized Gibbs with a translation group — Haar is
@@ -594,14 +693,22 @@ def interweave_location(spec: ModelSpec, data: ModelData, state: GibbsState,
         else:
             from .spatial import eta_ones_forms_at
             q1, s = eta_ones_forms_at(lvd, ls, lv.Eta, lv.alpha_idx)
-        if spec.has_phylo:
+        if spec.has_phylo and shard is None:
             e = data.Qeig[state.rho_idx]                  # (ns,)
             lamU = lam @ data.U
             G = (lamU / e[None, :]) @ lamU.T              # Lam iQ Lam'
             bB = (lamU / e[None, :]) @ (data.U.T @ u)
-        else:
+        elif spec.has_phylo:
+            e = data.Qeig[state.rho_idx]
+            lamU = shard.psum(lam @ data.U)               # projections psum
+            G = (lamU / e[None, :]) @ lamU.T
+            bB = (lamU / e[None, :]) @ shard.psum(data.U.T @ u)
+        elif shard is None:
             G = lam @ lam.T
             bB = lam @ u
+        else:
+            G = shard.psum(lam @ lam.T)
+            bB = shard.psum(lam @ u)
         P = v00 * G + jnp.diag(jnp.where(mask > 0, q1, 1.0))
         b = jnp.where(mask > 0, bB - s, 0.0)
         L = chol_spd(P)
@@ -636,7 +743,7 @@ def da_intercept_gate(spec: ModelSpec, has_intercept: bool) -> str | None:
 
 
 def interweave_da_intercept(spec: ModelSpec, data: ModelData,
-                            state: GibbsState, key) -> GibbsState:
+                            state: GibbsState, key, shard=None) -> GibbsState:
     """ASIS flip of the probit data augmentation for the intercept row:
     redraw ``Beta[int, j]`` with the *residual* ``R = Z - Beta[int]`` held
     fixed instead of ``Z`` itself (ancillary augmentation), then rebuild
@@ -677,7 +784,16 @@ def interweave_da_intercept(spec: ModelSpec, data: ModelData,
     Mu = jnp.einsum("ct,jt->cj", state.Gamma, data.Tr)
     u = state.iV[ii] @ (state.Beta - Mu)              # (ns,)
     v00 = state.iV[ii, ii]
-    t = truncated_normal(key, lo, hi, mean=b0 - u / v00, std=v00 ** -0.5)
+    if shard is None:
+        t = truncated_normal(key, lo, hi, mean=b0 - u / v00, std=v00 ** -0.5)
+    else:
+        # the (ns,) truncation bounds are tiny: gather them, draw the
+        # full-width truncated normal replicated, keep the local slice —
+        # bit-identical to the replicated draw
+        t_full = truncated_normal(
+            key, shard.gather_sp(lo, 0), shard.gather_sp(hi, 0),
+            mean=shard.gather_sp(b0 - u / v00, 0), std=v00 ** -0.5)
+        t = shard.slice_sp(t_full, 0)
     t = jnp.where(prob, t, b0)
     Z = jnp.where(prob[None, :], R + t[None, :], state.Z)
     return state.replace(Z=Z, Beta=state.Beta.at[ii].set(t))
@@ -688,14 +804,20 @@ def interweave_da_intercept(spec: ModelSpec, data: ModelData,
 # ---------------------------------------------------------------------------
 
 def update_inv_sigma(spec: ModelSpec, data: ModelData, state: GibbsState,
-                     key, E=None) -> GibbsState:
+                     key, E=None, shard=None) -> GibbsState:
     if not spec.any_estimated_sigma:
         return state
     Eps = state.Z - (total_loading(spec, data, state) if E is None else E)
     n_obs = data.Ymask.sum(axis=0)
     shape = data.aSigma + 0.5 * n_obs
     rate = data.bSigma + 0.5 * ((Eps * data.Ymask) ** 2).sum(axis=0)
-    draw = standard_gamma(key, shape) / rate
+    if shard is None:
+        draw = standard_gamma(key, shape) / rate
+    else:
+        # gamma shapes are species-dependent: gather the tiny (ns,) shape
+        # vector, draw full-width replicated, slice — bit-identical
+        draw = shard.slice_sp(
+            standard_gamma(key, shard.gather_sp(shape, 0)), 0) / rate
     iSigma = jnp.where(data.distr_estsig > 0, draw, 1.0 / data.sigma_fixed)
     return state.replace(iSigma=iSigma)
 
@@ -705,11 +827,14 @@ def update_inv_sigma(spec: ModelSpec, data: ModelData, state: GibbsState,
 # ---------------------------------------------------------------------------
 
 def update_nf(spec: ModelSpec, data: ModelData, state: GibbsState, r: int,
-              key) -> LevelState:
+              key, shard=None) -> LevelState:
     """Burn-in factor adaptation as pure mask arithmetic: with probability
     1/exp(1 + 5e-4 iter) either appends one factor (fresh prior draws in the
     next inactive slot) or drops all-shrunk factors (stable compaction permute
-    so the active block stays a prefix)."""
+    so the active block stays a prefix).  Sharded: the shrunk-proportion
+    statistics psum exact integer counts (bit-identical), the fresh psi
+    column draws full-width-and-slices, and the grow/drop decision stays
+    replicated on every shard."""
     lvd, lv, ls = data.levels[r], state.levels[r], spec.levels[r]
     ku, kadd = jax.random.split(jax.random.fold_in(key, r))
     k_eta, k_psi, k_del = jax.random.split(kadd, 3)
@@ -720,7 +845,13 @@ def update_nf(spec: ModelSpec, data: ModelData, state: GibbsState, r: int,
     mask = lv.nf_mask
     nf = mask.sum()
     eps_thr = 1e-3
-    small_prop = (jnp.abs(lv.Lambda) < eps_thr).mean(axis=(1, 2))
+    if shard is None:
+        small_prop = (jnp.abs(lv.Lambda) < eps_thr).mean(axis=(1, 2))
+    else:
+        cnt = shard.psum(
+            (jnp.abs(lv.Lambda) < eps_thr).sum(axis=(1, 2))
+            .astype(lv.Lambda.dtype))
+        small_prop = cnt / float(shard.ns * ls.ncr)
     redundant = (mask > 0) & (small_prop >= 1.0)
     num_red = redundant.sum()
 
@@ -746,8 +877,13 @@ def update_nf(spec: ModelSpec, data: ModelData, state: GibbsState, r: int,
     sel = jnp.where(do_add, onehot, 0.0)
     new_eta_col = jax.random.normal(k_eta, (ls.n_units,), dtype=lv.Eta.dtype)
     Eta = lv.Eta * (1 - sel)[None, :] + new_eta_col[:, None] * sel[None, :]
-    new_psi = standard_gamma(k_psi, jnp.broadcast_to(
-        lvd.nu[None, :] / 2, (spec.ns, ls.ncr))) / (lvd.nu[None, :] / 2)
+    if shard is None:
+        new_psi = standard_gamma(k_psi, jnp.broadcast_to(
+            lvd.nu[None, :] / 2, (spec.ns, ls.ncr))) / (lvd.nu[None, :] / 2)
+    else:
+        new_psi = shard.slice_sp(standard_gamma(k_psi, jnp.broadcast_to(
+            lvd.nu[None, :] / 2, (shard.ns, ls.ncr))), 0) \
+            / (lvd.nu[None, :] / 2)
     Psi = lv.Psi * (1 - sel)[:, None, None] \
         + new_psi[None] * sel[:, None, None]
     new_del = standard_gamma(k_del, lvd.a2) / lvd.b2

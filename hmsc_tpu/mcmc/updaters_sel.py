@@ -69,7 +69,7 @@ def effective_design(spec: ModelSpec, data: ModelData, state: GibbsState):
 # ---------------------------------------------------------------------------
 
 def update_w_rrr(spec: ModelSpec, data: ModelData, state: GibbsState,
-                 key, LRan_total) -> GibbsState:
+                 key, LRan_total, shard=None) -> GibbsState:
     """GLS draw of the reduced-rank projection weights wRRR | rest: precision
     kron(XRRR'XRRR, B_rrr diag(iSigma) B_rrr') + diag(vec(Psi*tau)), with the
     reference's column-major vec layout on the (nc_rrr, nc_orrr) matrix."""
@@ -92,11 +92,17 @@ def update_w_rrr(spec: ModelSpec, data: ModelData, state: GibbsState,
     S = state.Z - LFix - LRan_total
 
     A1 = (BetaR * state.iSigma[None, :]) @ BetaR.T        # (ncr, ncr)
+    if shard is not None:                 # cross-species B-products psum
+        A1 = shard.psum(A1)
     A2 = data.XRRRs.T @ data.XRRRs                        # (nco, nco)
     tau = jnp.cumprod(state.DeltaRRR)                     # (ncr,)
     prior_prec = (state.PsiRRR * tau[:, None]).T.reshape(-1)  # col-major vec
     prec = jnp.kron(A2, A1) + jnp.diag(prior_prec)
-    mu1 = ((BetaR * state.iSigma[None, :]) @ S.T @ data.XRRRs)  # (ncr, nco)
+    if shard is None:
+        mu1 = ((BetaR * state.iSigma[None, :]) @ S.T @ data.XRRRs)
+    else:
+        mu1 = shard.psum(
+            (BetaR * state.iSigma[None, :]) @ S.T) @ data.XRRRs
     rhs = mu1.T.reshape(-1)                               # col-major vec
     L = chol_spd(prec)
     eps = jax.random.normal(key, rhs.shape, dtype=rhs.dtype)
@@ -138,7 +144,7 @@ def update_w_rrr_priors(spec: ModelSpec, data: ModelData, state: GibbsState,
 # ---------------------------------------------------------------------------
 
 def update_beta_sel(spec: ModelSpec, data: ModelData, state: GibbsState,
-                    key, LRan_total) -> GibbsState:
+                    key, LRan_total, shard=None) -> GibbsState:
     """Metropolis flip of each (selection, species-group) inclusion switch.
     Group and selection counts are static, so the flips unroll at trace time;
     each proposal's likelihood delta is one masked whole-array reduction."""
@@ -175,6 +181,8 @@ def update_beta_sel(spec: ModelSpec, data: ModelData, state: GibbsState,
             delta = Lg * in_g[None, :]
             Enew = E + jnp.where(cur, -1.0, 1.0) * delta
             lldif = ((logdens(Enew) - logdens(E)) * in_g[None, :]).sum()
+            if shard is not None:         # cross-species likelihood delta
+                lldif = shard.psum(lldif)
             q = data.sel_q[i][g]
             pridif = jnp.where(cur, jnp.log1p(-q) - jnp.log(q),
                                jnp.log(q) - jnp.log1p(-q))

@@ -38,8 +38,8 @@ import argparse
 import json
 import os
 
-__all__ = ["LEDGER_PATH", "build_cost_ledger", "ledger_digest",
-           "load_ledger", "save_ledger", "diff_ledger",
+__all__ = ["LEDGER_PATH", "build_cost_ledger", "build_shard_ledger",
+           "ledger_digest", "load_ledger", "save_ledger", "diff_ledger",
            "measure_updaters", "profile_main", "CANONICAL_MODELS"]
 
 LEDGER_PATH = os.path.join(os.path.dirname(__file__), "cost_ledger.json")
@@ -82,6 +82,123 @@ def _cost_entry(compiled) -> dict:
 
 def _keep(name: str, only) -> bool:
     return not only or any(s in name for s in only)
+
+
+def _carry_pspecs(carry, spec, species_axis):
+    """PartitionSpecs for a block-chain carry (state, Xeff, LRan_total,
+    E_shared): the state from the committed table, the aux linear-predictor
+    arrays by shape (ny, ns) -> species on dim 1, a per-species design
+    list -> dim 0."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..mcmc.partition import STATE_SPECIES_DIMS, tree_pspecs
+    state, Xeff, LRan, E = carry
+    st = tree_pspecs(state, spec, species_axis, STATE_SPECIES_DIMS)
+
+    def aux(a):
+        if a is None or not hasattr(a, "ndim"):
+            return None
+        if a.ndim == 3 and a.shape[0] == spec.ns:
+            return P(species_axis, None, None)
+        if a.ndim == 2 and a.shape == (spec.ny, spec.ns):
+            return P(None, species_axis)
+        return P(*([None] * a.ndim))
+
+    return (st, aux(Xeff), aux(LRan), aux(E))
+
+
+def build_shard_ledger(devices: int = 8, models=None, only=None) -> dict:
+    """Sharded-sweep ledger programs: every schedule block of each
+    canonical spec's ns-divisible variant, individually ``shard_map``'d
+    over an emulated ``devices``-way species mesh with the committed
+    in/out PartitionSpecs, compiled, and walked for its collective bytes.
+
+    Entries are named ``<model>/shard<devices>:block:<name>`` (plus a
+    whole ``:sweep``) and carry the usual XLA cost/memory columns — all
+    PER-DEVICE under SPMD, so ``arg/temp`` bytes directly show the
+    ~1/shards state shrink — plus ``comm_bytes``/``collectives``: the
+    per-device bytes entering psum/all_gather per sweep, statically
+    walked from the jaxpr (:func:`hmsc_tpu.mcmc.partition.
+    collective_bytes`).  Returns {} when the process has fewer devices
+    (the committed entries are then simply not drift-checked)."""
+    import dataclasses as _dc
+
+    import jax
+    import numpy as np
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from ..analysis.jaxpr_rules import _build, _shard_models
+    from ..mcmc.partition import (DATA_SPECIES_DIMS, ShardCtx,
+                                  collective_bytes, tree_pspecs)
+    from ..mcmc.sweep import (make_sharded_sweep, make_sweep_schedule,
+                              sweep_prologue)
+
+    if len(jax.devices()) < devices:
+        return {}
+    mesh = Mesh(np.array(jax.devices()[:devices]).reshape(1, devices),
+                axis_names=("chains", "species"))
+
+    def _k():
+        return jax.random.key(0, impl="threefry2x32")
+
+    factories = _shard_models()
+    names = tuple(models) if models else tuple(factories)
+    programs: dict[str, dict] = {}
+    for mname in names:
+        if mname not in factories:
+            continue
+        spec, data, state = _build(factories[mname]())
+        ones = tuple(1 for _ in range(spec.nr))
+        ctx = ShardCtx(axis="species", n=devices, ns=spec.ns)
+        spec_l = _dc.replace(spec, ns=spec.ns // devices)
+
+        # global-structure chain (the carries each block receives) runs
+        # the replicated blocks eagerly; each sharded block is compiled
+        # on that same global carry with explicit in/out specs
+        steps_g = make_sweep_schedule(spec, None, ones)
+        steps_l = make_sweep_schedule(spec_l, None, ones, shard=ctx)
+
+        # an `only` filter that matches none of this model's shard names
+        # skips the whole chain (the tier-1 `--only /block:` slice would
+        # otherwise compile ~9 discarded programs per model just to
+        # advance the carry)
+        cand = [f"{mname}/shard{devices}:block:{b}" for b, _ in steps_g]
+        cand.append(f"{mname}/shard{devices}:sweep")
+        if only and not any(_keep(n, only) for n in cand):
+            continue
+        data_specs = tree_pspecs(data, spec, "species", DATA_SPECIES_DIMS,
+                                 x_is_list=spec.x_is_list)
+        state_it, ks = jax.jit(sweep_prologue)(state, _k())
+        carry = (state_it, None, None, None)
+        for (bname, block_g), (_, block_l) in zip(steps_g, steps_l):
+            carry_next = jax.jit(block_g)(data, carry, ks)
+            name = f"{mname}/shard{devices}:block:{bname}"
+            if _keep(name, only):
+                sm = shard_map(block_l, mesh=mesh,
+                               in_specs=(data_specs,
+                                         _carry_pspecs(carry, spec,
+                                                       "species"), P()),
+                               out_specs=_carry_pspecs(carry_next, spec,
+                                                       "species"),
+                               check_rep=False)
+                entry = _cost_entry(
+                    jax.jit(sm).lower(data, carry, ks).compile())
+                entry.update(collective_bytes(
+                    jax.make_jaxpr(sm)(data, carry, ks)))
+                programs[name] = entry
+            carry = carry_next
+
+        name = f"{mname}/shard{devices}:sweep"
+        if _keep(name, only):
+            sweep_s = make_sharded_sweep(spec, mesh, None, ones)
+            entry = _cost_entry(
+                jax.jit(sweep_s).lower(data, state, _k()).compile())
+            entry.update(collective_bytes(
+                jax.make_jaxpr(sweep_s)(data, state, _k())))
+            programs[name] = entry
+    return programs
 
 
 def build_cost_ledger(models=None, only=None) -> dict:
@@ -167,6 +284,11 @@ def build_cost_ledger(models=None, only=None) -> dict:
                 programs[name] = _cost_entry(
                     jax.jit(fn).lower(data, state, _k()).compile())
             break
+
+    # sharded-sweep programs (per-block comm-bytes column): present only
+    # when the process has >= 8 devices (CI forces the emulated mesh; a
+    # smaller environment simply does not drift-check these entries)
+    programs.update(build_shard_ledger(models=models, only=only))
     return {"version": LEDGER_VERSION, "jax": jax.__version__,
             "programs": dict(sorted(programs.items()))}
 
@@ -181,6 +303,15 @@ def ledger_digest(ledger: dict) -> dict:
         d = out.setdefault(mname, {"flops_total": None,
                                    "temp_bytes_peak": 0, "programs": 0})
         d["programs"] += 1
+        if prog.startswith("shard"):
+            # per-device SPMD numbers roll up separately: the whole-sweep
+            # comm bytes and per-device argument footprint
+            sh = d.setdefault("shard", {"comm_bytes": None,
+                                        "arg_bytes_per_device": None})
+            if prog.endswith(":sweep"):
+                sh["comm_bytes"] = entry.get("comm_bytes", 0)
+                sh["arg_bytes_per_device"] = entry.get("arg_bytes")
+            continue
         d["temp_bytes_peak"] = max(d["temp_bytes_peak"],
                                    entry.get("temp_bytes", 0))
         if prog == "sweep":
@@ -219,7 +350,7 @@ def diff_ledger(committed: dict | None, current: dict) -> list[str]:
         if prev is None:
             drift.append(f"{name}: no committed entry")
             continue
-        for k in ("flops", "bytes_accessed", "temp_bytes"):
+        for k in ("flops", "bytes_accessed", "temp_bytes", "comm_bytes"):
             if prev.get(k) != entry.get(k):
                 drift.append(f"{name}: {k} {prev.get(k)} -> {entry.get(k)}")
     return drift
@@ -269,11 +400,14 @@ def _render_static(ledger: dict, digest: dict, drift: list) -> str:
                          f"{d.get('flops_total')}, peak temp "
                          f"{d.get('temp_bytes_peak')} B) ==")
             lines.append(f"  {'program':<28} {'Mflops':>9} {'MB acc':>8} "
-                         f"{'arg KB':>8} {'temp KB':>8}")
+                         f"{'arg KB':>8} {'temp KB':>8} {'comm KB':>8}")
+        comm = e.get("comm_bytes")
         lines.append(f"  {prog:<28} {e['flops'] / 1e6:9.3f} "
                      f"{e['bytes_accessed'] / 1e6:8.2f} "
                      f"{e['arg_bytes'] / 1e3:8.1f} "
-                     f"{e['temp_bytes'] / 1e3:8.1f}")
+                     f"{e['temp_bytes'] / 1e3:8.1f} "
+                     + (f"{comm / 1e3:8.2f}" if comm is not None
+                        else f"{'-':>8}"))
     if drift:
         lines.append("\ncost-model drift vs committed ledger:")
         lines += [f"  {d}" for d in drift]
@@ -341,6 +475,11 @@ def profile_main(argv=None) -> int:
         # configures (auto-detected TPU included), so it must NOT be pinned
         # to CPU behind the user's back.
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        # the shard8 comm-bytes entries need the emulated species mesh;
+        # force the virtual device count before the backend initialises
+        # (no-op once a backend exists — the entries are then skipped)
+        from ..mcmc.partition import force_emulated_device_count
+        force_emulated_device_count(8)
     models = tuple(args.models.split(",")) if args.models else None
     only = tuple(args.only.split(",")) if args.only else None
     ledger_path = args.ledger or LEDGER_PATH

@@ -181,7 +181,7 @@ def _pg_moments(h, z):
     return mean, var
 
 
-def polya_gamma(key, h, z, n_terms: int = 0):
+def polya_gamma(key, h, z, n_terms: int = 0, *, _eps=None):
     """Polya-Gamma PG(h, z) draw (reference uses ``BayesLogit::rpg`` with
     h = y + 1000, ``R/updateZ.R:68,79``).
 
@@ -204,8 +204,13 @@ def polya_gamma(key, h, z, n_terms: int = 0):
         mean_trunc = (jnp.asarray(h)[..., None] / denom).sum(-1) / (2 * jnp.pi**2)
         return draw + (mean - mean_trunc)
     mean, var = _pg_moments(h, z)
-    eps = jax.random.normal(key, jnp.broadcast_shapes(jnp.shape(h), jnp.shape(z)),
-                            dtype=jnp.result_type(h, z))
+    # _eps: pre-drawn standard normals (the species-sharded sweep draws
+    # them full-width and slices, keeping shard draws independent and
+    # equal to the replicated stream)
+    eps = (jax.random.normal(key,
+                             jnp.broadcast_shapes(jnp.shape(h), jnp.shape(z)),
+                             dtype=jnp.result_type(h, z))
+           if _eps is None else _eps)
     return jnp.maximum(mean + jnp.sqrt(var) * eps, _TINY)
 
 

@@ -234,6 +234,46 @@ class ServingArtifact:
         self._cache[name] = a
         return a
 
+    def stored(self, name: str) -> np.ndarray:
+        """The parameter in its STORED dtype: the zero-copy f32 memmap
+        for f32 artifacts, an ``ml_dtypes.bfloat16`` view of the raw bit
+        patterns for bf16 artifacts (no f32 materialisation — the serving
+        engine stages this directly, keeping draws bf16 on-device and
+        halving serving HBM; compute kernels widen at entry, which is
+        exact, so predictions match the decoded path bit-for-bit)."""
+        entry = self.meta["params"].get(name)
+        if entry is None:
+            raise KeyError(
+                f"{name!r} is not in this serving artifact (has: "
+                f"{sorted(self.meta['params'])}) — re-run compaction with "
+                "params= including it")
+        if entry.get("stored_dtype") != "bfloat16_bits":
+            return self.pooled(name)
+        ck = ("stored", name)
+        if ck in self._cache:
+            return self._cache[ck]
+        import ml_dtypes
+        path = os.path.join(self.dir, entry["file"])
+        try:
+            bits = np.load(path, allow_pickle=False,
+                           mmap_mode="r" if self._mmap else None)
+        except (OSError, ValueError) as e:
+            raise CheckpointCorruptError(
+                f"{path}: unreadable artifact parameter "
+                f"({type(e).__name__}: {e})") from e
+        if self._verify and _crc(bits) != entry["crc32"]:
+            raise CheckpointCorruptError(
+                f"{path}: parameter {name!r} failed its integrity "
+                f"checksum — the artifact is corrupt; re-run compaction")
+        a = np.asarray(bits).view(ml_dtypes.bfloat16)
+        want = tuple(entry["shape"])
+        if a.shape != want:
+            raise CheckpointCorruptError(
+                f"{path}: parameter {name!r} has shape {a.shape}, "
+                f"manifest claims {want}")
+        self._cache[ck] = a
+        return a
+
     def cast_tolerance(self, name: str) -> dict | None:
         """The recorded bf16 cast error for a parameter (``None`` for
         bit-exact f32 storage)."""

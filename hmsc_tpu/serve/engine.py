@@ -150,7 +150,10 @@ class ServingEngine:
                 raise NotImplementedError(
                     "serving engine v1: covariate-dependent random levels "
                     "(xDim > 0) are not servable — use hmsc_tpu.predict")
-            pooled = {name: source.pooled(name)[::draw_thin]
+            # stored(): bf16 artifacts stage their draws AS bf16 (half the
+            # serving HBM; the kernels widen at entry — exact), f32
+            # artifacts stay the zero-copy memmap
+            pooled = {name: source.stored(name)[::draw_thin]
                       for name in (["Beta", "sigma"]
                                    + [f"Eta_{r}" for r in range(len(levels))]
                                    + [f"Lambda_{r}"
@@ -211,17 +214,28 @@ class ServingEngine:
 
         with self.telem.span("stage", n_draws=self.n_draws):
             f32 = jnp.float32
-            self._Beta = jnp.asarray(pooled["Beta"], f32)
-            self._sigma = jnp.asarray(pooled["sigma"], f32)
+
+            def _stage_dtype(a):
+                # preserve a bf16-stored artifact's dtype on device; all
+                # other sources stage f32 exactly as before
+                import ml_dtypes
+                if getattr(a, "dtype", None) == ml_dtypes.bfloat16:
+                    return jnp.bfloat16
+                return f32
+
+            self._Beta = jnp.asarray(pooled["Beta"],
+                                     _stage_dtype(pooled["Beta"]))
+            self._sigma = jnp.asarray(pooled["sigma"],
+                                      _stage_dtype(pooled["sigma"]))
             lams, etas = [], []
             for r in range(self.nr):
                 lam = pooled[f"Lambda_{r}"]
                 if lam.ndim == 4:
                     lam = lam[..., 0]
-                lams.append(jnp.asarray(lam, f32))
-                eta = np.asarray(pooled[f"Eta_{r}"], dtype=np.float32)
-                zero = np.zeros((eta.shape[0], 1, eta.shape[2]),
-                                dtype=np.float32)
+                lams.append(jnp.asarray(lam, _stage_dtype(lam)))
+                dt = np.dtype(_stage_dtype(pooled[f"Eta_{r}"]))
+                eta = np.asarray(pooled[f"Eta_{r}"], dtype=dt)
+                zero = np.zeros((eta.shape[0], 1, eta.shape[2]), dtype=dt)
                 etas.append(jnp.asarray(np.concatenate([eta, zero],
                                                        axis=1)))
             self._lams = tuple(lams)

@@ -152,6 +152,14 @@ def make_predict_kernel(*, nr: int, expected: bool, any_probit: bool,
     import jax.numpy as jnp
 
     def kernel(Beta, sigma, lams, etas, fam, ym, ys, X, unit_idx, key):
+        # bf16-staged artifacts upcast at entry: HBM holds the draws at
+        # half width, compute stays f32 (the widening cast is exact, so
+        # predictions match the old decode-at-load path bit-for-bit);
+        # f32-staged draws trace identically (the cast is a no-op)
+        f32 = jnp.float32
+        Beta, sigma = Beta.astype(f32), sigma.astype(f32)
+        lams = tuple(l.astype(f32) for l in lams)
+        etas = tuple(e.astype(f32) for e in etas)
         L = jnp.einsum("yc,ncj->nyj", X, Beta)
         for r in range(nr):
             rows = etas[r][:, unit_idx[r], :]           # (n, B, nf)
@@ -191,6 +199,12 @@ def make_conditional_kernel(*, nr: int, mcmc_step: int, expected: bool,
 
     def kernel(Beta, sigma, lams, etas, fam, ym, ys, X, unit_idx, Yc, mask,
                key):
+        # same entry upcast as the predict kernel: bf16 draws widen
+        # exactly; f32 draws trace identically
+        f32 = jnp.float32
+        Beta, sigma = Beta.astype(f32), sigma.astype(f32)
+        lams = tuple(l.astype(f32) for l in lams)
+        etas = tuple(e.astype(f32) for e in etas)
         n_draws = Beta.shape[0]
         rows0 = tuple(etas[r][:, unit_idx[r], :] for r in range(nr))
 

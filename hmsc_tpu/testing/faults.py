@@ -60,8 +60,8 @@ def inject_nan(updater: str = "update_beta_lambda", at_iteration: int = 1,
 
     real = getattr(U, updater)
 
-    def poisoned(spec, data, state, key, **kw):
-        state = real(spec, data, state, key, **kw)
+    def poisoned(spec, data, state, key, *a, **kw):
+        state = real(spec, data, state, key, *a, **kw)
         tgt = getattr(state, field)
         hit = (state.it == at_iteration).astype(tgt.dtype)
         return state.replace(**{field: tgt + hit * jnp.asarray(

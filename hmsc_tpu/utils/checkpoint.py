@@ -1905,6 +1905,7 @@ def resume_run(hM, checkpoint_path: str, *, verbose: int = 0,
                checkpoint_layout: str | None = None,
                allow_legacy_pickle: bool = False, mesh=None,
                chain_axis: str = "chains", species_axis: str = "species",
+               shard_sweep=None,
                pipeline: bool = True, coordinator=None, telemetry=None):
     """Continue an auto-checkpointed ``sample_mcmc`` run to completion.
 
@@ -2062,6 +2063,7 @@ def resume_run(hM, checkpoint_path: str, *, verbose: int = 0,
         retry_diverged=int(meta.get("retry_diverged", 0)),
         align_post=False, verbose=verbose, mesh=mesh,
         chain_axis=chain_axis, species_axis=species_axis,
+        shard_sweep=shard_sweep,
         progress_callback=progress_callback,
         checkpoint_every=ck_every,
         checkpoint_path=ckdir,

@@ -43,9 +43,12 @@ def make_mesh(n_chains: int | None = None, species_shards: int = 1,
     if n_chains is None:
         # derive the chain extent from the device count; needs divisibility
         if n % species_shards:
+            from ..mcmc.partition import nearest_divisor
             raise ValueError(
                 f"species_shards={species_shards} must divide the device "
-                f"count {n} (or pass n_chains explicitly)")
+                f"count {n}; the nearest valid species_shards for "
+                f"{n} device(s) is {nearest_divisor(n, species_shards)} "
+                "(or pass n_chains explicitly)")
         n_chain_devs = n // species_shards
     else:
         n_chain_devs = int(n_chains)

@@ -1,0 +1,295 @@
+"""Within-model sharding suite: the species-sharded Gibbs sweep
+(``shard_map`` over the emulated 8-device CPU mesh) agrees with the
+replicated sweep on every canonical spec, the sampler wiring shards and
+falls back correctly, checkpoints round-trip, and the committed
+comm-bytes ledger / collective fingerprints cover the sharded programs.
+
+Agreement contract (mcmc/partition.py): every random draw is taken at
+the global width and sliced, so the sharded draw stream EQUALS the
+replicated one; the only divergence is psum partial-sum rounding, pinned
+here to ``SHARD_AGREEMENT_TOL`` after ``_SWEEPS`` sweeps.
+"""
+
+import json
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+
+from hmsc_tpu.analysis.jaxpr_rules import _build, _shard_models
+from hmsc_tpu.mcmc.partition import (SHARD_AGREEMENT_TOL, ShardCtx,
+                                     collective_bytes, nearest_divisor)
+from hmsc_tpu.mcmc.sweep import make_sharded_sweep, make_sweep
+from hmsc_tpu.mcmc.sampler import sample_mcmc
+from hmsc_tpu.utils.mesh import make_mesh
+
+pytestmark = pytest.mark.shard
+
+_SWEEPS = 3           # chained sweeps per agreement check
+_DEVICES = 8
+
+
+def _mesh(shards):
+    from jax.sharding import Mesh
+    return Mesh(np.array(jax.devices()[:shards]).reshape(1, shards),
+                axis_names=("chains", "species"))
+
+
+def _chain(fn, data, state, key, n):
+    def run(state, key):
+        for _ in range(n):
+            key, sub = jax.random.split(key)
+            state = fn(data, state, sub)
+        return state
+    return jax.jit(run)(state, key)
+
+
+def _max_rel(a, b):
+    """Max abs error normalised by the array's magnitude (an elementwise
+    relative error would explode on near-zero entries whose ABSOLUTE
+    psum-rounding error is ~1e-6)."""
+    a, b = np.asarray(a, float), np.asarray(b, float)
+    if a.size == 0:
+        return 0.0
+    scale = max(float(np.max(np.abs(a))), 1e-6)
+    return float(np.max(np.abs(a - b)) / scale)
+
+
+def _assert_state_close(sa, sb, tol=SHARD_AGREEMENT_TOL):
+    la, lb = jax.tree.leaves(sa), jax.tree.leaves(sb)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        assert _max_rel(x, y) <= tol
+
+
+# ---------------------------------------------------------------------------
+# sweep-level agreement: 4 canonical specs x {1, 2, 4, 8} emulated devices
+# ---------------------------------------------------------------------------
+
+# tier-1 runs every spec at the full 8-way mesh (plus one 2-way case for
+# the uneven-layout seam); the inner shard counts ride the slow tier
+_FAST = {(m, 8) for m in ("base", "spatial", "rrr", "sel")} | {("base", 2)}
+_MATRIX = [pytest.param(m, k, id=f"{m}-sp{k}",
+                        marks=() if (m, k) in _FAST
+                        else (pytest.mark.slow,))
+           for m in ("base", "spatial", "rrr", "sel")
+           for k in (1, 2, 4, 8)]
+
+
+@pytest.mark.parametrize("model,shards", _MATRIX)
+def test_sharded_sweep_agrees_with_replicated(model, shards):
+    spec, data, state = _build(_shard_models()[model]())
+    ones = tuple(0 for _ in range(spec.nr))
+    key = jax.random.key(7, impl="threefry2x32")
+    ref = _chain(make_sweep(spec, None, ones), data, state, key, _SWEEPS)
+    fn = make_sharded_sweep(spec, _mesh(shards), None, ones)
+    got = _chain(fn, data, state, key, _SWEEPS)
+    _assert_state_close(ref, got)
+
+
+def test_sharded_sweep_with_nf_adaptation_agrees():
+    spec, data, state = _build(_shard_models()["base"]())
+    adapt = tuple(5 for _ in range(spec.nr))
+    key = jax.random.key(11, impl="threefry2x32")
+    ref = _chain(make_sweep(spec, None, adapt), data, state, key, _SWEEPS)
+    fn = make_sharded_sweep(spec, _mesh(4), None, adapt)
+    got = _chain(fn, data, state, key, _SWEEPS)
+    _assert_state_close(ref, got)
+
+
+# ---------------------------------------------------------------------------
+# sampler wiring
+# ---------------------------------------------------------------------------
+
+def test_sample_mcmc_sharded_draws_agree():
+    hM = _shard_models()["base"]()
+    kw = dict(samples=3, transient=2, n_chains=2, seed=3, align_post=False,
+              nf_cap=2)
+    post_r = sample_mcmc(hM, **kw)
+    post_s = sample_mcmc(hM, mesh=make_mesh(n_chains=1, species_shards=4),
+                         **kw)
+    for k in post_r.arrays:
+        assert _max_rel(post_r[k], post_s[k]) <= SHARD_AGREEMENT_TOL, k
+
+
+def test_sharded_checkpoint_resume_roundtrip(tmp_path):
+    """A sharded checkpointed run commits draws a replicated run agrees
+    with (within the recorded tolerance), and resume_run round-trips the
+    completed run."""
+    from hmsc_tpu.utils.checkpoint import resume_run
+    hM = _shard_models()["base"]()
+    kw = dict(samples=4, transient=2, n_chains=2, seed=5, align_post=False,
+              nf_cap=2)
+    post_r = sample_mcmc(hM, **kw)
+    ck = os.fspath(tmp_path / "run")
+    post_s = sample_mcmc(hM, mesh=make_mesh(n_chains=1, species_shards=2),
+                         checkpoint_every=2, checkpoint_path=ck, **kw)
+    post_l = resume_run(hM, ck)
+    for k in post_r.arrays:
+        # committed draws == the sharded run's in-memory draws, exactly
+        np.testing.assert_array_equal(np.asarray(post_l[k]),
+                                      np.asarray(post_s[k]))
+        # and both agree with the replicated run within tolerance
+        assert _max_rel(post_r[k], post_l[k]) <= SHARD_AGREEMENT_TOL, k
+
+
+def test_nondivisible_species_warns_and_replicates():
+    """ns % species_shards != 0: the documented warn-and-replicate path —
+    the warning names both values and the nearest valid divisor, and the
+    run is bit-identical to the meshless one."""
+    hM = _shard_models()["base"]()          # ns = 8
+    kw = dict(samples=2, transient=1, n_chains=1, seed=9, align_post=False,
+              nf_cap=2)
+    post_r = sample_mcmc(hM, **kw)
+    mesh = make_mesh(n_chains=1, species_shards=3)
+    with pytest.warns(RuntimeWarning) as rec:
+        post_s = sample_mcmc(hM, mesh=mesh, **kw)
+    msgs = [str(w.message) for w in rec]
+    hit = [m for m in msgs if "not divisible" in m]
+    assert hit, msgs
+    assert "ns=8" in hit[0] and "species_shards=3" in hit[0]
+    assert "nearest valid species_shards" in hit[0]
+    assert "is 4" in hit[0]                 # nearest divisor of 8 to 3
+    for k in post_r.arrays:
+        np.testing.assert_array_equal(np.asarray(post_r[k]),
+                                      np.asarray(post_s[k]))
+
+
+def test_nondivisible_species_strict_mode_raises():
+    """shard_sweep=True must never silently replicate: a non-divisible
+    ns is an error in strict mode (the user asked for the 1/shards
+    per-device state)."""
+    hM = _shard_models()["base"]()          # ns = 8
+    mesh = make_mesh(n_chains=1, species_shards=3)
+    with pytest.raises(ValueError, match="shard_sweep=True"):
+        sample_mcmc(hM, samples=1, transient=0, n_chains=1, seed=9,
+                    align_post=False, nf_cap=2, mesh=mesh,
+                    shard_sweep=True)
+    # ... and so must a mesh with nothing to shard over (no species axis /
+    # extent 1 / no mesh at all)
+    for bad_mesh in (None, make_mesh(n_chains=1, species_shards=1)):
+        with pytest.raises(ValueError, match="shard_sweep=True requires"):
+            sample_mcmc(hM, samples=1, transient=0, n_chains=1, seed=9,
+                        align_post=False, nf_cap=2, mesh=bad_mesh,
+                        shard_sweep=True)
+
+
+def test_make_mesh_error_names_nearest_divisor():
+    with pytest.raises(ValueError) as ei:
+        make_mesh(species_shards=3)         # 8 devices: 3 does not divide
+    msg = str(ei.value)
+    assert "species_shards=3" in msg and "8" in msg
+    assert "nearest valid species_shards" in msg and "4" in msg
+
+
+def _base_with_na(ny=12, ns=8):
+    """The canonical base model (probit + traits + phylo) with one NA
+    cell: has_na + phylo routes Beta through the dense path the sharded
+    sweep cannot express."""
+    import pandas as pd
+
+    from hmsc_tpu.data.td import random_coalescent_corr
+    from hmsc_tpu.model import Hmsc
+    from hmsc_tpu.random_level import (HmscRandomLevel,
+                                       set_priors_random_level)
+    rng = np.random.default_rng(11)
+    X = np.column_stack([np.ones(ny), rng.standard_normal((ny, 1))])
+    Y = (rng.standard_normal((ny, ns)) > 0).astype(float)
+    Y[0, 0] = np.nan
+    units = [f"u{i:02d}" for i in rng.integers(0, 5, ny)]
+    for i in range(5):
+        units[i % ny] = f"u{i:02d}"
+    study = pd.DataFrame({"lvl": units})
+    rl = HmscRandomLevel(units=study["lvl"])
+    set_priors_random_level(rl, nf_max=2, nf_min=2)
+    Tr = np.column_stack([np.ones(ns), rng.standard_normal(ns)])
+    return Hmsc(Y=Y, X=X, distr="probit", study_design=study,
+                ran_levels={"lvl": rl}, Tr=Tr,
+                C=random_coalescent_corr(ns, rng))
+
+
+def test_unsupported_model_falls_back_with_warning():
+    """Dense-phylo models (phylo + NA) cannot shard: auto mode warns and
+    falls back to GSPMD placement; shard_sweep=True raises."""
+    hM2 = _base_with_na()
+    mesh = make_mesh(n_chains=1, species_shards=4)
+    kw = dict(samples=1, transient=1, n_chains=1, seed=1, align_post=False,
+              nf_cap=2)
+    with pytest.warns(RuntimeWarning, match="falling back to GSPMD"):
+        sample_mcmc(hM2, mesh=mesh, **kw)
+    with pytest.raises(ValueError, match="shard_sweep=True"):
+        sample_mcmc(hM2, mesh=mesh, shard_sweep=True, **kw)
+
+
+# ---------------------------------------------------------------------------
+# committed artifacts: comm ledger + collective fingerprints
+# ---------------------------------------------------------------------------
+
+def test_collective_bytes_walker():
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    import jax.numpy as jnp
+    mesh = _mesh(4)
+
+    def f(x):
+        return jax.lax.psum(x, "species")
+    sm = shard_map(f, mesh=mesh, in_specs=P("species"), out_specs=P(),
+                   check_rep=False)
+    closed = jax.make_jaxpr(sm)(jnp.zeros((8,), jnp.float32))
+    out = collective_bytes(closed)
+    assert out["collectives"].get("psum") == 2 * 4   # local (2,) f32
+    assert out["comm_bytes"] == 8
+
+
+def test_comm_ledger_has_sharded_entries():
+    from hmsc_tpu.obs.profile import LEDGER_PATH
+    with open(LEDGER_PATH) as f:
+        led = json.load(f)
+    for m in ("base", "spatial", "rrr", "sel"):
+        entry = led["programs"].get(f"{m}/shard8:sweep")
+        assert entry is not None, f"{m}/shard8:sweep missing from ledger"
+        assert entry["comm_bytes"] > 0
+        assert "psum" in entry["collectives"]
+        blocks = [k for k in led["programs"]
+                  if k.startswith(f"{m}/shard8:block:")]
+        assert blocks, f"no per-block shard entries for {m}"
+        assert all("comm_bytes" in led["programs"][b] for b in blocks)
+
+
+def test_sharded_fingerprints_committed():
+    from hmsc_tpu.analysis.jaxpr_rules import FINGERPRINTS_PATH
+    with open(FINGERPRINTS_PATH) as f:
+        fps = json.load(f)["programs"]
+    names = [k for k in fps if k.startswith("sharded_sweep@")]
+    assert len(names) == 4, names
+    for k in names:
+        assert fps[k]["prims"].get("psum", 0) > 0, \
+            f"{k}: fingerprint records no collective sequence"
+
+
+def test_nearest_divisor():
+    assert nearest_divisor(8, 3) == 4       # tie 2/4 -> larger
+    assert nearest_divisor(8, 8) == 8
+    assert nearest_divisor(12, 5) == 6
+    assert nearest_divisor(7, 3) == 1 or nearest_divisor(7, 3) == 7
+    assert nearest_divisor(7, 6) == 7
+
+
+def test_shardctx_slice_matches_layout():
+    """slice_sp of a full-width draw reassembles to exactly the full
+    array (the draw-equality contract's mechanical core)."""
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    mesh = _mesh(4)
+    ctx = ShardCtx(axis="species", n=4, ns=8)
+    full = jnp.arange(24, dtype=jnp.float32).reshape(3, 8)
+
+    def body():
+        return ctx.slice_sp(full, 1)
+    out = shard_map(body, mesh=mesh, in_specs=(),
+                    out_specs=P(None, "species"), check_rep=False)()
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(full))
