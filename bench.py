@@ -301,6 +301,46 @@ def _shard_summary():
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _precision_summary():
+    """The mixed-precision digest: the committed per-class policy
+    selections (ledger-driven targeted blocks), the scaled-shape bytes
+    saved per sweep, the measured per-block agreement from the committed
+    precision_tolerance.json, and the pinned draw-stream agreement bound.
+    Pure reads of committed artifacts — no compiles, safe in both the
+    headline and the skip record."""
+    try:
+        from hmsc_tpu.mcmc.precision import (PRECISION_AGREEMENT_TOL,
+                                             load_tolerance)
+        from hmsc_tpu.obs.profile import ledger_digest, load_ledger
+        ledger = load_ledger()
+        digest = ledger_digest(ledger) if ledger else {}
+        tol = load_tolerance() or {}
+        out = {"agreement_tol": PRECISION_AGREEMENT_TOL, "models": {}}
+        for mname, sel in (ledger or {}).get("precision", {}).items():
+            t = tol.get("models", {}).get(mname, {})
+            out["models"][mname] = {
+                "blocks": sel.get("blocks"),
+                "bytes_ratio": sel.get("bytes_ratio"),
+                "bytes_saved_per_sweep": (digest.get(mname, {})
+                                          .get("precision", {})
+                                          .get("bytes_saved_per_sweep")),
+                "sweep_max_rel": t.get("sweep_max_rel"),
+            }
+        # the >=1.5x byte gate must FAIL when its evidence is missing: a
+        # ledger without the spatial/gpp selections (or with empty
+        # ratios) cannot vacuously pass
+        checks = []
+        for m in ("spatial", "gpp"):
+            sel = out["models"].get(m)
+            ratios = (sel or {}).get("bytes_ratio") or {}
+            checks.append(bool(ratios)
+                          and all(r >= 1.5 for r in ratios.values()))
+        out["gates_ok"] = all(checks)
+        return out
+    except Exception as e:                   # noqa: BLE001 — bench must emit
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _skip(reason: str):
     """Emit a parseable skip record instead of a bare nonzero exit: the
     bench trajectory must distinguish "chip unreachable this round" from "a
@@ -325,6 +365,7 @@ def _skip(reason: str):
         "chaos": _chaos_summary(),
         "cost_ledger": _cost_ledger_summary(),
         "shard": _shard_summary(),
+        "precision": _precision_summary(),
     }))
     raise SystemExit(0)
 
@@ -489,6 +530,11 @@ def main():
         # per-sweep collective counts (benchmarks/bench_shard.py) — the
         # model-parallel axis rides the trajectory
         "shard": _shard_summary(),
+        # mixed-precision digest (committed artifacts): per-class policy'd
+        # blocks, scaled-shape bytes saved, measured agreement bound
+        # (hmsc_tpu/mcmc/precision.py) — the hot-path precision assault
+        # rides the trajectory
+        "precision": _precision_summary(),
     }))
 
 

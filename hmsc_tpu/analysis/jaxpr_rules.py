@@ -333,6 +333,26 @@ def build_audit_context(expected_fingerprints=None) -> JaxprAudit:
             name=f"sweep@{mname}", path="hmsc_tpu/mcmc/sweep.py",
             closed=closed, closed_x64=closed_x64, x64_error=err))
 
+    # the mixed-precision sweep per canonical spec, under that class's
+    # in-code default policy (ledger-independent, so the audit is stable
+    # while the ledger is being re-recorded): same f64 probe / callback /
+    # const rules, committed fingerprints named `sweep_mp@<model>`, plus
+    # the jaxpr-mixed-precision rule asserting bf16 stays confined to
+    # these programs and never reaches a Cholesky/solve pivot
+    from ..mcmc.precision import default_policy, stage_data
+    for mname, (spec, data, state) in built.items():
+        policy = default_policy(spec, ledger={})
+        if policy is None:
+            continue
+        sweep_mp = make_sweep(spec, None, tuple(0 for _ in range(spec.nr)),
+                              precision=policy)
+        staged = stage_data(data, policy)
+        closed, closed_x64, err = _trace_pair(sweep_mp, data, state, _k(),
+                                              staged)
+        programs.append(AuditProgram(
+            name=f"sweep_mp@{mname}", path="hmsc_tpu/mcmc/precision.py",
+            closed=closed, closed_x64=closed_x64, x64_error=err))
+
     # segment runner: traced jaxpr + lowering (donation aliasing lives in
     # the lowering, not the jaxpr)
     from ..mcmc import sampler as sampler_mod
@@ -479,6 +499,41 @@ def check_f64(audit: JaxprAudit):
                 f"{p.name}: {sum(bad.values())} {'/'.join(sorted(bad))} "
                 f"values in the x64 trace — some op does not derive its "
                 f"dtype from its inputs"))
+    return findings
+
+
+@rule("jaxpr-mixed-precision", "error", "jaxpr",
+      "deliberate bf16 only: reduced-precision values appear ONLY in the "
+      "policy'd `sweep_mp@*` programs (a bf16 value in any other mcmc "
+      "program is a precision leak), and no Cholesky/triangular-solve "
+      "pivot ever takes a bf16 operand — the policy computes grams in "
+      "bf16 but factorises f32")
+def check_mixed_precision(audit: JaxprAudit):
+    findings = []
+    info = RULES["jaxpr-mixed-precision"]
+    for p in audit.programs:
+        is_mp = "_mp@" in p.name
+        in_mcmc = p.path.startswith("hmsc_tpu/mcmc")
+        n_bf16 = 0
+        for v in _all_vars(p.closed.jaxpr):
+            if str(getattr(v.aval, "dtype", "")) == "bfloat16":
+                n_bf16 += 1
+        if n_bf16 and in_mcmc and not is_mp:
+            findings.append(info.finding(
+                p.path, 1,
+                f"{p.name}: {n_bf16} bfloat16 value(s) in a program with "
+                f"no active precision policy — reduced precision must be "
+                f"scoped to the policy'd blocks"))
+        for eqn in _all_prims(p.closed.jaxpr):
+            if eqn.primitive.name not in ("cholesky", "triangular_solve"):
+                continue
+            bad = [str(v.aval.dtype) for v in eqn.invars
+                   if str(getattr(v.aval, "dtype", "")) == "bfloat16"]
+            if bad:
+                findings.append(info.finding(
+                    p.path, 1,
+                    f"{p.name}: `{eqn.primitive.name}` takes a bfloat16 "
+                    f"operand — pivots are f32-pinned under every policy"))
     return findings
 
 

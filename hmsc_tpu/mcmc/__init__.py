@@ -2,7 +2,8 @@ from .structs import (GibbsState, LevelSpec, LevelState, ModelData, ModelSpec,
                       build_model_data, build_state, LevelData,
                       state_nbytes)
 from .sampler import sample_mcmc
+from .precision import PRECISION_AGREEMENT_TOL, PrecisionPolicy
 
 __all__ = ["GibbsState", "LevelSpec", "LevelState", "ModelData", "ModelSpec",
            "LevelData", "build_model_data", "build_state", "state_nbytes",
-           "sample_mcmc"]
+           "sample_mcmc", "PrecisionPolicy", "PRECISION_AGREEMENT_TOL"]

@@ -122,10 +122,24 @@ def nearest_divisor(n: int, k: int) -> int:
 class ShardCtx:
     """Static geometry of the species sharding, closed over by the
     updaters inside the ``shard_map`` body.  ``ns`` is the GLOBAL species
-    count (the local spec's ``spec.ns`` is ``ns // n``)."""
+    count (the local spec's ``spec.ns`` is ``ns // n``).
+
+    ``local_rng`` (opt-in, ``sample_mcmc(local_rng=True)``) switches
+    every species-dim random draw from the default full-width-and-slice
+    scheme to a LOCAL draw: the shard index is folded into the block's
+    key (distinct streams per shard by construction) and only
+    ``ns_local``-wide randoms are generated.  This trades the
+    replicated-draw equality contract — the sharded stream no longer
+    equals the replicated sweep's, so sharded-vs-replicated agreement
+    only holds in distribution — for O(ns_local) draw cost (the
+    full-width draws are the main weak-scaling overhead at RNG-bound
+    sizes).  Determinism is unchanged: the same mesh/seed reproduces the
+    same stream, and kill -> resume stays bit-identical
+    (``tests/test_shard.py::test_local_rng_resume_roundtrip``)."""
     axis: str                   # mesh axis name ("species")
     n: int                      # number of shards
     ns: int                     # GLOBAL species count
+    local_rng: bool = False     # fold shard index, draw at local width
 
     @property
     def ns_local(self) -> int:
@@ -159,14 +173,36 @@ class ShardCtx:
         bad = jnp.where(ok, 0, 1).astype(jnp.int32)
         return self.psum(bad) == 0
 
-    # -- full-width RNG, sliced to the local shard ----------------------
+    # -- species-dim RNG ------------------------------------------------
+    # default: drawn at the GLOBAL width with the replicated key and
+    # sliced (replicated-draw equality); local_rng: shard-folded key,
+    # local width (O(ns_local) draw cost, streams differ from replicated)
+    def fold(self, key):
+        """The shard-local key for ``local_rng`` draws: the mesh axis
+        index folded into the replicated key."""
+        import jax
+        return jax.random.fold_in(key, jax.lax.axis_index(self.axis))
+
+    def local_shape(self, shape, dim: int) -> tuple:
+        """``shape`` with the species dimension cut to this shard."""
+        shape = tuple(shape)
+        return shape[:dim] + (self.ns_local,) + shape[dim + 1:]
+
     def uniform(self, key, shape, dtype, dim: int, **kw):
         import jax
+        if self.local_rng:
+            return jax.random.uniform(self.fold(key),
+                                      self.local_shape(shape, dim),
+                                      dtype=dtype, **kw)
         return self.slice_sp(jax.random.uniform(key, shape, dtype=dtype,
                                                 **kw), dim)
 
     def normal(self, key, shape, dtype, dim: int):
         import jax
+        if self.local_rng:
+            return jax.random.normal(self.fold(key),
+                                     self.local_shape(shape, dim),
+                                     dtype=dtype)
         return self.slice_sp(jax.random.normal(key, shape, dtype=dtype),
                              dim)
 

@@ -1,0 +1,453 @@
+"""Per-block mixed-precision policy for the Gibbs sweep.
+
+PR 8's cost ledger and measured per-updater wall shares name exactly
+which Gibbs blocks dominate each canonical spec; this module spends that
+data on the training sweep itself.  A :class:`PrecisionPolicy` maps named
+schedule blocks (:func:`~hmsc_tpu.mcmc.sweep.make_sweep_schedule`) to a
+reduced compute dtype: inside a policy'd block the heavy dots and grams
+run bf16-compute / f32-accumulate (``preferred_element_type`` on every
+routed contraction — :mod:`hmsc_tpu.ops.mixed`), reductions and every
+Cholesky/triangular-solve pivot stay f32-pinned, and the block's
+*sweep-invariant* model-data operands (the phylo eigenbasis ``U``, the
+spatial ``iWg`` grid, design matrices) are **staged**: cast to bf16 once
+per run and passed to the compiled runner as a real argument, so the hot
+blocks stream half the bytes every sweep instead of paying a cast
+(measured: XLA does not hoist converts out of the sweep scan, so an
+in-trace cast would *add* traffic).
+
+Alongside the dtype map, a policy activates the **fused batched Cholesky
+layouts** (``batched_layouts``): the three-triangular-solve
+``sample_mvn_prec`` collapses to one forward/back pair, the GPP
+per-unit inversion becomes one batched ``cho_solve``, the collapsed
+updaters fuse their paired solves, and the spatial quadratic grids
+restructure into single-pass contractions — one fused batched kernel per
+block instead of K small ones.
+
+Contracts:
+
+- ``precision_policy=None`` (the default) is the exact pre-policy
+  engine: no wrapper fires, every traced program is byte-identical to
+  the committed jaxpr fingerprints (the lint gate verifies this).
+- :data:`PRECISION_AGREEMENT_TOL` pins the one-sweep draw-stream
+  agreement between the policy'd sweep and the f32 sweep from an
+  identical state (normalised max-abs per state leaf, the
+  ``SHARD_AGREEMENT_TOL`` convention).  Unlike psum rounding this is a
+  genuine precision trade: the policy targets a *perturbed-within-
+  tolerance* posterior, exactly like ``compact --dtype bfloat16``'s
+  recorded-tolerance serving artifacts.
+- ``precision_tolerance.json`` (next to this module) records the
+  *measured* per-block deviation of every default-policy'd block on its
+  canonical spec — the training-side mirror of the serving compactor's
+  ``cast_tolerance()``.  Re-record with
+  ``python -m hmsc_tpu profile --update-precision``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+__all__ = ["PrecisionPolicy", "PRECISION_AGREEMENT_TOL", "TOLERANCE_PATH",
+           "SUPPORTED_BLOCKS", "classify_spec", "default_policy",
+           "resolve_policy", "stage_data", "staged_pspecs",
+           "measure_policy_tolerance", "load_tolerance", "save_tolerance",
+           "policy_ledger_models"]
+
+# One-sweep draw-stream agreement between the default-policy'd sweep and
+# the f32 sweep from an identical mid-chain state: max abs error per
+# state leaf normalised by the leaf's max magnitude (the
+# SHARD_AGREEMENT_TOL convention).  Measured on the canonical specs
+# (tests/test_precision.py): bf16 grams carry ~4e-3 relative rounding
+# into the conditional means/covariances, and one sweep of draws lands
+# ~1e-3..2e-2 off the f32 stream (worst leaf, spatial Full).  Pinned
+# with headroom at 6e-2; per-block measurements live in the committed
+# precision_tolerance.json.
+PRECISION_AGREEMENT_TOL = 6e-2
+
+TOLERANCE_PATH = os.path.join(os.path.dirname(__file__),
+                              "precision_tolerance.json")
+TOLERANCE_VERSION = 1
+
+# schedule blocks with a mixed-precision implementation (heavy dots and
+# grams routed through hmsc_tpu.ops.mixed); a policy naming any other
+# block is rejected at construction
+SUPPORTED_BLOCKS = ("BetaLambda", "GammaV", "Rho", "Eta", "EtaSpatial",
+                    "Alpha", "Interweave", "wRRR", "BetaSel",
+                    "Gamma2", "GammaEta")
+
+# ledger-driven default targets per canonical model class: the top
+# wall-share blocks of each class (cost-ledger byte ranking at the
+# scaled `scale:` shapes, intersected with SUPPORTED_BLOCKS).  The
+# committed ledger's `precision` section records the measured bytes
+# ratio per block; the >= 1.5x byte gate (tests/test_precision.py)
+# covers the gather-dominated targets of the TWO SPATIAL canonical
+# variants (Full + GPP).  The dot-bound base/rrr/sel targets carry
+# committed ratios BELOW 1 on the CPU cost model (bf16-dot
+# legalisation materialises f32 upcasts the MXU does not pay) — they
+# are MXU-motivated, opt-in, and transparently recorded, NOT
+# gate-protected; see BENCHMARKS.md "Mixed precision".
+_DEFAULT_TARGETS = {
+    "base": ("BetaLambda", "GammaV", "Rho"),
+    # Alpha is deliberately NOT targeted: its grid einsum lowers to a
+    # dot, and XLA's float normalisation materialises f32 upcasts of
+    # bf16 dot operands — the committed ledger measured only 1.2x there
+    # vs 1.5-1.9x on the gather-dominated blocks below (ledger-driven
+    # exclusion; see BENCHMARKS.md)
+    "spatial": ("EtaSpatial", "Interweave"),
+    "rrr": ("wRRR", "BetaLambda", "GammaV"),
+    "sel": ("BetaSel", "BetaLambda", "GammaV"),
+}
+
+# sweep-invariant model-data arrays staged to bf16 per class; per-level
+# arrays use "<field>_<r>".  Missing/None fields are skipped at staging,
+# so the spatial table lists every spatial method's grids and each model
+# stages whichever its level actually carries (Full: iWg; NNGP: the
+# Vecchia neighbour grids; GPP: the knot grids).
+_DEFAULT_STAGED = {
+    "base": ("U", "Qeig", "UTr", "X", "Tr"),
+    "spatial": ("iWg_0", "nn_coef_0", "nn_D_0", "idDg_0", "idDW12g_0",
+                "Fg_0", "iFg_0", "X"),
+    "rrr": ("X", "XRRRs"),
+    "sel": ("X",),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionPolicy:
+    """Hashable per-block precision policy.
+
+    ``blocks``: schedule-block names computed at ``dtype``;
+    ``staged``: model-data array names staged to ``dtype`` once per run
+    (``"U"`` for :class:`ModelData` fields, ``"iWg_0"`` for level 0's
+    grids); ``batched_layouts``: fused batched Cholesky/solve layouts in
+    the policy'd blocks.  ``dtype="float32"`` gives a layout-only policy
+    (exact compute, restructured kernels)."""
+    blocks: tuple
+    staged: tuple = ()
+    dtype: str = "bfloat16"
+    batched_layouts: bool = True
+
+    def __post_init__(self):
+        object.__setattr__(self, "blocks", tuple(self.blocks))
+        object.__setattr__(self, "staged", tuple(self.staged))
+        bad = [b for b in self.blocks if b not in SUPPORTED_BLOCKS]
+        if bad:
+            raise ValueError(
+                f"no mixed-precision implementation for block(s) {bad}; "
+                f"supported: {SUPPORTED_BLOCKS}")
+        if self.dtype not in ("bfloat16", "float32"):
+            raise ValueError("PrecisionPolicy.dtype must be 'bfloat16' or "
+                             f"'float32', got {self.dtype!r}")
+
+    def dtype_for(self, block: str):
+        """Compute dtype for a schedule block, or None when unpolicied."""
+        return self.dtype if block in self.blocks else None
+
+    def to_meta(self) -> dict:
+        """JSON-serializable form (checkpoint metadata: the policy changes
+        the draw stream, so resume must restore it exactly)."""
+        return {"blocks": list(self.blocks), "staged": list(self.staged),
+                "dtype": self.dtype,
+                "batched_layouts": bool(self.batched_layouts)}
+
+    @classmethod
+    def from_meta(cls, meta: dict) -> "PrecisionPolicy":
+        return cls(blocks=tuple(meta["blocks"]),
+                   staged=tuple(meta.get("staged", ())),
+                   dtype=meta.get("dtype", "bfloat16"),
+                   batched_layouts=bool(meta.get("batched_layouts", True)))
+
+
+def classify_spec(spec) -> str:
+    """The canonical model class whose ledger entry drives the default
+    policy for this spec."""
+    if any(ls.spatial is not None for ls in spec.levels):
+        return "spatial"
+    if spec.nc_rrr > 0:
+        return "rrr"
+    if spec.ncsel > 0:
+        return "sel"
+    return "base"
+
+
+def _block_applies(name: str, spec) -> bool:
+    if name == "Rho":
+        return bool(spec.has_phylo)
+    if name in ("EtaSpatial", "Alpha"):
+        return any(ls.spatial is not None for ls in spec.levels)
+    if name in ("Eta", "Interweave"):
+        return spec.nr > 0
+    if name == "wRRR":
+        return spec.nc_rrr > 0
+    if name == "BetaSel":
+        return spec.ncsel > 0
+    return True
+
+
+def default_policy(spec, ledger: dict | None = None):
+    """The ledger-driven default policy for this spec's model class, or
+    ``None`` when no targeted block applies.
+
+    The committed cost ledger's ``precision`` section (written by
+    ``profile --static --update-ledger``) records, per canonical class,
+    the targeted blocks and their measured per-sweep bytes ratio at the
+    scaled ledger shapes; the selection falls back to the in-code
+    defaults when the ledger is absent (fresh checkout mid-edit)."""
+    cls_ = classify_spec(spec)
+    blocks = _DEFAULT_TARGETS[cls_]
+    staged = _DEFAULT_STAGED[cls_]
+    if ledger is None:
+        from ..obs.profile import load_ledger
+        ledger = load_ledger()
+    sel = (ledger or {}).get("precision", {}).get(cls_)
+    if sel:
+        blocks = tuple(sel.get("blocks", blocks))
+        staged = tuple(sel.get("staged", staged))
+    blocks = tuple(b for b in blocks if _block_applies(b, spec))
+    if not blocks:
+        return None
+    return PrecisionPolicy(blocks=blocks, staged=staged)
+
+
+def resolve_policy(precision_policy, spec):
+    """Normalise ``sample_mcmc``'s ``precision_policy=`` argument:
+    ``None`` (exact engine) | ``"auto"``/``"default"`` (ledger-driven) |
+    a :class:`PrecisionPolicy` | its ``to_meta()`` dict."""
+    if precision_policy is None:
+        return None
+    if isinstance(precision_policy, str):
+        if precision_policy in ("auto", "default"):
+            return default_policy(spec)
+        raise ValueError(
+            f"precision_policy must be None, 'auto', a PrecisionPolicy or "
+            f"its to_meta() dict, got {precision_policy!r}")
+    if isinstance(precision_policy, dict):
+        return PrecisionPolicy.from_meta(precision_policy)
+    if isinstance(precision_policy, PrecisionPolicy):
+        return precision_policy
+    raise ValueError(f"precision_policy must be None, 'auto', a "
+                     f"PrecisionPolicy or its to_meta() dict, got "
+                     f"{type(precision_policy).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# staging
+# ---------------------------------------------------------------------------
+
+def _resolve_staged(data, name: str):
+    head, _, tail = name.rpartition("_")
+    if tail.isdigit() and head:
+        r = int(tail)
+        if r >= len(data.levels):
+            return None
+        return getattr(data.levels[r], head, None)
+    return getattr(data, name, None)
+
+
+def stage_data(data, policy: PrecisionPolicy) -> dict:
+    """The bf16 shadow table for ``policy.staged``: one cast per run,
+    passed to the compiled runner as a real argument (never a baked
+    constant) and resolved inside policy'd blocks by
+    :func:`hmsc_tpu.ops.mixed.staged`.  Non-float and absent fields are
+    skipped; a ``float32`` policy stages nothing (layout-only)."""
+    import jax.numpy as jnp
+    if policy.dtype == "float32":
+        return {}
+    dt = jnp.dtype(policy.dtype)
+    out = {}
+    for name in policy.staged:
+        arr = _resolve_staged(data, name)
+        if arr is None or not hasattr(arr, "dtype"):
+            continue
+        if not jnp.issubdtype(arr.dtype, jnp.floating):
+            continue
+        out[name] = arr.astype(dt)
+    return out
+
+
+def staged_pspecs(staged: dict, spec, species_axis: str,
+                  x_is_list: bool = False):
+    """PartitionSpecs for the staged shadow table on a species-sharded
+    mesh: each entry shards exactly like its f32 counterpart (the
+    committed :data:`~hmsc_tpu.mcmc.partition.DATA_SPECIES_DIMS` table,
+    resolved through the per-level name suffix, with ``tree_pspecs``'s
+    per-species-design special case for ``X``), everything else
+    replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    from .partition import DATA_SPECIES_DIMS
+
+    out = {}
+    for name, arr in staged.items():
+        head, _, tail = name.rpartition("_")
+        base = head if (tail.isdigit() and head) else name
+        ax = [None] * arr.ndim
+        d = DATA_SPECIES_DIMS.get(base)
+        if base == "X":
+            # a per-species design list is (ns, ny, nc): sharded on dim 0,
+            # exactly like its f32 counterpart in tree_pspecs
+            d = 0 if x_is_list else None
+        if d is not None and d < arr.ndim and arr.shape[d] == spec.ns:
+            ax[d] = species_axis
+        out[name] = P(*ax)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# recorded per-block tolerance (the training-side cast_tolerance())
+# ---------------------------------------------------------------------------
+
+def _leaf_dev(a, b) -> float:
+    """Max abs deviation normalised by the reference leaf's magnitude
+    (the SHARD_AGREEMENT_TOL convention)."""
+    import numpy as np
+    a = np.asarray(a, dtype=np.float64)
+    b = np.asarray(b, dtype=np.float64)
+    if a.size == 0 or not np.issubdtype(a.dtype, np.floating):
+        return 0.0
+    scale = max(float(np.max(np.abs(a))), 1e-6)
+    return float(np.max(np.abs(a - b)) / scale)
+
+
+def _carry_dev(ca, cb) -> float:
+    import jax
+    la, lb = jax.tree.leaves(ca), jax.tree.leaves(cb)
+    devs = [_leaf_dev(x, y) for x, y in zip(la, lb)
+            if hasattr(x, "dtype") and x.dtype.kind == "f"]
+    return max(devs) if devs else 0.0
+
+
+def measure_policy_tolerance(models=None, warmup: int = 2) -> dict:
+    """Measured per-block deviation of each default-policy'd block on its
+    canonical spec: the f32 block chain advances a warmed mid-sweep
+    carry, and at every policy'd block the policy variant is evaluated
+    on the SAME carry and compared (normalised max-abs over the carry),
+    plus the whole-sweep one-pass agreement.  Deterministic on a fixed
+    backend — committed like the cost ledger and drift-checked loosely
+    (float tolerances) by the tier-1 suite."""
+    import jax
+
+    from ..analysis.jaxpr_rules import _build, _canonical_models
+    from ..ops import mixed
+    from .sweep import make_sweep, make_sweep_schedule, sweep_prologue
+
+    factories = _canonical_models()
+    names = tuple(models) if models else tuple(factories)
+    out: dict[str, dict] = {}
+    for mname in names:
+        spec, data, state = _build(factories[mname]())
+        policy = default_policy(spec, ledger={})   # in-code defaults
+        if policy is None:
+            continue
+        staged = stage_data(data, policy)
+        zeros = tuple(0 for _ in range(spec.nr))
+        key = jax.random.key(23, impl="threefry2x32")
+        sweep = jax.jit(make_sweep(spec, None, zeros))
+        for _ in range(max(0, int(warmup))):
+            key, sub = jax.random.split(key)
+            state = sweep(data, state, sub)
+        state = jax.block_until_ready(state)
+        key, sub = jax.random.split(key)
+
+        steps_f32 = make_sweep_schedule(spec, None, zeros)
+        steps_mp = make_sweep_schedule(spec, None, zeros, precision=policy)
+        state_it, ks = jax.jit(sweep_prologue)(state, sub)
+        carry = (state_it, None, None, None)
+        blocks: dict[str, dict] = {}
+        for (bname, blk_f32), (_, blk_mp) in zip(steps_f32, steps_mp):
+            carry_next = jax.jit(blk_f32)(data, carry, ks)
+            if policy.dtype_for(bname) is not None:
+                def run_mp(data, carry, ks, staged):
+                    with mixed.staged_scope(staged):
+                        return blk_mp(data, carry, ks)
+                carry_mp = jax.jit(run_mp)(data, carry, ks, staged)
+                blocks[bname] = {
+                    "max_rel": round(_carry_dev(carry_next, carry_mp), 8)}
+            carry = carry_next
+
+        sweep_mp = make_sweep(spec, None, zeros, precision=policy)
+        # deliberate replay of the SAME subkey: the policy'd sweep must be
+        # compared draw-for-draw against the f32 pass traced above
+        # hmsc: ignore[rng-key-reuse]
+        state_mp = jax.jit(sweep_mp)(data, state, sub, staged)
+        out[mname] = {
+            "policy": policy.to_meta(),
+            "blocks": blocks,
+            "sweep_max_rel": round(_carry_dev(carry[0], state_mp), 8),
+        }
+    return {"version": TOLERANCE_VERSION, "models": out}
+
+
+def load_tolerance(path: str = TOLERANCE_PATH) -> dict | None:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (FileNotFoundError, ValueError):
+        return None
+    if doc.get("version") != TOLERANCE_VERSION:
+        return None
+    return doc
+
+
+def save_tolerance(doc: dict, path: str = TOLERANCE_PATH) -> None:
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=False)
+        f.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# scaled ledger models (the shapes the policy byte accounting is honest at)
+# ---------------------------------------------------------------------------
+
+def policy_ledger_models():
+    """Scaled variants of the canonical model classes for the cost
+    ledger's ``scale:`` / ``scale+mp:`` entries: species-heavy shapes
+    (the JSDM regime — PR 10's acceptance model is 10k species x 256
+    sites) where the policy's staged operands (the (ns, ns) phylo
+    eigenbasis, the (G, np, np) spatial grid) carry the block bytes.
+    The tiny audit specs stay the fingerprint/tolerance substrate; these
+    exist so the committed per-block byte ratios mean something."""
+    import numpy as np
+    import pandas as pd
+
+    from ..model import Hmsc
+    from ..random_level import HmscRandomLevel, set_priors_random_level
+    from ..analysis.jaxpr_rules import _canonical_models
+
+    base = _canonical_models()
+    models = {
+        # phylo base at ns >> ny: U is (ns, ns), Qeig (101, ns)
+        "base": lambda: base["base"](ny=48, ns=256),
+        # rrr / sel at moderate species counts (no staged grid dominates;
+        # the committed ratios record whatever the bf16 routing buys)
+        "rrr": lambda: base["rrr"](ny=96, ns=64),
+        "sel": lambda: base["sel"](ny=96, ns=64),
+    }
+
+    def spatial(ny=192, ns=8, n_units=96, method="Full", n_knots=None):
+        rng = np.random.default_rng(12)
+        X = np.column_stack([np.ones(ny), rng.standard_normal((ny, 1))])
+        Y = rng.standard_normal((ny, ns))
+        units = [f"u{i:03d}" for i in rng.integers(0, n_units, ny)]
+        for i in range(n_units):
+            units[i % ny] = f"u{i:03d}"
+        study = pd.DataFrame({"lvl": units})
+        s_df = pd.DataFrame(rng.uniform(size=(n_units, 2)),
+                            index=sorted(set(units)), columns=["x", "y"])
+        kw = dict(s_data=s_df, s_method=method)
+        if method == "GPP":
+            kw["s_knot"] = rng.uniform(size=(int(n_knots or 16), 2))
+        rl = HmscRandomLevel(**kw)
+        set_priors_random_level(rl, nf_max=2, nf_min=2)
+        return Hmsc(Y=Y, X=X, distr="normal", study_design=study,
+                    ran_levels={"lvl": rl})
+
+    models["spatial"] = spatial
+    # the knot-based predictive process: the SECOND spatial canonical
+    # method (reference vignette 4), whose (G, np, nK) knot grids are the
+    # gather-dominated byte stream the policy stages — with Full, the two
+    # spatial specs the >= 1.5x acceptance gate rides on
+    models["gpp"] = lambda: spatial(ny=448, ns=8, n_units=384,
+                                    method="GPP", n_knots=16)
+    return models

@@ -274,7 +274,8 @@ def _keep_record(name: str, record) -> bool:
 @functools.lru_cache(maxsize=64)
 def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin,
                      skip_init_z, record=None, nngp_dense_max=None,
-                     mesh=None, chain_axis="chains", species_axis="species"):
+                     mesh=None, chain_axis="chains", species_axis="species",
+                     precision=None, local_rng=False):
     """One jitted chain-vmapped sampling program per static config.
 
     Keyed on the hashable (spec, updater toggles, scan lengths) so repeated
@@ -294,6 +295,17 @@ def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin,
     snapshots the carry on-device before a checkpoint boundary).  A
     ``samples=0`` config is a pure burn-in segment: the sample scan has
     length 0 and the recorded tree comes back empty along the sample axis.
+
+    ``precision`` (a hashable :class:`~hmsc_tpu.mcmc.precision.
+    PrecisionPolicy`) engages the mixed-precision sweep: the runner takes
+    a trailing ``staged`` argument — the policy's bf16 shadow table
+    (:func:`~hmsc_tpu.mcmc.precision.stage_data`), deliberately NOT
+    donated (it is reused across every segment, unlike the carry) — and
+    the policy'd blocks trace inside their compute scopes.
+    ``precision=None`` keeps the historical 4-argument runner,
+    trace-identical to every prior release.  ``local_rng`` switches the
+    sharded sweep's species-dim draws to shard-local streams (see
+    :class:`~hmsc_tpu.mcmc.partition.ShardCtx`).
 
     ``mesh`` with a ``species_axis`` engages the SPECIES-SHARDED runner:
     the whole chain-vmapped program is wrapped in ``shard_map`` over the
@@ -317,9 +329,10 @@ def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin,
                     f"ns={spec.ns} is not divisible by the mesh's "
                     f"'{species_axis}' extent ({n_sp}); the sampler should "
                     "have fallen back to replication")
-            shard = ShardCtx(axis=species_axis, n=n_sp, ns=spec.ns)
+            shard = ShardCtx(axis=species_axis, n=n_sp, ns=spec.ns,
+                             local_rng=bool(local_rng))
             spec_run = _dc.replace(spec, ns=spec.ns // n_sp)
-    sweep = make_sweep(spec_run, updater, adapt_nf, shard)
+    sweep = make_sweep(spec_run, updater, adapt_nf, shard, precision)
 
     def first_bad_update(state, bad_it):
         """Track the first iteration whose carry went non-finite (divergence
@@ -336,7 +349,7 @@ def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin,
             ok = shard.all_ok(ok)
         return jnp.where((bad_it < 0) & ~ok, state.it, bad_it)
 
-    def run_chain(data, state, key, bad_it):
+    def run_chain(data, state, key, bad_it, staged=None):
         if not skip_init_z:
             # reference inits Z via one updateZ pass; a resumed or
             # continuation segment keeps its carried Z (and, so that the
@@ -349,7 +362,13 @@ def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin,
         def one_iter(carry, _):
             state, key, bad_it = carry
             key, sub = jax.random.split(key)
-            state = sweep(data, state, sub)
+            if precision is None:
+                state = sweep(data, state, sub)
+            else:
+                # same single consumption — only one branch ever traces
+                # (static on `precision`), the policy'd sweep just takes
+                # the staged table   # hmsc: ignore[rng-key-reuse]
+                state = sweep(data, state, sub, staged)
             bad_it = first_bad_update(state, bad_it)
             return (state, key, bad_it), None
 
@@ -368,7 +387,12 @@ def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin,
         carry, recs = jax.lax.scan(sample_step, carry, None, length=samples)
         return recs, carry[0], carry[2], carry[1]
 
-    mapped = jax.vmap(run_chain, in_axes=(None, 0, 0, 0))
+    if precision is None:
+        mapped = jax.vmap(run_chain, in_axes=(None, 0, 0, 0))
+    else:
+        # the staged shadow table rides unbatched (shared by every
+        # chain) and undonated (reused by every segment)
+        mapped = jax.vmap(run_chain, in_axes=(None, 0, 0, 0, None))
     if shard is None:
         return jax.jit(mapped, donate_argnums=(1, 2, 3))
 
@@ -379,13 +403,18 @@ def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin,
                             record_pspecs, tree_pspecs)
     rec_spec_for = record_pspecs(chain_axis, species_axis)
 
-    def fn(data, states, keys, bad):
+    def fn(data, states, keys, bad, *staged_args):
         in_specs = (
             tree_pspecs(data, spec, species_axis, DATA_SPECIES_DIMS,
                         x_is_list=spec.x_is_list),
             tree_pspecs(states, spec, species_axis, STATE_SPECIES_DIMS,
                         lead=chain_axis),
             P(chain_axis), P(chain_axis))
+        if precision is not None:
+            from .precision import staged_pspecs
+            in_specs = in_specs + (
+                staged_pspecs(staged_args[0] or {}, spec, species_axis,
+                              x_is_list=spec.x_is_list),)
         state_out = in_specs[1]
 
         # the recorded-sample tree's structure is known statically from
@@ -404,7 +433,7 @@ def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin,
         out_specs = (rec_specs, state_out, P(chain_axis), P(chain_axis))
         return shard_map(mapped, mesh=mesh, in_specs=in_specs,
                          out_specs=out_specs, check_rep=False)(
-                             data, states, keys, bad)
+                             data, states, keys, bad, *staged_args)
 
     return jax.jit(fn, donate_argnums=(1, 2, 3))
 
@@ -574,6 +603,7 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 init_state=None, profile_dir: str | None = None,
                 rng_impl: str | None = None, record_dtype=None,
                 retry_diverged: int = 0, record=None,
+                precision_policy=None, local_rng: bool = False,
                 checkpoint_every: int = 0, checkpoint_path: str | None = None,
                 checkpoint_keep: int = 3,
                 checkpoint_max_age_s: float | None = None,
@@ -794,6 +824,33 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
       fault-injection harness uses this to simulate device loss.  Any
       checkpoint already submitted for the boundary is drained to disk
       before the error escapes.
+    - ``precision_policy`` engages the per-block mixed-precision engine
+      (:mod:`hmsc_tpu.mcmc.precision`): ``"auto"`` selects the
+      ledger-driven default for this model class (the top wall-share
+      Gibbs blocks compute their heavy dots and grams bf16 with f32
+      accumulation, their sweep-invariant model-data operands are staged
+      to bf16 once per run, and the fused batched Cholesky layouts
+      activate); a :class:`~hmsc_tpu.mcmc.precision.PrecisionPolicy`
+      customises the block set.  Reductions and Cholesky/solve pivots
+      stay f32-pinned.  The draw stream is NOT the f32 stream: one-sweep
+      agreement is within the pinned
+      ``precision.PRECISION_AGREEMENT_TOL`` with per-block measurements
+      recorded in the committed ``precision_tolerance.json`` (the
+      training-side mirror of ``compact --dtype bfloat16``'s recorded
+      cast tolerance).  The default ``None`` is the exact pre-policy
+      engine — traced programs byte-identical to the committed
+      fingerprints.  The policy is stored in checkpoint metadata and
+      restored on resume (it changes the stream, so it is not
+      overridable there).
+    - ``local_rng=True`` (opt-in, requires the species-sharded sweep)
+      folds the shard index into the key and draws species-dim randoms
+      at O(ns_local) width instead of the default full-width-and-slice.
+      This trades the replicated-draw equality contract (sharded vs
+      replicated runs then agree only in distribution) for draw cost —
+      the full-width draws are the main weak-scaling overhead at
+      RNG-bound sizes.  Determinism is unchanged: same mesh/seed
+      reproduces the same stream, and kill -> resume stays
+      bit-identical.
     """
     import time
 
@@ -1029,6 +1086,20 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
             updater = dict(updater)
             updater["InterweaveDA"] = False
 
+    # per-block mixed-precision policy (mcmc/precision.py), resolved
+    # against the final spec so the ledger-driven "auto" selection sees
+    # the model class (and block applicability) it will actually run
+    from .precision import resolve_policy, stage_data
+    policy = resolve_policy(precision_policy, spec)
+    if policy is not None and profile_updaters is not None:
+        raise ValueError(
+            "profile_updaters is unsupported with a precision_policy: the "
+            "instrumented per-block pass runs the exact f32 schedule and "
+            "would mis-attribute the policy'd sweep — profile the f32 run, "
+            "or use the cost ledger's policy columns "
+            "(`python -m hmsc_tpu profile --static`)")
+    local_rng = bool(local_rng)
+
     updater_items = (tuple(sorted(updater.items())) if updater else None)
     sharding = None
     runner_mesh = None                    # engages the shard_map sweep path
@@ -1117,6 +1188,17 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
             state0 = _shard_species(state0, mesh, spec, sp, lead=chain_axis)
             if sp is not None:
                 data = _shard_species(data, mesh, spec, sp, lead=None)
+    if local_rng and runner_mesh is None:
+        raise ValueError(
+            "local_rng=True requires the species-sharded sweep (a mesh "
+            "with a species axis of extent >= 2 and a shardable model) — "
+            "on the replicated sweep there is no shard to localise the "
+            "draws to")
+
+    # the policy's staged bf16 shadow table: cast ONCE here (after any
+    # mesh placement, so the shadows inherit their originals' sharding)
+    # and passed to every segment as a real, undonated runner argument
+    staged_tbl = stage_data(data, policy) if policy is not None else None
 
     # progress printing and auto-checkpointing both split the sample scan
     # into host-level segments (the reference's per-iteration printout,
@@ -1409,6 +1491,17 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 "checkpoint_max_bytes": checkpoint_max_bytes,
                 "checkpoint_layout": checkpoint_layout,
                 "process_count": n_procs,
+                # both change the draw stream: resume restores them from
+                # here, never from overrides
+                "precision_policy": (policy.to_meta() if policy is not None
+                                     else None),
+                "local_rng": bool(local_rng),
+                # a local_rng stream folds the shard index into the keys,
+                # so a continuation must re-shard over the SAME species
+                # extent — resume_run checks this
+                "species_shards": (int(runner_mesh.shape[species_axis])
+                                   if (local_rng and runner_mesh is not None)
+                                   else None),
             }
 
         # ALL snapshot-write/layout logic lives in CheckpointWriter
@@ -1466,14 +1559,17 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                                   trans_seg, int(thin), skip_z, record,
                                   spatial._NNGP_DENSE_MAX,
                                   mesh=runner_mesh, chain_axis=chain_axis,
-                                  species_axis=species_axis)
+                                  species_axis=species_axis,
+                                  precision=policy, local_rng=local_rng)
             # a cache miss means this static config is new to the process:
             # the dispatch below pays XLA trace + compile synchronously —
             # name the span for what it spends its time on
             fresh = _compiled_runner.cache_info().misses > miss0
             with telem.span("compile" if fresh else "dispatch", seg=si):
-                recs, state_cur, bad_cur, keys = fn(data, state_cur, keys,
-                                                    bad_cur)
+                args = (data, state_cur, keys, bad_cur)
+                if policy is not None:
+                    args = args + (staged_tbl,)
+                recs, state_cur, bad_cur, keys = fn(*args)
             skip_z = True
             sweeps_done += trans_seg + int(seg) * int(thin)
             if not in_burnin:
@@ -1756,6 +1852,10 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                               chain_axis=chain_axis,
                               species_axis=species_axis,
                               shard_sweep=shard_sweep,
+                              precision_policy=(policy.to_meta()
+                                                if policy is not None
+                                                else None),
+                              local_rng=(local_rng and sub_mesh is not None),
                               init_state=sub_init,
                               rng_impl=rng_impl, record_dtype=record_dtype,
                               retry_diverged=retry_diverged - 1,
@@ -1779,6 +1879,10 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                               mesh=sub_mesh, chain_axis=chain_axis,
                               species_axis=species_axis,
                               shard_sweep=shard_sweep,
+                              precision_policy=(policy.to_meta()
+                                                if policy is not None
+                                                else None),
+                              local_rng=(local_rng and sub_mesh is not None),
                               rng_impl=rng_impl, record_dtype=record_dtype,
                               retry_diverged=retry_diverged - 1,
                               record=record, return_state=want_state)
@@ -1890,6 +1994,10 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                     align_post=False, verbose=verbose, rng_impl=rng_impl,
                     record_dtype=record_dtype,
                     retry_diverged=retry_diverged - 1, record=record,
+                    # the repair restart runs replicated single-process:
+                    # keep the policy'd numerics, drop the shard-local RNG
+                    precision_policy=(policy.to_meta() if policy is not None
+                                      else None),
                     coordinator=SingleProcessCoordinator(),
                     return_state=True)
                 if warm_state is not None:

@@ -25,8 +25,9 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax.scipy.linalg import solve_triangular
+from jax.scipy.linalg import cho_solve, solve_triangular
 
+from ..ops import mixed as mx
 from ..ops.linalg import chol_spd, sample_mvn_prec
 from .structs import GibbsState, LevelState, ModelData, ModelSpec
 from .updaters import _masked_level_gram, lambda_effective
@@ -110,9 +111,19 @@ def gpp_factor(LiSL, idD, M1, Fm):
     nK = M1.shape[2]
     A = LiSL + jnp.eye(nf, dtype=idD.dtype)[None] * idD.T[:, :, None]
     LA = chol_spd(A)
-    iA = jax.vmap(lambda Lc: solve_triangular(
-        Lc.T, solve_triangular(Lc, jnp.eye(nf, dtype=idD.dtype), lower=True),
-        lower=False))(LA)                               # (np, nf, nf)
+    if mx.layouts_active():
+        # fused batched layout: ONE batched forward/back solve pair over
+        # the np-unit batch instead of a vmapped closure of two
+        # per-unit triangular solves (policy-gated; the default path
+        # below is the fingerprint-pinned original)
+        iA = cho_solve((LA, True),
+                       jnp.broadcast_to(jnp.eye(nf, dtype=idD.dtype),
+                                        A.shape))       # (np, nf, nf)
+    else:
+        iA = jax.vmap(lambda Lc: solve_triangular(
+            Lc.T, solve_triangular(Lc, jnp.eye(nf, dtype=idD.dtype),
+                                   lower=True),
+            lower=False))(LA)                           # (np, nf, nf)
     # H = blockdiag(F_h) - M' iA M   over the (nf*nK) knot space
     MtAM = jnp.einsum("hum,uhg,gun->hmgn", M1, iA, M1)
     H = -MtAM
@@ -142,20 +153,22 @@ def gpp_draw(payload, F, eps1, eps2):
     return mean + noise1 + jnp.einsum("uhg,ug->uh", iA, Mw)
 
 
-def _gather_iW(lvd, alpha_idx):
-    """(nf, np, np) dense precisions iW(alpha_h) per factor."""
-    return lvd.iWg[alpha_idx]
-
-
-def _nngp_dense_iW(lvd, alpha_idx, npr):
+def _nngp_dense_iW(lvd, alpha_idx, npr, r: int = 0):
     """Densify the Vecchia precision iW = RiW' RiW for each factor's alpha.
 
     RiW rows: (e_i - sum_k A[i,k] e_{nn[i,k]}) / sqrt(D_i); built by scattering
     the neighbour coefficients into an (np, np) matrix per factor.
+    Policy'd blocks gather from the staged bf16 neighbour grids (the
+    dominant read); the densified factor and its gram stay f32.
     """
-    coef = lvd.nn_coef[alpha_idx]                 # (nf, np, k)
-    D = lvd.nn_D[alpha_idx]                       # (nf, np)
+    coef = mx.staged_level("nn_coef", r, lvd.nn_coef)[alpha_idx]
+    D = mx.staged_level("nn_D", r, lvd.nn_D)[alpha_idx]  # (nf, np)
     nf, _, k = coef.shape
+    dt = lvd.nn_D.dtype                           # f32 build regardless
+    if coef.dtype != dt:
+        coef = coef.astype(dt)
+    if D.dtype != dt:
+        D = D.astype(dt)
     rows = jnp.broadcast_to(jnp.arange(npr)[None, :, None], (nf, npr, k))
     RiW = jnp.zeros((nf, npr, npr), dtype=coef.dtype)
     RiW = RiW.at[jnp.arange(nf)[:, None, None], rows,
@@ -178,9 +191,13 @@ def update_eta_spatial(spec: ModelSpec, data: ModelData, state: GibbsState,
                                  shard)
 
     if ls.spatial == "Full":
-        iW = _gather_iW(lvd, lv.alpha_idx)        # (nf, np, np)
+        # policy'd blocks gather from the staged bf16 grid — the (G, np,
+        # np) structure read is the block's dominant byte stream
+        iW = mx.staged_level("iWg", r, lvd.iWg)[lv.alpha_idx]  # (nf, np, np)
     else:  # NNGP
-        iW = _nngp_dense_iW(lvd, lv.alpha_idx, npr)
+        iW = _nngp_dense_iW(lvd, lv.alpha_idx, npr, r)
+    if iW.dtype != F.dtype:
+        iW = iW.astype(F.dtype)
 
     # big precision (nf*np)^2, factor-major: blockdiag(iW_h) + unit-diagonal
     # factor coupling LiSL_u scattered at (h*np+u, g*np+u)
@@ -253,12 +270,17 @@ def _eta_gpp(spec, data, state, r, key, S, shard=None):
     LiSL, F = _masked_level_gram(spec, data, lvd, ls, lv, state.iSigma, S,
                                  shard)
 
-    idD = lvd.idDg[lv.alpha_idx]                  # (nf, np)
+    # policy'd blocks gather from the staged bf16 knot grids — the
+    # (G, np, nK) structure reads dominate the GPP block's bytes; the
+    # gathered (per-alpha) slices widen back to f32 immediately, so the
+    # Woodbury factorisation below is exact-pivot f32 either way
+    _f32 = lambda a: a.astype(F.dtype) if a.dtype != F.dtype else a
+    idD = _f32(mx.staged_level("idDg", r, lvd.idDg)[lv.alpha_idx])
     alpha0 = (lvd.alphapw[lv.alpha_idx, 0] == 0)  # alpha=0 slots: W=I
     idD = jnp.where(alpha0[:, None], 1.0, idD)
-    M1 = lvd.idDW12g[lv.alpha_idx]                # (nf, np, nK)
+    M1 = _f32(mx.staged_level("idDW12g", r, lvd.idDW12g)[lv.alpha_idx])
     M1 = jnp.where(alpha0[:, None, None], 0.0, M1)
-    Fm = lvd.Fg[lv.alpha_idx]                     # (nf, nK, nK)
+    Fm = _f32(mx.staged_level("Fg", r, lvd.Fg)[lv.alpha_idx])  # (nf, nK, nK)
     payload = gpp_factor(LiSL, idD, M1, Fm)
     k1, k2 = jax.random.split(key)
     eps1 = jax.random.normal(k1, (npr, nf), dtype=F.dtype)
@@ -269,18 +291,29 @@ def _eta_gpp(spec, data, state, r, key, S, shard=None):
 
 # ---------------------------------------------------------------------------
 
-def eta_quad_grid(lvd, ls, eta):
+def eta_quad_grid(lvd, ls, eta, r: int = 0):
     """(v, ld): per-factor prior quadratics eta_h' iW_g eta_h, both (nf, G),
     over the whole alpha grid.  Consumed by update_alpha; the interweaving
     scale move uses the single-point :func:`eta_quad_at` instead."""
     if ls.spatial == "Full":
-        v = jnp.einsum("hu,guv,hv->hg", eta.T, lvd.iWg, eta.T)
+        iWg = mx.staged_level("iWg", r, lvd.iWg)
+        if mx.layouts_active():
+            # single-pass layout: one (G, np*np) x (np*np, nf)
+            # contraction over the per-factor outer products instead of
+            # the grid-transposing three-operand einsum (policy-gated —
+            # the branch below is the fingerprint-pinned original)
+            E2 = jnp.einsum("uh,vh->huv", eta, eta)     # (nf, np, np)
+            v = mx.einsum("guv,huv->hg", iWg, E2)
+        else:
+            v = mx.einsum("hu,guv,hv->hg", eta.T, iWg, eta.T)
         ld = lvd.detWg[None, :]
     elif ls.spatial == "NNGP":
         eta_nn = eta[lvd.nn_idx]                    # (np, k, nf)
-        pred = jnp.einsum("gik,ikh->hgi", lvd.nn_coef, eta_nn)  # (nf, G, np)
+        pred = mx.einsum("gik,ikh->hgi",
+                         mx.staged_level("nn_coef", r, lvd.nn_coef),
+                         eta_nn)                                # (nf, G, np)
         res = eta.T[:, None, :] - pred                          # (nf, G, np)
-        v = (res**2 / lvd.nn_D[None]).sum(axis=2)               # (nf, G)
+        v = (res**2 / mx.staged_level("nn_D", r, lvd.nn_D)[None]).sum(axis=2)
         ld = lvd.detWg[None, :]
     else:  # GPP
         q_full = jnp.einsum("uh,uh->h", eta, eta)
@@ -292,26 +325,28 @@ def eta_quad_grid(lvd, ls, eta):
     return v, ld
 
 
-def eta_quad_at(lvd, ls, eta, alpha_idx):
+def eta_quad_at(lvd, ls, eta, alpha_idx, r: int = 0):
     """(nf,) prior quadratic eta_h' iW(alpha_h) eta_h at each factor's
     *current* alpha only — same algebra as :func:`eta_quad_grid` with the
     grid axis gathered away up front (the interweaving move needs one point
     per factor; evaluating the whole 101-point grid for it roughly doubled
     the update_alpha-scale prior cost per sweep)."""
     if ls.spatial == "Full":
-        iW = lvd.iWg[alpha_idx]                               # (nf, np, np)
-        return jnp.einsum("hu,huv,hv->h", eta.T, iW, eta.T)
+        iW = mx.staged_level("iWg", r, lvd.iWg)[alpha_idx]    # (nf, np, np)
+        return mx.einsum("hu,huv,hv->h", eta.T, iW, eta.T)
     if ls.spatial == "NNGP":
-        coef = lvd.nn_coef[alpha_idx]                         # (nf, np, k)
-        D = lvd.nn_D[alpha_idx]                               # (nf, np)
+        coef = mx.staged_level("nn_coef", r, lvd.nn_coef)[alpha_idx]
+        D = mx.staged_level("nn_D", r, lvd.nn_D)[alpha_idx]   # (nf, np)
         eta_nn = eta[lvd.nn_idx]                              # (np, k, nf)
-        pred = jnp.einsum("hik,ikh->hi", coef, eta_nn)        # (nf, np)
+        pred = mx.einsum("hik,ikh->hi", coef, eta_nn)         # (nf, np)
         res = eta.T - pred
         return (res**2 / D).sum(axis=1)
-    # GPP
-    idD = lvd.idDg[alpha_idx]                                 # (nf, np)
-    W12 = lvd.idDW12g[alpha_idx]                              # (nf, np, nK)
-    iF = lvd.iFg[alpha_idx]                                   # (nf, nK, nK)
+    # GPP — gathers count the full knot grids; staged bf16 halves them,
+    # the gathered slices widen to eta's dtype before the small einsums
+    _f32 = lambda a: a.astype(eta.dtype) if a.dtype != eta.dtype else a
+    idD = _f32(mx.staged_level("idDg", r, lvd.idDg)[alpha_idx])
+    W12 = _f32(mx.staged_level("idDW12g", r, lvd.idDW12g)[alpha_idx])
+    iF = _f32(mx.staged_level("iFg", r, lvd.iFg)[alpha_idx])  # (nf, nK, nK)
     t1 = jnp.einsum("hu,uh->h", idD, eta**2)
     Et = jnp.einsum("uh,hum->hm", eta, W12)                   # (nf, nK)
     t2 = jnp.einsum("hm,hmn,hn->h", Et, iF, Et)
@@ -319,29 +354,37 @@ def eta_quad_at(lvd, ls, eta, alpha_idx):
     return jnp.where(lvd.alphapw[alpha_idx, 0] == 0, q_full, t1 - t2)
 
 
-def eta_ones_forms_at(lvd, ls, eta, alpha_idx):
+def eta_ones_forms_at(lvd, ls, eta, alpha_idx, r: int = 0):
     """``(1' iW_h 1, 1' iW_h eta_h)`` per factor at each factor's current
     alpha, with ONE gather of the level's prior structures (the location
     interweave needs both; three :func:`eta_quad_at` polarization calls
     would triple the prior-quadratic cost)."""
     npr = eta.shape[0]
     if ls.spatial == "Full":
-        iW = lvd.iWg[alpha_idx]                               # (nf, np, np)
-        w = iW.sum(axis=2)                                    # iW_h @ 1
+        iW = mx.staged_level("iWg", r, lvd.iWg)[alpha_idx]    # (nf, np, np)
+        if iW.dtype != eta.dtype:
+            # staged bf16 gather: accumulate the row sums in f32 — the
+            # policy never lets a reduction run at bf16
+            w = iW.sum(axis=2, dtype=eta.dtype)
+        else:
+            w = iW.sum(axis=2)                                # iW_h @ 1
         return w.sum(axis=1), jnp.einsum("hu,uh->h", w, eta)
     if ls.spatial == "NNGP":
-        coef = lvd.nn_coef[alpha_idx]                         # (nf, np, k)
-        D = lvd.nn_D[alpha_idx]                               # (nf, np)
+        coef = mx.staged_level("nn_coef", r, lvd.nn_coef)[alpha_idx]
+        D = mx.staged_level("nn_D", r, lvd.nn_D)[alpha_idx]   # (nf, np)
         # RiW x rows: (x_i - sum_k A[i,k] x_nn[i,k]) / sqrt(D_i)
         sqD = jnp.sqrt(D)
-        r1 = (1.0 - coef.sum(axis=2)) / sqD                   # RiW @ 1
-        pred = jnp.einsum("hik,ikh->hi", coef, eta[lvd.nn_idx])
+        csum = (coef.sum(axis=2, dtype=eta.dtype)
+                if coef.dtype != eta.dtype else coef.sum(axis=2))
+        r1 = (1.0 - csum) / sqD                               # RiW @ 1
+        pred = mx.einsum("hik,ikh->hi", coef, eta[lvd.nn_idx])
         re = (eta.T - pred) / sqD                             # RiW @ eta
         return (r1**2).sum(axis=1), (r1 * re).sum(axis=1)
     # GPP: x' iW y = sum_u idD x y - (x' M1) iF (M1' y); alpha=0 -> I
-    idD = lvd.idDg[alpha_idx]                                 # (nf, np)
-    W12 = lvd.idDW12g[alpha_idx]                              # (nf, np, nK)
-    iF = lvd.iFg[alpha_idx]                                   # (nf, nK, nK)
+    _f32g = lambda a: a.astype(eta.dtype) if a.dtype != eta.dtype else a
+    idD = _f32g(mx.staged_level("idDg", r, lvd.idDg)[alpha_idx])
+    W12 = _f32g(mx.staged_level("idDW12g", r, lvd.idDW12g)[alpha_idx])
+    iF = _f32g(mx.staged_level("iFg", r, lvd.iFg)[alpha_idx])
     E1 = W12.sum(axis=1)                                      # 1' idDW12
     Ee = jnp.einsum("uh,hum->hm", eta, W12)
     q1 = idD.sum(axis=1) - jnp.einsum("hm,hmn,hn->h", E1, iF, E1)
@@ -357,7 +400,7 @@ def update_alpha(spec: ModelSpec, data: ModelData, state: GibbsState, r: int,
     """Per-factor categorical draw of the GP range on the alphapw grid:
     log p_g  =  log prior_g - 0.5 log|W_g| - 0.5 eta' iW_g eta."""
     lvd, lv, ls = data.levels[r], state.levels[r], spec.levels[r]
-    v, ld = eta_quad_grid(lvd, ls, lv.Eta)
+    v, ld = eta_quad_grid(lvd, ls, lv.Eta, r=r)
     loglike = jnp.log(lvd.alphapw[None, :, 1]) - 0.5 * ld - 0.5 * v
     idx = jax.random.categorical(key, loglike, axis=-1).astype(jnp.int32)
     idx = jnp.where(lv.nf_mask > 0, idx, 0)

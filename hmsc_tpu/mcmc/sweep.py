@@ -58,8 +58,24 @@ def effective_spec_data(spec: ModelSpec, data: ModelData, state: GibbsState):
 # registered updaters; every other name matches ``mcmc/registry.py``.
 # Every block runs strictly after ``sweep_prologue`` (it+1 + key split).
 
+def _precision_block(fn, dtype, layouts):
+    """Wrap one schedule block in a mixed-precision compute scope
+    (:mod:`hmsc_tpu.ops.mixed`) — entered at TRACE time around the
+    block's fold, so the routed dots/grams inside see the policy's
+    compute dtype and the fused batched layouts.  Never applied when
+    ``precision is None``: the default schedule is the exact historical
+    blocks (fingerprint-pinned)."""
+    from ..ops import mixed
+
+    def wrapped(data, carry, ks):
+        with mixed.scope(dtype, layouts):
+            return fn(data, carry, ks)
+    return wrapped
+
+
 def make_sweep_schedule(spec: ModelSpec, updater: dict | None = None,
-                        adapt_nf: tuple | None = None, shard=None):
+                        adapt_nf: tuple | None = None, shard=None,
+                        precision=None):
     updater = updater or {}
     on = lambda name: updater.get(name, True) is not False
     adapt_nf = adapt_nf or tuple(0 for _ in range(spec.nr))
@@ -87,6 +103,10 @@ def make_sweep_schedule(spec: ModelSpec, updater: dict | None = None,
     steps: list = []
 
     def add(name, fn):
+        if precision is not None:
+            dt = precision.dtype_for(name)
+            if dt is not None:
+                fn = _precision_block(fn, dt, precision.batched_layouts)
         steps.append((name, fn))
 
     if has_dynamic_x:
@@ -321,28 +341,50 @@ def sweep_prologue(state: GibbsState, key):
 
 
 def make_sweep(spec: ModelSpec, updater: dict | None = None,
-               adapt_nf: tuple | None = None, shard=None):
+               adapt_nf: tuple | None = None, shard=None, precision=None):
     """The production fused sweep: the schedule's blocks folded inline into
     one pure ``(data, state, key) -> state`` function (one traced program;
     XLA fuses across block boundaries exactly as before the schedule
-    existed — the committed jaxpr fingerprints pin the op sequence)."""
-    steps = make_sweep_schedule(spec, updater, adapt_nf, shard)
+    existed — the committed jaxpr fingerprints pin the op sequence).
 
-    def sweep(data: ModelData, state: GibbsState, key) -> GibbsState:
+    With a :class:`~hmsc_tpu.mcmc.precision.PrecisionPolicy` the returned
+    function takes a fourth ``staged`` argument — the policy's bf16
+    shadow table (:func:`~hmsc_tpu.mcmc.precision.stage_data`), passed as
+    a real argument so it is never baked into the program — and the
+    policy'd blocks trace inside their mixed-precision scopes.
+    ``precision=None`` returns the exact historical 3-argument sweep."""
+    steps = make_sweep_schedule(spec, updater, adapt_nf, shard, precision)
+
+    if precision is None:
+        def sweep(data: ModelData, state: GibbsState, key) -> GibbsState:
+            state, ks = sweep_prologue(state, key)
+            carry = (state, None, None, None)
+            for _name, block in steps:
+                # blocks receive the full subkey TABLE and statically index
+                # disjoint rows — the fold passes ks through, never consumes it
+                carry = block(data, carry, ks)  # hmsc: ignore[rng-key-reuse]
+            return carry[0]
+
+        return sweep
+
+    from ..ops import mixed
+
+    def sweep_mp(data: ModelData, state: GibbsState, key,
+                 staged=None) -> GibbsState:
         state, ks = sweep_prologue(state, key)
         carry = (state, None, None, None)
-        for _name, block in steps:
-            # blocks receive the full subkey TABLE and statically index
-            # disjoint rows — the fold passes ks through, never consumes it
-            carry = block(data, carry, ks)  # hmsc: ignore[rng-key-reuse]
+        with mixed.staged_scope(staged):
+            for _name, block in steps:
+                carry = block(data, carry, ks)  # hmsc: ignore[rng-key-reuse]
         return carry[0]
 
-    return sweep
+    return sweep_mp
 
 
 def make_sharded_sweep(spec: ModelSpec, mesh, updater: dict | None = None,
                        adapt_nf: tuple | None = None,
-                       species_axis: str = "species"):
+                       species_axis: str = "species", precision=None,
+                       local_rng: bool = False):
     """The species-sharded sweep as a standalone ``shard_map`` program:
     one pure ``(data, state, key) -> state`` function for a CHAINLESS
     state, with the in/out PartitionSpecs from :mod:`.partition` made
@@ -366,21 +408,40 @@ def make_sharded_sweep(spec: ModelSpec, mesh, updater: dict | None = None,
     if spec.ns % n_sp:
         raise ValueError(f"ns={spec.ns} not divisible by the mesh's "
                          f"'{species_axis}' extent ({n_sp})")
-    shard = ShardCtx(axis=species_axis, n=n_sp, ns=spec.ns)
+    shard = ShardCtx(axis=species_axis, n=n_sp, ns=spec.ns,
+                     local_rng=bool(local_rng))
     spec_l = _dc.replace(spec, ns=spec.ns // n_sp)
-    body = make_sweep(spec_l, updater, adapt_nf, shard)
+    body = make_sweep(spec_l, updater, adapt_nf, shard, precision)
 
-    def sharded(data: ModelData, state: GibbsState, key) -> GibbsState:
+    if precision is None:
+        def sharded(data: ModelData, state: GibbsState, key) -> GibbsState:
+            in_specs = (
+                tree_pspecs(data, spec, species_axis, DATA_SPECIES_DIMS,
+                            x_is_list=spec.x_is_list),
+                tree_pspecs(state, spec, species_axis, STATE_SPECIES_DIMS),
+                P())
+            return shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=in_specs[1], check_rep=False)(
+                                 data, state, key)
+
+        return sharded
+
+    from .precision import staged_pspecs
+
+    def sharded_mp(data: ModelData, state: GibbsState, key,
+                   staged=None) -> GibbsState:
         in_specs = (
             tree_pspecs(data, spec, species_axis, DATA_SPECIES_DIMS,
                         x_is_list=spec.x_is_list),
             tree_pspecs(state, spec, species_axis, STATE_SPECIES_DIMS),
-            P())
+            P(),
+            staged_pspecs(staged or {}, spec, species_axis,
+                          x_is_list=spec.x_is_list))
         return shard_map(body, mesh=mesh, in_specs=in_specs,
                          out_specs=in_specs[1], check_rep=False)(
-                             data, state, key)
+                             data, state, key, staged or {})
 
-    return sharded
+    return sharded_mp
 
 
 # ---------------------------------------------------------------------------

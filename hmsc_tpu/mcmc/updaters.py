@@ -13,10 +13,20 @@ import jax.numpy as jnp
 import numpy as np
 from jax.scipy.linalg import solve_triangular
 
+from ..ops import mixed as mx
 from ..ops.linalg import chol_spd, sample_mvn_prec, sample_mvn_prec_batched
 from ..ops.rand import (polya_gamma, standard_gamma, truncated_normal,
                         truncated_normal_onesided, wishart)
 from .structs import GibbsState, LevelState, ModelData, ModelSpec
+
+# Heavy dots and grams route through hmsc_tpu.ops.mixed (`mx.matmul` /
+# `mx.einsum` / `mx.staged`): outside a precision-policy scope these are
+# LITERALLY the plain jnp calls (byte-identical traces, fingerprint-
+# pinned); inside a policy'd block they compute bf16 with f32
+# accumulation, and `mx.staged` resolves the policy's pre-cast shadow of
+# sweep-invariant model data.  Reductions, Cholesky factorisations and
+# triangular solves never route through mx — their dtypes stay pinned to
+# their f32 operands (audited by `jaxpr-mixed-precision`).
 
 __all__ = ["linear_fixed", "level_loading", "update_z", "update_beta_lambda",
            "update_gamma_v", "gamma_given_beta", "update_rho",
@@ -41,15 +51,15 @@ def linear_fixed(spec: ModelSpec, data: ModelData, Beta: jnp.ndarray) -> jnp.nda
     """LFix = X @ Beta; per-species X handled as a batched contraction
     (reference updateZ.R:12-24)."""
     if spec.x_is_list:
-        return jnp.einsum("jyc,cj->yj", data.X, Beta)
-    return data.X @ Beta
+        return mx.einsum("jyc,cj->yj", mx.staged("X", data.X), Beta)
+    return mx.matmul(mx.staged("X", data.X), Beta)
 
 
 def level_loading(data_lv, lv: LevelState) -> jnp.ndarray:
     """LRan_r = sum_k (Eta[pi,:] * x_row[:,k]) @ Lambda[:,:,k]."""
     lam = lambda_effective(lv)
     eta_rows = lv.Eta[data_lv.pi_row]
-    return jnp.einsum("yf,yk,fjk->yj", eta_rows, data_lv.x_row, lam)
+    return mx.einsum("yf,yk,fjk->yj", eta_rows, data_lv.x_row, lam)
 
 
 def total_loading(spec: ModelSpec, data: ModelData, state: GibbsState) -> jnp.ndarray:
@@ -212,12 +222,12 @@ def _per_species_design_gram(spec, data, XE, mask):
         Es = XE  # (ny, K) factor part shared
         def gram(Xj, mj):
             D = jnp.concatenate([Xj, Es], axis=1)
-            return jnp.einsum("ip,i,iq->pq", D, mj, D), D
+            return mx.einsum("ip,i,iq->pq", D, mj, D), D
         G, _ = jax.vmap(gram, in_axes=(0, 1))(data.X, mask)
         return G
     if spec.has_na:
-        return jnp.einsum("ip,ij,iq->jpq", XE, mask, XE)
-    G = XE.T @ XE
+        return mx.einsum("ip,ij,iq->jpq", XE, mask, XE)
+    G = mx.matmul(XE.T, XE)
     return jnp.broadcast_to(G, (spec.ns,) + G.shape)
 
 
@@ -236,16 +246,16 @@ def _beta_lambda_joint(spec, data, state, key, shard=None):
     if spec.x_is_list:
         def per_species(Xj, mj, Sj):
             D = jnp.concatenate([Xj, XE_factor], axis=1)
-            G = jnp.einsum("ip,i,iq->pq", D, mj, D)
-            rhs_lik = D.T @ (Sj * mj)
+            G = mx.einsum("ip,i,iq->pq", D, mj, D)
+            rhs_lik = mx.matmul(D.T, Sj * mj)
             return G, rhs_lik
         G, rhs_lik = jax.vmap(per_species, in_axes=(0, 1, 1))(data.X, mask, state.Z)
     else:
         G = _per_species_design_gram(spec, data, XE, mask)
         if spec.has_na:
-            rhs_lik = jnp.einsum("ip,ij,ij->jp", XE, mask, state.Z)
+            rhs_lik = mx.einsum("ip,ij,ij->jp", XE, mask, state.Z)
         else:
-            rhs_lik = (XE.T @ state.Z).T                  # (ns, P)
+            rhs_lik = mx.matmul(XE.T, state.Z).T          # (ns, P)
 
     # per-species posterior precision = blkdiag(iV, diag(psi*tau)) + iSigma_j*G_j
     eyeP = jnp.eye(P, dtype=G.dtype)
@@ -279,12 +289,12 @@ def _lambda_given_beta(spec, data, state, key, shard=None):
     prior_lam = _stacked_lambda_prior(spec, state)        # (K, ns)
     mask = data.Ymask
     if spec.has_na:
-        G = jnp.einsum("ip,ij,iq->jpq", Es, mask, Es)
-        rhs_lik = jnp.einsum("ip,ij,ij->jp", Es, mask, S)
+        G = mx.einsum("ip,ij,iq->jpq", Es, mask, Es)
+        rhs_lik = mx.einsum("ip,ij,ij->jp", Es, mask, S)
     else:
-        G0 = Es.T @ Es
+        G0 = mx.matmul(Es.T, Es)
         G = jnp.broadcast_to(G0, (spec.ns,) + G0.shape)
-        rhs_lik = (Es.T @ S).T
+        rhs_lik = mx.matmul(Es.T, S).T
     prec = state.iSigma[:, None, None] * G \
         + jnp.eye(K, dtype=G.dtype)[None] * prior_lam.T[:, :, None]
     rhs = state.iSigma[:, None] * rhs_lik
@@ -320,21 +330,23 @@ def _beta_given_lambda_phylo(spec, data, state, key, shard=None):
     if spec.homoskedastic_fixed and not spec.has_na and not spec.x_is_list:
         sigma2 = data.sigma_fixed[0]
         isig = 1.0 / sigma2
-        XtX = data.X.T @ data.X
+        Xs = mx.staged("X", data.X)
+        Us = mx.staged("U", data.U)
+        XtX = mx.matmul(Xs.T, Xs)
         Lv = chol_spd(state.iV)
         B = solve_triangular(Lv, solve_triangular(Lv, XtX, lower=True).T, lower=True)
         g, R = jnp.linalg.eigh((B + B.T) / 2)
         Wm = solve_triangular(Lv.T, R, lower=False)       # W' iV W = I, W' XtX W = diag(g)
-        XW = data.X @ Wm
-        R0 = S - data.X @ M
-        T = (XW.T @ R0) @ data.U                          # (nc, ns)
+        XW = mx.matmul(Xs, Wm)
+        R0 = S - mx.matmul(Xs, M)
+        T = mx.matmul(mx.matmul(XW.T, R0), Us)            # (nc, ns)
         if shard is not None:
             T = shard.psum(T)
         prec = 1.0 / e[None, :] + isig * g[:, None]
         mean = (isig * T) / prec
         eps = jax.random.normal(key, mean.shape, dtype=mean.dtype)
         Gt = mean + eps / jnp.sqrt(prec)
-        Beta = M + Wm @ (Gt @ data.U.T)
+        Beta = M + mx.matmul(Wm, mx.matmul(Gt, Us.T))
         return state.replace(Beta=Beta)
 
     # general dense (nc*ns) system, species-major vec ordering
@@ -379,12 +391,14 @@ def _phylo_trq(spec, data, state, shard=None):
     if spec.has_phylo:
         e = data.Qeig[state.rho_idx]
         se = jnp.sqrt(e)
-        UTs = data.UTr / se[:, None]
-        TrQ = data.U @ (UTs / se[:, None])                # iQ Tr (ns, nt)
-        TtQT = UTs.T @ UTs
+        UTs = mx.staged("UTr", data.UTr) / se[:, None]
+        TrQ = mx.matmul(mx.staged("U", data.U),
+                        UTs / se[:, None])                # iQ Tr (ns, nt)
+        TtQT = mx.matmul(UTs.T, UTs)
     else:
+        Trs = mx.staged("Tr", data.Tr)
         TrQ = data.Tr
-        TtQT = data.Tr.T @ data.Tr
+        TtQT = mx.matmul(Trs.T, Trs)
         if shard is not None:
             TtQT = shard.psum(TtQT)
     return TrQ, TtQT
@@ -397,7 +411,8 @@ def gamma_given_beta(spec: ModelSpec, data: ModelData, state: GibbsState,
     TrQ, TtQT = _phylo_trq(spec, data, state, shard)
     prec = data.iUGamma + jnp.kron(TtQT, state.iV)
     rhs0 = data.iUGamma @ data.mGamma     # (trace order matches the
-    t2 = (state.iV @ state.Beta) @ TrQ    # historical one-liner)
+    t2 = mx.matmul(mx.matmul(state.iV, state.Beta),
+                   TrQ)                   # historical one-liner)
     if shard is not None:                 # cross-species contraction
         t2 = shard.psum(t2)
     rhs = rhs0 + t2.T.reshape(-1)
@@ -422,12 +437,13 @@ def update_gamma_v(spec: ModelSpec, data: ModelData, state: GibbsState,
         # sqrt-split the 1/e weights so f32 intermediates stay ~1/sqrt(e_min)
         # and the Gram products are exactly symmetric PSD
         if shard is None:
-            Et = (E @ data.U) / se[None, :]
+            Et = mx.matmul(E, mx.staged("U", data.U)) / se[None, :]
         else:
-            Et = shard.psum(E @ data.U) / se[None, :]
-        A = Et @ Et.T
+            Et = shard.psum(mx.matmul(E, mx.staged("U", data.U))) \
+                / se[None, :]
+        A = mx.matmul(Et, Et.T)
     else:
-        A = E @ E.T
+        A = mx.matmul(E, E.T)
         if shard is not None:
             A = shard.psum(A)
 
@@ -446,11 +462,11 @@ def update_rho(spec: ModelSpec, data: ModelData, state: GibbsState,
     one psum completes the eigenbasis projection; the grid scan then runs
     replicated at full width (``Qeig`` is replicated data)."""
     E = state.Beta - state.Gamma @ data.Tr.T
-    Et = E @ data.U                                        # (nc, ns)
+    Et = mx.matmul(E, mx.staged("U", data.U))              # (nc, ns)
     if shard is not None:
         Et = shard.psum(Et)
-    q = jnp.einsum("cj,cd,dj->j", Et, state.iV, Et)        # (ns,)
-    v = (q[None, :] / data.Qeig).sum(axis=1)               # (G,)
+    q = mx.einsum("cj,cd,dj->j", Et, state.iV, Et)         # (ns,)
+    v = (q[None, :] / mx.staged("Qeig", data.Qeig)).sum(axis=1)  # (G,)
     loglike = jnp.log(data.rhopw[:, 1]) - 0.5 * spec.nc * data.logdetQ - 0.5 * v
     idx = jax.random.categorical(key, loglike)
     return state.replace(rho_idx=idx.astype(jnp.int32))
@@ -484,6 +500,11 @@ def update_lambda_priors(spec: ModelSpec, data: ModelData, state: GibbsState,
         if shard is None:
             psi = standard_gamma(
                 kpsi, jnp.broadcast_to(a_psi, lam2.shape)) / b_psi
+        elif shard.local_rng:
+            # O(ns_local) draw with the shard-folded key (lam2 is local)
+            psi = standard_gamma(
+                shard.fold(kpsi), jnp.broadcast_to(a_psi, lam2.shape)) \
+                / b_psi
         else:
             g_full = standard_gamma(kpsi, jnp.broadcast_to(
                 a_psi, (ls.nf_max, ns_g, ls.ncr)))
@@ -526,28 +547,28 @@ def _masked_level_gram(spec, data, lvd, ls, lv, iSigma, S, shard=None):
     if ls.x_dim == 0:
         lam = lambda_effective(lv)[:, :, 0]                # (nf, ns)
         if spec.has_na:
-            rows = jnp.einsum("fj,gj,j,ij->ifg", lam, lam, iSigma, data.Ymask)
+            rows = mx.einsum("fj,gj,j,ij->ifg", lam, lam, iSigma, data.Ymask)
             LiSL = jax.ops.segment_sum(rows, lvd.pi_row, num_segments=npr)
             if shard is not None:
                 LiSL = shard.psum(LiSL)
-            Fr = (S * iSigma[None, :] * data.Ymask) @ lam.T
+            Fr = mx.matmul(S * iSigma[None, :] * data.Ymask, lam.T)
         else:
-            shared = (lam * iSigma[None, :]) @ lam.T
+            shared = mx.matmul(lam * iSigma[None, :], lam.T)
             if shard is not None:
                 shared = shard.psum(shared)
             LiSL = lvd.unit_count[:, None, None] * shared[None]
-            Fr = (S * iSigma[None, :]) @ lam.T
+            Fr = mx.matmul(S * iSigma[None, :], lam.T)
         F = jax.ops.segment_sum(Fr, lvd.pi_row, num_segments=npr)
         if shard is not None:
             F = shard.psum(F)
         return LiSL, F
     lam = lambda_effective(lv)                              # (nf, ns, ncr)
-    lam_u = jnp.einsum("fjk,uk->ufj", lam, lvd.x_unit)      # (np, nf, ns)
+    lam_u = mx.einsum("fjk,uk->ufj", lam, lvd.x_unit)       # (np, nf, ns)
     Mu_cnt = jax.ops.segment_sum(data.Ymask, lvd.pi_row, num_segments=npr)
-    LiSL = jnp.einsum("ufj,ugj,j,uj->ufg", lam_u, lam_u, iSigma, Mu_cnt)
+    LiSL = mx.einsum("ufj,ugj,j,uj->ufg", lam_u, lam_u, iSigma, Mu_cnt)
     T = jax.ops.segment_sum(S * iSigma[None, :] * data.Ymask, lvd.pi_row,
                             num_segments=npr)
-    F = jnp.einsum("uj,ufj->uf", T, lam_u)
+    F = mx.einsum("uj,ufj->uf", T, lam_u)
     if shard is not None:
         LiSL = shard.psum(LiSL)
         F = shard.psum(F)
@@ -574,7 +595,7 @@ def update_eta_nonspatial(spec, data, state, r: int, key, S, shard=None):
 # model; Liu & Sabatti 2000 generalized Gibbs / Yu & Meng 2011 interweaving)
 # ---------------------------------------------------------------------------
 
-def _eta_prior_quad(lvd, lv, ls) -> jnp.ndarray:
+def _eta_prior_quad(lvd, lv, ls, r: int = 0) -> jnp.ndarray:
     """(nf,) quadratic form eta_h' iW(alpha_h) eta_h under the level's actual
     factor prior (identity for unstructured levels; the spatial precision at
     each factor's current alpha for Full/NNGP/GPP — same grid algebra as
@@ -582,7 +603,7 @@ def _eta_prior_quad(lvd, lv, ls) -> jnp.ndarray:
     if ls.spatial is None:
         return (lv.Eta ** 2).sum(axis=0)
     from .spatial import eta_quad_at
-    return eta_quad_at(lvd, ls, lv.Eta, lv.alpha_idx)
+    return eta_quad_at(lvd, ls, lv.Eta, lv.alpha_idx, r=r)
 
 
 def interweave_scale(spec: ModelSpec, data: ModelData, state: GibbsState,
@@ -604,7 +625,7 @@ def interweave_scale(spec: ModelSpec, data: ModelData, state: GibbsState,
         lvd, lv, ls = data.levels[r], state.levels[r], spec.levels[r]
         kr1, kr2 = jax.random.split(jax.random.fold_in(key, r))
         mask = lv.nf_mask                                 # (nf,)
-        A = _eta_prior_quad(lvd, lv, ls)
+        A = _eta_prior_quad(lvd, lv, ls, r=r)
         delta = jnp.where(mask[:, None] > 0, lv.Delta, 1.0)
         tau = jnp.cumprod(delta, axis=0)                  # (nf, ncr)
         B = (lv.Psi * tau[:, None, :] * lv.Lambda ** 2).sum(axis=(1, 2))
@@ -692,23 +713,25 @@ def interweave_location(spec: ModelSpec, data: ModelData, state: GibbsState,
             s = lv.Eta.sum(axis=0)                        # 1' eta_h
         else:
             from .spatial import eta_ones_forms_at
-            q1, s = eta_ones_forms_at(lvd, ls, lv.Eta, lv.alpha_idx)
+            q1, s = eta_ones_forms_at(lvd, ls, lv.Eta, lv.alpha_idx, r=r)
+        Us = mx.staged("U", data.U) if spec.has_phylo else None
         if spec.has_phylo and shard is None:
             e = data.Qeig[state.rho_idx]                  # (ns,)
-            lamU = lam @ data.U
-            G = (lamU / e[None, :]) @ lamU.T              # Lam iQ Lam'
-            bB = (lamU / e[None, :]) @ (data.U.T @ u)
+            lamU = mx.matmul(lam, Us)
+            G = mx.matmul(lamU / e[None, :], lamU.T)      # Lam iQ Lam'
+            bB = mx.matmul(lamU / e[None, :], mx.matmul(Us.T, u))
         elif spec.has_phylo:
             e = data.Qeig[state.rho_idx]
-            lamU = shard.psum(lam @ data.U)               # projections psum
-            G = (lamU / e[None, :]) @ lamU.T
-            bB = (lamU / e[None, :]) @ shard.psum(data.U.T @ u)
+            lamU = shard.psum(mx.matmul(lam, Us))         # projections psum
+            G = mx.matmul(lamU / e[None, :], lamU.T)
+            bB = mx.matmul(lamU / e[None, :],
+                           shard.psum(mx.matmul(Us.T, u)))
         elif shard is None:
-            G = lam @ lam.T
-            bB = lam @ u
+            G = mx.matmul(lam, lam.T)
+            bB = mx.matmul(lam, u)
         else:
-            G = shard.psum(lam @ lam.T)
-            bB = shard.psum(lam @ u)
+            G = shard.psum(mx.matmul(lam, lam.T))
+            bB = shard.psum(mx.matmul(lam, u))
         P = v00 * G + jnp.diag(jnp.where(mask > 0, q1, 1.0))
         b = jnp.where(mask > 0, bB - s, 0.0)
         L = chol_spd(P)
@@ -786,6 +809,10 @@ def interweave_da_intercept(spec: ModelSpec, data: ModelData,
     v00 = state.iV[ii, ii]
     if shard is None:
         t = truncated_normal(key, lo, hi, mean=b0 - u / v00, std=v00 ** -0.5)
+    elif shard.local_rng:
+        # local mode: draw on the local bounds with the folded key
+        t = truncated_normal(shard.fold(key), lo, hi,
+                             mean=b0 - u / v00, std=v00 ** -0.5)
     else:
         # the (ns,) truncation bounds are tiny: gather them, draw the
         # full-width truncated normal replicated, keep the local slice —
@@ -813,6 +840,9 @@ def update_inv_sigma(spec: ModelSpec, data: ModelData, state: GibbsState,
     rate = data.bSigma + 0.5 * ((Eps * data.Ymask) ** 2).sum(axis=0)
     if shard is None:
         draw = standard_gamma(key, shape) / rate
+    elif shard.local_rng:
+        # local mode: the shapes are already local — no gather, no slice
+        draw = standard_gamma(shard.fold(key), shape) / rate
     else:
         # gamma shapes are species-dependent: gather the tiny (ns,) shape
         # vector, draw full-width replicated, slice — bit-identical
@@ -879,6 +909,10 @@ def update_nf(spec: ModelSpec, data: ModelData, state: GibbsState, r: int,
     Eta = lv.Eta * (1 - sel)[None, :] + new_eta_col[:, None] * sel[None, :]
     if shard is None:
         new_psi = standard_gamma(k_psi, jnp.broadcast_to(
+            lvd.nu[None, :] / 2, (spec.ns, ls.ncr))) / (lvd.nu[None, :] / 2)
+    elif shard.local_rng:
+        # local spec: spec.ns is already the shard width
+        new_psi = standard_gamma(shard.fold(k_psi), jnp.broadcast_to(
             lvd.nu[None, :] / 2, (spec.ns, ls.ncr))) / (lvd.nu[None, :] / 2)
     else:
         new_psi = shard.slice_sp(standard_gamma(k_psi, jnp.broadcast_to(

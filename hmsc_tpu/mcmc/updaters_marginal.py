@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 from jax.scipy.linalg import cho_solve
 
+from ..ops import mixed as mx
 from ..ops.linalg import chol_spd, sample_mvn_prec
 from .structs import GibbsState, ModelData, ModelSpec
 from . import updaters as U
@@ -106,12 +107,23 @@ def update_gamma2(spec: ModelSpec, data: ModelData, state: GibbsState,
     isig = state.iSigma                                   # (ns,)
     iP = state.iV[None] + isig[:, None, None] * XX        # (ns, nc, nc)
     LiP = chol_spd(iP)
-    XXiPXX = jnp.einsum("jpq,jqr->jpr", XX,
-                        cho_solve((LiP, True), XX))
-    W = isig[:, None, None] * (XX - isig[:, None, None] * XXiPXX)
-    # X' Sigma_j^{-1} z_j = iSig_j (X'z_j - iSig_j XX iP^{-1} X'z_j)
-    XiSz = isig[:, None] * (XtS - isig[:, None] * jnp.einsum(
-        "jpq,jq->jp", XX, cho_solve((LiP, True), XtS[..., None])[..., 0]))
+    if mx.layouts_active():
+        # fused batched layout (policy-gated): ONE batched cho_solve on
+        # the concatenated [XX | X'z] right-hand side instead of two
+        # separate solve chains against the same factor
+        sol = cho_solve((LiP, True),
+                        jnp.concatenate([XX, XtS[..., None]], axis=-1))
+        XXiPXX = jnp.einsum("jpq,jqr->jpr", XX, sol[..., :nc])
+        W = isig[:, None, None] * (XX - isig[:, None, None] * XXiPXX)
+        XiSz = isig[:, None] * (XtS - isig[:, None] * jnp.einsum(
+            "jpq,jq->jp", XX, sol[..., nc]))
+    else:
+        XXiPXX = jnp.einsum("jpq,jqr->jpr", XX,
+                            cho_solve((LiP, True), XX))
+        W = isig[:, None, None] * (XX - isig[:, None, None] * XXiPXX)
+        # X' Sigma_j^{-1} z_j = iSig_j (X'z_j - iSig_j XX iP^{-1} X'z_j)
+        XiSz = isig[:, None] * (XtS - isig[:, None] * jnp.einsum(
+            "jpq,jq->jp", XX, cho_solve((LiP, True), XtS[..., None])[..., 0]))
 
     # column-major vec(Gamma) (t-major blocks of nc), as in update_gamma_v
     prec = data.iUGamma + jnp.einsum("jt,ju,jpq->tpuq", data.Tr, data.Tr,
@@ -210,11 +222,22 @@ def update_gamma_eta(spec: ModelSpec, data: ModelData, state: GibbsState,
         Wd = Wd + jnp.einsum("fg,p,pq->fpgq", G, counts,
                              jnp.eye(npr))
         Lw = chol_spd(Wd.reshape(nf * npr, nf * npr))
-        iWT = cho_solve((Lw, True), T)
-        iWu = cho_solve((Lw, True), u)
+        if mx.layouts_active():
+            # fused batched layout (policy-gated): one solve on [T | u]
+            sol = cho_solve((Lw, True),
+                            jnp.concatenate([T, u[:, None]], axis=1))
+            iWT, iWu = sol[:, :-1], sol[:, -1]
+        else:
+            iWT = cho_solve((Lw, True), T)
+            iWu = cho_solve((Lw, True), u)
     else:
-        iWT = _w_solve_blocks(G, counts, T)
-        iWu = _w_solve_blocks(G, counts, u[:, None])[:, 0]
+        if mx.layouts_active():
+            sol = _w_solve_blocks(G, counts,
+                                  jnp.concatenate([T, u[:, None]], axis=1))
+            iWT, iWu = sol[:, :-1], sol[:, -1]
+        else:
+            iWT = _w_solve_blocks(G, counts, T)
+            iWu = _w_solve_blocks(G, counts, u[:, None])[:, 0]
 
     # Eta-marginal likelihood precision and rhs on vec(Beta)
     jr = jnp.arange(ns)

@@ -21,6 +21,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..ops import mixed as mx
 from ..ops.linalg import chol_spd, sample_mvn_prec
 from ..ops.rand import standard_gamma
 from .structs import GibbsState, ModelData, ModelSpec
@@ -79,30 +80,32 @@ def update_w_rrr(spec: ModelSpec, data: ModelData, state: GibbsState,
     # residual against the non-RRR fixed part + random loadings; base X
     # carries only the nc_nrrr columns, and any selection zeroing stays in
     # force through the mask
+    Xs = mx.staged("X", data.X)
+    XRs = mx.staged("XRRRs", data.XRRRs)
     if spec.ncsel > 0:
         m = selection_mask(spec, data, state.BetaSel)[:, :ncn]
         if spec.x_is_list:
-            LFix = jnp.einsum("jyc,jc,cj->yj", data.X, m, BetaN)
+            LFix = mx.einsum("jyc,jc,cj->yj", Xs, m, BetaN)
         else:
-            LFix = jnp.einsum("yc,jc,cj->yj", data.X, m, BetaN)
+            LFix = mx.einsum("yc,jc,cj->yj", Xs, m, BetaN)
     elif spec.x_is_list:
-        LFix = jnp.einsum("jyc,cj->yj", data.X, BetaN)
+        LFix = mx.einsum("jyc,cj->yj", Xs, BetaN)
     else:
-        LFix = data.X @ BetaN
+        LFix = mx.matmul(Xs, BetaN)
     S = state.Z - LFix - LRan_total
 
-    A1 = (BetaR * state.iSigma[None, :]) @ BetaR.T        # (ncr, ncr)
+    A1 = mx.matmul(BetaR * state.iSigma[None, :], BetaR.T)  # (ncr, ncr)
     if shard is not None:                 # cross-species B-products psum
         A1 = shard.psum(A1)
-    A2 = data.XRRRs.T @ data.XRRRs                        # (nco, nco)
+    A2 = mx.matmul(XRs.T, XRs)                            # (nco, nco)
     tau = jnp.cumprod(state.DeltaRRR)                     # (ncr,)
     prior_prec = (state.PsiRRR * tau[:, None]).T.reshape(-1)  # col-major vec
     prec = jnp.kron(A2, A1) + jnp.diag(prior_prec)
     if shard is None:
-        mu1 = ((BetaR * state.iSigma[None, :]) @ S.T @ data.XRRRs)
+        mu1 = mx.matmul(mx.matmul(BetaR * state.iSigma[None, :], S.T), XRs)
     else:
-        mu1 = shard.psum(
-            (BetaR * state.iSigma[None, :]) @ S.T) @ data.XRRRs
+        mu1 = mx.matmul(shard.psum(
+            mx.matmul(BetaR * state.iSigma[None, :], S.T)), XRs)
     rhs = mu1.T.reshape(-1)                               # col-major vec
     L = chol_spd(prec)
     eps = jax.random.normal(key, rhs.shape, dtype=rhs.dtype)
@@ -150,9 +153,9 @@ def update_beta_sel(spec: ModelSpec, data: ModelData, state: GibbsState,
     each proposal's likelihood delta is one masked whole-array reduction."""
     Xa, per_species = effective_design(spec, data, state)   # current masked X
     if per_species:
-        E = jnp.einsum("jyc,cj->yj", Xa, state.Beta)
+        E = mx.einsum("jyc,cj->yj", Xa, state.Beta)
     else:
-        E = Xa @ state.Beta
+        E = mx.matmul(Xa, state.Beta)
     E = E + LRan_total
     std = state.iSigma[None, :] ** -0.5
 
@@ -169,9 +172,9 @@ def update_beta_sel(spec: ModelSpec, data: ModelData, state: GibbsState,
         cov = data.sel_cov[i]
         # linear-predictor contribution of the switched block, per species
         if spec.x_is_list:
-            Lg = jnp.einsum("jyc,c,cj->yj", Xfull, cov, state.Beta)
+            Lg = mx.einsum("jyc,c,cj->yj", Xfull, cov, state.Beta)
         else:
-            Lg = (Xfull * cov[None, :]) @ state.Beta      # (ny, ns)
+            Lg = mx.matmul(Xfull * cov[None, :], state.Beta)  # (ny, ns)
         n_groups = data.sel_q[i].shape[0]
         keys = jax.random.split(jax.random.fold_in(key, i), n_groups)
         bs = BetaSel[i]

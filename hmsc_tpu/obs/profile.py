@@ -39,8 +39,9 @@ import json
 import os
 
 __all__ = ["LEDGER_PATH", "build_cost_ledger", "build_shard_ledger",
-           "ledger_digest", "load_ledger", "save_ledger", "diff_ledger",
-           "measure_updaters", "profile_main", "CANONICAL_MODELS"]
+           "build_precision_ledger", "ledger_digest", "load_ledger",
+           "save_ledger", "diff_ledger", "measure_updaters", "profile_main",
+           "CANONICAL_MODELS"]
 
 LEDGER_PATH = os.path.join(os.path.dirname(__file__), "cost_ledger.json")
 LEDGER_VERSION = 1
@@ -201,6 +202,97 @@ def build_shard_ledger(devices: int = 8, models=None, only=None) -> dict:
     return programs
 
 
+def build_precision_ledger(models=None, only=None) -> tuple[dict, dict]:
+    """Mixed-precision ledger programs at the SCALED canonical shapes
+    (:func:`hmsc_tpu.mcmc.precision.policy_ledger_models` — species-heavy
+    JSDM sizes where the staged operands carry the block bytes; the tiny
+    audit specs under-resolve per-sweep traffic):
+
+    - ``<model>/scale:block:<name>`` — every schedule block of the scaled
+      spec, f32 (the before column);
+    - ``<model>/scale+mp:block:<name>`` — the default policy's targeted
+      blocks compiled with the policy scopes active and the staged
+      operands passed pre-cast (bf16 arguments: staging is paid once per
+      run, so the entry records steady-state per-sweep bytes);
+    - ``<model>/scale+mp:sweep`` — the whole policy'd sweep.
+
+    Returns ``(programs, precision_section)`` where the section records,
+    per model class, the targeted blocks/staged names and the measured
+    per-block ``bytes_ratio`` (f32 bytes-accessed over policy'd) — the
+    committed, drift-checked data `default_policy` spends.
+    """
+    import jax
+
+    from ..mcmc.precision import (default_policy, policy_ledger_models,
+                                  stage_data)
+    from ..mcmc.sweep import make_sweep, make_sweep_schedule, sweep_prologue
+    from ..ops import mixed
+
+    def _k():
+        return jax.random.key(0, impl="threefry2x32")
+
+    from ..analysis.jaxpr_rules import _build
+    factories = policy_ledger_models()
+    names = tuple(models) if models else tuple(factories)
+    programs: dict[str, dict] = {}
+    section: dict[str, dict] = {}
+    for mname in names:
+        if mname not in factories:
+            continue
+        spec, data, state = _build(factories[mname]())
+        policy = default_policy(spec, ledger={})   # in-code targets
+        if policy is None:
+            continue
+        ones = tuple(1 for _ in range(spec.nr))
+        staged = stage_data(data, policy)
+
+        steps = make_sweep_schedule(spec, None, ones)
+        steps_mp = make_sweep_schedule(spec, None, ones, precision=policy)
+        # an `only` filter that matches none of this model's names skips
+        # the whole (compile-heavy, scaled-shape) chain
+        cand = [f"{mname}/scale:block:{b}" for b, _ in steps]
+        cand += [f"{mname}/scale+mp:block:{b}" for b in policy.blocks]
+        cand.append(f"{mname}/scale+mp:sweep")
+        if only and not any(_keep(n, only) for n in cand):
+            continue
+        state_it, ks = jax.jit(sweep_prologue)(state, _k())
+        carry = (state_it, None, None, None)
+        ratios: dict[str, float] = {}
+        for (bname, block), (_, block_mp) in zip(steps, steps_mp):
+            name = f"{mname}/scale:block:{bname}"
+            compiled = jax.jit(block).lower(data, carry, ks).compile()
+            ref_entry = _cost_entry(compiled)
+            if _keep(name, only):
+                programs[name] = ref_entry
+            if policy.dtype_for(bname) is not None:
+                def run_mp(data, carry, ks, staged, _b=block_mp):
+                    with mixed.staged_scope(staged):
+                        return _b(data, carry, ks)
+                mp_entry = _cost_entry(jax.jit(run_mp).lower(
+                    data, carry, ks, staged).compile())
+                mp_name = f"{mname}/scale+mp:block:{bname}"
+                if _keep(mp_name, only):
+                    programs[mp_name] = mp_entry
+                if mp_entry["bytes_accessed"]:
+                    ratios[bname] = round(
+                        ref_entry["bytes_accessed"]
+                        / mp_entry["bytes_accessed"], 3)
+            carry = compiled(data, carry, ks)
+
+        name = f"{mname}/scale+mp:sweep"
+        if _keep(name, only):
+            sweep_mp = make_sweep(spec, None, ones, precision=policy)
+            programs[name] = _cost_entry(jax.jit(sweep_mp).lower(
+                data, state, _k(), staged).compile())
+        section[mname] = {
+            "blocks": list(policy.blocks),
+            "staged": list(policy.staged),
+            "dtype": policy.dtype,
+            "bytes_ratio": ratios,
+        }
+    return programs, section
+
+
 def build_cost_ledger(models=None, only=None) -> dict:
     """Compile and cost-analyse, per canonical spec:
 
@@ -289,15 +381,25 @@ def build_cost_ledger(models=None, only=None) -> dict:
     # when the process has >= 8 devices (CI forces the emulated mesh; a
     # smaller environment simply does not drift-check these entries)
     programs.update(build_shard_ledger(models=models, only=only))
+
+    # mixed-precision programs at the scaled shapes + the committed
+    # per-class policy selection (what `default_policy` spends)
+    mp_programs, precision = build_precision_ledger(models=models, only=only)
+    programs.update(mp_programs)
     return {"version": LEDGER_VERSION, "jax": jax.__version__,
+            "precision": precision,
             "programs": dict(sorted(programs.items()))}
 
 
 def ledger_digest(ledger: dict) -> dict:
     """Per-canonical-spec roll-up for bench records and report rendering:
     the sweep program's total flops, the peak temp HBM across that spec's
-    programs, and the program count."""
+    programs, and the program count.  The scaled mixed-precision entries
+    roll up separately (``precision``: targeted blocks, f32-over-policy'd
+    bytes ratio per block, per-sweep bytes saved at the scaled shapes) so
+    the tiny-spec numbers keep their historical meaning."""
     out: dict[str, dict] = {}
+    saved: dict[str, dict[str, int]] = {}
     for name, entry in ledger.get("programs", {}).items():
         mname, _, prog = name.partition("/")
         d = out.setdefault(mname, {"flops_total": None,
@@ -312,10 +414,28 @@ def ledger_digest(ledger: dict) -> dict:
                 sh["comm_bytes"] = entry.get("comm_bytes", 0)
                 sh["arg_bytes_per_device"] = entry.get("arg_bytes")
             continue
+        if prog.startswith("scale"):
+            _, _, bname = prog.partition(":block:")
+            if bname:
+                sv = saved.setdefault(mname, {})
+                sign = -1 if prog.startswith("scale+mp") else 1
+                sv[bname] = sv.get(bname, 0) \
+                    + sign * entry.get("bytes_accessed", 0)
+            continue
         d["temp_bytes_peak"] = max(d["temp_bytes_peak"],
                                    entry.get("temp_bytes", 0))
         if prog == "sweep":
             d["flops_total"] = entry.get("flops")
+    for mname, sel in ledger.get("precision", {}).items():
+        d = out.setdefault(mname, {"flops_total": None,
+                                   "temp_bytes_peak": 0, "programs": 0})
+        pairs = {b: v for b, v in saved.get(mname, {}).items()
+                 if b in sel.get("bytes_ratio", {})}
+        d["precision"] = {
+            "blocks": sel.get("blocks"),
+            "bytes_ratio": sel.get("bytes_ratio"),
+            "bytes_saved_per_sweep": int(sum(pairs.values())) or None,
+        }
     return out
 
 
@@ -353,6 +473,19 @@ def diff_ledger(committed: dict | None, current: dict) -> list[str]:
         for k in ("flops", "bytes_accessed", "temp_bytes", "comm_bytes"):
             if prev.get(k) != entry.get(k):
                 drift.append(f"{name}: {k} {prev.get(k)} -> {entry.get(k)}")
+    # the precision selection (policy'd blocks, staged names, measured
+    # byte ratios) is drift-checked like any other ledger column — a
+    # routing change that moves a ratio must be a review-visible diff
+    old_p = committed.get("precision", {})
+    for cls_, sel in current.get("precision", {}).items():
+        prev = old_p.get(cls_)
+        if prev is None:
+            drift.append(f"precision/{cls_}: no committed selection")
+            continue
+        for k in ("blocks", "staged", "dtype", "bytes_ratio"):
+            if prev.get(k) != sel.get(k):
+                drift.append(
+                    f"precision/{cls_}: {k} {prev.get(k)} -> {sel.get(k)}")
     return drift
 
 
@@ -408,6 +541,16 @@ def _render_static(ledger: dict, digest: dict, drift: list) -> str:
                      f"{e['temp_bytes'] / 1e3:8.1f} "
                      + (f"{comm / 1e3:8.2f}" if comm is not None
                         else f"{'-':>8}"))
+    prec = ledger.get("precision", {})
+    if prec:
+        lines.append("\nmixed-precision policy selection (committed, "
+                     "drift-checked; ratios are f32 over policy'd "
+                     "bytes-accessed at the scaled shapes):")
+        for cls_, sel in prec.items():
+            ratios = ", ".join(f"{b} x{r}" for b, r
+                               in sel.get("bytes_ratio", {}).items())
+            lines.append(f"  {cls_}: {','.join(sel.get('blocks', []))} "
+                         f"[{ratios}] staged={','.join(sel.get('staged', []))}")
     if drift:
         lines.append("\ncost-model drift vs committed ledger:")
         lines += [f"  {d}" for d in drift]
@@ -455,6 +598,11 @@ def profile_main(argv=None) -> int:
     ap.add_argument("--update-ledger", action="store_true",
                     help="re-record the committed cost_ledger.json from "
                          "the current build (after reviewing the drift)")
+    ap.add_argument("--update-precision", action="store_true",
+                    help="re-record the committed precision_tolerance.json "
+                         "(measured per-block mixed-precision deviation of "
+                         "the default policies — the training-side "
+                         "cast_tolerance())")
     ap.add_argument("--check", action="store_true",
                     help="exit 1 when the static ledger drifts from the "
                          "committed one")
@@ -495,6 +643,19 @@ def profile_main(argv=None) -> int:
 
     result: dict = {"version": LEDGER_VERSION}
     drift: list[str] = []
+    if args.update_precision:
+        if models:
+            print("--update-precision requires a full build (no --models): "
+                  "the committed artifact covers every canonical class")
+            return 2
+        from ..mcmc.precision import (TOLERANCE_PATH,
+                                      measure_policy_tolerance,
+                                      save_tolerance)
+        tol = measure_policy_tolerance()
+        save_tolerance(tol)
+        result["precision_tolerance"] = tol
+        print(f"wrote {TOLERANCE_PATH} "
+              f"({len(tol['models'])} model classes)")
     if args.static:
         ledger = build_cost_ledger(models=models, only=only)
         digest = ledger_digest(ledger)

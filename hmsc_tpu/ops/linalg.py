@@ -10,6 +10,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax.scipy.linalg import cho_solve, solve_triangular
 
+from . import mixed as _mx
+
 __all__ = ["chol_spd", "solve_from_chol", "sample_mvn_prec",
            "sample_mvn_prec_batched"]
 
@@ -36,7 +38,18 @@ def sample_mvn_prec(L: jnp.ndarray, rhs: jnp.ndarray, eps: jnp.ndarray) -> jnp.n
     """Draw from N(P^{-1} rhs, P^{-1}) given L = chol(P) and eps ~ N(0, I).
 
     mean = P^{-1} rhs; noise = L^{-T} eps  (cov L^{-T} L^{-1} = P^{-1}).
-    """
+
+    Under an active precision-policy scope with batched layouts
+    (:func:`hmsc_tpu.ops.mixed.layouts_active`) the mean and noise fold
+    into ONE forward/back solve pair — ``x = L^{-T}(L^{-1} rhs + eps)`` —
+    instead of the historical three triangular solves (cho_solve's two
+    plus the separate noise solve): same distribution exactly, one fewer
+    pass over ``L``.  The solves themselves always run in the operands'
+    own (f32) dtype — the policy's bf16 compute never reaches a pivot."""
+    if _mx.layouts_active():
+        y = solve_triangular(L, rhs, lower=True)
+        return solve_triangular(jnp.swapaxes(L, -1, -2), y + eps,
+                                lower=False)
     mean = cho_solve((L, True), rhs)
     noise = solve_triangular(jnp.swapaxes(L, -1, -2), eps, lower=False)
     return mean + noise

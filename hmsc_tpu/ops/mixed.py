@@ -1,0 +1,137 @@
+"""Trace-time mixed-precision context for the Gibbs sweep's hot ops.
+
+The per-block precision policy (:mod:`hmsc_tpu.mcmc.precision`) decides
+*which* schedule blocks compute their heavy dots and grams in reduced
+precision; this module is the *mechanism*: a trace-time scope the sweep
+assembler enters around a policy'd block, plus drop-in ``matmul`` /
+``einsum`` wrappers the updaters route their large contractions through.
+
+Contract (the whole point of the design):
+
+- **No active scope -> byte-identical traces.**  Outside a scope every
+  wrapper is *literally* the plain ``jnp`` call — same primitive, same
+  params — so the default ``precision_policy=None`` path produces the
+  exact jaxprs the committed fingerprints pin.  The analysis layer
+  verifies this, not just asserts it.
+- **bf16 compute, f32 accumulate.**  Inside a scope, float operands are
+  cast to the scope's compute dtype and every dot/einsum carries
+  ``preferred_element_type=float32``, so accumulation and all outputs
+  stay f32.  Reductions outside these wrappers, Cholesky factorisations
+  and triangular solves are *never* routed through this module — their
+  pivots stay f32-pinned (audited by the ``jaxpr-mixed-precision``
+  rule).
+- **Staged operands.**  Sweep-invariant model-data arrays (the phylo
+  eigenbasis ``U``, the spatial ``iWg``/Vecchia grids, the design
+  ``X``...) dominate the bytes of the hot blocks, and casting them
+  inside the sweep would *add* traffic every sweep (XLA does not hoist
+  converts out of the scan — measured).  The policy therefore stages
+  bf16 shadow copies once per run, passed to the runner as a real
+  argument; :func:`staged` resolves a name to the shadow inside a
+  scope and falls back to the f32 array outside one (or when the
+  policy does not stage that name).  The f32 originals stay intact for
+  every non-policy'd block.
+
+The scopes are plain Python stacks manipulated at *trace* time (the
+sweep assembles blocks in Python), never inside traced control flow.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+
+import jax.numpy as jnp
+
+__all__ = ["scope", "staged_scope", "active_dtype", "layouts_active",
+           "staged", "staged_level", "matmul", "einsum"]
+
+
+@dataclasses.dataclass(frozen=True)
+class _Scope:
+    dtype: object          # jnp dtype for compute casts; None = pass-through
+    layouts: bool          # fused batched cholesky/solve layouts active
+
+
+_SCOPES: list[_Scope] = []
+_STAGED: list[dict] = []   # name -> pre-cast shadow array (trace-time values)
+
+
+@contextlib.contextmanager
+def scope(dtype, layouts: bool = True):
+    """Enter a mixed-precision compute scope for one schedule block.
+
+    ``dtype`` is a dtype-like (``"bfloat16"``) or ``None``/``"float32"``
+    for a layout-only scope (fused solves, full-precision compute)."""
+    dt = None
+    if dtype is not None:
+        dt = jnp.dtype(dtype)
+        if dt == jnp.float32:
+            dt = None             # layout-only: keep the exact f32 ops
+    _SCOPES.append(_Scope(dtype=dt, layouts=bool(layouts)))
+    try:
+        yield
+    finally:
+        _SCOPES.pop()
+
+
+@contextlib.contextmanager
+def staged_scope(staged: dict | None):
+    """Provide the staged shadow table for the duration of a sweep trace
+    (entered once around the whole block chain; per-block :func:`scope`
+    entries decide whether lookups resolve)."""
+    _STAGED.append(staged or {})
+    try:
+        yield
+    finally:
+        _STAGED.pop()
+
+
+def active_dtype():
+    """The current compute dtype, or ``None`` outside any scope (or in a
+    layout-only scope)."""
+    return _SCOPES[-1].dtype if _SCOPES else None
+
+
+def layouts_active() -> bool:
+    return bool(_SCOPES) and _SCOPES[-1].layouts
+
+
+def staged(name: str, x):
+    """The policy's pre-cast shadow of model-data array ``name`` inside an
+    active compute scope; ``x`` itself otherwise."""
+    if _SCOPES and _SCOPES[-1].dtype is not None and _STAGED:
+        shadow = _STAGED[-1].get(name)
+        if shadow is not None:
+            return shadow
+    return x
+
+
+def staged_level(name: str, r: int, x):
+    """Per-level variant: level arrays stage under ``"<name>_<r>"``."""
+    return staged(f"{name}_{r}", x)
+
+
+def _cast(x, dt):
+    if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating) \
+            and x.dtype != dt:
+        return x.astype(dt)
+    return x
+
+
+def matmul(a, b):
+    """``a @ b``; bf16 compute / f32 accumulate inside an active scope."""
+    dt = active_dtype()
+    if dt is None:
+        return a @ b
+    return jnp.matmul(_cast(a, dt), _cast(b, dt),
+                      preferred_element_type=jnp.float32)
+
+
+def einsum(eq: str, *operands):
+    """``jnp.einsum``; bf16 compute / f32 accumulate inside an active
+    scope."""
+    dt = active_dtype()
+    if dt is None:
+        return jnp.einsum(eq, *operands)
+    return jnp.einsum(eq, *(_cast(o, dt) for o in operands),
+                      preferred_element_type=jnp.float32)

@@ -39,7 +39,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..utils.formula import design_matrix
+from ..utils.formula import align_factor_levels, design_matrix
 from .latent import predict_latent_factor
 
 __all__ = ["predict"]
@@ -60,10 +60,23 @@ def _new_design(hM, x_data, X):
     if x_data is not None and X is not None:
         raise ValueError("Hmsc.predict: only one of XData and X arguments can be specified")
     if x_data is not None:
+        # pin the TRAINING frame's factor levels (R's xlev): a prediction
+        # frame holding a subset of a categorical's fitted levels — e.g. a
+        # gradient frame's constant non-focal factor — must still expand
+        # to the fitted design's column count
+        ref = hM.x_data
         if isinstance(x_data, (list, tuple)):
-            mats = [design_matrix(hM.x_formula, df)[0] for df in x_data]
+            refs = (ref if isinstance(ref, (list, tuple))
+                    else [ref] * len(x_data))
+            mats = [design_matrix(hM.x_formula,
+                                  align_factor_levels(df, rf))[0]
+                    for df, rf in zip(x_data, refs)]
             return np.stack(mats, axis=0), True
-        M, _ = design_matrix(hM.x_formula, x_data)
+        M, _ = design_matrix(
+            hM.x_formula,
+            align_factor_levels(x_data,
+                                ref[0] if isinstance(ref, (list, tuple))
+                                else ref))
         return M, False
     if X is not None:
         X = np.asarray(X, dtype=float)

@@ -2037,6 +2037,24 @@ def resume_run(hM, checkpoint_path: str, *, verbose: int = 0,
     record = meta.get("record")
     ckdir = (os.fspath(checkpoint_path) if os.path.isdir(checkpoint_path)
              else (os.path.dirname(ck.path) or "."))
+    # stream-defining extras (PR 12): the mixed-precision policy and the
+    # shard-local RNG mode come from the checkpoint, like seed/thin — a
+    # local_rng continuation additionally needs the SAME species extent
+    # (the shard index is folded into every species draw's key)
+    stored_local_rng = bool(meta.get("local_rng", False))
+    if stored_local_rng:
+        want_sp = meta.get("species_shards")
+        have_sp = (int(mesh.shape[species_axis])
+                   if (mesh is not None
+                       and species_axis in getattr(mesh, "axis_names", ()))
+                   else None)
+        if want_sp is not None and have_sp != want_sp:
+            raise CheckpointError(
+                f"{ck.path}: run used local_rng over {want_sp} species "
+                f"shard(s); resume must pass a mesh with the same "
+                f"'{species_axis}' extent (got "
+                f"{have_sp if have_sp is not None else 'no species axis'}) "
+                "— the shard-local key streams are not layout-invariant")
     from ..mcmc.sampler import sample_mcmc
     cont = sample_mcmc(
         hM, samples=total - done, transient=remaining_t,
@@ -2061,6 +2079,8 @@ def resume_run(hM, checkpoint_path: str, *, verbose: int = 0,
         # owning rank warm-restarts, the repair commits at that boundary),
         # so the stored retry policy survives a re-sharded continuation
         retry_diverged=int(meta.get("retry_diverged", 0)),
+        precision_policy=meta.get("precision_policy"),
+        local_rng=stored_local_rng,
         align_post=False, verbose=verbose, mesh=mesh,
         chain_axis=chain_axis, species_axis=species_axis,
         shard_sweep=shard_sweep,

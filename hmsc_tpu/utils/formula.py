@@ -24,7 +24,7 @@ import re
 
 import numpy as np
 
-__all__ = ["design_matrix", "Formula"]
+__all__ = ["design_matrix", "Formula", "align_factor_levels"]
 
 _SAFE_FUNCS = {
     "log": np.log, "log2": np.log2, "log10": np.log10, "log1p": np.log1p,
@@ -214,3 +214,47 @@ class Formula:
 def design_matrix(formula: str, df) -> tuple[np.ndarray, list[str]]:
     """R ``model.matrix(formula, df)`` equivalent (subset; see module doc)."""
     return Formula(formula).design(df)
+
+
+def align_factor_levels(df, ref_df):
+    """``df`` with every categorical column coerced to the *training*
+    frame's level set (R's ``xlev=`` argument to ``model.matrix``).
+
+    Prediction frames routinely hold a SUBSET of the fitted levels — a
+    gradient frame sets a non-focal factor to one constant value — and
+    deriving the one-hot set from the observed values would then build a
+    design with fewer columns than the fitted Beta has rows (the
+    ``predict(gradient=...)`` einsum shape failure).  Pandas categorical
+    columns carry their level set explicitly, and :func:`design_matrix`
+    already honours it; this helper installs the training levels.  A new
+    value absent from the training levels is an error (the fitted model
+    has no coefficient for it), matching R's ``model.matrix`` behaviour.
+    """
+    import pandas as pd
+
+    if ref_df is None or not hasattr(df, "columns"):
+        return df
+    out = df.copy()
+    for col in df.columns:
+        if col not in getattr(ref_df, "columns", ()):
+            continue
+        ref = ref_df[col]
+        ref_vals = np.asarray(ref)
+        is_cat = (ref_vals.dtype.kind in "OUSb"
+                  or str(getattr(ref, "dtype", "")) == "category")
+        if not is_cat:
+            continue
+        cats = getattr(getattr(ref, "cat", None), "categories", None)
+        if cats is None:
+            cats = sorted({str(v) for v in ref_vals})
+        else:
+            cats = [str(c) for c in cats]
+        new_vals = [str(v) for v in np.asarray(df[col])]
+        unknown = sorted(set(new_vals) - set(cats))
+        if unknown:
+            raise ValueError(
+                f"prediction data: factor {col!r} has level(s) {unknown} "
+                f"absent from the fitted levels {cats} — the model has no "
+                "coefficient for them")
+        out[col] = pd.Categorical(new_vals, categories=cats)
+    return out

@@ -265,3 +265,72 @@ def test_eta_nngp_cg_matches_dense():
                        atol=4 * sd.max() / np.sqrt(600) + 1e-3)
     assert np.allclose(dense.std(axis=0), cg.std(axis=0), rtol=0.25,
                        atol=0.02)
+
+
+def test_nngp_dense_cg_crossover_agreement():
+    """Driving HMSC_TPU_NNGP_DENSE_MAX across the coefficient boundary
+    flips updateEta between the dense joint cholesky and the matrix-free
+    CG sampler.  Both must describe the SAME full conditional: on one
+    spec/key, (1) the densified precision equals the matrix-free apply,
+    and (2) the two solvers' conditional means agree within the CG
+    tolerance (the two paths' noise constructions differ by design, so
+    draw-by-draw equality is not the contract — the shared system is)."""
+    from jax.scipy.linalg import cho_solve
+
+    from hmsc_tpu.mcmc import spatial as SP
+    from hmsc_tpu.mcmc.spatial import vecchia_ops, _nngp_dense_iW
+    from hmsc_tpu.mcmc.updaters import _masked_level_gram
+    from hmsc_tpu.ops.linalg import chol_spd
+
+    m = small_model(distr="normal", spatial="NNGP", ny=60, ns=6, n_units=20,
+                    nf=2, seed=23, n_neighbours=5)
+    spec, data, state, _ = build_all(m, seed=11, nf_cap=2)
+    lvd, lv, ls = data.levels[0], state.levels[0], spec.levels[0]
+    npr, nf = ls.n_units, ls.nf_max   # 20 * 2 = 40 coefficients
+    import jax.numpy as jnp
+    S = jnp.asarray(np.asarray(state.Z)
+                    - np.asarray(U.linear_fixed(spec, data, state.Beta)))
+    key = jax.random.key(31, impl="threefry2x32")
+
+    # both sides of the boundary produce finite draws on the same key
+    old = SP._NNGP_DENSE_MAX
+    try:
+        SP._NNGP_DENSE_MAX = npr * nf + 1       # dense side
+        eta_dense = SP.update_eta_spatial(spec, data, state, 0, key, S).Eta
+        SP._NNGP_DENSE_MAX = npr * nf - 1       # CG side of the boundary
+        eta_cg = SP.update_eta_spatial(spec, data, state, 0, key, S).Eta
+    finally:
+        SP._NNGP_DENSE_MAX = old
+    assert np.isfinite(np.asarray(eta_dense)).all()
+    assert np.isfinite(np.asarray(eta_cg)).all()
+
+    # the two paths factorise the same precision: dense assembly vs the
+    # matrix-free Vecchia apply agree on random probes...
+    LiSL, F = _masked_level_gram(spec, data, lvd, ls, lv, state.iSigma, S)
+    iW = _nngp_dense_iW(lvd, lv.alpha_idx, npr)
+    big = np.zeros((nf, npr, nf, npr), dtype=np.float32)
+    for h in range(nf):
+        big[h, :, h, :] = np.asarray(iW)[h]
+    LiSL_np = np.asarray(LiSL)
+    for u in range(npr):
+        big[:, u, :, u] += LiSL_np[u]
+    riw_t, pmv = vecchia_ops(lvd.nn_idx, lvd.nn_coef[lv.alpha_idx],
+                             jnp.sqrt(lvd.nn_D[lv.alpha_idx]), LiSL)
+    rng = np.random.default_rng(2)
+    P = big.reshape(nf * npr, nf * npr)
+    for _ in range(3):
+        x = jnp.asarray(rng.standard_normal((npr, nf)), jnp.float32)
+        lhs = P @ np.asarray(x).T.reshape(-1)
+        rhs = np.asarray(pmv(x)).T.reshape(-1)
+        assert np.allclose(lhs, rhs, atol=1e-4 * max(1.0, np.abs(lhs).max()))
+
+    # ... so the conditional means agree within the CG tolerance
+    tol = 1e-5
+    mean_dense = cho_solve((chol_spd(jnp.asarray(P)), True),
+                           np.asarray(F).T.reshape(-1))
+    mean_cg, _ = jax.scipy.sparse.linalg.cg(pmv, F, x0=jnp.zeros_like(F),
+                                            tol=tol, maxiter=500)
+    md = np.asarray(mean_dense).reshape(nf, npr).T
+    mc = np.asarray(mean_cg)
+    scale = max(np.abs(md).max(), 1.0)
+    assert np.allclose(md, mc, atol=100 * tol * scale)

@@ -1,0 +1,349 @@
+"""Per-block mixed-precision policy suite (mcmc/precision.py).
+
+Contracts pinned here:
+
+- ``precision_policy=None`` is the exact pre-policy engine (the lint
+  fingerprint gate pins the traces; this suite pins the API surface).
+- The default policy'd sweep agrees with the f32 sweep within the pinned
+  ``PRECISION_AGREEMENT_TOL`` after one sweep from an identical state,
+  on every canonical spec with a default policy.
+- bf16 stays confined: the policy'd trace contains bf16, the default
+  trace none, and no Cholesky/triangular-solve pivot ever takes bf16.
+- The committed cost ledger's precision section records >= 1.5x
+  bytes-accessed reduction on the targeted blocks of the two spatial
+  canonical variants (Full + GPP) — the acceptance gate.
+- The committed precision_tolerance.json reproduces (loosely — float
+  measurements) from the current build.
+- The fused batched layouts are exact: the two-solve sample_mvn_prec
+  matches the historical three-solve path to f32 rounding.
+- The policy composes with the species-sharded sweep, survives a
+  checkpoint -> resume round-trip bit-identically, and is restored from
+  checkpoint metadata (it changes the draw stream).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hmsc_tpu.analysis.jaxpr_rules import _build, _canonical_models, \
+    _shard_models
+from hmsc_tpu.mcmc.precision import (PRECISION_AGREEMENT_TOL,
+                                     PrecisionPolicy, default_policy,
+                                     load_tolerance,
+                                     measure_policy_tolerance, stage_data)
+from hmsc_tpu.mcmc.sampler import sample_mcmc
+from hmsc_tpu.mcmc.sweep import make_sharded_sweep, make_sweep
+from hmsc_tpu.obs.profile import load_ledger
+
+pytestmark = pytest.mark.precision
+
+
+def _key(s=3):
+    return jax.random.key(s, impl="threefry2x32")
+
+
+def _max_rel(a, b):
+    a, b = np.asarray(a, float), np.asarray(b, float)
+    if a.size == 0:
+        return 0.0
+    scale = max(float(np.max(np.abs(a))), 1e-6)
+    return float(np.max(np.abs(a - b)) / scale)
+
+
+def _state_dev(sa, sb):
+    devs = [0.0]
+    for x, y in zip(jax.tree.leaves(sa), jax.tree.leaves(sb)):
+        if hasattr(x, "dtype") and np.asarray(x).dtype.kind == "f":
+            devs.append(_max_rel(x, y))
+    return max(devs)
+
+
+# ---------------------------------------------------------------------------
+# draw-stream agreement (the PRECISION_AGREEMENT_TOL contract)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("model", ["base", "spatial", "rrr", "sel"])
+def test_policy_sweep_one_sweep_agreement(model):
+    spec, data, state = _build(_canonical_models()[model]())
+    pol = default_policy(spec, ledger={})
+    assert pol is not None and pol.blocks
+    zeros = tuple(0 for _ in range(spec.nr))
+    ref = jax.jit(make_sweep(spec, None, zeros))(data, state, _key())
+    mp = jax.jit(make_sweep(spec, None, zeros, precision=pol))(
+        data, state, _key(), stage_data(data, pol))
+    dev = _state_dev(ref, mp)
+    assert 0 < dev <= PRECISION_AGREEMENT_TOL, dev
+
+
+def test_policy_output_dtypes_stay_f32():
+    """bf16 is compute-only: every state leaf of the policy'd sweep keeps
+    its f32 dtype (f32 accumulation via preferred_element_type)."""
+    spec, data, state = _build(_canonical_models()["base"]())
+    pol = default_policy(spec, ledger={})
+    out = jax.jit(make_sweep(spec, None, (0,), precision=pol))(
+        data, state, _key(), stage_data(data, pol))
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(out)):
+        if hasattr(a, "dtype"):
+            assert a.dtype == b.dtype
+
+
+def test_bf16_confined_and_pivots_pinned():
+    """The policy'd trace contains bf16 values, the default trace none,
+    and no cholesky/triangular_solve eqn takes a bf16 operand anywhere."""
+    from hmsc_tpu.analysis.jaxpr_rules import _all_prims, _all_vars
+
+    spec, data, state = _build(_canonical_models()["spatial"]())
+    pol = default_policy(spec, ledger={})
+    zeros = tuple(0 for _ in range(spec.nr))
+    cl_f32 = jax.make_jaxpr(make_sweep(spec, None, zeros))(
+        data, state, _key())
+    cl_mp = jax.make_jaxpr(make_sweep(spec, None, zeros, precision=pol))(
+        data, state, _key(), stage_data(data, pol))
+
+    def n_bf16(closed):
+        return sum(str(getattr(v.aval, "dtype", "")) == "bfloat16"
+                   for v in _all_vars(closed.jaxpr))
+
+    assert n_bf16(cl_f32) == 0
+    assert n_bf16(cl_mp) > 0
+    for eqn in _all_prims(cl_mp.jaxpr):
+        if eqn.primitive.name in ("cholesky", "triangular_solve"):
+            for v in eqn.invars:
+                assert str(v.aval.dtype) != "bfloat16"
+
+
+# ---------------------------------------------------------------------------
+# committed artifacts: ledger byte gate + tolerance round-trip
+# ---------------------------------------------------------------------------
+
+def test_ledger_precision_bytes_gate():
+    """Acceptance gate: the committed ledger records >= 1.5x bytes-accessed
+    reduction on the targeted blocks of at least two canonical specs (the
+    Full and GPP spatial variants — the gather-dominated blocks the
+    default policy stages)."""
+    ledger = load_ledger()
+    assert ledger is not None and "precision" in ledger
+    passing = 0
+    for mname in ("spatial", "gpp"):
+        sel = ledger["precision"].get(mname)
+        assert sel, f"no committed precision selection for {mname}"
+        ratios = sel["bytes_ratio"]
+        assert set(sel["blocks"]) <= set(ratios)
+        if all(ratios[b] >= 1.5 for b in sel["blocks"]):
+            passing += 1
+    assert passing >= 2, ledger["precision"]
+
+
+def test_ledger_has_policy_programs():
+    ledger = load_ledger()
+    progs = ledger["programs"]
+    for mname in ("base", "spatial", "gpp", "rrr", "sel"):
+        assert f"{mname}/scale+mp:sweep" in progs
+        sel = ledger["precision"][mname]
+        for b in sel["blocks"]:
+            assert f"{mname}/scale:block:{b}" in progs
+            assert f"{mname}/scale+mp:block:{b}" in progs
+
+
+def test_tolerance_artifact_roundtrip():
+    """The committed precision_tolerance.json reproduces from the current
+    build: same policy'd block set, measured deviations within loose
+    float slack, every recorded deviation inside the pinned agreement
+    tolerance."""
+    committed = load_tolerance()
+    assert committed is not None
+    fresh = measure_policy_tolerance(models=("base",))
+    com_b = committed["models"]["base"]["blocks"]
+    new_b = fresh["models"]["base"]["blocks"]
+    assert set(com_b) == set(new_b)
+    for bname, rec in new_b.items():
+        assert rec["max_rel"] <= PRECISION_AGREEMENT_TOL
+        assert abs(rec["max_rel"] - com_b[bname]["max_rel"]) \
+            <= 0.5 * PRECISION_AGREEMENT_TOL
+    assert fresh["models"]["base"]["sweep_max_rel"] \
+        <= PRECISION_AGREEMENT_TOL
+
+
+# ---------------------------------------------------------------------------
+# fused batched layouts
+# ---------------------------------------------------------------------------
+
+def test_two_solve_mvn_layout_exact():
+    """The layout-gated two-solve sample_mvn_prec equals the historical
+    cho_solve + noise-solve path to f32 rounding (same distribution by
+    construction; numerically a reassociation)."""
+    from hmsc_tpu.ops import mixed
+    from hmsc_tpu.ops.linalg import chol_spd, sample_mvn_prec
+
+    rng = np.random.default_rng(0)
+    A = rng.standard_normal((24, 24))
+    P = jnp.asarray(A @ A.T + 24 * np.eye(24), jnp.float32)
+    L = chol_spd(P)
+    rhs = jnp.asarray(rng.standard_normal(24), jnp.float32)
+    eps = jnp.asarray(rng.standard_normal(24), jnp.float32)
+    ref = sample_mvn_prec(L, rhs, eps)
+    with mixed.scope("float32", layouts=True):
+        fused = sample_mvn_prec(L, rhs, eps)
+    assert _max_rel(ref, fused) < 1e-5
+
+
+def test_layout_only_policy_close_to_exact():
+    """dtype='float32' gives a layout-only policy: restructured kernels,
+    full-precision compute — draws match the default path to solver
+    reassociation rounding, far inside the bf16 tolerance."""
+    spec, data, state = _build(_canonical_models()["spatial"]())
+    pol = PrecisionPolicy(blocks=("EtaSpatial", "Interweave"),
+                          dtype="float32")
+    zeros = tuple(0 for _ in range(spec.nr))
+    ref = jax.jit(make_sweep(spec, None, zeros))(data, state, _key())
+    mp = jax.jit(make_sweep(spec, None, zeros, precision=pol))(
+        data, state, _key(), stage_data(data, pol))
+    assert _state_dev(ref, mp) < 1e-3
+
+
+def test_gpp_fused_inverse_layout():
+    """gpp_factor's batched cho_solve layout equals the vmapped per-unit
+    double triangular solve."""
+    from hmsc_tpu.mcmc.spatial import gpp_factor
+    from hmsc_tpu.ops import mixed
+
+    rng = np.random.default_rng(1)
+    npr, nf, nK = 7, 3, 4
+    B = rng.standard_normal((npr, nf, nf))
+    LiSL = jnp.asarray(np.einsum("upq,urq->upr", B, B)
+                       + 3 * np.eye(nf), jnp.float32)
+    idD = jnp.asarray(rng.uniform(1.0, 2.0, (nf, npr)), jnp.float32)
+    M1 = jnp.asarray(0.1 * rng.standard_normal((nf, npr, nK)), jnp.float32)
+    C = rng.standard_normal((nf, nK, nK))
+    Fm = jnp.asarray(np.einsum("hmn,hkn->hmk", C, C)
+                     + 5 * np.eye(nK), jnp.float32)
+    ref = gpp_factor(LiSL, idD, M1, Fm)
+    with mixed.scope("float32", layouts=True):
+        fused = gpp_factor(LiSL, idD, M1, Fm)
+    for a, b in zip(ref[:4], fused[:4]):
+        assert _max_rel(a, b) < 1e-4
+
+
+# ---------------------------------------------------------------------------
+# sampler wiring: auto policy, sharded composition, checkpoint round-trip
+# ---------------------------------------------------------------------------
+
+def test_sample_mcmc_auto_policy_runs_and_stays_finite():
+    hM = _canonical_models()["base"]()
+    post = sample_mcmc(hM, samples=3, transient=2, n_chains=2, seed=1,
+                       nf_cap=2, align_post=False,
+                       precision_policy="auto")
+    for k in post.arrays:
+        assert np.isfinite(np.asarray(post[k], float)).all(), k
+
+
+def test_sharded_policy_agreement():
+    """policy'd sharded sweep vs the replicated f32 sweep: bf16 rounding
+    plus psum rounding, still inside the precision tolerance after one
+    sweep (8-way emulated mesh)."""
+    from jax.sharding import Mesh
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 emulated devices")
+    spec, data, state = _build(_shard_models()["base"]())
+    pol = default_policy(spec, ledger={})
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(1, 4),
+                axis_names=("chains", "species"))
+    zeros = tuple(0 for _ in range(spec.nr))
+    ref = jax.jit(make_sweep(spec, None, zeros))(data, state, _key())
+    sh = jax.jit(make_sharded_sweep(spec, mesh, None, zeros,
+                                    precision=pol))(
+        data, state, _key(), stage_data(data, pol))
+    assert _state_dev(ref, sh) <= PRECISION_AGREEMENT_TOL
+
+
+def test_sharded_policy_per_species_design_agreement():
+    """x_is_list regression: a per-species design model carries X as
+    (ns, ny, nc) — species-sharded on dim 0.  staged_pspecs must shard
+    the staged bf16 X shadow exactly like tree_pspecs shards the f32 X,
+    or the shard_map body sees a full-width staged X against ns_local
+    state (shape-mismatch trace failure)."""
+    from jax.sharding import Mesh
+
+    from hmsc_tpu.model import Hmsc
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 emulated devices")
+    rng = np.random.default_rng(4)
+    ny, ns = 12, 8
+    X = [np.column_stack([np.ones(ny), rng.standard_normal(ny)])
+         for _ in range(ns)]                     # per-species X list
+    Y = (rng.standard_normal((ny, ns)) > 0).astype(float)
+    spec, data, state = _build(Hmsc(Y=Y, X=X, distr="probit"))
+    assert spec.x_is_list
+    pol = default_policy(spec, ledger={})
+    assert "X" in pol.staged
+    staged = stage_data(data, pol)
+    assert staged["X"].shape[0] == ns
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(1, 2),
+                axis_names=("chains", "species"))
+    zeros = tuple(0 for _ in range(spec.nr))
+    ref = jax.jit(make_sweep(spec, None, zeros))(data, state, _key())
+    sh = jax.jit(make_sharded_sweep(spec, mesh, None, zeros,
+                                    precision=pol))(
+        data, state, _key(), staged)
+    assert _state_dev(ref, sh) <= PRECISION_AGREEMENT_TOL
+
+
+def test_policy_checkpoint_resume_roundtrip(tmp_path):
+    """A policy'd checkpointed run resumes bit-identically (the policy is
+    stored in the run metadata and restored — it changes the stream)."""
+    from hmsc_tpu.utils.checkpoint import resume_run
+
+    hM = _canonical_models()["base"]()
+    ck = os.fspath(tmp_path / "run")
+    kw = dict(samples=4, transient=2, n_chains=2, seed=7, nf_cap=2,
+              align_post=False, precision_policy="auto")
+    post = sample_mcmc(hM, checkpoint_every=2, checkpoint_path=ck, **kw)
+    post_l = resume_run(hM, ck)
+    for k in post.arrays:
+        np.testing.assert_array_equal(np.asarray(post[k]),
+                                      np.asarray(post_l[k]))
+    # and the stream genuinely differs from the f32 run's
+    post_f32 = sample_mcmc(hM, **{**kw, "precision_policy": None})
+    assert any(_max_rel(post[k], post_f32[k]) > 0 for k in post.arrays)
+
+
+# ---------------------------------------------------------------------------
+# validation
+# ---------------------------------------------------------------------------
+
+def test_policy_validation_errors():
+    with pytest.raises(ValueError, match="no mixed-precision"):
+        PrecisionPolicy(blocks=("NotABlock",))
+    with pytest.raises(ValueError, match="dtype"):
+        PrecisionPolicy(blocks=("GammaV",), dtype="float16")
+    hM = _canonical_models()["base"]()
+    with pytest.raises(ValueError, match="precision_policy"):
+        sample_mcmc(hM, samples=1, n_chains=1, nf_cap=2,
+                    precision_policy="bogus")
+    with pytest.raises(ValueError, match="local_rng"):
+        sample_mcmc(hM, samples=1, n_chains=1, nf_cap=2, local_rng=True)
+    with pytest.raises(ValueError, match="profile_updaters"):
+        sample_mcmc(hM, samples=1, n_chains=1, nf_cap=2,
+                    precision_policy="auto", profile_updaters=1)
+
+
+def test_policy_meta_roundtrip():
+    pol = PrecisionPolicy(blocks=("GammaV", "Rho"), staged=("U",),
+                          dtype="bfloat16", batched_layouts=False)
+    assert PrecisionPolicy.from_meta(pol.to_meta()) == pol
+
+
+def test_default_policy_filters_inapplicable_blocks():
+    """A non-phylo model classified 'base' must not carry the Rho block
+    (it never runs there)."""
+    from tests.util import build_all, small_model
+
+    spec, _, _, _ = build_all(small_model(seed=3), nf_cap=2)
+    pol = default_policy(spec, ledger={})
+    assert pol is None or "Rho" not in pol.blocks

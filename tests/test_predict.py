@@ -247,6 +247,40 @@ def test_construct_gradient_non_focal_modes(fitted_xdata):
     assert np.allclose(gr3["XDataNew"]["x2"], 1.5)
 
 
+def test_construct_gradient_categorical_non_focal(fitted_xdata):
+    """Known-gap regression (ROADMAP): a CATEGORICAL non-focal covariate
+    in a formula model.  The gradient frame pins the non-focal factor to
+    one predicted level per grid point, so the rebuilt design derived its
+    one-hot set from the OBSERVED values — fewer columns than the fitted
+    Beta rows, and predict(gradient=...) died with an einsum "Size of
+    label 'c'" shape failure.  The design build now pins the TRAINING
+    frame's levels (R's xlev)."""
+    rng = np.random.default_rng(19)
+    ny, ns = 48, 3
+    xdf = pd.DataFrame({
+        "x1": rng.standard_normal(ny),
+        "hab": rng.choice(["forest", "meadow", "bog"], size=ny),
+    })
+    Y = ((xdf["x1"].values[:, None] + rng.standard_normal((ny, ns))) > 0
+         ).astype(float)
+    m = Hmsc(Y=Y, x_data=xdf, x_formula="~x1+hab", distr="probit")
+    post = sample_mcmc(m, samples=6, transient=6, n_chains=1, seed=2,
+                       nf_cap=2, align_post=False)
+    # type-1 non-focal policy: the factor is pinned to its mode, so the
+    # gradient frame deterministically holds ONE of the three fitted
+    # levels (the regression's trigger)
+    gr = construct_gradient(m, "x1", {"hab": [1]}, ngrid=6)
+    assert len(set(map(str, gr["XDataNew"]["hab"]))) == 1
+    pred = predict(post, gradient=gr, expected=True, seed=0)
+    assert pred.shape == (6, 6, ns)
+    assert np.isfinite(pred).all()
+    # a fixed (type 3) unseen level is a clear error, not a mis-shaped
+    # design
+    bad = construct_gradient(m, "x1", {"hab": [3, "tundra"]}, ngrid=4)
+    with pytest.raises(ValueError, match="tundra"):
+        predict(post, gradient=bad, expected=True, seed=0)
+
+
 def test_prepare_gradient(fitted_xdata):
     m, post = fitted_xdata
     xnew = pd.DataFrame({"x1": [0.0, 1.0], "x2": [0.0, 0.0]})

@@ -136,6 +136,69 @@ def test_sharded_checkpoint_resume_roundtrip(tmp_path):
         assert _max_rel(post_r[k], post_l[k]) <= SHARD_AGREEMENT_TOL, k
 
 
+def test_local_rng_resume_roundtrip(tmp_path):
+    """Opt-in local_rng mode (shard-folded keys, O(ns_local) species
+    draws): deterministic, self-consistent across kill-style resume
+    (agreement-vs-itself: the committed+resumed posterior is bit-identical
+    to the uninterrupted local_rng run), and a genuinely different stream
+    from the replicated-equality default."""
+    from hmsc_tpu.utils.checkpoint import resume_run
+    hM = _shard_models()["base"]()
+    mesh = make_mesh(n_chains=1, species_shards=2)
+    kw = dict(samples=4, transient=2, n_chains=2, seed=5, align_post=False,
+              nf_cap=2)
+    post_u = sample_mcmc(hM, mesh=mesh, local_rng=True, **kw)
+    ck = os.fspath(tmp_path / "run")
+    post_c = sample_mcmc(hM, mesh=mesh, local_rng=True, checkpoint_every=2,
+                         checkpoint_path=ck, **kw)
+    post_l = resume_run(hM, ck)
+    post_d = sample_mcmc(hM, mesh=mesh, **kw)     # default full-width mode
+    differs = False
+    for k in post_u.arrays:
+        np.testing.assert_array_equal(np.asarray(post_u[k]),
+                                      np.asarray(post_c[k]))
+        np.testing.assert_array_equal(np.asarray(post_u[k]),
+                                      np.asarray(post_l[k]))
+        differs |= not np.array_equal(np.asarray(post_u[k]),
+                                      np.asarray(post_d[k]))
+    assert differs, "local_rng produced the replicated-equality stream"
+
+
+def test_local_rng_requires_sharded_sweep():
+    hM = _shard_models()["base"]()
+    with pytest.raises(ValueError, match="local_rng"):
+        sample_mcmc(hM, samples=1, n_chains=1, nf_cap=2, align_post=False,
+                    local_rng=True)
+
+
+def test_local_rng_resume_rejects_changed_shard_count(tmp_path):
+    """The shard-folded key streams are NOT layout-invariant: a local_rng
+    continuation over a different species extent is rejected with a clear
+    error instead of silently forking the stream."""
+    from hmsc_tpu.utils.checkpoint import CheckpointError, resume_run
+    hM = _shard_models()["base"]()
+    ck = os.fspath(tmp_path / "run")
+    try:
+        sample_mcmc(hM, mesh=make_mesh(n_chains=1, species_shards=2),
+                    local_rng=True, samples=4, transient=1, n_chains=2,
+                    seed=5, align_post=False, nf_cap=2, checkpoint_every=2,
+                    checkpoint_path=ck, progress_callback=_kill_after(1))
+    except RuntimeError:
+        pass
+    with pytest.raises(CheckpointError, match="local_rng"):
+        resume_run(hM, ck, mesh=make_mesh(n_chains=1, species_shards=4))
+
+
+def _kill_after(n):
+    calls = {"n": 0}
+
+    def cb(done, total):
+        calls["n"] += 1
+        if calls["n"] > n:
+            raise RuntimeError("simulated device loss")
+    return cb
+
+
 def test_nondivisible_species_warns_and_replicates():
     """ns % species_shards != 0: the documented warn-and-replicate path —
     the warning names both values and the nearest valid divisor, and the
