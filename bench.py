@@ -220,29 +220,38 @@ def _cost_ledger_summary():
         return {"error": f"{type(e).__name__}: {e}"}
 
 
-def _serving_summary():
-    """The serving-layer digest (`benchmarks/bench_serving.py`): p50/p99
-    latency, micro-batched throughput and the zero-recompile counter for
-    the bucketed posterior serving engine, run in a CPU-pinned subprocess —
-    the serving gates are CPU-CI-enforceable by design, so the trajectory
-    records them even on rounds where the accelerator is unreachable (and
-    the bench's own accelerator run is never perturbed by a second JAX
-    backend in-process)."""
+def _digest_subprocess(argv, timeout=900, env_extra=None, line=-1):
+    """Run one benchmark script in a CPU-pinned subprocess and parse its
+    JSON digest line (``line`` indexes stdout's lines); ``gates_ok``
+    records the exit status.  Shared by every per-subsystem digest so the
+    trajectory records each path even on rounds where the accelerator is
+    unreachable — and so parsing/error-record fixes happen once."""
     import os
     import subprocess
     import sys
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.update(env_extra or {})
     try:
         r = subprocess.run(
-            [sys.executable, "benchmarks/bench_serving.py", "--reps", "100"],
-            capture_output=True, text=True, timeout=900, env=env,
+            [sys.executable] + list(argv),
+            capture_output=True, text=True, timeout=timeout, env=env,
             cwd=os.path.dirname(os.path.abspath(__file__)))
-        digest = json.loads(r.stdout.splitlines()[0])
+        digest = json.loads(r.stdout.strip().splitlines()[line])
         digest["gates_ok"] = r.returncode == 0
         return digest
     except Exception as e:                   # noqa: BLE001 — bench must emit
         return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _serving_summary():
+    """The serving-layer digest (`benchmarks/bench_serving.py`): p50/p99
+    latency, micro-batched throughput and the zero-recompile counter for
+    the bucketed posterior serving engine — the serving gates are
+    CPU-CI-enforceable by design (and the bench's own accelerator run is
+    never perturbed by a second JAX backend in-process)."""
+    return _digest_subprocess(
+        ["benchmarks/bench_serving.py", "--reps", "100"], line=0)
 
 
 def _chaos_summary():
@@ -253,24 +262,11 @@ def _chaos_summary():
     with the throughput gate informational (this shared box's wall is
     import-dominated at CI scale; the full-size 70% throughput gate is
     `python benchmarks/bench_chaos.py` standalone)."""
-    import os
-    import subprocess
-    import sys
-
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-    try:
-        r = subprocess.run(
-            [sys.executable, "benchmarks/bench_chaos.py", "--samples", "16",
-             "--transient", "8", "--checkpoint-every", "8", "--chains", "4",
-             "--nprocs", "2", "--kill-rate", "0.03", "--seed", "7",
-             "--no-throughput-gate"],
-            capture_output=True, text=True, timeout=900, env=env,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
-        digest = json.loads(r.stdout.strip().splitlines()[-1])
-        digest["gates_ok"] = r.returncode == 0
-        return digest
-    except Exception as e:                   # noqa: BLE001 — bench must emit
-        return {"error": f"{type(e).__name__}: {e}"}
+    return _digest_subprocess(
+        ["benchmarks/bench_chaos.py", "--samples", "16",
+         "--transient", "8", "--checkpoint-every", "8", "--chains", "4",
+         "--nprocs", "2", "--kill-rate", "0.03", "--seed", "7",
+         "--no-throughput-gate"])
 
 
 def _shard_summary():
@@ -282,23 +278,10 @@ def _shard_summary():
     trajectory records the model-parallel path even on rounds where the
     accelerator is unreachable."""
     import os
-    import subprocess
-    import sys
-
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
-                        + " --xla_force_host_platform_device_count=8"
-                        ).strip()
-    try:
-        r = subprocess.run(
-            [sys.executable, "benchmarks/bench_shard.py", "--digest"],
-            capture_output=True, text=True, timeout=900, env=env,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
-        digest = json.loads(r.stdout.strip().splitlines()[-1])
-        digest["gates_ok"] = r.returncode == 0
-        return digest
-    except Exception as e:                   # noqa: BLE001 — bench must emit
-        return {"error": f"{type(e).__name__}: {e}"}
+    xla = (os.environ.get("XLA_FLAGS", "")
+           + " --xla_force_host_platform_device_count=8").strip()
+    return _digest_subprocess(["benchmarks/bench_shard.py", "--digest"],
+                              env_extra={"XLA_FLAGS": xla})
 
 
 def _precision_summary():
@@ -341,6 +324,17 @@ def _precision_summary():
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _multitenant_summary():
+    """The multi-tenant batched-fitting digest
+    (`benchmarks/bench_multitenant.py --digest`): reduced-scale aggregate
+    batched-vs-serial speedup for a mixed-shape fleet, bucket occupancy /
+    padding waste, the zero-padding bit-exactness gate and the
+    masked-padding tolerance gate — CPU-only subprocess, so the
+    trajectory records the many-small-models path on every round."""
+    return _digest_subprocess(
+        ["benchmarks/bench_multitenant.py", "--digest"], timeout=1800)
+
+
 def _skip(reason: str):
     """Emit a parseable skip record instead of a bare nonzero exit: the
     bench trajectory must distinguish "chip unreachable this round" from "a
@@ -366,6 +360,7 @@ def _skip(reason: str):
         "cost_ledger": _cost_ledger_summary(),
         "shard": _shard_summary(),
         "precision": _precision_summary(),
+        "multitenant": _multitenant_summary(),
     }))
     raise SystemExit(0)
 
@@ -535,6 +530,7 @@ def main():
         # (hmsc_tpu/mcmc/precision.py) — the hot-path precision assault
         # rides the trajectory
         "precision": _precision_summary(),
+        "multitenant": _multitenant_summary(),
     }))
 
 

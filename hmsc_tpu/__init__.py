@@ -14,6 +14,7 @@ from .random_level import HmscRandomLevel, set_priors_random_level
 from .precompute import (compute_data_parameters, compute_initial_parameters,
                          construct_knots)
 from .mcmc.sampler import sample_mcmc
+from .mcmc.multitenant import sample_mcmc_batched
 from .post import (Posterior, pool_mcmc_chains, compute_associations,
                    convert_to_coda_object, effective_size, gelman_rhat,
                    align_posterior, evaluate_model_fit, compute_waic,
@@ -37,6 +38,7 @@ from .plots import (plot_beta, plot_gamma, plot_gradient,
 
 # reference-style camelCase aliases
 sampleMcmc = sample_mcmc
+sampleMcmcBatched = sample_mcmc_batched
 setPriors = set_priors
 computeDataParameters = compute_data_parameters
 computeInitialParameters = compute_initial_parameters

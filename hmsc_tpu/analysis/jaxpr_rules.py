@@ -353,6 +353,31 @@ def build_audit_context(expected_fingerprints=None) -> JaxprAudit:
             name=f"sweep_mp@{mname}", path="hmsc_tpu/mcmc/precision.py",
             closed=closed, closed_x64=closed_x64, x64_error=err))
 
+    # the tenant-masked batched sweep (mcmc/multitenant.py) on the padded
+    # canonical specs that can join a batch: same f64 probe / callback /
+    # const / fingerprint rules, committed fingerprints named
+    # `batched_sweep@<model>`.  A zero-padding bucket folds the EXACT
+    # production sweep (no mask ops), so only the padded variant needs its
+    # own fingerprint; the unpadded programs above already pin that path.
+    from ..mcmc.multitenant import (batch_unsupported_reason, bucket_dims,
+                                    make_batched_sweep, pad_spec,
+                                    pad_state, pad_tenant)
+    for mname, (spec, data, state) in built.items():
+        if batch_unsupported_reason(spec) is not None:
+            continue
+        dims = bucket_dims(spec)
+        spec_b = pad_spec(spec, dims, has_na=True)
+        data_b = pad_tenant(spec, data, dims)
+        state_b = pad_state(spec, state, dims)
+        sweep_b = make_batched_sweep(spec_b, None,
+                                     tuple(0 for _ in range(spec_b.nr)))
+        closed, closed_x64, err = _trace_pair(sweep_b, data_b, state_b,
+                                              _k())
+        programs.append(AuditProgram(
+            name=f"batched_sweep@{mname}",
+            path="hmsc_tpu/mcmc/multitenant.py",
+            closed=closed, closed_x64=closed_x64, x64_error=err))
+
     # segment runner: traced jaxpr + lowering (donation aliasing lives in
     # the lowering, not the jaxpr)
     from ..mcmc import sampler as sampler_mod

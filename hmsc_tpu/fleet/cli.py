@@ -25,8 +25,17 @@ def fleet_main(argv=None) -> int:
         prog="python -m hmsc_tpu fleet",
         description="elastic fleet supervisor: spawn R worker ranks, "
                     "restart failures with backoff, shrink/grow at "
-                    "committed manifest boundaries")
-    ap.add_argument("config", help="JSON fleet config (FleetConfig schema)")
+                    "committed manifest boundaries; with --jobs (or a "
+                    "config jobs_dir) run the JOB-QUEUE mode instead — bin "
+                    "job files by padded shape bucket and dispatch each "
+                    "bucket as one supervised batched fitting job")
+    ap.add_argument("config", nargs="?", default=None,
+                    help="JSON fleet config (FleetConfig schema); optional "
+                         "in --jobs mode when --ckpt-dir/--work-dir are "
+                         "given")
+    ap.add_argument("--jobs", default=None,
+                    help="job-queue mode: directory of *.json job files "
+                         "(see hmsc_tpu.fleet.jobs for the schema)")
     ap.add_argument("--nprocs", type=int, default=None,
                     help="override the config's initial fleet size")
     ap.add_argument("--ckpt-dir", default=None,
@@ -47,9 +56,38 @@ def fleet_main(argv=None) -> int:
     from .config import FleetConfig
     from .supervisor import FleetSupervisor
 
-    cfg = FleetConfig.from_json(args.config, nprocs=args.nprocs,
-                                ckpt_dir=args.ckpt_dir,
-                                work_dir=args.work_dir)
+    if args.config is not None:
+        cfg = FleetConfig.from_json(args.config, nprocs=args.nprocs,
+                                    ckpt_dir=args.ckpt_dir,
+                                    work_dir=args.work_dir,
+                                    jobs_dir=args.jobs)
+    elif args.jobs is not None:
+        if args.ckpt_dir is None or args.work_dir is None:
+            ap.error("--jobs without a config file requires --ckpt-dir "
+                     "and --work-dir")
+        cfg = FleetConfig(ckpt_dir=args.ckpt_dir, work_dir=args.work_dir,
+                          nprocs=1, jobs_dir=args.jobs)
+    else:
+        ap.error("a config file (or --jobs with --ckpt-dir/--work-dir) "
+                 "is required")
+
+    if cfg.jobs_dir is not None:
+        if args.chaos_seed is not None:
+            # the Poisson rank-kill schedule targets worker ranks; wiring
+            # it to bucket jobs is future work — refuse rather than let an
+            # operator believe a chaos drill ran (JobQueue.run's
+            # chaos_kill_at hook covers the drill in tests)
+            ap.error("--chaos-seed is not supported in job-queue mode")
+        from .jobs import JobQueue
+        summary = JobQueue(cfg).run()
+        print(json.dumps(summary))
+        if summary["ok"]:
+            return 0
+        # same failure-class taxonomy as the rank fleet below: a queue
+        # whose only failures are surfaced divergences exits 77
+        return {"diverged": EXIT_DIVERGED}.get(summary["status"],
+                                               EXIT_FAILURE)
+
     chaos = None
     if args.chaos_seed is not None:
         from ..testing.chaos import poisson_schedule

@@ -61,6 +61,12 @@ class FleetConfig:
     wall_timeout_s: float = 600.0        # per attempt
     poll_s: float = 0.1
     pin_cpus: bool = False
+    # job-queue mode (fleet --jobs / jobs_dir set): bin the directory's job
+    # files by padded-shape bucket and dispatch each bucket as ONE
+    # supervised batched fitting job (hmsc_tpu.fleet.jobs); run_kw then
+    # feeds sample_mcmc_batched and nprocs/ladder are ignored
+    jobs_dir: str | None = None
+    bucket_rounding: dict | None = None
 
     def __post_init__(self):
         self.run_kw = dict(self.run_kw or {})

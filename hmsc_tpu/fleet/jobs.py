@@ -1,0 +1,383 @@
+"""Fleet job-queue mode: shape-bucketed dispatch of batched fitting jobs.
+
+``python -m hmsc_tpu fleet --jobs <dir>`` turns the PR 9 supervisor into a
+scheduler for the multi-tenant batched sampler
+(:func:`~hmsc_tpu.mcmc.multitenant.sample_mcmc_batched`): every ``*.json``
+job file under the queue directory describes one small model; the queue
+bins the jobs by padded-shape-bucket fingerprint
+(:func:`~hmsc_tpu.mcmc.multitenant.bucket_key`) and dispatches each bucket
+as ONE supervised worker subprocess running the vmapped pad-and-mask batch
+— K tenants per chip-program instead of K serial runs.
+
+Job file schema (one JSON object per file)::
+
+    {"name": "regionA",                  # unique tenant name (default: stem)
+     "model": {"ny": 40, "ns": 5, ...},  # build_worker_model kwargs
+     "seed": 11}                         # per-tenant seed (default: stable
+                                         #  hash of the name)
+
+The run cadence (samples / transient / thin / n_chains /
+checkpoint_every) is queue-wide, from the fleet config's ``run_kw`` —
+bucketing requires a uniform cadence anyway.
+
+Supervision mirrors the rank fleet: each bucket attempt is watched by exit
+code, failures restart with exponential backoff under a per-bucket budget,
+and every restart RESUMES from the bucket's per-tenant manifests (each
+tenant continues from its own last committed mark — zero committed draws
+lost for any tenant, by the same append-layout argument as the rank
+fleet).  Every decision lands in ``fleet-events.jsonl``: per-bucket
+``job_dispatch`` / ``job_exit``, per-tenant ``tenant_done`` completion
+events, and a final ``queue_end`` carrying the batch ``report`` section
+(per-bucket occupancy / padding-waste metrics).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+from ..exit_codes import EXIT_DIVERGED, EXIT_OK, describe
+
+__all__ = ["JobQueue", "scan_jobs", "plan_buckets", "batch_worker_main",
+           "bucket_ckpt_dir", "queue_status"]
+
+
+def queue_status(outcomes: list[dict]) -> str:
+    """The queue's failure class from its per-bucket outcomes — mapped to
+    an exit code by the fleet CLI exactly like the rank supervisor's
+    status ('diverged' -> 77, any other failure -> 1)."""
+    bad = [o for o in outcomes if not o["ok"]]
+    if not outcomes:
+        return "empty-queue"
+    if not bad:
+        return "ok"
+    if all(o["diverged"] for o in bad):
+        # every failure is a surfaced divergence, not a supervision
+        # failure — callers branch on 77 like the rank fleet's
+        return "diverged"
+    return "job-failed"
+
+
+def bucket_ckpt_dir(root: str, bkey: str) -> str:
+    return os.path.join(os.fspath(root), f"bucket-{bkey}")
+
+
+def _job_seed(name: str) -> int:
+    import hashlib
+    return int.from_bytes(hashlib.sha256(name.encode()).digest()[:4],
+                          "little") % (2**31 - 1)
+
+
+def scan_jobs(jobs_dir: str) -> list[dict]:
+    """Load every ``*.json`` job file under ``jobs_dir`` (sorted, so the
+    queue order is deterministic).  Each job gets a unique ``name`` (file
+    stem default) and a stable per-tenant ``seed``."""
+    jobs, seen = [], set()
+    for fn in sorted(os.listdir(jobs_dir)):
+        if not fn.endswith(".json"):
+            continue
+        path = os.path.join(jobs_dir, fn)
+        with open(path) as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict):
+            raise ValueError(f"{path}: job file must be a JSON object")
+        name = str(doc.get("name", os.path.splitext(fn)[0]))
+        if name in seen:
+            raise ValueError(f"{path}: duplicate job name {name!r}")
+        seen.add(name)
+        jobs.append({"name": name, "model": dict(doc.get("model", {})),
+                     "seed": int(doc.get("seed", _job_seed(name))),
+                     "path": path})
+    return jobs
+
+
+def plan_buckets(jobs: list[dict], rounding: dict | None = None) -> dict:
+    """Bin jobs by padded-shape-bucket fingerprint.  Builds each job's
+    spec host-side (cheap — no sampling, no compile) and groups by
+    :func:`~hmsc_tpu.mcmc.multitenant.bucket_key`."""
+    from ..mcmc.multitenant import (batch_unsupported_reason, bucket_key)
+    from ..mcmc.structs import build_model_data, build_spec
+    from ..precompute import compute_data_parameters
+    from ..testing.multiproc import build_worker_model
+
+    buckets: dict[str, list[dict]] = {}
+    for job in jobs:
+        hM = build_worker_model(**job["model"])
+        spec = build_spec(hM)
+        reason = batch_unsupported_reason(spec)
+        if reason is not None:
+            raise ValueError(
+                f"job {job['name']!r}: cannot join a padded batch "
+                f"({reason})")
+        data = build_model_data(hM, compute_data_parameters(hM), spec)
+        buckets.setdefault(bucket_key(spec, data, rounding), []).append(job)
+    return buckets
+
+
+# ---------------------------------------------------------------------------
+# the batch worker (one subprocess per dispatched bucket)
+# ---------------------------------------------------------------------------
+
+def batch_worker_main(argv=None) -> int:
+    """One bucket's worker: build the tenants' models, run (or resume) the
+    vmapped batched fit with per-tenant manifests, write the result record.
+    Exit codes follow :mod:`hmsc_tpu.exit_codes`: 0 on success, 77 when
+    any tenant completed diverged, 1 anything else."""
+    import argparse
+
+    ap = argparse.ArgumentParser(description="batched-bucket fitting worker")
+    ap.add_argument("--jobs", required=True,
+                    help="JSON list of job objects (name/model/seed)")
+    ap.add_argument("--ckpt-dir", required=True,
+                    help="this bucket's checkpoint root (per-tenant "
+                         "manifests land in tenant-<name>/ under it)")
+    ap.add_argument("--run", default="{}",
+                    help="JSON kwargs for sample_mcmc_batched")
+    ap.add_argument("--action", choices=("run", "resume"), default="run")
+    ap.add_argument("--rounding", default=None,
+                    help="JSON bucket_rounding override")
+    ap.add_argument("--kill-at", type=int, default=None,
+                    help="hard-kill (SIGKILL) once N samples are recorded "
+                         "— the mid-run death the manifests must survive")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    from ..mcmc.multitenant import sample_mcmc_batched
+    from ..obs import get_logger
+    from ..testing.multiproc import build_worker_model
+
+    jobs = json.loads(args.jobs)
+    run_kw = dict(json.loads(args.run))
+    run_kw.setdefault("samples", 8)
+    run_kw.setdefault("checkpoint_every",
+                      max(1, int(run_kw["samples"]) // 4))
+    rounding = json.loads(args.rounding) if args.rounding else None
+
+    models = [build_worker_model(**j.get("model", {})) for j in jobs]
+    names = [j["name"] for j in jobs]
+    seeds = [int(j.get("seed", _job_seed(j["name"]))) for j in jobs]
+
+    if args.kill_at is not None:
+        kill_at = int(args.kill_at)
+
+        def progress_callback(done, total):
+            if done >= kill_at:
+                # the snapshot fan-out rides the background writer: wait
+                # for every tenant's manifest to land so the drill tests
+                # resume-from-manifest, not the trivial nothing-committed
+                # fresh restart
+                import glob
+                import signal
+                deadline = time.time() + 60.0
+                names = [j["name"] for j in jobs]
+                while time.time() < deadline:
+                    if all(glob.glob(os.path.join(
+                            args.ckpt_dir, f"tenant-{n}", "manifest-*"))
+                            for n in names):
+                        break
+                    time.sleep(0.05)
+                os.kill(os.getpid(), signal.SIGKILL)
+    else:
+        progress_callback = None
+
+    try:
+        posts, report = sample_mcmc_batched(
+            models, names=names, seeds=seeds,
+            checkpoint_path=args.ckpt_dir,
+            resume=(args.action == "resume"),
+            bucket_rounding=rounding,
+            progress_callback=progress_callback,
+            return_report=True, **run_kw)
+    except Exception as e:            # noqa: BLE001 — the supervisor reads
+        get_logger().warn(f"batch worker failed: {type(e).__name__}: {e}")
+        raise
+
+    tenants = []
+    any_diverged = False
+    for name, post in zip(names, posts):
+        good = bool(np.asarray(post.chain_health["good_chains"]).all())
+        any_diverged |= not good
+        tenants.append({
+            "tenant": name, "ok": good,
+            "samples": int(post.samples), "n_chains": int(post.n_chains),
+            "first_bad_it": [int(x) for x in
+                             np.asarray(post.chain_health["first_bad_it"])],
+            "digest": {k: float(np.asarray(v, dtype=np.float64).sum())
+                       for k, v in post.arrays.items()},
+        })
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"tenants": tenants, "report": report}, f)
+    return EXIT_DIVERGED if any_diverged else EXIT_OK
+
+
+# ---------------------------------------------------------------------------
+# the queue supervisor
+# ---------------------------------------------------------------------------
+
+class JobQueue:
+    """Supervise a job-queue run: plan buckets, dispatch each as a watched
+    worker subprocess, restart-with-resume on failure under a per-bucket
+    budget, and emit the fleet event timeline + occupancy report."""
+
+    def __init__(self, cfg, *, jobs_dir: str | None = None):
+        from ..obs import RunTelemetry
+        self.cfg = cfg
+        self.jobs_dir = os.fspath(jobs_dir or cfg.jobs_dir)
+        self.telem = RunTelemetry(proc=0)
+        self.attempt_log: list = []
+        self._t0 = time.monotonic()
+
+    def _emit(self, name: str, **fields) -> None:
+        self.telem.emit("fleet", name, **fields)
+        self.telem.flush()            # the stream must be tailable live
+
+    def _spawn(self, bkey: str, jobs: list, action: str, attempt: int,
+               kill_at: int | None = None):
+        from ..testing.multiproc import _pkg_root, worker_env
+        cfg = self.cfg
+        out = os.path.join(cfg.work_dir, f"job-{bkey}-{attempt:03d}.json")
+        cmd = [sys.executable, "-c",
+               "from hmsc_tpu.fleet.jobs import batch_worker_main; "
+               "raise SystemExit(batch_worker_main())",
+               "--jobs", json.dumps([{k: v for k, v in j.items()
+                                      if k != "path"} for j in jobs]),
+               "--ckpt-dir", bucket_ckpt_dir(cfg.ckpt_dir, bkey),
+               "--run", json.dumps(cfg.run_kw),
+               "--action", action, "--out", out]
+        if getattr(cfg, "bucket_rounding", None):
+            cmd += ["--rounding", json.dumps(cfg.bucket_rounding)]
+        if kill_at is not None:
+            cmd += ["--kill-at", str(int(kill_at))]
+        log_path = os.path.join(cfg.work_dir,
+                                f"job-{bkey}-{attempt:03d}.log")
+        logf = open(log_path, "w")
+        p = subprocess.Popen(cmd, cwd=_pkg_root(), env=worker_env(),
+                             stdout=logf, stderr=subprocess.STDOUT)
+        logf.close()
+        self._emit("job_dispatch", bucket=bkey, attempt=attempt, pid=p.pid,
+                   action=action, n_tenants=len(jobs),
+                   tenants=[j["name"] for j in jobs])
+        return p, out, log_path
+
+    def _run_bucket_supervised(self, bkey: str, jobs: list,
+                               chaos_kill_at=None) -> dict:
+        """Dispatch one bucket to completion under the restart budget.
+        ``chaos_kill_at`` arms a first-attempt mid-run SIGKILL (the chaos
+        drill: the retry must resume from per-tenant manifests with zero
+        committed draws lost)."""
+        from ..utils.checkpoint import checkpoint_files
+        cfg = self.cfg
+        budget = int(cfg.restart_budget)
+        attempt = 0
+        result = None
+        diverged = False
+        while True:
+            attempt += 1
+            ck_root = bucket_ckpt_dir(cfg.ckpt_dir, bkey)
+            has_ck = any(
+                checkpoint_files(os.path.join(ck_root, d))
+                for d in (os.listdir(ck_root)
+                          if os.path.isdir(ck_root) else [])
+                if d.startswith("tenant-"))
+            action = "resume" if has_ck else "run"
+            kill = chaos_kill_at if attempt == 1 else None
+            p, out, log_path = self._spawn(bkey, jobs, action, attempt,
+                                           kill_at=kill)
+            try:
+                rc = p.wait(timeout=cfg.wall_timeout_s)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                rc = p.wait()
+            rec = None
+            if os.path.exists(out):
+                try:
+                    with open(out) as f:
+                        rec = json.load(f)
+                except (OSError, ValueError):
+                    rec = None
+            self._emit("job_exit", bucket=bkey, attempt=attempt, rc=int(rc),
+                       outcome=describe(rc))
+            self.attempt_log.append({"bucket": bkey, "attempt": attempt,
+                                     "action": action, "rc": int(rc)})
+            if rc == EXIT_OK and rec is not None:
+                result = rec
+                break
+            if rc == EXIT_DIVERGED and rec is not None:
+                # deterministic blow-ups recur; surface instead of burning
+                # the budget (mirrors the rank fleet's policy)
+                result = rec
+                diverged = True
+                break
+            budget -= 1
+            if budget <= 0:
+                self._emit("job_abort", bucket=bkey,
+                           reason="budget-exhausted", attempts=attempt)
+                break
+            backoff = min(cfg.backoff_base_s
+                          * cfg.backoff_factor ** (attempt - 1),
+                          cfg.backoff_max_s)
+            self._emit("backoff", bucket=bkey, seconds=round(backoff, 3))
+            time.sleep(backoff)
+        if result is not None:
+            for trec in result.get("tenants", []):
+                self._emit("tenant_done", bucket=bkey, **trec)
+        return {"bucket": bkey, "attempts": attempt,
+                "ok": result is not None
+                and all(t["ok"] for t in result.get("tenants", [])),
+                "diverged": diverged, "result": result}
+
+    def run(self, chaos_kill_at=None) -> dict:
+        """Run the whole queue: scan, plan, dispatch every bucket.
+        Returns the summary dict the CLI prints (with the batch ``report``
+        section: per-bucket occupancy and padding waste)."""
+        from .supervisor import fleet_events_path
+        cfg = self.cfg
+        os.makedirs(cfg.work_dir, exist_ok=True)
+        os.makedirs(cfg.ckpt_dir, exist_ok=True)
+        self.telem.attach_sink(fleet_events_path(cfg.ckpt_dir),
+                               truncate=True)
+        jobs = scan_jobs(self.jobs_dir)
+        buckets = plan_buckets(jobs, getattr(cfg, "bucket_rounding", None))
+        self._emit("queue_start", n_jobs=len(jobs), n_buckets=len(buckets),
+                   buckets={k: [j["name"] for j in v]
+                            for k, v in sorted(buckets.items())})
+        outcomes = []
+        for bkey, bjobs in sorted(buckets.items()):
+            outcomes.append(self._run_bucket_supervised(
+                bkey, bjobs, chaos_kill_at=chaos_kill_at))
+        report = {"buckets": [], "occupancy": None, "padding_waste": None}
+        cr = cp = 0
+        for o in outcomes:
+            rep = (o["result"] or {}).get("report") or {}
+            for b in rep.get("buckets", []):
+                report["buckets"].append(b)
+                cr += b.get("cells_real", 0)
+                cp += b.get("cells_padded", 0)
+        if cp:
+            report["occupancy"] = round(cr / cp, 4)
+            report["padding_waste"] = round(1.0 - cr / cp, 4)
+        status = queue_status(outcomes)
+        summary = {
+            "ok": status == "ok",
+            "status": status,
+            "n_jobs": len(jobs), "n_buckets": len(buckets),
+            "bucket_outcomes": [{k: v for k, v in o.items()
+                                 if k != "result"} for o in outcomes],
+            "tenants_done": sum(
+                len((o["result"] or {}).get("tenants", []))
+                for o in outcomes),
+            "report": report,
+            "wall_s": round(time.monotonic() - self._t0, 3),
+        }
+        self._emit("queue_end", **summary)
+        return summary
+
+
+if __name__ == "__main__":
+    raise SystemExit(batch_worker_main())

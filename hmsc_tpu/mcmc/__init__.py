@@ -1,9 +1,12 @@
 from .structs import (GibbsState, LevelSpec, LevelState, ModelData, ModelSpec,
-                      build_model_data, build_state, LevelData,
-                      state_nbytes)
+                      build_model_data, build_state, LevelData, LevelTenant,
+                      TenantMasks, state_nbytes)
 from .sampler import sample_mcmc
+from .multitenant import (TENANT_PAD_AGREEMENT_TOL, sample_mcmc_batched)
 from .precision import PRECISION_AGREEMENT_TOL, PrecisionPolicy
 
 __all__ = ["GibbsState", "LevelSpec", "LevelState", "ModelData", "ModelSpec",
-           "LevelData", "build_model_data", "build_state", "state_nbytes",
-           "sample_mcmc", "PrecisionPolicy", "PRECISION_AGREEMENT_TOL"]
+           "LevelData", "LevelTenant", "TenantMasks", "build_model_data",
+           "build_state", "state_nbytes", "sample_mcmc",
+           "sample_mcmc_batched", "TENANT_PAD_AGREEMENT_TOL",
+           "PrecisionPolicy", "PRECISION_AGREEMENT_TOL"]

@@ -1,0 +1,1237 @@
+"""Multi-tenant batched fitting: one vmapped pad-and-mask sweep over K models.
+
+The north-star workload is many *small* regional / taxon-specific JSDMs
+(PAPER.md's model family — probit/normal/Poisson observation models with
+traits, phylogeny and unstructured random levels).  Run serially, each
+tiny model wastes a chip: per-sweep dispatch overhead and XLA compilation
+dominate while the arithmetic is microscopic.  This module batches K
+same-structure models into ONE jitted segment runner:
+
+- **Shape buckets**: model specs are grouped by a structural fingerprint
+  (:func:`bucket_key`) — static flags that pick traced code paths — plus
+  their padded dims (``ny``/``ns``/``nc``/``nt``/``np``/``nf`` rounded up
+  to the bucket granularity).  Models in one bucket run as one program.
+- **Pad and mask**: every per-model array is padded to the bucket dims.
+  Padded rows/species ride the existing ``has_na`` masked-gram machinery
+  (a padded cell IS a missing cell), padded covariates/traits carry
+  exact-zero design columns with identity prior blocks, and a
+  :class:`~.structs.TenantMasks` threads per-model validity masks +
+  real-count scalars through the updaters (Wishart degrees of freedom,
+  shrinkage gamma shapes, Nf statistics, interweave Jacobian exponents).
+  The batched sweep re-masks the carry after every Gibbs block, so padded
+  slots provably contribute exact zeros to every real entry — block
+  precisions stay block-diagonal between real and padded indices, and
+  Cholesky/solve factors preserve that decoupling bitwise
+  (``tests/test_multitenant.py`` pins junk-in-padding invariance per
+  registered updater).
+- **vmap over the model axis**: the existing chain-vmapped segment body is
+  vmapped once more over models, with per-model data, RNG key streams and
+  divergence trackers.  A tenant's blow-up (non-finite carry) is confined
+  to its own vmap lane and never disturbs another tenant's draws.
+- **Per-tenant manifests**: each tenant checkpoints into its own
+  subdirectory through a standard :class:`~..utils.checkpoint.
+  CheckpointWriter` — the committed state/draws are sliced back to the
+  tenant's REAL shapes, so every manifest is a fully ordinary single-model
+  checkpoint (loadable, resumable, splice-repairable by the existing
+  tools).  ``retry_diverged`` restarts only a diverged tenant's chains
+  from that tenant's last healthy manifest; healthy tenants' shard files
+  are byte-untouched.
+
+Contracts:
+
+- **Zero padding** (every model in the bucket already at the bucket dims,
+  identical specs): masks are omitted entirely and the batched program
+  folds the production sweep verbatim — each tenant's draw stream is
+  **bit-identical** to its own unbatched ``sample_mcmc`` run with the
+  same seed, *up to XLA's lane-count-sensitive kernel tiling*.
+  Bit-exactness is pinned by tests on the CPU backend for the
+  formula-built model family at the tier-1 lane counts (K x chains <= 8);
+  above that XLA CPU re-tiles its batched kernels and per-lane results
+  drift at the ULP level (measured ~1e-6 max, gated in
+  ``benchmarks/bench_multitenant.py``).  Models whose trace includes the
+  fusion-boundary-sensitive interweave dot (raw-matrix designs with a
+  ones column, ``x_ones_ind`` set — see the PR 8 schedule notes) can sit
+  at 1-ULP agreement even at K=1, because the extra model-axis vmap
+  moves that dot's fusion boundary; the hard cross-family contract is
+  the bench's ULP bound, not bitwise equality.
+- **Masked padding**: padded slots contribute exact zeros (bitwise
+  junk-invariance per updater), but RNG draws happen at padded widths, so
+  a padded tenant's stream is a *different realisation* of the same
+  posterior; end-to-end agreement with the unbatched run is statistical,
+  within :data:`TENANT_PAD_AGREEMENT_TOL` on posterior means.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..precompute import compute_data_parameters
+from .structs import (DEFAULT_NF_CAP, GibbsState, LevelTenant, ModelData,
+                      ModelSpec, TenantMasks, build_model_data, build_spec,
+                      build_state)
+from .sweep import (effective_spec_data, make_sweep_schedule, record_sample,
+                    sweep_prologue)
+from . import spatial
+from . import updaters as U
+
+__all__ = ["sample_mcmc_batched", "bucket_key", "bucket_dims",
+           "batch_unsupported_reason", "make_batched_sweep",
+           "mask_tenant_state", "pad_tenant", "pad_spec", "pad_state",
+           "slice_tenant_state", "TENANT_PAD_AGREEMENT_TOL",
+           "DEFAULT_BUCKET_ROUNDING", "tenant_dir"]
+
+# Committed masked-padding contract: a padded tenant's posterior MEANS agree
+# with its own unbatched run within this tolerance at the regression tests'
+# sample counts (Monte-Carlo error dominates — padding contributes exact
+# zeros, only the RNG draw widths differ).  Zero-padding buckets are exempt:
+# they are bit-identical.
+TENANT_PAD_AGREEMENT_TOL = 0.35
+
+# Default pad granularity per dimension: dims round UP to the next multiple,
+# bounding both padding waste (< one granule per dim) and program count
+# (every model in a granule-aligned box shares one compiled runner).
+DEFAULT_BUCKET_ROUNDING = {"ny": 16, "ns": 4, "nc": 2, "nt": 2,
+                           "np": 8, "nf": 2}
+
+
+def _round_up(n: int, g: int) -> int:
+    g = max(1, int(g))
+    return int(-(-int(n) // g) * g)
+
+
+def tenant_dir(base: str, name: str) -> str:
+    """The per-tenant checkpoint subdirectory under a batched run's
+    ``checkpoint_path`` — one ordinary append-layout snapshot directory
+    per model."""
+    return os.path.join(os.fspath(base), f"tenant-{name}")
+
+
+# ---------------------------------------------------------------------------
+# bucketing
+# ---------------------------------------------------------------------------
+
+def batch_unsupported_reason(spec: ModelSpec,
+                             updater: dict | None = None) -> str | None:
+    """Why this model cannot join a padded batch, or ``None`` when it can.
+    The supported family is PAPER.md's core: normal/probit/Poisson
+    observation models, traits, phylogeny, unstructured random levels."""
+    for ls in spec.levels:
+        if ls.spatial is not None:
+            return (f"spatial random level '{ls.name}' ({ls.spatial}): the "
+                    "spatial precision grids have no padded formulation yet")
+        if ls.x_dim > 0:
+            return (f"covariate-dependent random level '{ls.name}' "
+                    "(xDim > 0)")
+    if spec.x_is_list:
+        return "per-species design matrices (x_is_list)"
+    if spec.ncsel > 0:
+        return "spike-and-slab variable selection (XSelect)"
+    if spec.nc_rrr > 0:
+        return "reduced-rank regression (XRRRData)"
+    up = updater or {}
+    if up.get("Gamma2") is True or up.get("GammaEta") is True:
+        return "opt-in collapsed updaters (Gamma2/GammaEta)"
+    if up.get("InterweaveDA") is True:
+        return "opt-in probit-DA intercept interweave (InterweaveDA)"
+    return None
+
+
+def bucket_dims(spec: ModelSpec, rounding: dict | None = None) -> dict:
+    """This model's padded target dims under the rounding granularity."""
+    g = dict(DEFAULT_BUCKET_ROUNDING)
+    g.update(rounding or {})
+    return {
+        "ny": _round_up(spec.ny, g["ny"]),
+        "ns": _round_up(spec.ns, g["ns"]),
+        "nc": _round_up(spec.nc, g["nc"]),
+        "nt": _round_up(spec.nt, g["nt"]),
+        "np": tuple(_round_up(ls.n_units, g["np"]) for ls in spec.levels),
+        "nf": tuple(_round_up(ls.nf_max, g["nf"]) for ls in spec.levels),
+    }
+
+
+def _struct_sig(spec: ModelSpec, data: ModelData) -> tuple:
+    """The trace-path part of the bucket key: every static flag that picks
+    compiled code, EXCLUDING the raw dims (those enter via padded dims)."""
+    return (
+        spec.nr,
+        tuple((ls.x_dim, ls.spatial, ls.ncr) for ls in spec.levels),
+        spec.has_phylo, spec.n_rho,
+        spec.any_normal, spec.any_probit, spec.any_poisson,
+        spec.any_estimated_sigma, spec.homoskedastic_fixed,
+        spec.x_is_list, spec.ncsel, spec.nc_rrr,
+        data.x_ones_ind is not None,
+        data.x_intercept_ind is not None,
+        data.tr_intercept_ind is not None,
+    )
+
+
+def bucket_key(spec: ModelSpec, data: ModelData,
+               rounding: dict | None = None) -> str:
+    """The shape-bucket fingerprint: models with equal keys batch into one
+    padded vmapped program.  ``has_na`` joins the key as its *effective*
+    value — a model that pads at all runs under the masked-gram (has_na)
+    trace, so NA and no-NA models share a bucket unless both are already
+    exactly at the bucket dims."""
+    import hashlib
+    dims = bucket_dims(spec, rounding)
+    padded = _is_padded(spec, dims)
+    sig = (_struct_sig(spec, data), tuple(sorted(dims.items())),
+           bool(spec.has_na or padded))
+    return hashlib.sha256(repr(sig).encode()).hexdigest()[:16]
+
+
+def _is_padded(spec: ModelSpec, dims: dict) -> bool:
+    return (dims["ny"] != spec.ny or dims["ns"] != spec.ns
+            or dims["nc"] != spec.nc or dims["nt"] != spec.nt
+            or any(dims["np"][r] != spec.levels[r].n_units
+                   for r in range(spec.nr))
+            or any(dims["nf"][r] != spec.levels[r].nf_max
+                   for r in range(spec.nr)))
+
+
+# ---------------------------------------------------------------------------
+# padding
+# ---------------------------------------------------------------------------
+
+def _padded(a, targets: dict, fill: float = 0.0):
+    """np-pad ``a`` up to ``targets[axis]`` with a constant fill."""
+    a = np.asarray(a)
+    pads = [(0, max(0, int(targets.get(ax, a.shape[ax])) - a.shape[ax]))
+            for ax in range(a.ndim)]
+    if not any(p[1] for p in pads):
+        return a
+    return np.pad(a, pads, constant_values=fill)
+
+
+def _pad_diag_one(a, n: int):
+    """Pad a square matrix to (n, n) with zeros, ones on the pad diagonal
+    (identity pad block: exact real/pad decoupling through Cholesky)."""
+    a = np.asarray(a)
+    k = a.shape[0]
+    out = _padded(a, {0: n, 1: n})
+    if n > k:
+        idx = np.arange(k, n)
+        out[idx, idx] = 1.0
+    return out
+
+
+def _pad_scale_par(sp, n: int):
+    """(2, d) back-transform params: pad means with 0, scales with 1."""
+    sp = np.asarray(sp)
+    out = _padded(sp, {1: n})
+    if n > sp.shape[1]:
+        out[1, sp.shape[1]:] = 1.0
+    return out
+
+
+def _remap_gamma_vec(v, nt: int, nc: int, nt_p: int, nc_p: int):
+    """Re-lay a (nt*nc,) Gamma-vec (t-major: index t*nc + c) into the
+    padded (nt_p*nc_p,) ordering with zero fill."""
+    return _padded(np.asarray(v).reshape(nt, nc), {0: nt_p, 1: nc_p}).ravel()
+
+
+def _remap_gamma_mat(m, nt: int, nc: int, nt_p: int, nc_p: int):
+    """Re-lay a (nt*nc, nt*nc) Gamma-vec matrix into padded vec ordering,
+    identity on the pad diagonal."""
+    n_p = nt_p * nc_p
+    out = np.eye(n_p, dtype=np.asarray(m).dtype)
+    idx = (np.arange(nt)[:, None] * nc_p + np.arange(nc)[None, :]).ravel()
+    out[np.ix_(idx, idx)] = np.asarray(m)
+    return out
+
+
+def pad_spec(spec: ModelSpec, dims: dict, has_na: bool) -> ModelSpec:
+    """The shared bucket spec: padded dims, masked-gram trace forced on."""
+    levels = tuple(
+        dataclasses.replace(ls, n_units=int(dims["np"][r]),
+                            nf_max=int(dims["nf"][r]),
+                            nf_min=min(ls.nf_min, int(dims["nf"][r])),
+                            nf_capped=ls.nf_capped)
+        for r, ls in enumerate(spec.levels))
+    return dataclasses.replace(
+        spec, ny=int(dims["ny"]), ns=int(dims["ns"]), nc=int(dims["nc"]),
+        nt=int(dims["nt"]), has_na=bool(has_na), levels=levels,
+        # batch-eligible models carry no RRR columns (nc == nc_nrrr), so
+        # the padded spec keeps that identity — record_sample's RRR concat
+        # branch (spec.nc > nc_nrrr) must not fire against the padded
+        # x_scale_par
+        nc_nrrr=int(dims["nc"]))
+
+
+def _tenant_masks(spec: ModelSpec, dims: dict, dtype=np.float32):
+    def m(real, padded):
+        out = np.zeros(padded, dtype=dtype)
+        out[:real] = 1.0
+        return out
+    levels = tuple(
+        LevelTenant(
+            unit_mask=jnp.asarray(m(ls.n_units, dims["np"][r])),
+            n_units=jnp.asarray(float(ls.n_units), dtype=dtype),
+            nf_cap=jnp.asarray(float(ls.nf_max), dtype=dtype),
+            nf_min=jnp.asarray(float(ls.nf_min), dtype=dtype),
+            nf_capped=jnp.asarray(float(ls.nf_capped), dtype=dtype))
+        for r, ls in enumerate(spec.levels))
+    return TenantMasks(
+        row_mask=jnp.asarray(m(spec.ny, dims["ny"])),
+        sp_mask=jnp.asarray(m(spec.ns, dims["ns"])),
+        cov_mask=jnp.asarray(m(spec.nc, dims["nc"])),
+        tr_mask=jnp.asarray(m(spec.nt, dims["nt"])),
+        n_rows=jnp.asarray(float(spec.ny), dtype=dtype),
+        n_sp=jnp.asarray(float(spec.ns), dtype=dtype),
+        n_cov=jnp.asarray(float(spec.nc), dtype=dtype),
+        df_v=jnp.asarray(float(spec.f0 + spec.ns), dtype=dtype),
+        levels=levels)
+
+
+def pad_tenant(spec: ModelSpec, data: ModelData, dims: dict) -> ModelData:
+    """One tenant's padded :class:`ModelData` (with its ``tenant`` masks).
+
+    Padding construction (every choice makes the pad slots exactly inert):
+    rows/species pad as MISSING cells (``Ymask=0`` — the has_na grams skip
+    them), covariates pad as all-zero design columns with identity prior
+    blocks (``V0``/``iUGamma``), traits pad as zero columns, phylogeny
+    pads block-diagonally with unit eigenvalues, and the back-transform
+    scale params pad as (mean 0, scale 1) so ``record_sample`` divides by
+    ones."""
+    ny, ns, nc, nt = dims["ny"], dims["ns"], dims["nc"], dims["nt"]
+    f32 = lambda a: jnp.asarray(np.asarray(a, dtype=np.float32))
+
+    levels = []
+    for r in range(spec.nr):
+        lvd = data.levels[r]
+        np_p = int(dims["np"][r])
+        np_r = spec.levels[r].n_units
+        pi = np.asarray(lvd.pi_row)
+        # padded rows point at the first padded unit (or unit 0 when the
+        # unit axis itself is unpadded) — their stats are Ymask-zeroed
+        # either way, this just keeps the segment sums tidy
+        pad_unit = np_r if np_p > np_r else 0
+        pi_p = _padded(pi, {0: ny}, fill=pad_unit).astype(np.int32)
+        levels.append(lvd.replace(
+            pi_row=jnp.asarray(pi_p),
+            unit_count=f32(_padded(lvd.unit_count, {0: np_p})),
+            x_row=f32(_padded(lvd.x_row, {0: ny}, fill=1.0)),
+            x_unit=f32(_padded(lvd.x_unit, {0: np_p}, fill=1.0)),
+        ))
+
+    kw = dict(
+        Y=f32(_padded(data.Y, {0: ny, 1: ns})),
+        Ymask=f32(_padded(data.Ymask, {0: ny, 1: ns})),
+        X=f32(_padded(data.X, {0: ny, 1: nc})),
+        Tr=f32(_padded(data.Tr, {0: ns, 1: nt})),
+        distr_family=jnp.asarray(
+            _padded(np.asarray(data.distr_family), {0: ns},
+                    fill=1).astype(np.int32)),
+        distr_estsig=f32(_padded(data.distr_estsig, {0: ns})),
+        sigma_fixed=f32(_padded(data.sigma_fixed, {0: ns}, fill=1.0)),
+        mGamma=f32(_remap_gamma_vec(data.mGamma, spec.nt, spec.nc, nt, nc)),
+        iUGamma=f32(_remap_gamma_mat(data.iUGamma, spec.nt, spec.nc,
+                                     nt, nc)),
+        UGamma=f32(_remap_gamma_mat(data.UGamma, spec.nt, spec.nc, nt, nc)),
+        V0=f32(_pad_diag_one(data.V0, nc)),
+        aSigma=f32(_padded(data.aSigma, {0: ns}, fill=1.0)),
+        bSigma=f32(_padded(data.bSigma, {0: ns}, fill=1.0)),
+        levels=tuple(levels),
+        x_scale_par=f32(_pad_scale_par(data.x_scale_par, nc)),
+        tr_scale_par=f32(_pad_scale_par(data.tr_scale_par, nt)),
+        y_scale_par=f32(_pad_scale_par(data.y_scale_par, ns)),
+        x_intercept_ind=data.x_intercept_ind,
+        tr_intercept_ind=data.tr_intercept_ind,
+        x_ones_ind=data.x_ones_ind,
+        tenant=_tenant_masks(spec, dims),
+    )
+    if spec.has_phylo:
+        kw.update(
+            rhopw=f32(data.rhopw),
+            # padded species are phylogenetically independent: Q(rho)'s pad
+            # block is the identity for EVERY rho (rho C_pad + (1-rho) I =
+            # I), so the pad eigenvalues are exactly 1 and logdetQ is the
+            # real model's unchanged
+            Qeig=f32(_padded(data.Qeig, {1: ns}, fill=1.0)),
+            logdetQ=f32(data.logdetQ),
+            U=f32(_pad_diag_one(data.U, ns)),
+            UTr=f32(_padded(data.UTr, {0: ns, 1: nt})),
+        )
+    return ModelData(**kw)
+
+
+def pad_state(spec: ModelSpec, state: GibbsState, dims: dict,
+              lead: int = 0) -> GibbsState:
+    """One tenant's carry padded to the bucket dims, pad slots in their
+    masked-neutral values (zeros; ones for precisions/Delta/Psi).
+
+    ``lead`` shifts the padded axes right by that many leading batch axes
+    (``lead=1`` pads a whole (chains, ...) carry in one host pass — the
+    resume path re-pads loaded real-shape carries this way)."""
+    ny, ns, nc, nt = dims["ny"], dims["ns"], dims["nc"], dims["nt"]
+    f32 = lambda a: jnp.asarray(np.asarray(a, dtype=np.float32))
+    sh = lambda d: {ax + lead: v for ax, v in d.items()}
+
+    def diag_one(a, n):
+        a = np.asarray(a)
+        k = a.shape[lead]
+        out = _padded(a, sh({0: n, 1: n}))
+        if n > k:
+            idx = np.arange(k, n)
+            out[..., idx, idx] = 1.0
+        return out
+
+    levels = []
+    for r in range(spec.nr):
+        lv = state.levels[r]
+        np_p, nf_p = int(dims["np"][r]), int(dims["nf"][r])
+        levels.append(lv.replace(
+            Eta=f32(_padded(lv.Eta, sh({0: np_p, 1: nf_p}))),
+            Lambda=f32(_padded(lv.Lambda, sh({0: nf_p, 1: ns}))),
+            Psi=f32(_padded(lv.Psi, sh({0: nf_p, 1: ns}), fill=1.0)),
+            Delta=f32(_padded(lv.Delta, sh({0: nf_p}), fill=1.0)),
+            alpha_idx=jnp.asarray(_padded(np.asarray(lv.alpha_idx),
+                                          sh({0: nf_p})).astype(np.int32)),
+            nf_mask=f32(_padded(lv.nf_mask, sh({0: nf_p}))),
+        ))
+    return state.replace(
+        Z=f32(_padded(state.Z, sh({0: ny, 1: ns}))),
+        Beta=f32(_padded(state.Beta, sh({0: nc, 1: ns}))),
+        Gamma=f32(_padded(state.Gamma, sh({0: nc, 1: nt}))),
+        iV=f32(diag_one(state.iV, nc)),
+        iSigma=f32(_padded(state.iSigma, sh({0: ns}), fill=1.0)),
+        levels=tuple(levels))
+
+
+def slice_tenant_state(spec: ModelSpec, state: GibbsState) -> GibbsState:
+    """Slice a padded carry back to the tenant's REAL shapes — the inverse
+    of :func:`pad_state`, so per-tenant checkpoints hold ordinary
+    unbatched-shape state (directly loadable by the standard tools)."""
+    levels = []
+    for r in range(spec.nr):
+        lv = state.levels[r]
+        np_r, nf_r = spec.levels[r].n_units, spec.levels[r].nf_max
+        levels.append(lv.replace(
+            Eta=lv.Eta[..., :np_r, :nf_r],
+            Lambda=lv.Lambda[..., :nf_r, :spec.ns, :],
+            Psi=lv.Psi[..., :nf_r, :spec.ns, :],
+            Delta=lv.Delta[..., :nf_r, :],
+            alpha_idx=lv.alpha_idx[..., :nf_r],
+            nf_mask=lv.nf_mask[..., :nf_r],
+        ))
+    return state.replace(
+        Z=state.Z[..., :spec.ny, :spec.ns],
+        Beta=state.Beta[..., :spec.nc, :spec.ns],
+        Gamma=state.Gamma[..., :spec.nc, :spec.nt],
+        iV=state.iV[..., :spec.nc, :spec.nc],
+        iSigma=state.iSigma[..., :spec.ns],
+        levels=tuple(levels))
+
+
+# recorded-sample dims per parameter (after the leading chain/sample axes);
+# symbols resolve against the tenant's REAL spec
+_REC_DIMS = {
+    "Beta": ("nc", "ns"), "Gamma": ("nc", "nt"), "V": ("nc", "nc"),
+    "sigma": ("ns",), "rho": (),
+    "Eta": ("np", "nf"), "Lambda": ("nf", "ns", None), "Psi": ("nf", "ns",
+                                                               None),
+    "Delta": ("nf", None), "Alpha": ("nf",), "nfMask": ("nf",),
+}
+
+
+def _slice_record(name: str, arr, spec: ModelSpec):
+    """Slice one recorded array (leading chain/sample axes preserved) down
+    to the tenant's real dims."""
+    head, _, tail = name.rpartition("_")
+    base, r = (head, int(tail)) if tail.isdigit() else (name, None)
+    dims = _REC_DIMS.get(base)
+    if dims is None:
+        return arr
+    sizes = {"nc": spec.nc, "ns": spec.ns, "nt": spec.nt}
+    if r is not None:
+        sizes["np"] = spec.levels[r].n_units
+        sizes["nf"] = spec.levels[r].nf_max
+    lead = arr.ndim - len(dims)
+    sl = tuple([slice(None)] * lead
+               + [slice(None) if d is None else slice(0, sizes[d])
+                  for d in dims])
+    return arr[sl]
+
+
+# ---------------------------------------------------------------------------
+# the masked batched sweep
+# ---------------------------------------------------------------------------
+
+def mask_tenant_state(spec: ModelSpec, ten: TenantMasks,
+                      state: GibbsState) -> GibbsState:
+    """Re-zero every padded carry slot (ones for the precision-like
+    fields, identity pad block for ``iV``).  Applied after every Gibbs
+    block: each updater then sees exactly-inert padding on entry, which is
+    what makes the real-slice draws independent of pad content — and keeps
+    ``record_sample``'s ``inv(iV)`` exactly block-decoupled."""
+    rm, sm, cm, tm = ten.row_mask, ten.sp_mask, ten.cov_mask, ten.tr_mask
+    iV = state.iV * (cm[:, None] * cm[None, :]) + jnp.diag(1.0 - cm)
+    levels = []
+    for r in range(spec.nr):
+        lv = state.levels[r]
+        um = ten.levels[r].unit_mask
+        levels.append(lv.replace(
+            Eta=lv.Eta * um[:, None],
+            Lambda=lv.Lambda * sm[None, :, None],
+            Psi=jnp.where(sm[None, :, None] > 0, lv.Psi,
+                          jnp.ones((), dtype=lv.Psi.dtype)),
+        ))
+    return state.replace(
+        Z=state.Z * rm[:, None] * sm[None, :],
+        Beta=state.Beta * cm[:, None] * sm[None, :],
+        Gamma=state.Gamma * cm[:, None] * tm[None, :],
+        iV=iV,
+        iSigma=jnp.where(sm > 0, state.iSigma,
+                         jnp.ones((), dtype=state.iSigma.dtype)),
+        levels=tuple(levels))
+
+
+def make_batched_sweep(spec: ModelSpec, updater: dict | None = None,
+                       adapt_nf: tuple | None = None, precision=None):
+    """The tenant-masked sweep: the standard schedule's blocks folded with
+    a carry re-mask between blocks.  With ``data.tenant is None`` the fold
+    is LITERALLY :func:`~.sweep.make_sweep`'s (no mask ops trace), so the
+    zero-padding path stays byte-identical to the committed fingerprints;
+    composes with a :class:`~.precision.PrecisionPolicy` exactly like the
+    production sweep (the policy'd blocks trace inside their scopes, the
+    4th ``staged`` argument carries the bf16 shadow table)."""
+    steps = make_sweep_schedule(spec, updater, adapt_nf, None, precision)
+
+    def _fold(data, state, ks):
+        carry = (state, None, None, None)
+        for _name, block in steps:
+            # blocks statically index disjoint rows of the subkey table
+            carry = block(data, carry, ks)  # hmsc: ignore[rng-key-reuse]
+            if data.tenant is not None:
+                carry = (mask_tenant_state(spec, data.tenant, carry[0]),
+                         *carry[1:])
+        return carry[0]
+
+    if precision is None:
+        def sweep(data: ModelData, state: GibbsState, key) -> GibbsState:
+            state, ks = sweep_prologue(state, key)
+            return _fold(data, state, ks)
+        return sweep
+
+    from ..ops import mixed
+
+    def sweep_mp(data: ModelData, state: GibbsState, key,
+                 staged=None) -> GibbsState:
+        state, ks = sweep_prologue(state, key)
+        with mixed.staged_scope(staged):
+            return _fold(data, state, ks)
+    return sweep_mp
+
+
+@functools.lru_cache(maxsize=32)
+def _batched_runner(spec, updater_items, adapt_nf, samples, transient, thin,
+                    skip_init_z, record=None, nngp_dense_max=None,
+                    precision=None):
+    """One jitted (model, chain)-vmapped segment program per static config.
+
+    Mirrors :func:`~.sampler._compiled_runner`'s chain body exactly (same
+    init-Z pass, same scan nesting, same donation) with TWO differences:
+    the data pytree is vmapped over a leading model axis, and the sweep is
+    the tenant-masked fold.  At zero padding (no ``tenant`` masks) the
+    per-lane math is the production run-chain's — the bit-identity tests
+    pin lane equality on CPU."""
+    from .sampler import _keep_record
+    updater = dict(updater_items) if updater_items else None
+    sweep = make_batched_sweep(spec, updater, adapt_nf, precision)
+
+    def first_bad_update(state, bad_it):
+        ok = jnp.bool_(True)
+        for leaf in jax.tree.leaves(state):
+            if jnp.issubdtype(leaf.dtype, jnp.floating):
+                ok = ok & jnp.all(jnp.isfinite(leaf))
+        return jnp.where((bad_it < 0) & ~ok, state.it, bad_it)
+
+    def run_chain(data, state, key, bad_it, staged=None):
+        if not skip_init_z:
+            key, k0 = jax.random.split(key)
+            spec0, data0 = effective_spec_data(spec, data, state)
+            state = U.update_z(spec0, data0, state, k0)
+            if data.tenant is not None:
+                state = mask_tenant_state(spec, data.tenant, state)
+        bad_it = first_bad_update(state, bad_it)
+
+        def one_iter(carry, _):
+            state, key, bad_it = carry
+            key, sub = jax.random.split(key)
+            if precision is None:
+                state = sweep(data, state, sub)
+            else:
+                # single consumption — only one branch traces (static on
+                # `precision`)   # hmsc: ignore[rng-key-reuse]
+                state = sweep(data, state, sub, staged)
+            bad_it = first_bad_update(state, bad_it)
+            return (state, key, bad_it), None
+
+        carry = (state, key, bad_it)
+        if transient > 0:
+            carry, _ = jax.lax.scan(one_iter, carry, None, length=transient)
+
+        def sample_step(carry, _):
+            carry, _ = jax.lax.scan(one_iter, carry, None, length=thin)
+            rec = record_sample(spec, data, carry[0])
+            if record is not None:
+                rec = {k: v for k, v in rec.items()
+                       if _keep_record(k, record)}
+            return carry, rec
+
+        carry, recs = jax.lax.scan(sample_step, carry, None, length=samples)
+        return recs, carry[0], carry[2], carry[1]
+
+    if precision is None:
+        inner = jax.vmap(run_chain, in_axes=(None, 0, 0, 0))
+        mapped = jax.vmap(inner, in_axes=(0, 0, 0, 0))
+    else:
+        inner = jax.vmap(run_chain, in_axes=(None, 0, 0, 0, None))
+        mapped = jax.vmap(inner, in_axes=(0, 0, 0, 0, 0))
+    return jax.jit(mapped, donate_argnums=(1, 2, 3))
+
+
+# ---------------------------------------------------------------------------
+# the batched driver
+# ---------------------------------------------------------------------------
+
+def _stack(trees):
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+
+
+@dataclasses.dataclass
+class _Tenant:
+    """One model's per-run bookkeeping inside a bucket."""
+    index: int                    # position in the caller's model list
+    name: str
+    hM: object
+    spec: ModelSpec               # REAL spec
+    data: ModelData               # REAL data
+    seed: int | None
+    base_post: object = None      # resumed base segment
+    base_samples: int = 0
+    shards: list | None = None
+    init_state: object = None     # REAL-shape carry (chains, ...)
+    init_keys: object = None
+    done: bool = False            # already complete at resume time
+    post: object = None
+    writer: object = None         # CheckpointWriter
+    records: list = dataclasses.field(default_factory=list)
+    retry_info: dict | None = None
+
+
+def _occupancy(tenants, dims) -> dict:
+    cell_pad = float(dims["ny"] * dims["ns"]) * max(1, len(tenants))
+    cell_real = float(sum(t.spec.ny * t.spec.ns for t in tenants))
+    return {"cells_real": int(cell_real), "cells_padded": int(cell_pad),
+            "occupancy": round(cell_real / cell_pad, 4),
+            "padding_waste": round(1.0 - cell_real / cell_pad, 4)}
+
+
+def sample_mcmc_batched(models, samples: int, transient: int = 0,
+                        thin: int = 1, n_chains: int = 1,
+                        seeds=None, seed: int | None = None, names=None,
+                        updater: dict | None = None,
+                        nf_cap: int = DEFAULT_NF_CAP, adapt_nf=None,
+                        record=None, record_dtype=None,
+                        align_post: bool = True, rng_impl: str | None = None,
+                        precision_policy=None, retry_diverged: int = 0,
+                        verbose: int = 0, checkpoint_every: int = 0,
+                        checkpoint_path: str | None = None,
+                        checkpoint_keep: int = 3,
+                        bucket_rounding: dict | None = None,
+                        resume: bool = False, pipeline: bool = True,
+                        progress_callback=None,
+                        return_report: bool = False):
+    """Fit K models as vmapped pad-and-mask batches — one jitted segment
+    runner per shape bucket instead of K serial ``sample_mcmc`` runs.
+
+    ``models`` is a sequence of :class:`~hmsc_tpu.model.Hmsc`; every model
+    runs the same cadence (``samples``/``transient``/``thin``/
+    ``n_chains``).  Per-model seeds come from ``seeds`` (a sequence) or
+    are derived from the base ``seed``.  Returns the per-model
+    :class:`~hmsc_tpu.post.Posterior` list in input order (with
+    ``return_report=True``, a ``(posteriors, report)`` tuple — the report
+    carries per-bucket occupancy / padding-waste metrics).
+
+    Checkpointing (``checkpoint_every`` + ``checkpoint_path``) fans out to
+    per-tenant manifests: each model snapshots into
+    ``<checkpoint_path>/tenant-<name>/`` as an ordinary append-layout
+    single-model checkpoint (state and draws sliced to the model's REAL
+    shapes).  A killed batched run resumes with ``resume=True``: each
+    tenant continues from its own last committed manifest — tenants
+    interrupted at different marks regroup into same-progress sub-batches,
+    so no tenant ever loses a committed draw.  ``retry_diverged`` restarts
+    only a diverged tenant's chains (warm, from that tenant's last healthy
+    manifest when one exists) and repairs that tenant's manifest; healthy
+    tenants' committed shard files are byte-untouched.
+
+    Contracts: zero-padding buckets are bit-identical per tenant to the
+    unbatched ``sample_mcmc`` with the same seed; padded buckets agree
+    within :data:`TENANT_PAD_AGREEMENT_TOL` (see module docstring).
+    """
+    import time
+
+    from ..obs import get_logger
+    from ..post.posterior import Posterior
+
+    t0 = time.perf_counter()
+    models = list(models)
+    K = len(models)
+    if K == 0:
+        return ([], {"buckets": []}) if return_report else []
+    if names is None:
+        names = [f"m{i:03d}" for i in range(K)]
+    names = [str(n) for n in names]
+    if len(set(names)) != K:
+        raise ValueError("tenant names must be unique")
+    if seeds is None:
+        if seed is None:
+            seeds = [None] * K
+        else:
+            srng = np.random.default_rng(seed)
+            seeds = [int(s) for s in srng.integers(0, 2**31 - 1, size=K)]
+    seeds = list(seeds)
+    if len(seeds) != K:
+        raise ValueError(f"seeds carries {len(seeds)} entries for {K} "
+                         "models")
+    if adapt_nf is not None:
+        # same guard as sample_mcmc: adaptation past the burn-in would
+        # mix latent dimensionalities inside the recorded window
+        if any(int(a) > int(transient)
+               for a in np.atleast_1d(np.asarray(adapt_nf)).ravel()):
+            raise ValueError("transient parameter should be no less than "
+                             "any element of adaptNf parameter")
+    ck_every = int(checkpoint_every or 0)
+    if ck_every and checkpoint_path is None:
+        raise ValueError("checkpoint_every requires checkpoint_path")
+    if checkpoint_path is not None and ck_every == 0:
+        ck_every = int(samples)
+    # retry_diverged without checkpointing is allowed: the per-tenant warm
+    # restart needs manifests, but a cold per-tenant retry works without
+    # them — parity with sample_mcmc's checkpoint-free cold retry
+    log = get_logger()
+
+    if rng_impl is None:
+        plat = jax.default_backend()
+        rng_impl = "rbg" if ("tpu" in plat or "axon" in plat) \
+            else "threefry2x32"
+
+    adapt_nf_arg = adapt_nf
+
+    # -- per-model build + bucketing ---------------------------------------
+    from .sampler import normalize_record
+    tenants: list[_Tenant] = []
+    for i, hM in enumerate(models):
+        spec = build_spec(hM, nf_cap)
+        reason = batch_unsupported_reason(spec, updater)
+        if reason is not None:
+            raise NotImplementedError(
+                f"model {names[i]!r} cannot join a padded batch: {reason} "
+                "— fit it with sample_mcmc instead")
+        # same validation + tuple-normalisation as sample_mcmc (the runner
+        # is lru_cache'd on it, and Eta needs its Lambda sign reference);
+        # batch-eligible models share structure, so every tenant resolves
+        # the same tuple
+        record = normalize_record(spec, record)
+        dp = compute_data_parameters(hM)
+        data = build_model_data(hM, dp, spec)
+        tenants.append(_Tenant(index=i, name=names[i], hM=hM, spec=spec,
+                               data=data, seed=seeds[i]))
+
+    buckets: dict[str, list[_Tenant]] = {}
+    for t in tenants:
+        buckets.setdefault(
+            bucket_key(t.spec, t.data, bucket_rounding), []).append(t)
+
+    # -- resume: per-tenant manifest recovery ------------------------------
+    if resume:
+        if checkpoint_path is None:
+            raise ValueError("resume=True requires checkpoint_path (the "
+                             "batched run's tenant-manifest root)")
+        from ..utils.checkpoint import (CheckpointError, checkpoint_files,
+                                        latest_valid_checkpoint)
+        for t in tenants:
+            d = tenant_dir(checkpoint_path, t.name)
+            if not checkpoint_files(d):
+                continue              # fresh tenant
+            try:
+                ck = latest_valid_checkpoint(d, t.hM)
+            except CheckpointError:
+                continue              # unusable: restart this tenant fresh
+            meta = dict(ck.run_meta or {})
+            # stream-defining parameters always come from the original run
+            # (the resume_run invariant): a continuation under different
+            # values would splice a DIFFERENT Gibbs schedule / draw stream
+            # onto the committed base — refuse up front instead of letting
+            # concat_posteriors (or nothing at all) catch it after the
+            # continuation's compute is spent
+            given = {"transient": int(transient), "thin": int(thin),
+                     "n_chains": int(n_chains), "nf_cap": int(nf_cap),
+                     "rng_impl": rng_impl,
+                     "updater": dict(updater) if updater else None,
+                     "seed": None if t.seed is None else int(t.seed),
+                     "record": (list(record) if record is not None
+                                else None)}
+            for kf, gv in given.items():
+                if kf in meta and meta[kf] != gv:
+                    raise CheckpointError(
+                        f"tenant {t.name!r}: resume with a different "
+                        f"{kf} ({gv!r}) than the checkpointed run's "
+                        f"({meta[kf]!r}) — stream-defining parameters "
+                        "are not overridable on a batched resume")
+            done = int(meta.get("samples_done", ck.post.samples or 0))
+            if done >= int(samples):
+                t.done = True
+                t.post = ck.post
+                continue
+            t.base_post = ck.post if ck.post.arrays else None
+            t.base_samples = done
+            t.shards = (list(ck.header.get("shards", []))
+                        if ck.path.endswith(".json") else None)
+            t.init_state = ck.state
+            t.init_keys = ck.keys
+
+    posts: list = [None] * K
+    report = {"buckets": [], "n_models": K,
+              "cadence": {"samples": int(samples),
+                          "transient": int(transient), "thin": int(thin),
+                          "n_chains": int(n_chains)}}
+
+    for bkey, group in sorted(buckets.items()):
+        # same-progress sub-batches: a kill mid-fan-out can leave adjacent
+        # tenants one committed mark apart — each sub-batch runs uniform
+        # remaining segments, so nothing committed is ever re-recorded
+        subgroups: dict[int, list[_Tenant]] = {}
+        for t in group:
+            if t.done:
+                posts[t.index] = _finish_tenant(t, align_post)
+                continue
+            subgroups.setdefault(int(t.base_samples), []).append(t)
+        for done0, sub in sorted(subgroups.items()):
+            binfo = _run_bucket(
+                bkey, sub, samples=int(samples) - done0,
+                transient=int(transient) if done0 == 0 else 0,
+                thin=int(thin),
+                n_chains=int(n_chains), updater=updater, nf_cap=int(nf_cap),
+                adapt_nf=adapt_nf_arg, record=record,
+                record_dtype=record_dtype,
+                rng_impl=rng_impl, precision_policy=precision_policy,
+                retry_diverged=int(retry_diverged), verbose=int(verbose),
+                ck_every=ck_every, checkpoint_path=checkpoint_path,
+                checkpoint_keep=int(checkpoint_keep),
+                bucket_rounding=bucket_rounding, pipeline=bool(pipeline),
+                progress_callback=progress_callback,
+                total_samples=int(samples),
+                transient_total=int(transient), log=log)
+            report["buckets"].append(binfo)
+            for t in sub:
+                posts[t.index] = _finish_tenant(t, align_post)
+
+    report["wall_s"] = round(time.perf_counter() - t0, 4)
+    if report["buckets"]:
+        tot_real = sum(b["cells_real"] for b in report["buckets"])
+        tot_pad = sum(b["cells_padded"] for b in report["buckets"])
+        report["occupancy"] = round(tot_real / max(tot_pad, 1), 4)
+        report["padding_waste"] = round(1.0 - tot_real / max(tot_pad, 1), 4)
+    if return_report:
+        return posts, report
+    return posts
+
+
+def _finish_tenant(t: _Tenant, align_post: bool):
+    post = t.post
+    if t.base_post is not None and post is not None \
+            and post is not t.base_post:
+        from ..utils.checkpoint import concat_posteriors
+        post = concat_posteriors(t.base_post, post, align=False)
+        if t.retry_info is not None:
+            post.retry_info = t.retry_info
+    if align_post and post is not None and t.spec.nr > 0:
+        from ..post.align import align_posterior
+        for _ in range(5):
+            if align_posterior(post) == 0:
+                break
+    return post
+
+
+def _chain_keys(seed, n_chains: int, rng_impl: str, it0: int = 0):
+    """The tenant's per-chain key table, derived EXACTLY like
+    ``sample_mcmc``'s (same seed ⇒ same stream — the zero-padding
+    bit-identity contract hangs on this)."""
+    if it0 > 0:
+        rng = np.random.default_rng([0 if seed is None else int(seed), it0])
+    else:
+        rng = np.random.default_rng(seed)
+    chain_seeds = rng.integers(0, 2**31 - 1, size=int(n_chains))
+    return jax.vmap(lambda s: jax.random.key(s, impl=rng_impl))(
+        jnp.asarray(chain_seeds))
+
+
+def _run_bucket(bkey, tenants, *, samples, transient, thin, n_chains,
+                updater, nf_cap, adapt_nf, record, record_dtype, rng_impl,
+                precision_policy, retry_diverged, verbose, ck_every,
+                checkpoint_path, checkpoint_keep, bucket_rounding, pipeline,
+                progress_callback, total_samples, transient_total,
+                log) -> dict:
+    """Run one shape bucket's tenants as a single vmapped segment loop."""
+    import time
+
+    from ..post.posterior import Posterior
+    from .precision import resolve_policy, stage_data
+    from .sampler import (_InlineWriter, _SegmentWriter, _pack_records,
+                          _unpack_records)
+
+    t0 = time.perf_counter()
+    K = len(tenants)
+    dims0 = bucket_dims(tenants[0].spec, bucket_rounding)
+    # zero padding: every tenant already AT the bucket dims with identical
+    # static specs — masks are omitted entirely and the traced per-lane
+    # program is the production sweep's (bit-identity contract)
+    zero_pad = (all(not _is_padded(t.spec, dims0) for t in tenants)
+                and all(t.spec == tenants[0].spec for t in tenants))
+    if zero_pad:
+        spec_b = tenants[0].spec
+        datas = [t.data for t in tenants]
+    else:
+        spec_b = pad_spec(tenants[0].spec, dims0, has_na=True)
+        datas = [pad_tenant(t.spec, t.data, dims0) for t in tenants]
+        waste = _occupancy(tenants, dims0)["padding_waste"]
+        if waste > 0.5:
+            log.warn_once(
+                f"pad-waste:{bkey}",
+                f"shape bucket {bkey}: padding waste {waste:.0%} of batched "
+                f"cells ({K} tenants padded to ny={dims0['ny']}, "
+                f"ns={dims0['ns']}) — tighten bucket_rounding or regroup "
+                "models to reclaim throughput")
+    data_b = _stack(datas)
+
+    # per-tenant initial carries + key streams
+    states, keys, skip_z = [], [], False
+    for t in tenants:
+        if t.init_state is not None:
+            st = t.init_state          # (chains, ...) REAL shapes
+            lead = int(jax.tree.leaves(st)[0].shape[0])
+            if lead != n_chains:
+                raise ValueError(
+                    f"tenant {t.name!r}: resumed carry has {lead} chains, "
+                    f"expected {n_chains}")
+            it0 = int(np.asarray(st.it).ravel()[0])
+            if not zero_pad:
+                st = pad_state(t.spec, st, dims0, lead=1)
+            else:
+                st = jax.tree.map(
+                    lambda x: jnp.copy(x) if isinstance(x, jax.Array)
+                    else x, st)
+            if t.init_keys is not None:
+                kt = jnp.copy(t.init_keys)
+            else:
+                kt = _chain_keys(t.seed, n_chains, rng_impl, it0=it0)
+            skip_z = True
+        else:
+            chain_states = []
+            rng = np.random.default_rng(t.seed)
+            chain_seeds = rng.integers(0, 2**31 - 1, size=n_chains)
+            for s in chain_seeds:
+                st1 = build_state(t.hM, t.spec, int(s))
+                if not zero_pad:
+                    st1 = pad_state(t.spec, st1, dims0)
+                chain_states.append(st1)
+            st = _stack(chain_states)
+            kt = _chain_keys(t.seed, n_chains, rng_impl)
+        states.append(st)
+        keys.append(kt)
+    if skip_z and any(t.init_state is None for t in tenants):
+        raise ValueError("a sub-batch mixes resumed and fresh tenants — "
+                         "the driver groups by progress before calling "
+                         "_run_bucket")
+    state_b = _stack(states)
+    state_b = jax.tree.map(
+        lambda x: jnp.asarray(x, dtype=x.dtype) if hasattr(x, "dtype")
+        else x, state_b)
+    keys_b = jnp.stack(keys)
+    bad_b = jnp.full((K, n_chains), -1, dtype=jnp.int32)
+
+    if adapt_nf is None:
+        adapt_nf_res = tuple(transient for _ in range(spec_b.nr))
+    else:
+        adapt_nf_res = tuple(int(a) for a in
+                             np.broadcast_to(adapt_nf, (spec_b.nr,)))
+    updater_items = tuple(sorted(updater.items())) if updater else None
+
+    policy = resolve_policy(precision_policy, spec_b)
+    staged_tbl = None
+    if policy is not None:
+        staged_tbl = jax.vmap(lambda d: stage_data(d, policy))(data_b)
+
+    # segment plan: sampling-mark cuts only (burn-in stays fused into the
+    # first segment; per-tenant manifests begin at the first recorded mark)
+    marks = {int(samples)}
+    if verbose:
+        chunk = max(1, int(round(verbose / thin)))
+        marks.update(range(chunk, int(samples), chunk))
+    if ck_every:
+        marks.update(range(ck_every, int(samples), ck_every))
+    cuts = sorted(marks)
+    seg_sizes = [b - a for a, b in zip([0] + cuts[:-1], cuts)]
+    ck_marks = ({m for m in cuts if m % ck_every == 0} | {int(samples)}
+                if ck_every else set())
+
+    # per-tenant checkpoint writers (ordinary append-layout, real shapes)
+    for t in tenants:
+        t.records = []
+    if ck_every:
+        from ..utils.checkpoint import CheckpointWriter
+        for t in tenants:
+            d = tenant_dir(checkpoint_path, t.name)
+            os.makedirs(d, exist_ok=True)
+            t.writer = CheckpointWriter(
+                d, "append", t.spec, hM=t.hM, records=t.records,
+                base_post=t.base_post, base_samples=t.base_samples,
+                shards=t.shards, keep=int(checkpoint_keep),
+                keys_impl=rng_impl)
+
+    writer = _SegmentWriter(2) if pipeline else _InlineWriter()
+    host_segs: list = []              # fetched (K, C, S, ...) record trees
+
+    def _collect(packed):
+        """Writer-thread item: force the fetch and, when checkpointing,
+        append each tenant's real-sliced record view (the per-tenant
+        CheckpointWriter flush cursors read these lists)."""
+        tree = _unpack_records(*packed)
+        host_segs.append(tree)
+        if ck_every:
+            for k, t in enumerate(tenants):
+                t.records.append(
+                    {name: _slice_record(name, np.asarray(arr[k]), t.spec)
+                     for name, arr in tree.items()})
+
+    def _tenant_meta(t: _Tenant, done_now: int) -> dict:
+        return {
+            "samples_total": int(total_samples),
+            "samples_done": t.base_samples + int(done_now),
+            "transient": int(transient_total),
+            "thin": int(thin), "n_chains": int(n_chains),
+            "seed": None if t.seed is None else int(t.seed),
+            "nf_cap": int(nf_cap), "rng_impl": rng_impl,
+            "adapt_nf": [int(a) for a in adapt_nf_res[:t.spec.nr]],
+            "dtype": "float32",
+            "record": list(record) if record is not None else None,
+            "record_dtype": (None if record_dtype is None
+                             else np.dtype(record_dtype).name),
+            "updater": dict(updater) if updater else None,
+            "retry_diverged": int(retry_diverged),
+            "align_post": False,
+            "checkpoint_every": ck_every,
+            "checkpoint_keep": int(checkpoint_keep),
+            "checkpoint_max_age_s": None,
+            "checkpoint_archive_every": 0,
+            "checkpoint_max_bytes": None,
+            "checkpoint_layout": "append",
+            "process_count": 1,
+            "precision_policy": (policy.to_meta() if policy is not None
+                                 else None),
+            "local_rng": False, "species_shards": None,
+            # multitenant provenance (informational — the manifest is an
+            # ordinary single-model checkpoint either way)
+            "batched": {"bucket": bkey, "tenant": t.name,
+                        "zero_padding": bool(zero_pad)},
+        }
+
+    def _fanout_snapshots(state_snap, key_data, bad_snap, done_now):
+        """Writer-thread item (FIFO after this segment's fetch): commit one
+        ordinary single-model snapshot per tenant, carry sliced to the
+        tenant's real shapes."""
+        for k, t in enumerate(tenants):
+            if t.writer is None:
+                continue
+            st_k = jax.tree.map(
+                lambda x: x[k] if isinstance(x, jax.Array) else x,
+                state_snap)
+            if not zero_pad:
+                st_k = slice_tenant_state(t.spec, st_k)
+            t.writer.snapshot(int(done_now), st_k, key_data[k],
+                              np.asarray(bad_snap[k]),
+                              _tenant_meta(t, done_now))
+
+    done = 0
+    try:
+        for si, seg in enumerate(seg_sizes):
+            trans_seg = int(transient) if si == 0 else 0
+            fn = _batched_runner(spec_b, updater_items, adapt_nf_res,
+                                 int(seg), trans_seg, int(thin),
+                                 skip_z, record, spatial._NNGP_DENSE_MAX,
+                                 policy)
+            args = (data_b, state_b, keys_b, bad_b)
+            if policy is not None:
+                args = args + (staged_tbl,)
+            recs, state_b, bad_b, keys_b = fn(*args)
+            skip_z = True
+            done += int(seg)
+            writer.submit(functools.partial(
+                _collect, _pack_records(recs, record_dtype)))
+            del recs
+            if done in ck_marks:
+                # snapshot fan-out: copies dispatched BEFORE the next
+                # segment donates the carry buffers
+                st_snap = jax.tree.map(
+                    lambda x: jnp.copy(x) if isinstance(x, jax.Array)
+                    else x, state_b)
+                kd_snap = jnp.array(jax.random.key_data(keys_b))
+                bad_snap = jnp.copy(bad_b)
+                writer.submit(functools.partial(
+                    _fanout_snapshots, st_snap, kd_snap, bad_snap, done))
+            if verbose:
+                log.info(f"bucket {bkey}: segment {si + 1}/"
+                         f"{len(seg_sizes)} ({done}/{samples} samples, "
+                         f"{K} tenants)")
+            if progress_callback is not None:
+                progress_callback(done, int(samples))
+        writer.barrier()
+    finally:
+        writer.shutdown()
+
+    # merge fetched segments (sample axis = 2 after the model/chain axes)
+    recs_all = (jax.tree.map(lambda *xs: np.concatenate(xs, axis=2),
+                             *host_segs)
+                if len(host_segs) > 1 else host_segs[0])
+
+    first_bad = np.asarray(bad_b)
+    key_data_final = np.asarray(jax.random.key_data(keys_b))
+    wall = time.perf_counter() - t0
+
+    # per-tenant posterior assembly + divergence containment + retry
+    for k, t in enumerate(tenants):
+        rec_t = {name: _slice_record(name, np.asarray(arr[k]), t.spec)
+                 for name, arr in recs_all.items()}
+        post = Posterior(t.hM, t.spec, rec_t, samples=samples,
+                         transient=int(transient), thin=thin)
+        fb = first_bad[k].copy()
+        post.set_chain_health(fb)
+        for c in np.nonzero(fb >= 0)[0]:
+            log.warn(f"tenant {t.name!r}: chain {int(c)} diverged "
+                     f"(non-finite state first seen at sweep "
+                     f"{int(fb[c])}); its draws are excluded from pooled "
+                     "summaries")
+        t.post = post
+        if retry_diverged > 0 and (fb >= 0).any():
+            st_k = jax.tree.map(
+                lambda x: x[k] if isinstance(x, jax.Array) else x, state_b)
+            if not zero_pad:
+                st_k = slice_tenant_state(t.spec, st_k)
+            _retry_tenant(
+                t, fb, samples=samples, transient=transient, thin=thin,
+                updater=updater, nf_cap=nf_cap, adapt_nf=adapt_nf,
+                record=record, record_dtype=record_dtype,
+                rng_impl=rng_impl, precision_policy=precision_policy,
+                retry_diverged=retry_diverged,
+                checkpoint_path=checkpoint_path, ck_every=ck_every,
+                final_state=st_k, final_keys=key_data_final[k],
+                meta_fn=_tenant_meta, n_chains=n_chains,
+                transient_total=transient_total)
+
+    binfo = dict(_occupancy(tenants, dims0),
+                 key=bkey, n_tenants=K, zero_padding=bool(zero_pad),
+                 dims={k: v for k, v in dims0.items()},
+                 tenants=[t.name for t in tenants],
+                 wall_s=round(wall, 4),
+                 diverged={t.name: [int(c) for c in
+                                    np.nonzero(first_bad[k] >= 0)[0]]
+                           for k, t in enumerate(tenants)
+                           if (first_bad[k] >= 0).any()})
+    return binfo
+
+
+def _retry_tenant(t: _Tenant, first_bad, *, samples, transient, thin,
+                  updater, nf_cap, adapt_nf, record, record_dtype, rng_impl,
+                  precision_policy, retry_diverged, checkpoint_path,
+                  ck_every, final_state, final_keys, meta_fn, n_chains,
+                  transient_total=None):
+    """Per-tenant divergence splice (mirrors ``sample_mcmc``'s
+    single-process retry): restart ONLY this tenant's diverged chains —
+    warm from its own last healthy manifest when one exists — and repair
+    that tenant's manifest sequence.  Other tenants' posteriors, manifests
+    and shard files are untouched by construction (everything here runs on
+    sliced per-tenant data)."""
+    from .sampler import _find_warm_restart, sample_mcmc
+
+    bad = np.nonzero(first_bad >= 0)[0]
+    rng = np.random.default_rng(
+        None if t.seed is None else [int(t.seed), 777])
+    warm = None
+    if ck_every and checkpoint_path is not None:
+        d = tenant_dir(checkpoint_path, t.name)
+        warm = _find_warm_restart(d, t.hM, bad, t.base_samples, samples)
+    want_state = bool(ck_every)
+    # burn-in accounting for a RESUMED tenant: this sub-run's transient is
+    # 0 (the continuation), but its manifests and a cold restart both
+    # reason in the tenant's OWN absolute iterations — the original run's
+    # full transient plus the committed draws (mirrors sample_mcmc's
+    # `transient + it0` cold restart)
+    trans_full = int(transient if transient_total is None
+                     else transient_total)
+    it0 = int(t.base_samples) * int(thin)
+    adapt_res = (adapt_nf if adapt_nf is not None else trans_full)
+    common = dict(
+        thin=thin, n_chains=len(bad),
+        seed=int(rng.integers(2**31 - 1)), updater=updater, nf_cap=nf_cap,
+        align_post=False, rng_impl=rng_impl, record=record,
+        record_dtype=record_dtype, retry_diverged=retry_diverged - 1,
+        precision_policy=precision_policy, return_state=want_state)
+    if warm is not None:
+        warm_state, warm_s0, warm_t_done = warm
+        sub_init = jax.tree.map(
+            lambda x: jnp.asarray(np.asarray(x)[bad]), warm_state)
+        rem_t = (max(0, trans_full - int(warm_t_done))
+                 if warm_s0 == 0 and warm_t_done else 0)
+        out = sample_mcmc(
+            t.hM, samples=samples - warm_s0, transient=rem_t,
+            adapt_nf=[int(a) for a in
+                      np.broadcast_to(adapt_res, (t.spec.nr,))],
+            init_state=sub_init, **common)
+        splice_from = int(warm_s0)
+    else:
+        # cold restart: no healthy snapshot — burn-in covers the tenant's
+        # full prior progress so freshly initialised chains never splice
+        # unburned draws into a resumed continuation
+        out = sample_mcmc(t.hM, samples=samples,
+                          transient=trans_full + it0,
+                          adapt_nf=adapt_nf, **common)
+        splice_from = 0
+    sub_state = None
+    if want_state:
+        out, sub_state = out
+    sub = out
+    post = t.post
+    for kname in post.arrays:
+        a = post.arrays[kname]
+        if not a.flags.writeable:
+            a = a.copy()
+        a[bad, splice_from:] = sub.arrays[kname]
+        post.arrays[kname] = a
+    fb = first_bad.copy()
+    fb[bad] = sub.chain_health["first_bad_it"]
+    post.set_chain_health(fb)
+    t.retry_info = post.retry_info = {
+        "retried_chains": tuple(int(c) for c in bad),
+        "healthy_after_retry": tuple(
+            bool(b < 0) for b in
+            np.asarray(sub.chain_health["first_bad_it"])),
+        "warm_start_samples": splice_from if warm is not None else None,
+    }
+    if ck_every and t.writer is not None and sub_state is not None:
+        def _splice(a, b):
+            a = np.asarray(a).copy()
+            a[bad] = np.asarray(b)
+            return jnp.asarray(a)
+        final = jax.tree.map(_splice, final_state, sub_state)
+        meta = dict(meta_fn(t, int(samples)), retry_info=t.retry_info)
+        t.writer.rewrite_spliced(splice_from, int(samples), final,
+                                 jnp.asarray(final_keys), fb, post, meta)

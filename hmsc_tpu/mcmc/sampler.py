@@ -271,6 +271,67 @@ def _keep_record(name: str, record) -> bool:
     return name in record or base in record
 
 
+def normalize_record(spec, record):
+    """Validate a ``record=`` restriction against ``spec`` and return it as
+    a sorted hashable tuple (``None`` passes through).  Shared by
+    ``sample_mcmc`` and the batched multitenant path — both feed the result
+    into an ``lru_cache``'d runner, so the tuple form is load-bearing, and
+    both owe the user the same rejection of names the model never emits."""
+    if record is None:
+        return None
+    if isinstance(record, str):
+        record = (record,)
+    level_pars = {"Eta", "Lambda", "Psi", "Delta", "Alpha"}
+    # names the model structure never emits: accepting them would pass
+    # validation yet record nothing, and the user's later post[...] lookup
+    # would blame the record= restriction instead of the model itself
+    absent = set()
+    if not spec.has_phylo:
+        absent.add("rho")
+    if spec.nc_rrr == 0:
+        absent.update({"wRRR", "PsiRRR", "DeltaRRR"})
+    if spec.nr == 0:
+        absent.update(level_pars)
+    bad, structural = [], []
+    for k in record:
+        head, _, tail = k.rpartition("_")
+        if tail.isdigit():
+            # suffixed names: only per-level parameters carry a level
+            # index, and it must name an existing level — anything else
+            # would pass validation yet silently record nothing
+            if head not in level_pars or int(tail) >= spec.nr:
+                bad.append(k)
+        elif k in absent:
+            structural.append(k)
+        elif k not in _RECORDABLE:
+            bad.append(k)
+    if structural:
+        raise ValueError(
+            f"record: parameter(s) {structural} do not exist on this "
+            "model ('rho' needs a phylogeny (C=/phylo_tree=); "
+            "'wRRR'/'PsiRRR'/'DeltaRRR' need XRRRData; per-level "
+            "parameters need at least one random level) — the run "
+            "would silently record nothing for them")
+    if bad:
+        raise ValueError(
+            f"record: unknown parameter name(s) {bad}; valid names are "
+            f"{sorted(_RECORDABLE)} (per-level parameters "
+            f"{sorted(level_pars)} also accept a _<level> suffix "
+            f"below nr={spec.nr})")
+    rec_set = set(record)
+    # sign-alignment coupling: Eta flips with Lambda's sign, and Beta's
+    # RRR rows flip with wRRR's — recording one without its sign
+    # reference would leave it silently sign-mixed across chains, so the
+    # reference array is force-included (both are small blocks)
+    for k in list(rec_set):
+        head, _, tail = k.rpartition("_")
+        if k == "Eta" or (tail.isdigit() and head == "Eta"):
+            rec_set.add("Lambda" if k == "Eta" else f"Lambda_{tail}")
+    if spec.nc_rrr > 0:
+        rec_set.add("wRRR")
+    return tuple(sorted(rec_set))
+
+
 @functools.lru_cache(maxsize=64)
 def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin,
                      skip_init_z, record=None, nngp_dense_max=None,
@@ -944,58 +1005,7 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         raise ValueError("transient parameter should be no less than any element of adaptNf parameter")
 
     spec = build_spec(hM, nf_cap)
-    if record is not None:
-        if isinstance(record, str):
-            record = (record,)
-        level_pars = {"Eta", "Lambda", "Psi", "Delta", "Alpha"}
-        # names the model structure never emits: accepting them would pass
-        # validation yet record nothing, and the user's later post[...] lookup
-        # would blame the record= restriction instead of the model itself
-        absent = set()
-        if not spec.has_phylo:
-            absent.add("rho")
-        if spec.nc_rrr == 0:
-            absent.update({"wRRR", "PsiRRR", "DeltaRRR"})
-        if spec.nr == 0:
-            absent.update(level_pars)
-        bad, structural = [], []
-        for k in record:
-            head, _, tail = k.rpartition("_")
-            if tail.isdigit():
-                # suffixed names: only per-level parameters carry a level
-                # index, and it must name an existing level — anything else
-                # would pass validation yet silently record nothing
-                if head not in level_pars or int(tail) >= spec.nr:
-                    bad.append(k)
-            elif k in absent:
-                structural.append(k)
-            elif k not in _RECORDABLE:
-                bad.append(k)
-        if structural:
-            raise ValueError(
-                f"record: parameter(s) {structural} do not exist on this "
-                "model ('rho' needs a phylogeny (C=/phylo_tree=); "
-                "'wRRR'/'PsiRRR'/'DeltaRRR' need XRRRData; per-level "
-                "parameters need at least one random level) — the run "
-                "would silently record nothing for them")
-        if bad:
-            raise ValueError(
-                f"record: unknown parameter name(s) {bad}; valid names are "
-                f"{sorted(_RECORDABLE)} (per-level parameters "
-                f"{sorted(level_pars)} also accept a _<level> suffix "
-                f"below nr={spec.nr})")
-        rec_set = set(record)
-        # sign-alignment coupling: Eta flips with Lambda's sign, and Beta's
-        # RRR rows flip with wRRR's — recording one without its sign
-        # reference would leave it silently sign-mixed across chains, so the
-        # reference array is force-included (both are small blocks)
-        for k in list(rec_set):
-            head, _, tail = k.rpartition("_")
-            if k == "Eta" or (tail.isdigit() and head == "Eta"):
-                rec_set.add("Lambda" if k == "Eta" else f"Lambda_{tail}")
-        if spec.nc_rrr > 0:
-            rec_set.add("wRRR")
-        record = tuple(sorted(rec_set))
+    record = normalize_record(spec, record)
     if data_par is None:
         data_par = compute_data_parameters(hM)
     data = build_model_data(hM, data_par, spec, dtype=dtype)
@@ -1154,10 +1164,15 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 # silently replicate — the whole point was the 1/shards
                 # per-device state
                 raise ValueError(f"shard_sweep=True but {msg}")
-            import warnings
-            warnings.warn(
+            # per-invocation dedup: the fallback used to warn once per
+            # warnings-registry state, segment cadence permitting; one
+            # warning per sample_mcmc call is the signal.  A retry /
+            # continuation SUB-call builds its own logger and warns afresh
+            # — it is a new sampling run of the same program
+            log.warn_once(
+                "shard-divisibility",
                 f"{msg}; species arrays are replicated (chains-only "
-                "parallelism)", RuntimeWarning, stacklevel=2)
+                "parallelism)")
             sp = None
         want_shard = (sp is not None and int(mesh.shape[sp]) > 1
                       and shard_sweep is not False)
@@ -1169,11 +1184,10 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                     raise ValueError(
                         f"shard_sweep=True but the species-sharded sweep "
                         f"does not support this model: {reason}")
-                import warnings
-                warnings.warn(
+                log.warn_once(
+                    "shard-unsupported",
                     f"species-sharded sweep unavailable for this model "
-                    f"({reason}); falling back to GSPMD placement",
-                    RuntimeWarning, stacklevel=2)
+                    f"({reason}); falling back to GSPMD placement")
                 want_shard = False
         sharding = NamedSharding(mesh, P(chain_axis))
         if want_shard:

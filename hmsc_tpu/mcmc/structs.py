@@ -27,8 +27,8 @@ from ..model import FIXED_SIGMA2, Hmsc
 from ..precompute import DataParams, compute_initial_parameters
 
 __all__ = ["LevelSpec", "ModelSpec", "LevelData", "ModelData", "LevelState",
-           "GibbsState", "build_model_data", "build_state", "state_nbytes",
-           "DEFAULT_NF_CAP"]
+           "GibbsState", "LevelTenant", "TenantMasks", "build_model_data",
+           "build_state", "state_nbytes", "DEFAULT_NF_CAP"]
 
 # static cap on latent factors per level (reference grows nf up to ns,
 # updateNf.R:26; static XLA shapes need a concrete bound)
@@ -154,6 +154,46 @@ class ModelData(struct.PyTreeNode):
     # moves for raw-matrix designs whose first column is ones — measured in
     # round 5: every prior interweave A/B had the move gated off.
     x_ones_ind: Any = None       # () int32 or None
+    # pad-and-mask multitenancy (mcmc/multitenant.py): per-model validity
+    # masks + real-count scalars.  None on every single-model path — the
+    # updaters branch on this at trace time, keeping the default programs
+    # byte-identical to the committed fingerprints.
+    tenant: Any = None           # TenantMasks or None
+
+
+class LevelTenant(struct.PyTreeNode):
+    """Per-model per-level validity info for one pad-and-mask tenant
+    (:mod:`.multitenant`).  Scalars are traced f32/int so they can vary
+    per model under the batched runner's model-axis vmap."""
+    unit_mask: Any               # (np,) 1.0 real unit / 0.0 padding
+    n_units: Any                 # () f32 real unit count
+    nf_cap: Any                  # () f32 the model's own factor growth bound
+    nf_min: Any                  # () f32 the model's own factor floor
+    nf_capped: Any               # () f32 1.0 when nf_cap cut the user bound
+
+
+class TenantMasks(struct.PyTreeNode):
+    """Per-model validity masks for the pad-and-mask batched sweep.
+
+    ``ModelData.tenant`` is ``None`` on every single-model path — the
+    updaters test it at TRACE time, so the default traced programs are
+    byte-identical to the pre-multitenant ones (fingerprint-pinned).  When
+    present, each mask flags the REAL slice of a padded dimension and the
+    scalar counts replace the static ``spec`` counts wherever a count
+    enters the math (Wishart degrees of freedom, shrinkage gamma shapes,
+    Nf statistics, interweave Jacobian exponents)."""
+    row_mask: Any                # (ny,) 1.0 real row
+    sp_mask: Any                 # (ns,) 1.0 real species
+    cov_mask: Any                # (nc,) 1.0 real covariate
+    tr_mask: Any                 # (nt,) 1.0 real trait
+    n_rows: Any                  # () f32 real ny — no updater reads it
+    #   (row statistics come from the Ymask-padded data, e.g. sigma's
+    #   per-species n_obs); carried as the per-tenant row-count scalar for
+    #   mask consumers (the fault-injection tests key on it)
+    n_sp: Any                    # () f32 real ns
+    n_cov: Any                   # () f32 real nc
+    df_v: Any                    # () f32 Wishart df f0 + real ns
+    levels: tuple = ()           # tuple[LevelTenant]
 
 
 class LevelState(struct.PyTreeNode):

@@ -451,7 +451,26 @@ def update_gamma_v(spec: ModelSpec, data: ModelData, state: GibbsState,
     Lw = chol_spd(A + data.V0)
     T = solve_triangular(Lw.T,
                          jnp.eye(spec.nc, dtype=A.dtype), lower=False)  # T T' = (A+V0)^{-1}
-    iV = wishart(kv, spec.f0 + ns_g, T)
+    if data.tenant is None:
+        iV = wishart(kv, spec.f0 + ns_g, T)
+    else:
+        # pad-and-mask tenant: the degrees of freedom count REAL species
+        # only, and the drawn precision is re-blocked so padded covariates
+        # stay exactly decoupled (identity pad block) — the real block of
+        # the Bartlett product T A (T A)' reads only real-index normals
+        # (T is block-diagonal, A lower-triangular), so the real-block
+        # Wishart law is untouched by the masking.  A pad index's chi^2
+        # shape (df_v - i)/2 can go non-positive when nc pads far beyond
+        # the real model (df_v counts REAL covariates/species only); the
+        # resulting NaN diag would contaminate the real block through the
+        # TA pad columns (0 * NaN), so pad lanes draw a harmless positive
+        # shape instead — gamma draws are per-element, so the real lanes'
+        # stream is bit-unchanged
+        cm = data.tenant.cov_mask
+        idx = jnp.arange(spec.nc, dtype=T.dtype)
+        df_vec = jnp.where(cm > 0, data.tenant.df_v, idx + 2.0)
+        iV = wishart(kv, df_vec, T)
+        iV = iV * (cm[:, None] * cm[None, :]) + jnp.diag(1.0 - cm)
     return gamma_given_beta(spec, data, state.replace(iV=iV), kg, shard)
 
 
@@ -467,7 +486,11 @@ def update_rho(spec: ModelSpec, data: ModelData, state: GibbsState,
         Et = shard.psum(Et)
     q = mx.einsum("cj,cd,dj->j", Et, state.iV, Et)         # (ns,)
     v = (q[None, :] / mx.staged("Qeig", data.Qeig)).sum(axis=1)  # (G,)
-    loglike = jnp.log(data.rhopw[:, 1]) - 0.5 * spec.nc * data.logdetQ - 0.5 * v
+    # tenant: the Gaussian normalisation counts real covariates (padded
+    # Beta rows are exact zeros with unit pad eigenvalues, so q and the
+    # per-model logdetQ already exclude the padding)
+    nc_g = spec.nc if data.tenant is None else data.tenant.n_cov
+    loglike = jnp.log(data.rhopw[:, 1]) - 0.5 * nc_g * data.logdetQ - 0.5 * v
     idx = jax.random.categorical(key, loglike)
     return state.replace(rho_idx=idx.astype(jnp.int32))
 
@@ -485,6 +508,9 @@ def update_lambda_priors(spec: ModelSpec, data: ModelData, state: GibbsState,
     drawn full-width and sliced; the delta tail sums psum; delta itself
     stays replicated."""
     ns_g = spec.ns if shard is None else shard.ns
+    # tenant: the delta gamma shapes count REAL species (padded Lambda
+    # columns are exact zeros, so the Msum tail already excludes them)
+    ns_stat = ns_g if data.tenant is None else data.tenant.n_sp
     new_levels = []
     for r in range(spec.nr):
         lvd, lv = data.levels[r], state.levels[r]
@@ -520,10 +546,10 @@ def update_lambda_priors(spec: ModelSpec, data: ModelData, state: GibbsState,
         for h in range(ls.nf_max):
             tau = jnp.cumprod(delta, axis=0)
             if h == 0:
-                ad = lvd.a1 + 0.5 * ns_g * nf_act
+                ad = lvd.a1 + 0.5 * ns_stat * nf_act
                 b0 = lvd.b1
             else:
-                ad = lvd.a2 + 0.5 * ns_g * n_geq[h]
+                ad = lvd.a2 + 0.5 * ns_stat * n_geq[h]
                 b0 = lvd.b2
             tail = (tau[h:] * Msum[h:] * mask[h:, None]).sum(axis=0)
             bd = b0 + 0.5 * tail / delta[h]
@@ -632,10 +658,19 @@ def interweave_scale(spec: ModelSpec, data: ModelData, state: GibbsState,
         if shard is not None:             # cross-species prior-mass sum
             B = shard.psum(B)
         ns_g = spec.ns if shard is None else shard.ns
-        k_exp = ls.n_units - ns_g * ls.ncr
-        # float(): a bare np.float64 scalar is strong-typed and would
-        # upcast the whole proposal under an x64 config
-        sigma = float(2.38 / np.sqrt(2.0 * (ls.n_units + ns_g * ls.ncr)))
+        if data.tenant is None:
+            k_exp = ls.n_units - ns_g * ls.ncr
+            # float(): a bare np.float64 scalar is strong-typed and would
+            # upcast the whole proposal under an x64 config
+            sigma = float(2.38 / np.sqrt(2.0 * (ls.n_units + ns_g * ls.ncr)))
+        else:
+            # tenant: the Jacobian exponent and the proposal curvature
+            # count REAL units/species of THIS model (traced per-model
+            # scalars under the batched vmap)
+            nu_r = data.tenant.levels[r].n_units.astype(A.dtype)
+            ns_r = data.tenant.n_sp.astype(A.dtype)
+            k_exp = nu_r - ns_r * ls.ncr
+            sigma = 2.38 * jax.lax.rsqrt(2.0 * (nu_r + ns_r * ls.ncr))
         u = sigma * jax.random.normal(kr1, (ls.nf_max,), dtype=A.dtype)
         c = jnp.exp(u)
         log_acc = (-0.5 * A * (c ** 2 - 1.0)
@@ -709,7 +744,13 @@ def interweave_location(spec: ModelSpec, data: ModelData, state: GibbsState,
         mask = lv.nf_mask
         u = iV[ii] @ (Beta - Mu)                          # (ns,)
         if ls.spatial is None:
-            q1 = jnp.full((ls.nf_max,), float(ls.n_units), dtype=lam.dtype)
+            if data.tenant is None:
+                q1 = jnp.full((ls.nf_max,), float(ls.n_units),
+                              dtype=lam.dtype)
+            else:                         # tenant: 1'1 over REAL units only
+                q1 = jnp.broadcast_to(
+                    data.tenant.levels[r].n_units.astype(lam.dtype),
+                    (ls.nf_max,))
             s = lv.Eta.sum(axis=0)                        # 1' eta_h
         else:
             from .spatial import eta_ones_forms_at
@@ -875,8 +916,16 @@ def update_nf(spec: ModelSpec, data: ModelData, state: GibbsState, r: int,
     mask = lv.nf_mask
     nf = mask.sum()
     eps_thr = 1e-3
-    if shard is None:
+    if shard is None and data.tenant is None:
         small_prop = (jnp.abs(lv.Lambda) < eps_thr).mean(axis=(1, 2))
+    elif shard is None:
+        # tenant: the shrunk-proportion statistic counts REAL species only
+        # (padded Lambda columns are exact zeros — counting them would read
+        # as shrunk and spuriously drop factors)
+        ten = data.tenant
+        cnt = ((jnp.abs(lv.Lambda) < eps_thr)
+               * ten.sp_mask[None, :, None]).sum(axis=(1, 2))
+        small_prop = cnt / (ten.n_sp * ls.ncr)
     else:
         cnt = shard.psum(
             (jnp.abs(lv.Lambda) < eps_thr).sum(axis=(1, 2))
@@ -885,16 +934,27 @@ def update_nf(spec: ModelSpec, data: ModelData, state: GibbsState, r: int,
     redundant = (mask > 0) & (small_prop >= 1.0)
     num_red = redundant.sum()
 
+    # tenant: the growth bound and floor are the MODEL's own (the bucket's
+    # static nf_max only sizes the padded slots)
+    if data.tenant is None:
+        nf_hi, nf_lo = ls.nf_max, ls.nf_min
+    else:
+        nf_hi = data.tenant.levels[r].nf_cap
+        nf_lo = data.tenant.levels[r].nf_min
     grow_wanted = (it > 20) & (num_red == 0) \
         & jnp.all(jnp.where(mask > 0, small_prop < 0.995, True))
-    add_ok = (nf < ls.nf_max) & grow_wanted
-    drop_ok = (num_red > 0) & (nf > ls.nf_min)
+    add_ok = (nf < nf_hi) & grow_wanted
+    drop_ok = (num_red > 0) & (nf > nf_lo)
     # factor-cap observability: count adaptation events where growth was
     # wanted but the static nf_cap blocked it (the sampler warns post-run
     # when nonzero).  Only when the cap — not the user's own
     # min(rL.nf_max, ns) bound, which the reference also honours
     # (updateNf.R:26) — is the binding constraint.
-    if ls.nf_capped:
+    if data.tenant is not None:
+        nf_sat = lv.nf_sat + (
+            (adapt & grow_wanted & (nf >= nf_hi)).astype(jnp.int32)
+            * data.tenant.levels[r].nf_capped.astype(jnp.int32))
+    elif ls.nf_capped:
         nf_sat = lv.nf_sat + (adapt & grow_wanted
                               & (nf >= ls.nf_max)).astype(jnp.int32)
     else:

@@ -24,6 +24,7 @@ class RunLogger:
         self.telemetry = telemetry
         self.proc = int(proc)
         self.n_procs = int(n_procs)
+        self._warned: set = set()
 
     def _write(self, stream, level: str, msg: str) -> None:
         prefix = f"[p{self.proc}] " if self.n_procs > 1 else ""
@@ -36,6 +37,29 @@ class RunLogger:
 
     def warn(self, msg: str) -> None:
         self._write(sys.stderr, "warning", msg)
+
+    def warn_once(self, key: str, msg: str, *, category=RuntimeWarning,
+                  stacklevel: int = 3) -> bool:
+        """Deliver ``msg`` as a real ``warnings.warn`` (so test/filtering
+        machinery keeps working) at most ONCE per run for a given ``key``.
+
+        Repeated structural conditions — the sharded sweep's
+        nearest-valid-divisor fallback, a batched bucket's padding-waste
+        report — are re-detected at every segment/bucket boundary; without
+        per-run dedup they spam one identical warning per boundary.  The
+        dedup scope is this logger: the sampler constructs one logger per
+        ``sample_mcmc`` invocation, so a *new* run — including a retry /
+        continuation sub-call, which is a new sampling run with its own
+        logger — warns afresh.  Returns True when the warning was actually
+        delivered."""
+        if key in self._warned:
+            return False
+        self._warned.add(key)
+        import warnings
+        warnings.warn(msg, category, stacklevel=stacklevel)
+        if self.telemetry is not None:
+            self.telemetry.emit("log", "warning", text=msg, dedup_key=key)
+        return True
 
 
 def get_logger(telemetry=None, proc: int = 0, n_procs: int = 1) -> RunLogger:

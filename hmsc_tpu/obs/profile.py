@@ -293,6 +293,77 @@ def build_precision_ledger(models=None, only=None) -> tuple[dict, dict]:
     return programs, section
 
 
+BATCH_LEDGER_K = 4
+
+
+def build_batch_ledger(models=None, only=None) -> tuple[dict, dict]:
+    """Multi-tenant batched-sweep ledger programs
+    (:mod:`hmsc_tpu.mcmc.multitenant`):
+
+    - ``<model>/batch:sweep@K{k}`` — the tenant-masked padded sweep
+      vmapped over a K-lane model axis at the canonical spec's bucket
+      dims (the per-sweep cost of one batched bucket step);
+    - ``<model>/batch:sweep@pad`` — the single-lane padded masked sweep
+      (the marginal per-tenant cost, for occupancy accounting).
+
+    Returns ``(programs, batch_section)`` where the section commits, per
+    model class, the bucket dims and the padding occupancy/waste of the
+    canonical K-lane bucket — drift-checked by ``profile --check`` like
+    the precision selection."""
+    import jax
+
+    from ..analysis.jaxpr_rules import _build, _canonical_models
+    from ..mcmc.multitenant import (batch_unsupported_reason, bucket_dims,
+                                    make_batched_sweep, pad_spec, pad_state,
+                                    pad_tenant)
+
+    def _k():
+        return jax.random.key(0, impl="threefry2x32")
+
+    factories = _canonical_models()
+    names = tuple(models) if models else tuple(factories)
+    programs: dict[str, dict] = {}
+    section: dict[str, dict] = {}
+    for mname in names:
+        if mname not in factories:
+            continue
+        spec, data, state = _build(factories[mname]())
+        if batch_unsupported_reason(spec) is not None:
+            continue
+        dims = bucket_dims(spec)
+        cand = [f"{mname}/batch:sweep@K{BATCH_LEDGER_K}",
+                f"{mname}/batch:sweep@pad"]
+        if only and not any(_keep(n, only) for n in cand):
+            continue
+        spec_b = pad_spec(spec, dims, has_na=True)
+        data_b = pad_tenant(spec, data, dims)
+        state_b = pad_state(spec, state, dims)
+        sweep_b = make_batched_sweep(spec_b, None,
+                                     tuple(0 for _ in range(spec_b.nr)))
+        if _keep(cand[1], only):
+            programs[cand[1]] = _cost_entry(
+                jax.jit(sweep_b).lower(data_b, state_b, _k()).compile())
+        if _keep(cand[0], only):
+            stack = lambda t: jax.tree.map(
+                lambda x: jax.numpy.stack([x] * BATCH_LEDGER_K), t)
+            keys = jax.vmap(lambda s: jax.random.key(
+                s, impl="threefry2x32"))(jax.numpy.arange(BATCH_LEDGER_K))
+            vsweep = jax.vmap(sweep_b, in_axes=(0, 0, 0))
+            programs[cand[0]] = _cost_entry(
+                jax.jit(vsweep).lower(stack(data_b), stack(state_b),
+                                      keys).compile())
+        real = spec.ny * spec.ns
+        padded = dims["ny"] * dims["ns"]
+        section[mname] = {
+            "k": BATCH_LEDGER_K,
+            "dims": {kk: (list(v) if isinstance(v, tuple) else v)
+                     for kk, v in dims.items()},
+            "occupancy": round(real / padded, 4),
+            "padding_waste": round(1.0 - real / padded, 4),
+        }
+    return programs, section
+
+
 def build_cost_ledger(models=None, only=None) -> dict:
     """Compile and cost-analyse, per canonical spec:
 
@@ -386,8 +457,13 @@ def build_cost_ledger(models=None, only=None) -> dict:
     # per-class policy selection (what `default_policy` spends)
     mp_programs, precision = build_precision_ledger(models=models, only=only)
     programs.update(mp_programs)
+
+    # multi-tenant batched-sweep programs + the committed per-class bucket
+    # occupancy metrics (mcmc/multitenant.py)
+    batch_programs, batch = build_batch_ledger(models=models, only=only)
+    programs.update(batch_programs)
     return {"version": LEDGER_VERSION, "jax": jax.__version__,
-            "precision": precision,
+            "precision": precision, "batch": batch,
             "programs": dict(sorted(programs.items()))}
 
 
@@ -422,6 +498,14 @@ def ledger_digest(ledger: dict) -> dict:
                 sv[bname] = sv.get(bname, 0) \
                     + sign * entry.get("bytes_accessed", 0)
             continue
+        if prog.startswith("batch"):
+            # K-lane padded-bucket numbers roll up separately (the padded
+            # shapes would distort the tiny-spec peaks)
+            bt = d.setdefault("batch", {})
+            if "@K" in prog:
+                bt["sweep_flops_k"] = entry.get("flops")
+                bt["sweep_bytes_k"] = entry.get("bytes_accessed")
+            continue
         d["temp_bytes_peak"] = max(d["temp_bytes_peak"],
                                    entry.get("temp_bytes", 0))
         if prog == "sweep":
@@ -436,6 +520,12 @@ def ledger_digest(ledger: dict) -> dict:
             "bytes_ratio": sel.get("bytes_ratio"),
             "bytes_saved_per_sweep": int(sum(pairs.values())) or None,
         }
+    for mname, sel in ledger.get("batch", {}).items():
+        d = out.setdefault(mname, {"flops_total": None,
+                                   "temp_bytes_peak": 0, "programs": 0})
+        d.setdefault("batch", {}).update(
+            k=sel.get("k"), occupancy=sel.get("occupancy"),
+            padding_waste=sel.get("padding_waste"))
     return out
 
 
@@ -486,6 +576,19 @@ def diff_ledger(committed: dict | None, current: dict) -> list[str]:
             if prev.get(k) != sel.get(k):
                 drift.append(
                     f"precision/{cls_}: {k} {prev.get(k)} -> {sel.get(k)}")
+    # the batched-bucket section (bucket dims + occupancy/padding waste of
+    # the canonical K-lane bucket) drifts visibly too — a rounding or
+    # padding change silently moving occupancy must surface in review
+    old_b = committed.get("batch", {})
+    for cls_, sel in current.get("batch", {}).items():
+        prev = old_b.get(cls_)
+        if prev is None:
+            drift.append(f"batch/{cls_}: no committed section")
+            continue
+        for k in ("k", "dims", "occupancy", "padding_waste"):
+            if prev.get(k) != sel.get(k):
+                drift.append(
+                    f"batch/{cls_}: {k} {prev.get(k)} -> {sel.get(k)}")
     return drift
 
 

@@ -1,0 +1,670 @@
+"""Multi-tenant batched fitting suite (mcmc/multitenant.py).
+
+Covers: shape bucketing, pad-and-mask correctness (bitwise junk-invariance
+per registered updater — the block-level mask-leak catcher), zero-padding
+bit-identity vs unbatched runs, padded statistical agreement, per-tenant
+manifest fan-out + kill/resume, per-tenant retry_diverged isolation with
+byte-untouched healthy-tenant shards, and the fleet job-queue dispatch.
+"""
+
+import glob
+import hashlib
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from util import small_model, build_all
+
+from hmsc_tpu.mcmc import multitenant as MT
+from hmsc_tpu.mcmc.multitenant import (TENANT_PAD_AGREEMENT_TOL,
+                                       batch_unsupported_reason, bucket_dims,
+                                       bucket_key, make_batched_sweep,
+                                       mask_tenant_state, pad_spec,
+                                       pad_state, pad_tenant,
+                                       sample_mcmc_batched,
+                                       slice_tenant_state, tenant_dir)
+from hmsc_tpu.mcmc.sampler import sample_mcmc
+
+pytestmark = pytest.mark.tenant
+
+R1 = {"ny": 1, "ns": 1, "nc": 1, "nt": 1, "np": 1, "nf": 1}
+
+
+def _build_md(m, nf_cap=4):
+    spec, data, state, dp = build_all(m, nf_cap=nf_cap)
+    return spec, data, state
+
+
+# ---------------------------------------------------------------------------
+# bucketing
+# ---------------------------------------------------------------------------
+
+def test_bucket_key_groups_and_separates():
+    m1 = small_model(ny=25, ns=3, nc=2, distr="normal", n_units=5, seed=0)
+    m2 = small_model(ny=30, ns=4, nc=2, distr="normal", n_units=6, seed=1)
+    m3 = small_model(ny=25, ns=3, nc=2, distr="probit", n_units=5, seed=2)
+    k1 = bucket_key(*_build_md(m1)[:2])
+    k2 = bucket_key(*_build_md(m2)[:2])
+    k3 = bucket_key(*_build_md(m3)[:2])
+    # same structure, shapes inside one padded box -> same bucket
+    assert k1 == k2
+    # different observation model -> different traced program -> new bucket
+    assert k3 != k1
+    # a coarser rounding is a different box
+    assert bucket_key(*_build_md(m1)[:2], {"ny": 64}) != k1
+
+
+def test_bucket_dims_round_up():
+    spec, _, _ = _build_md(small_model(ny=25, ns=5, nc=2, n_units=5))
+    d = bucket_dims(spec)
+    assert d["ny"] == 32 and d["ns"] == 8 and d["nc"] == 2
+    assert d["np"] == (8,) and d["nf"] == (2,)
+
+
+def test_unsupported_models_rejected():
+    spatial = small_model(ny=16, ns=3, spatial="Full", n_units=5, seed=3)
+    spec, data, _ = _build_md(spatial)
+    assert "spatial" in batch_unsupported_reason(spec)
+    with pytest.raises(NotImplementedError, match="spatial"):
+        sample_mcmc_batched([spatial], samples=2)
+    base = small_model(ny=16, ns=3, n_units=5)
+    spec_b, _, _ = _build_md(base)
+    assert batch_unsupported_reason(spec_b) is None
+    assert "collapsed" in batch_unsupported_reason(spec_b, {"Gamma2": True})
+
+
+# ---------------------------------------------------------------------------
+# pad/slice round-trips
+# ---------------------------------------------------------------------------
+
+def test_pad_slice_state_round_trip():
+    m = small_model(ny=25, ns=5, nc=2, n_units=5, with_phylo=True,
+                    with_traits=True, seed=4)
+    spec, data, state = _build_md(m)
+    dims = bucket_dims(spec)
+    padded = pad_state(spec, state, dims)
+    back = slice_tenant_state(spec, padded)
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_padded_data_masks_are_consistent():
+    m = small_model(ny=25, ns=5, nc=2, n_units=5, with_phylo=True,
+                    with_traits=True, seed=4)
+    spec, data, state = _build_md(m)
+    dims = bucket_dims(spec)
+    db = pad_tenant(spec, data, dims)
+    ten = db.tenant
+    assert int(ten.row_mask.sum()) == spec.ny
+    assert int(ten.sp_mask.sum()) == spec.ns
+    assert float(ten.df_v) == spec.f0 + spec.ns
+    # padded cells are missing cells; padded design columns are zero
+    Ym = np.asarray(db.Ymask)
+    assert (Ym[spec.ny:, :] == 0).all() and (Ym[:, spec.ns:] == 0).all()
+    assert (np.asarray(db.X)[spec.ny:, :] == 0).all()
+    # pad phylogeny: identity eigen-block, unit eigenvalues
+    assert np.allclose(np.asarray(db.Qeig)[:, spec.ns:], 1.0)
+    U = np.asarray(db.U)
+    assert (U[: spec.ns, spec.ns:] == 0).all()
+    assert np.allclose(U[spec.ns:, spec.ns:], np.eye(dims["ns"] - spec.ns))
+
+
+# ---------------------------------------------------------------------------
+# pad-and-mask correctness per registered updater (the mask-leak catcher)
+# ---------------------------------------------------------------------------
+
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _padded_base():
+    m = small_model(ny=21, ns=5, nc=2, n_units=5, distr="probit",
+                    with_phylo=True, with_traits=True, nt=2, seed=6)
+    spec, data, state = _build_md(m)
+    dims = bucket_dims(spec)
+    spec_b = pad_spec(spec, dims, has_na=True)
+    data_b = pad_tenant(spec, data, dims)
+    state_b = mask_tenant_state(spec_b, data_b.tenant,
+                                pad_state(spec, state, dims))
+    return spec, spec_b, data_b, state_b
+
+
+def _junk_masked_cells(data_b, state, fill=999.0):
+    """Junk every DON'T-CARE slot: Y and Z at Ymask-masked cells (every
+    padded cell IS a masked cell, plus any real NA cell) and the padded
+    design rows.  A correct updater multiplies all of these by an exact
+    zero mask somewhere, so its real output slice cannot move; junking
+    state slots the masked sweep keeps at NEUTRAL values (Beta/Gamma pads,
+    identity iV pad block, unit Psi/Delta/iSigma pads) is out of contract
+    — the between-block re-mask maintains those by construction."""
+    Ym = data_b.Ymask
+    rm = data_b.tenant.row_mask
+    data_j = data_b.replace(
+        Y=jnp.where(Ym > 0, data_b.Y, fill),
+        X=jnp.where(rm[:, None] > 0, data_b.X, fill))
+    state_j = state.replace(Z=jnp.where(Ym > 0, state.Z, fill))
+    return data_j, state_j
+
+
+def _applicable_entries():
+    from hmsc_tpu.mcmc.registry import UPDATER_REGISTRY
+    _, spec_b, data_b, _ = _padded_base()
+    out = []
+    for e in UPDATER_REGISTRY:
+        # the collapsed marginal updaters are rejected by the batched path
+        if e.name in ("Gamma2", "GammaEta"):
+            continue
+        if e.applies(spec_b, data_b):
+            out.append(e.name)
+    return out
+
+
+@pytest.mark.parametrize("name", _applicable_entries())
+def test_updater_pad_junk_invariance(name):
+    """Junk written into every masked cell (padded/NA Y and Z cells,
+    padded design rows) must leave the updater's REAL output slice
+    bit-identical — a gram or likelihood term missing its Ymask, or a row
+    reduction missing its row mask, breaks bitwise equality here.  This is
+    the block-level mask-leak catcher for every registered updater the
+    batched path can run."""
+    from hmsc_tpu.mcmc.registry import UPDATER_REGISTRY
+    entry = {e.name: e for e in UPDATER_REGISTRY}[name]
+    spec, spec_b, data_b, clean = _padded_base()
+    data_j, state_j = _junk_masked_cells(data_b, clean)
+    key = jax.random.key(9, impl="threefry2x32")
+
+    fn = jax.jit(lambda d, st: entry.fn(spec_b, d, st, key))
+    out_c, out_d = fn(data_b, clean), fn(data_j, state_j)
+    # normalise both outputs to full GibbsState-shaped trees when the
+    # updater returns a LevelState (Eta/Nf return just the level)
+    if not hasattr(out_c, "Beta"):
+        out_c = clean.replace(levels=(out_c,) + tuple(clean.levels[1:]))
+        out_d = state_j.replace(levels=(out_d,) + tuple(state_j.levels[1:]))
+    # Z is the one field where junk legitimately persists at masked cells
+    # (the junk was injected there); compare it at REAL OBSERVED cells only
+    Ym = np.asarray(data_b.Ymask) > 0
+    zc = np.where(Ym, np.asarray(out_c.Z), 0.0)
+    zd = np.where(Ym, np.asarray(out_d.Z), 0.0)
+    np.testing.assert_array_equal(zc, zd, err_msg=f"{name}: Z leak")
+    sc = slice_tenant_state(spec, out_c.replace(Z=jnp.zeros_like(out_c.Z)))
+    sd = slice_tenant_state(spec, out_d.replace(Z=jnp.zeros_like(out_d.Z)))
+    for a, b in zip(jax.tree.leaves(sc), jax.tree.leaves(sd)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=f"{name}: mask leak")
+
+
+def test_masked_sweep_junk_invariance_end_to_end():
+    """The composed masked sweep under the same don't-care junk: real
+    observed draws bit-identical, and the output pads are already neutral
+    (re-masking is a no-op on the sweep's output)."""
+    spec, spec_b, data_b, clean = _padded_base()
+    data_j, state_j = _junk_masked_cells(data_b, clean)
+    sweep = make_batched_sweep(spec_b, None, (1,))
+    key = jax.random.key(3, impl="threefry2x32")
+    out_c = jax.jit(sweep)(data_b, clean, key)
+    out_d = jax.jit(sweep)(data_j, state_j, key)
+    Ym = np.asarray(data_b.Ymask) > 0
+    np.testing.assert_array_equal(np.where(Ym, np.asarray(out_c.Z), 0.0),
+                                  np.where(Ym, np.asarray(out_d.Z), 0.0))
+    sc = slice_tenant_state(spec, out_c.replace(Z=jnp.zeros_like(out_c.Z)))
+    sd = slice_tenant_state(spec, out_d.replace(Z=jnp.zeros_like(out_d.Z)))
+    for a, b in zip(jax.tree.leaves(sc), jax.tree.leaves(sd)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # output pads are already neutral: re-masking is a no-op
+    remasked = mask_tenant_state(spec_b, data_b.tenant, out_c)
+    for a, b in zip(jax.tree.leaves(out_c), jax.tree.leaves(remasked)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# zero-padding bit-identity + padded agreement
+# ---------------------------------------------------------------------------
+
+def test_zero_padding_bit_identity_vs_unbatched():
+    ms = [small_model(ny=24, ns=4, nc=2, distr="probit", n_units=6, seed=s)
+          for s in (0, 5, 9)]
+    seeds = [11, 22, 33]
+    posts, rep = sample_mcmc_batched(
+        ms, samples=5, transient=3, n_chains=2, seeds=seeds,
+        bucket_rounding=R1, return_report=True)
+    assert len(rep["buckets"]) == 1 and rep["buckets"][0]["zero_padding"]
+    assert rep["padding_waste"] == 0.0
+    for m, s, pb in zip(ms, seeds, posts):
+        ps = sample_mcmc(m, samples=5, transient=3, n_chains=2, seed=s)
+        assert set(pb.arrays) == set(ps.arrays)
+        for k in ps.arrays:
+            np.testing.assert_array_equal(pb.arrays[k], ps.arrays[k],
+                                          err_msg=k)
+
+
+def test_padded_tenant_statistical_agreement():
+    """A padded tenant is a different realisation of the SAME posterior:
+    padding contributes exact zeros, only the RNG draw widths differ —
+    posterior means agree within the committed tolerance."""
+    m = small_model(ny=30, ns=5, nc=2, distr="normal", n_units=6, seed=7)
+    (pb,), rep = sample_mcmc_batched(
+        [m], samples=150, transient=60, n_chains=2, seeds=[3],
+        bucket_rounding={"ny": 48, "ns": 8, "nc": 2, "nt": 2,
+                         "np": 8, "nf": 2},
+        return_report=True)
+    assert not rep["buckets"][0]["zero_padding"]
+    ps = sample_mcmc(m, samples=150, transient=60, n_chains=2, seed=3)
+    for k in ("Beta", "Gamma"):
+        mb = np.asarray(pb.arrays[k], dtype=np.float64).mean((0, 1))
+        ms_ = np.asarray(ps.arrays[k], dtype=np.float64).mean((0, 1))
+        assert np.abs(mb - ms_).max() <= TENANT_PAD_AGREEMENT_TOL, k
+
+
+def test_mixed_distribution_flags_separate_buckets():
+    mn = small_model(ny=24, ns=4, nc=2, distr="normal", n_units=6, seed=0)
+    mp = small_model(ny=24, ns=4, nc=2, distr="probit", n_units=6, seed=1)
+    posts, rep = sample_mcmc_batched(
+        [mn, mp], samples=3, transient=2, n_chains=2, seeds=[1, 2],
+        return_report=True)
+    assert len(rep["buckets"]) == 2
+    for p in posts:
+        for v in p.arrays.values():
+            assert np.isfinite(np.asarray(v)).all()
+
+
+# ---------------------------------------------------------------------------
+# per-tenant manifests, kill/resume, retry isolation
+# ---------------------------------------------------------------------------
+
+def _two_tenant_fleet():
+    return ([small_model(ny=25, ns=3, nc=2, distr="normal", n_units=5,
+                         seed=2),
+             small_model(ny=37, ns=6, nc=2, distr="normal", n_units=7,
+                         seed=3)],
+            [7, 8],
+            {"ny": 64, "ns": 8, "nc": 2, "nt": 2, "np": 8, "nf": 2})
+
+
+def _shard_hashes(root):
+    return {p: hashlib.sha256(open(p, "rb").read()).hexdigest()
+            for p in glob.glob(os.path.join(root, "tenant-*", "seg-*.npz"))}
+
+
+@pytest.mark.filterwarnings("ignore:shape bucket")
+def test_tenant_manifest_fanout_and_kill_resume(tmp_path):
+    ms, seeds, r = _two_tenant_fleet()
+    ref_dir, kill_dir = str(tmp_path / "ref"), str(tmp_path / "kill")
+    posts_ref = sample_mcmc_batched(
+        ms, samples=6, transient=3, n_chains=2, seeds=seeds,
+        bucket_rounding=r, checkpoint_every=2, checkpoint_path=ref_dir)
+    # every tenant owns an ordinary single-model manifest directory
+    for name, m in zip(("m000", "m001"), ms):
+        d = tenant_dir(ref_dir, name)
+        files = sorted(os.listdir(d))
+        assert any(f.startswith("manifest-") for f in files)
+        from hmsc_tpu.utils.checkpoint import latest_valid_checkpoint
+        ck = latest_valid_checkpoint(d, m)
+        assert int(ck.post.samples) == 6
+        assert ck.run_meta["batched"]["tenant"] == name
+
+    class Kill(Exception):
+        pass
+
+    def cb(done, total):
+        if done >= 4:
+            raise Kill()
+
+    with pytest.raises(Kill):
+        sample_mcmc_batched(ms, samples=6, transient=3, n_chains=2,
+                            seeds=seeds, bucket_rounding=r,
+                            checkpoint_every=2, checkpoint_path=kill_dir,
+                            progress_callback=cb)
+    pre = _shard_hashes(kill_dir)
+    assert pre, "the kill left no committed shards"
+    posts_res = sample_mcmc_batched(
+        ms, samples=6, transient=3, n_chains=2, seeds=seeds,
+        bucket_rounding=r, checkpoint_every=2, checkpoint_path=kill_dir,
+        resume=True)
+    # committed shards byte-untouched; spliced result bit-identical
+    post_h = _shard_hashes(kill_dir)
+    for p, h in pre.items():
+        assert post_h.get(p) == h, f"committed shard rewritten: {p}"
+    for pr, pc in zip(posts_ref, posts_res):
+        for k in pr.arrays:
+            np.testing.assert_array_equal(
+                np.asarray(pr.arrays[k]), np.asarray(pc.arrays[k]),
+                err_msg=k)
+    # a completed run resumes to the same posterior without sampling
+    posts_done = sample_mcmc_batched(
+        ms, samples=6, transient=3, n_chains=2, seeds=seeds,
+        bucket_rounding=r, checkpoint_every=2, checkpoint_path=ref_dir,
+        resume=True)
+    for pr, pd in zip(posts_ref, posts_done):
+        for k in pr.arrays:
+            np.testing.assert_array_equal(np.asarray(pr.arrays[k]),
+                                          np.asarray(pd.arrays[k]))
+
+
+@pytest.mark.filterwarnings("ignore:shape bucket")
+@pytest.mark.filterwarnings("ignore:chain .* diverged")
+def test_retry_diverged_isolated_to_one_tenant(tmp_path):
+    """A NaN blow-up in ONE tenant's lane: retry_diverged restarts only
+    that tenant's chains from its last healthy manifest; the healthy
+    tenant's draws and committed shard files are byte-untouched (the
+    multitenant mirror of PR 9's multi-process splice test)."""
+    from hmsc_tpu.mcmc import sampler as sampler_mod
+    from hmsc_tpu.mcmc import updaters as U
+
+    ms, seeds, r = _two_tenant_fleet()
+    clean_dir = str(tmp_path / "clean")
+    posts_clean = sample_mcmc_batched(
+        ms, samples=6, transient=3, n_chains=2, seeds=seeds,
+        bucket_rounding=r, checkpoint_every=2, checkpoint_path=clean_dir)
+
+    # poison tenant 0 only (its real row count is 25), at sweep 8 — past
+    # the 2nd checkpoint mark, so a healthy warm-restart manifest exists
+    real = U.update_beta_lambda
+
+    def poisoned(spec, data, state, key, *a, **kw):
+        state = real(spec, data, state, key, *a, **kw)
+        if data.tenant is None:
+            return state              # the unbatched retry runs clean
+        hit = ((state.it == 8)
+               & (data.tenant.n_rows == 25.0)).astype(state.Beta.dtype)
+        return state.replace(Beta=state.Beta + hit * jnp.asarray(
+            jnp.nan, dtype=state.Beta.dtype))
+
+    fault_dir = str(tmp_path / "fault")
+    U.update_beta_lambda = poisoned
+    MT._batched_runner.cache_clear()
+    sampler_mod._compiled_runner.cache_clear()
+    try:
+        posts_fault = sample_mcmc_batched(
+            ms, samples=6, transient=3, n_chains=2, seeds=seeds,
+            bucket_rounding=r, checkpoint_every=2,
+            checkpoint_path=fault_dir, retry_diverged=1)
+    finally:
+        U.update_beta_lambda = real
+        MT._batched_runner.cache_clear()
+        sampler_mod._compiled_runner.cache_clear()
+
+    # tenant 0 was retried and is healthy after the splice
+    p0 = posts_fault[0]
+    assert p0.retry_info is not None
+    assert all(p0.retry_info["healthy_after_retry"])
+    assert np.asarray(p0.chain_health["good_chains"]).all()
+    for v in p0.arrays.values():
+        assert np.isfinite(np.asarray(v)).all()
+    # the warm restart came from tenant 0's own manifest (not from scratch)
+    assert p0.retry_info["warm_start_samples"] is not None
+
+    # tenant 1 never diverged and its draws are EXACTLY the clean run's
+    p1 = posts_fault[1]
+    assert not p1.retry_info["retried_chains"]
+    for k in p1.arrays:
+        np.testing.assert_array_equal(
+            np.asarray(p1.arrays[k]), np.asarray(posts_clean[1].arrays[k]),
+            err_msg=f"healthy tenant perturbed: {k}")
+    # ... and its committed shard files are byte-identical to a clean run
+    clean_h = {os.path.relpath(p, clean_dir): h
+               for p, h in _shard_hashes(clean_dir).items()
+               if "tenant-m001" in p}
+    fault_h = {os.path.relpath(p, fault_dir): h
+               for p, h in _shard_hashes(fault_dir).items()
+               if "tenant-m001" in p}
+    assert clean_h and clean_h == fault_h
+
+
+# ---------------------------------------------------------------------------
+# warn-once dedup (obs.log)
+# ---------------------------------------------------------------------------
+
+def test_warn_once_dedup_per_run():
+    import warnings
+
+    from hmsc_tpu.obs import get_logger
+    log = get_logger()
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        assert log.warn_once("k1", "first delivery") is True
+        assert log.warn_once("k1", "suppressed duplicate") is False
+        assert log.warn_once("k2", "other key") is True
+    msgs = [str(w.message) for w in rec]
+    assert msgs == ["first delivery", "other key"]
+    # a NEW run (new logger) warns afresh
+    with warnings.catch_warnings(record=True) as rec2:
+        warnings.simplefilter("always")
+        assert get_logger().warn_once("k1", "fresh run") is True
+    assert [str(w.message) for w in rec2] == ["fresh run"]
+
+
+# ---------------------------------------------------------------------------
+# ledger + fingerprints coverage
+# ---------------------------------------------------------------------------
+
+def test_ledger_batch_section_drift_check():
+    from hmsc_tpu.obs.profile import diff_ledger
+    committed = {"programs": {}, "precision": {},
+                 "batch": {"base": {"k": 4, "dims": {"ny": 16},
+                                    "occupancy": 0.5,
+                                    "padding_waste": 0.5}}}
+    same = json.loads(json.dumps(committed))
+    assert diff_ledger(committed, same) == []
+    moved = json.loads(json.dumps(committed))
+    moved["batch"]["base"]["occupancy"] = 0.25
+    drift = diff_ledger(committed, moved)
+    assert any("batch/base: occupancy" in d for d in drift)
+
+
+def test_committed_ledger_has_batch_entries():
+    from hmsc_tpu.obs.profile import load_ledger
+    ledger = load_ledger()
+    assert ledger is not None
+    assert "base" in ledger.get("batch", {})
+    assert any(name.endswith("batch:sweep@K4")
+               for name in ledger["programs"])
+
+
+def test_committed_fingerprints_cover_batched_sweep():
+    from hmsc_tpu.analysis.jaxpr_rules import load_fingerprints
+    fp = load_fingerprints()
+    names = fp.get("programs", fp)
+    assert any(n.startswith("batched_sweep@") for n in names)
+
+
+# ---------------------------------------------------------------------------
+# fleet job-queue mode
+# ---------------------------------------------------------------------------
+
+def _write_jobs(jobs_dir, shapes):
+    os.makedirs(jobs_dir, exist_ok=True)
+    for i, (ny, ns) in enumerate(shapes):
+        with open(os.path.join(jobs_dir, f"job{i}.json"), "w") as f:
+            json.dump({"name": f"r{i}",
+                       "model": {"ny": ny, "ns": ns, "nc": 2,
+                                 "n_units": 5, "seed": i},
+                       "seed": 100 + i}, f)
+
+
+def test_job_queue_plan_two_buckets(tmp_path):
+    from hmsc_tpu.fleet.jobs import plan_buckets, scan_jobs
+    jobs_dir = str(tmp_path / "jobs")
+    _write_jobs(jobs_dir, [(20, 3), (24, 4), (70, 6), (76, 7)])
+    jobs = scan_jobs(jobs_dir)
+    assert [j["name"] for j in jobs] == ["r0", "r1", "r2", "r3"]
+    buckets = plan_buckets(jobs)
+    assert len(buckets) == 2
+    sizes = sorted(len(v) for v in buckets.values())
+    assert sizes == [2, 2]
+
+
+@pytest.mark.multiproc
+def test_job_queue_dispatch_and_chaos_kill(tmp_path):
+    """The acceptance drill: one supervised queue run dispatches >= 2
+    shape buckets with per-tenant manifests and completion events; a
+    chaos-style mid-run SIGKILL on the first attempt loses zero committed
+    draws for ANY tenant (the restart resumes per-tenant and the final
+    draws equal a never-killed run's)."""
+    from hmsc_tpu.fleet.config import FleetConfig
+    from hmsc_tpu.fleet.jobs import JobQueue
+
+    jobs_dir = str(tmp_path / "jobs")
+    _write_jobs(jobs_dir, [(20, 3), (24, 4), (70, 6), (76, 7)])
+    run_kw = {"samples": 8, "n_chains": 2, "checkpoint_every": 4,
+              "transient": 4}
+
+    ref = JobQueue(FleetConfig(
+        ckpt_dir=str(tmp_path / "ck-ref"), work_dir=str(tmp_path / "w-ref"),
+        nprocs=1, jobs_dir=jobs_dir, run_kw=dict(run_kw))).run()
+    assert ref["ok"] and ref["n_buckets"] == 2
+    assert ref["tenants_done"] == 4
+    assert ref["report"]["occupancy"] is not None
+
+    chaos = JobQueue(FleetConfig(
+        ckpt_dir=str(tmp_path / "ck-chaos"),
+        work_dir=str(tmp_path / "w-chaos"),
+        nprocs=1, jobs_dir=jobs_dir, run_kw=dict(run_kw)))
+    summary = chaos.run(chaos_kill_at=4)   # SIGKILL mid-run, 1st attempt
+    assert summary["ok"], summary
+    assert any(a["attempt"] > 1 for a in chaos.attempt_log), \
+        "the chaos kill never forced a restart"
+    assert any(a["action"] == "resume" for a in chaos.attempt_log), \
+        "the restart did not resume from the tenant manifests"
+
+    # zero committed draws lost for any tenant: final digests identical
+    ev_ref = [json.loads(l) for l in
+              open(os.path.join(str(tmp_path / "ck-ref"),
+                                "fleet-events.jsonl"))]
+    ev_chaos = [json.loads(l) for l in
+                open(os.path.join(str(tmp_path / "ck-chaos"),
+                                  "fleet-events.jsonl"))]
+
+    def tenant_digests(evs):
+        return {e["tenant"]: e["digest"] for e in evs
+                if e.get("name") == "tenant_done"}
+    d_ref, d_chaos = tenant_digests(ev_ref), tenant_digests(ev_chaos)
+    assert set(d_ref) == set(d_chaos) == {"r0", "r1", "r2", "r3"}
+    for t in d_ref:
+        for k, v in d_ref[t].items():
+            assert np.isclose(v, d_chaos[t][k], rtol=0, atol=0), \
+                f"tenant {t} lost/changed draws in {k} after the kill"
+    # event timeline: dispatch/exit per bucket + queue lifecycle
+    names = [e.get("name") for e in ev_chaos]
+    assert names.count("queue_start") == 1 and names.count("queue_end") == 1
+    assert names.count("tenant_done") == 4
+    assert names.count("job_dispatch") >= 3   # 2 buckets + >=1 restart
+
+
+def test_batched_adapt_nf_guard_matches_sample_mcmc():
+    """The batched entry point enforces sample_mcmc's transient >=
+    adapt_nf guard — adaptation past the burn-in would mix latent
+    dimensionalities inside the recorded window."""
+    m = small_model(ny=16, ns=3, nc=2, distr="normal", n_units=5, seed=1)
+    with pytest.raises(ValueError, match="adaptNf"):
+        sample_mcmc_batched([m], samples=3, transient=2, n_chains=1,
+                            seeds=[1], adapt_nf=[10], bucket_rounding=R1)
+
+
+def test_batched_resume_rejects_stream_param_changes(tmp_path):
+    """A batched resume under different stream-defining parameters must
+    refuse up front (the resume_run invariant) — a continuation with a
+    different updater/seed would splice a different draw stream onto the
+    committed base."""
+    from hmsc_tpu.utils.checkpoint import CheckpointError
+    m = small_model(ny=16, ns=3, nc=2, distr="normal", n_units=5, seed=1)
+    ck = str(tmp_path / "ck")
+    kw = dict(samples=6, transient=2, n_chains=1, checkpoint_every=2,
+              checkpoint_path=ck, bucket_rounding=R1)
+    sample_mcmc_batched([m], seeds=[9], **kw)
+    for bad_kw in ({"seeds": [10]},
+                   {"seeds": [9], "updater": {"Alpha": False}}):
+        with pytest.raises(CheckpointError, match="stream-defining"):
+            sample_mcmc_batched([m], resume=True, **dict(kw, **bad_kw))
+
+
+def test_jobs_cli_rejects_chaos_flags(tmp_path):
+    from hmsc_tpu.fleet.cli import fleet_main
+    with pytest.raises(SystemExit) as ei:
+        fleet_main(["--jobs", str(tmp_path), "--ckpt-dir", str(tmp_path),
+                    "--work-dir", str(tmp_path), "--chaos-seed", "7"])
+    assert ei.value.code == 2
+
+
+def test_queue_status_failure_classes():
+    """The queue's exit taxonomy mirrors the rank fleet's: divergence-only
+    failures surface as 'diverged' (CLI exit 77), anything harder as
+    'job-failed' (exit 1)."""
+    from hmsc_tpu.fleet.jobs import queue_status
+    ok = {"ok": True, "diverged": False}
+    div = {"ok": False, "diverged": True}
+    hard = {"ok": False, "diverged": False}
+    assert queue_status([]) == "empty-queue"
+    assert queue_status([ok, ok]) == "ok"
+    assert queue_status([ok, div]) == "diverged"
+    assert queue_status([div, hard]) == "job-failed"
+    assert queue_status([hard]) == "job-failed"
+
+
+# ---------------------------------------------------------------------------
+# record= plumbing + padded-nc regression
+# ---------------------------------------------------------------------------
+
+def test_batched_padded_nc_bucket_runs():
+    """nc padding regression: pad_spec must carry nc_nrrr to the padded nc
+    or record_sample's RRR concat branch fires against the already-padded
+    x_scale_par (shape crash — only reachable when nc itself pads)."""
+    ms = [small_model(ny=20, ns=3, nc=3, distr="normal", n_units=5, seed=s)
+          for s in (1, 2)]
+    posts = sample_mcmc_batched(ms, samples=3, transient=2, n_chains=1,
+                                seeds=[7, 8],
+                                bucket_rounding={"ny": 24, "ns": 4, "nc": 4,
+                                                 "nt": 2, "np": 8, "nf": 2})
+    for m, p in zip(ms, posts):
+        assert p["Beta"].shape[2:] == (3, m.ns)
+        assert np.isfinite(np.asarray(p["Beta"])).all()
+
+
+def test_batched_wide_nc_padding_stays_finite():
+    """Wishart pad-df regression: when nc pads far beyond the real
+    covariate count, a pad index's chi^2 shape (df_v - i)/2 goes
+    non-positive — the NaN Bartlett diag used to contaminate the REAL iV
+    block through the TA pad columns (0 * NaN).  Pad lanes now draw a
+    harmless positive shape; the run must stay finite and undiverged."""
+    ms = [small_model(ny=16, ns=3, nc=2, distr="normal", n_units=5, seed=s)
+          for s in (1, 2)]
+    posts = sample_mcmc_batched(ms, samples=4, transient=3, n_chains=1,
+                                seeds=[7, 8],
+                                bucket_rounding={"ny": 16, "ns": 4,
+                                                 "nc": 12, "nt": 2,
+                                                 "np": 8, "nf": 2})
+    for p in posts:
+        assert (np.asarray(p.chain_health["first_bad_it"]) < 0).all()
+        for v in p.arrays.values():
+            assert np.isfinite(np.asarray(v)).all()
+
+
+def test_batched_record_normalized_like_sample_mcmc():
+    """record= rides the same validation as sample_mcmc: list inputs
+    normalise (the runner cache needs a hashable tuple), Eta force-includes
+    its Lambda sign reference, unknown names raise."""
+    m = small_model(ny=20, ns=3, nc=2, distr="normal", n_units=5, seed=3)
+    (p,) = sample_mcmc_batched([m], samples=3, transient=2, n_chains=1,
+                               seeds=[5], record=["Eta"], bucket_rounding=R1)
+    assert any(k.startswith("Lambda") for k in p.arrays), sorted(p.arrays)
+    with pytest.raises(ValueError, match="unknown parameter"):
+        sample_mcmc_batched([m], samples=2, n_chains=1, seeds=[5],
+                            record=("bogus",), bucket_rounding=R1)
+
+
+# ---------------------------------------------------------------------------
+# precision-policy composition
+# ---------------------------------------------------------------------------
+
+def test_batched_composes_with_precision_policy():
+    ms = [small_model(ny=24, ns=4, nc=2, distr="probit", n_units=6, seed=s)
+          for s in (0, 5)]
+    posts = sample_mcmc_batched(ms, samples=3, transient=2, n_chains=2,
+                                seeds=[1, 2], precision_policy="auto")
+    for p in posts:
+        for v in p.arrays.values():
+            assert np.isfinite(np.asarray(v)).all()
