@@ -324,6 +324,17 @@ def _precision_summary():
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _refit_summary():
+    """The streaming-refit digest (`benchmarks/bench_refit.py --digest`):
+    warm-start + adaptive-transient refit vs from-scratch fit on the
+    appended dataset — sweeps-to-recovered-ESS speedup (>=3x gate),
+    posterior-mean agreement z, epochs committed — CPU-only subprocess,
+    so the models-that-live-with-their-data path rides the trajectory on
+    every round."""
+    return _digest_subprocess(
+        ["benchmarks/bench_refit.py", "--digest"], timeout=1800)
+
+
 def _multitenant_summary():
     """The multi-tenant batched-fitting digest
     (`benchmarks/bench_multitenant.py --digest`): reduced-scale aggregate
@@ -361,6 +372,7 @@ def _skip(reason: str):
         "shard": _shard_summary(),
         "precision": _precision_summary(),
         "multitenant": _multitenant_summary(),
+        "refit": _refit_summary(),
     }))
     raise SystemExit(0)
 
@@ -531,6 +543,11 @@ def main():
         # rides the trajectory
         "precision": _precision_summary(),
         "multitenant": _multitenant_summary(),
+        # streaming-refit digest (CPU subprocess): warm-start refit vs
+        # fresh-fit sweeps-to-ESS speedup + posterior agreement on the
+        # appended dataset (benchmarks/bench_refit.py) — models that live
+        # with their data ride the trajectory
+        "refit": _refit_summary(),
     }))
 
 

@@ -13,8 +13,9 @@ from .model import Hmsc, XSelect, set_priors
 from .random_level import HmscRandomLevel, set_priors_random_level
 from .precompute import (compute_data_parameters, compute_initial_parameters,
                          construct_knots)
-from .mcmc.sampler import sample_mcmc
+from .mcmc.sampler import sample_mcmc, grow_carry_state
 from .mcmc.multitenant import sample_mcmc_batched
+from .refit import update_run, append_data, load_epoch_posterior
 from .post import (Posterior, pool_mcmc_chains, compute_associations,
                    convert_to_coda_object, effective_size, gelman_rhat,
                    align_posterior, evaluate_model_fit, compute_waic,

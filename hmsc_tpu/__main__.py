@@ -13,11 +13,13 @@ and measured per-updater wall timing — see README "Profiling"),
 ``compact`` (thin + re-shard a fitted run into a
 serving-optimised artifact, optionally bf16), ``serve`` (long-lived
 HTTP posterior-serving engine: compile-cached bucketed predict kernels +
-micro-batching, see README "Serving"), and ``fleet`` (elastic fleet
+micro-batching, see README "Serving"), ``fleet`` (elastic fleet
 supervisor: spawn R worker ranks, heartbeat liveness, backoff restarts,
-shrink/grow degradation — see README "Elastic fleet runs").  Bare
-arguments keep the historical bench behaviour: ``python -m hmsc_tpu
---ns 50`` still works.
+shrink/grow degradation — see README "Elastic fleet runs"), and ``refit``
+(streaming refits: append new survey rows to a fitted run, warm-start
+chains, adaptive abbreviated transient, commit a new serving epoch — see
+README "Streaming refits").  Bare arguments keep the historical bench
+behaviour: ``python -m hmsc_tpu --ns 50`` still works.
 """
 
 import sys
@@ -48,6 +50,9 @@ def main(argv=None):
     if argv[:1] == ["fleet"]:
         from .fleet.cli import fleet_main
         return fleet_main(argv[1:])
+    if argv[:1] == ["refit"]:
+        from .refit.cli import refit_main
+        return refit_main(argv[1:])
     if argv[:1] == ["bench"]:
         argv = argv[1:]
     return bench_main(argv)

@@ -589,7 +589,7 @@ def check_mutable_default(ctx: ModuleContext):
 # ---------------------------------------------------------------------------
 
 _PRINT_EXEMPT = ("obs/", "__main__.py", "bench_cli.py", "analysis/cli.py",
-                 "fleet/cli.py")
+                 "fleet/cli.py", "refit/cli.py")
 
 
 @rule("bare-print", "error", "ast",

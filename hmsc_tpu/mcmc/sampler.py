@@ -28,7 +28,7 @@ from .sweep import effective_spec_data, make_sweep, record_sample
 from . import spatial
 from . import updaters as U
 
-__all__ = ["sample_mcmc", "instrumented_sweep"]
+__all__ = ["sample_mcmc", "instrumented_sweep", "grow_carry_state"]
 
 
 class _InlineWriter:
@@ -330,6 +330,117 @@ def normalize_record(spec, record):
     if spec.nc_rrr > 0:
         rec_set.add("wRRR")
     return tuple(sorted(rec_set))
+
+
+def grow_carry_state(state, hM_old: Hmsc, hM_new: Hmsc, *, seed: int = 0,
+                     nf_cap: int = DEFAULT_NF_CAP):
+    """Warm-start entry for data-shape growth (streaming refits): re-shape a
+    committed chain carry ``state`` (chains-leading, as checkpoints store
+    it) fitted on ``hM_old`` so it initialises chains on ``hM_new`` — the
+    same model with appended sampling rows (``ny`` grows) and possibly new
+    random-level units (``np`` grows).
+
+    All stream-defining parameter blocks (Beta, Gamma, iV, iSigma, Lambda,
+    Psi, Delta, rho, BetaSel, the sweep counter ``it``) carry over
+    untouched — only the data-shaped leaves change:
+
+    - per-level ``Eta`` rows are scattered into the new unit order (the
+      ``Hmsc`` constructor re-sorts unit labels, so an appended unit may
+      land anywhere in the index space), and genuinely NEW units draw their
+      rows from the N(0,1) factor prior (masked to the active factors),
+      seeded deterministically by ``seed``;
+    - ``Z``'s appended rows initialise at the carried state's linear
+      predictor (exactly :func:`~hmsc_tpu.mcmc.structs.build_state`'s
+      fresh-chain idiom — the in-sweep Z update re-draws them from the
+      truncated/observed law on the first sweep).
+
+    Structure changes that WOULD change the stream (ns/nc/nt/levels, factor
+    caps, spatial methods) are rejected — a refit pins them from the parent
+    run's metadata.  New units on spatial or covariate-dependent levels
+    need per-unit data this entry cannot invent, and are rejected too."""
+    spec_old = build_spec(hM_old, nf_cap)
+    spec_new = build_spec(hM_new, nf_cap)
+    fixed = ("ns", "nc", "nt", "nr", "ncsel", "nc_rrr", "has_phylo")
+    diff = [f for f in fixed
+            if getattr(spec_old, f) != getattr(spec_new, f)]
+    if diff:
+        raise ValueError(
+            f"grow_carry_state: model structure changed in {diff} — a "
+            "warm start can only grow the data axes (ny, per-level np); "
+            "everything else is pinned from the parent run")
+    if spec_new.ny < spec_old.ny:
+        raise ValueError(
+            f"grow_carry_state: ny shrank ({spec_old.ny} -> {spec_new.ny})"
+            " — appends only")
+    if spec_old.x_is_list or spec_new.x_is_list:
+        raise NotImplementedError(
+            "grow_carry_state: species-specific designs (X lists) are not "
+            "refittable yet")
+    n_chains = int(np.asarray(state.Z).shape[0])
+    rng = np.random.default_rng(seed)
+
+    new_levels = []
+    etas_new = []
+    for r in range(spec_new.nr):
+        lo, ln = spec_old.levels[r], spec_new.levels[r]
+        if (lo.nf_max, lo.nf_min, lo.ncr, lo.x_dim, lo.spatial) != \
+                (ln.nf_max, ln.nf_min, ln.ncr, ln.x_dim, ln.spatial):
+            raise ValueError(
+                f"grow_carry_state: level {hM_new.rl_names[r]!r} changed "
+                "structurally (factor bounds / unit covariates / spatial "
+                "method) — pinned from the parent run")
+        pos = {u: i for i, u in enumerate(hM_new.pi_names[r])}
+        missing = [u for u in hM_old.pi_names[r] if u not in pos]
+        if missing:
+            raise ValueError(
+                f"grow_carry_state: level {hM_new.rl_names[r]!r} lost "
+                f"units {missing[:5]} — appends only")
+        perm = np.array([pos[u] for u in hM_old.pi_names[r]],
+                        dtype=np.int64)
+        fresh = sorted(set(range(ln.n_units)) - set(perm.tolist()))
+        eta_old = np.asarray(state.levels[r].Eta)
+        eta = np.zeros((n_chains, ln.n_units, eta_old.shape[2]),
+                       dtype=eta_old.dtype)
+        eta[:, perm] = eta_old
+        if fresh:
+            if ln.spatial is not None:
+                raise NotImplementedError(
+                    f"grow_carry_state: new units on the spatial level "
+                    f"{hM_new.rl_names[r]!r} need coordinates/grids the "
+                    "warm start cannot invent — refit with rows at "
+                    "existing units, or fit the grown level fresh")
+            if ln.x_dim > 0:
+                raise NotImplementedError(
+                    f"grow_carry_state: new units on the covariate-"
+                    f"dependent level {hM_new.rl_names[r]!r} (xDim > 0) "
+                    "need per-unit covariates — not refittable yet")
+            draw = rng.standard_normal(
+                (n_chains, len(fresh), eta_old.shape[2]))
+            mask = np.asarray(state.levels[r].nf_mask)      # (chains, nf)
+            eta[:, fresh] = (draw * mask[:, None, :]).astype(eta_old.dtype)
+        etas_new.append(eta)
+        new_levels.append(state.levels[r].replace(Eta=jnp.asarray(eta)))
+
+    Z_old = np.asarray(state.Z)
+    m = spec_new.ny - spec_old.ny
+    if m == 0:
+        return state.replace(levels=tuple(new_levels))
+    # appended rows start at the carried linear predictor, per chain
+    Xs_new = np.asarray(hM_new.XScaled)[spec_old.ny:]
+    Beta = np.asarray(state.Beta)                      # (chains, nc, ns)
+    L = np.einsum("mk,cks->cms", Xs_new, Beta)
+    for r in range(spec_new.nr):
+        pi = hM_new.Pi[spec_old.ny:, r]
+        lam = np.asarray(state.levels[r].Lambda)       # (chains, nf, ns, ncr)
+        mask = np.asarray(state.levels[r].nf_mask)     # (chains, nf)
+        lam = lam * mask[:, :, None, None]
+        rL = hM_new.ranLevels[r]
+        x_row = (rL.x_for(hM_new.pi_names[r])[pi] if rL.x_dim > 0
+                 else np.ones((m, 1)))
+        L = L + np.einsum("cmf,mk,cfsk->cms", etas_new[r][:, pi], x_row,
+                          lam)
+    Z = np.concatenate([Z_old, L.astype(Z_old.dtype)], axis=1)
+    return state.replace(Z=jnp.asarray(Z), levels=tuple(new_levels))
 
 
 @functools.lru_cache(maxsize=64)

@@ -46,7 +46,7 @@ import json
 import os
 
 __all__ = ["load_run_events", "load_fleet_events", "build_report",
-           "render_report",
+           "render_report", "epoch_drift_report", "render_drift",
            "prometheus_textfile", "serving_prometheus_textfile",
            "report_main", "PROM_GAUGES"]
 
@@ -552,6 +552,92 @@ def serving_prometheus_textfile(stats: dict) -> str:
     return "\n".join(out) + "\n"
 
 
+def epoch_drift_report(run_dir: str, hM0=None,
+                       params: tuple = ("Beta",)) -> dict:
+    """Cross-epoch posterior drift for a streaming-refit run directory.
+
+    For every committed epoch (:mod:`hmsc_tpu.refit`), the monitored
+    parameters' pooled posterior mean/sd are computed, and each
+    consecutive epoch pair gets a Welch-style drift score per entry:
+    ``z = |mean_k - mean_{k-1}| / sqrt(sd_{k-1}^2/ess_{k-1}
+    + sd_k^2/ess_k)`` with each window's mean-variance scaled by its
+    EFFECTIVE sample size (autocorrelated MCMC draws carry far less
+    information than their raw count — a plain var/n would flag pure
+    Monte-Carlo wobble as drift).  On this scale MC wobble sits near 1
+    and a real posterior shift (the appended data moving the estimand)
+    stands out.  Epoch 0 is the original fit; the report is the audit
+    trail for "did the refreshed posterior move because of the new rows,
+    or break?"."""
+    import numpy as np
+
+    from ..post.diagnostics import effective_size
+    from ..refit.epochs import epoch_metadata, load_epoch_posterior
+    from ..utils.checkpoint import committed_epochs
+
+    ks = committed_epochs(run_dir)
+    if len(ks) == 0:
+        raise ValueError(f"{run_dir}: no committed epochs to report on")
+    stats = {}
+    epochs_out = []
+    for k in ks:
+        post, hM, _ = load_epoch_posterior(run_dir, k, hM0=hM0)
+        ent = {"epoch": k, "ny": int(hM.ny), "samples": int(post.samples),
+               "n_chains": int(post.n_chains)}
+        meta = epoch_metadata(run_dir, k)
+        if meta:
+            ent.update(new_rows=meta.get("new_rows"),
+                       transient_sweeps=meta.get("transient_sweeps"))
+        epochs_out.append(ent)
+        per = {}
+        for p in params:
+            if p not in post.arrays:
+                continue
+            a = np.asarray(post.pooled(p), dtype=float)
+            # ESS from the chain-structured draws (autocorrelation-aware)
+            ess = np.maximum(np.asarray(
+                effective_size(np.asarray(post[p], dtype=float)),
+                dtype=float), 2.0)
+            per[p] = (a.mean(axis=0), a.std(axis=0, ddof=1), ess)
+        stats[k] = per
+    pairs = []
+    for k0, k1 in zip(ks, ks[1:]):
+        per_param = {}
+        for p in params:
+            if p not in stats[k0] or p not in stats[k1]:
+                continue
+            m0, s0, n0 = stats[k0][p]
+            m1, s1, n1 = stats[k1][p]
+            se = np.sqrt(s0 ** 2 / n0 + s1 ** 2 / n1)
+            z = np.abs(m1 - m0) / np.maximum(se, 1e-12)
+            per_param[p] = {"max_z": round(float(z.max()), 3),
+                            "mean_z": round(float(z.mean()), 3),
+                            "n_entries": int(z.size)}
+        pairs.append({"from": k0, "to": k1, "params": per_param})
+    return {"run_dir": os.fspath(run_dir), "epochs": epochs_out,
+            "drift": pairs}
+
+
+def render_drift(drift: dict) -> str:
+    """Text rendering of :func:`epoch_drift_report`."""
+    out = [f"cross-epoch posterior drift — {drift['run_dir']}", ""]
+    out.append("  epoch   ny      samples  chains  +rows  transient")
+    for e in drift["epochs"]:
+        out.append(
+            f"  {e['epoch']:>5}   {e['ny']:<7} {e['samples']:<8} "
+            f"{e['n_chains']:<7} {e.get('new_rows') or '-':<6} "
+            f"{e.get('transient_sweeps') or '-'}")
+    out.append("")
+    for pair in drift["drift"]:
+        out.append(f"  epoch {pair['from']} -> {pair['to']}:")
+        for p, d in pair["params"].items():
+            out.append(
+                f"    {p:<8} max_z={d['max_z']:<8} mean_z={d['mean_z']:<8}"
+                f" ({d['n_entries']} entries)")
+    if not drift["drift"]:
+        out.append("  (single epoch — nothing to compare yet)")
+    return "\n".join(out)
+
+
 def report_main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m hmsc_tpu report",
@@ -566,7 +652,18 @@ def report_main(argv=None) -> int:
     ap.add_argument("--prom", metavar="FILE", default=None,
                     help="also write a Prometheus textfile-collector "
                          "export of the final gauges to FILE")
+    ap.add_argument("--drift", action="store_true",
+                    help="cross-epoch posterior drift report for a "
+                         "streaming-refit run directory (epoch 0 vs each "
+                         "committed refit epoch; Welch-style z per "
+                         "monitored entry)")
     args = ap.parse_args(argv)
+
+    if args.drift:
+        drift = epoch_drift_report(args.run_dir)
+        print(json.dumps(drift, indent=1) if args.json
+              else render_drift(drift))
+        return 0
 
     report = build_report(args.run_dir)
     if args.json:

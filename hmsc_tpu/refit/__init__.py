@@ -1,0 +1,23 @@
+"""Streaming refits: models that live with their data.
+
+``update_run(run_dir, new_Y, ...)`` appends freshly surveyed rows to a
+fitted, checkpointed run, warm-starts every chain from the last committed
+posterior state, runs an abbreviated *adaptive* transient (stopping on
+running split-R-hat/ESS), and commits the refreshed draws as a new
+immutable manifest epoch — which the serving engine hot-reloads behind an
+atomic flip (``ServingEngine.reload()`` / ``POST /flip``).
+
+See :mod:`hmsc_tpu.refit.driver` for the phase protocol and
+:mod:`hmsc_tpu.refit.epochs` for the on-disk epoch layout.
+"""
+
+from .data import append_data, new_data_digest
+from .driver import RefitAborted, RefitResult, update_run
+from .epochs import (commit_epoch, epoch_metadata, load_epoch_posterior,
+                     load_new_data, rebuild_epoch_model, save_new_data)
+
+__all__ = [
+    "update_run", "RefitResult", "RefitAborted", "append_data",
+    "new_data_digest", "rebuild_epoch_model", "load_epoch_posterior",
+    "epoch_metadata", "commit_epoch", "save_new_data", "load_new_data",
+]

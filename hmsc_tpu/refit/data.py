@@ -1,0 +1,197 @@
+"""Data-append validation for streaming refits.
+
+:func:`append_data` takes a fitted model and the newly surveyed rows and
+builds the *grown* :class:`~hmsc_tpu.model.Hmsc` the refit samples: the
+response matrix gains rows (NA-imputed cells allowed — exactly like the
+original fit's missing-data handling), the design matrix gains the matching
+covariate rows, and new sampling units may join existing unstructured
+random levels.
+
+Everything stream-defining is PINNED from the parent model, never
+re-derived from the appended data:
+
+- X/Y/Tr column scaling uses the parent's recorded scale parameters (a
+  refit must live in the parent's covariate space, or the carried Beta
+  would be silently mis-scaled);
+- priors (V0, f0, Gamma, sigma, rho grid) are copied verbatim;
+- the random-level prior objects are shared, so factor bounds match.
+
+The one deliberately *derived* piece is the unit index space: the ``Hmsc``
+constructor sorts unit labels, so an appended unit can land anywhere in the
+new index order — :func:`append_data` therefore reports nothing about
+ordering and the warm start re-aligns Eta rows by LABEL
+(:func:`hmsc_tpu.mcmc.sampler.grow_carry_state`).
+
+v1 scope: shared designs only (no per-species X lists), no reduced-rank
+covariates, no spike-and-slab selection groups; new units are accepted on
+unstructured levels only (spatial / covariate-dependent levels need
+per-unit data an append cannot invent — rows at *existing* units of those
+levels are fine).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+import numpy as np
+
+from ..model import Hmsc
+
+__all__ = ["append_data", "new_data_digest"]
+
+
+def _scale_with(par, M):
+    """Apply recorded (mu, sd) column scaling: columns the original fit
+    left unscaled carry (0, 1) and pass through."""
+    mu, sd = np.asarray(par)[0], np.asarray(par)[1]
+    return (np.asarray(M, dtype=float) - mu) / sd
+
+
+def new_data_digest(new_Y, new_X, new_units) -> str:
+    """Deterministic content digest of one append — a resumed refit
+    validates the caller's rows against the epoch's persisted copy."""
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(np.asarray(new_Y, dtype=np.float64)))
+    if new_X is not None:
+        h.update(np.ascontiguousarray(np.asarray(new_X, dtype=np.float64)))
+    units = {k: [str(u) for u in v] for k, v in (new_units or {}).items()}
+    h.update(json.dumps(units, sort_keys=True).encode())
+    return h.hexdigest()
+
+
+def append_data(hM: Hmsc, new_Y, new_X=None, new_units=None) -> Hmsc:
+    """Validate appended survey rows and build the grown model.
+
+    ``new_Y`` is the ``(m, ns)`` block of new responses (NaN marks
+    unobserved cells).  ``new_X`` is the matching ``(m, nc)`` block of RAW
+    covariate rows (same columns as the parent's ``X``; scaled here with
+    the parent's recorded parameters).  ``new_units`` maps each random
+    level's name to its ``m`` unit labels — labels already in the training
+    design join their unit, unseen labels create new units (unstructured
+    levels only).  Returns the grown ``Hmsc``; the caller warm-starts it
+    via :func:`~hmsc_tpu.mcmc.sampler.grow_carry_state`."""
+    if hM.x_is_list:
+        raise NotImplementedError(
+            "append_data: species-specific designs (X lists) are not "
+            "refittable yet — fit the grown dataset fresh")
+    if hM.nc_rrr > 0:
+        raise NotImplementedError(
+            "append_data: reduced-rank covariates (XRRR) are not "
+            "refittable yet — fit the grown dataset fresh")
+    if hM.ncsel > 0:
+        raise NotImplementedError(
+            "append_data: spike-and-slab selection groups (XSelect) are "
+            "not refittable yet — fit the grown dataset fresh")
+
+    new_Y = np.atleast_2d(np.asarray(new_Y, dtype=float))
+    m = new_Y.shape[0]
+    if m < 1 or new_Y.shape[1] != hM.ns:
+        raise ValueError(
+            f"append_data: new_Y has shape {new_Y.shape}, expected "
+            f"(m >= 1, ns={hM.ns}) — one row per new sampling unit, one "
+            "column per species (NaN for unobserved cells)")
+    probit = hM.distr[:, 0] == 2
+    if probit.any():
+        v = new_Y[:, probit]
+        bad = np.isfinite(v) & (v != 0.0) & (v != 1.0)
+        if bad.any():
+            raise ValueError(
+                "append_data: probit species take 0/1 (or NaN) responses; "
+                f"got {v[bad][:5].tolist()}")
+
+    if hM.nc > 0:
+        if new_X is None:
+            if np.all(hM.X == hM.X[:1]):
+                # constant design (e.g. intercept-only): replicate it
+                new_X = np.repeat(hM.X[:1], m, axis=0)
+            else:
+                raise ValueError(
+                    "append_data: the model has covariates — pass new_X "
+                    f"with shape (m={m}, nc={hM.nc}) raw covariate rows "
+                    "(same columns as the training X, unscaled)")
+        new_X = np.atleast_2d(np.asarray(new_X, dtype=float))
+        if new_X.shape != (m, hM.nc):
+            raise ValueError(
+                f"append_data: new_X has shape {new_X.shape}, expected "
+                f"({m}, {hM.nc}) — raw rows in the training X's columns")
+        if np.isnan(new_X).any():
+            raise ValueError("append_data: new_X must contain no NA values")
+    else:
+        new_X = np.empty((m, 0))
+
+    # per-level labels for the new rows; unseen labels create new units
+    # on unstructured levels only
+    new_units = dict(new_units or {})
+    unknown = sorted(set(new_units) - set(hM.rl_names))
+    if unknown:
+        raise ValueError(
+            f"append_data: new_units names unknown level(s) {unknown}; "
+            f"the model's random levels are {hM.rl_names}")
+    labels_by_level = []
+    for r, name in enumerate(hM.rl_names):
+        labels = new_units.get(name)
+        if labels is None:
+            raise ValueError(
+                f"append_data: new_units must give the {m} unit labels "
+                f"for random level {name!r} (new rows must join the "
+                "study design)")
+        labels = [str(u) for u in labels]
+        if len(labels) != m:
+            raise ValueError(
+                f"append_data: new_units[{name!r}] has {len(labels)} "
+                f"labels for {m} new rows")
+        rL = hM.ranLevels[r]
+        fresh = sorted(set(labels) - set(hM.pi_names[r]))
+        if fresh and rL.s_dim != 0:
+            raise NotImplementedError(
+                f"append_data: new units {fresh[:5]} on the spatial "
+                f"level {name!r} need coordinates — refit with rows at "
+                "existing units, or fit the grown level fresh")
+        if fresh and rL.x_dim > 0:
+            raise NotImplementedError(
+                f"append_data: new units {fresh[:5]} on the covariate-"
+                f"dependent level {name!r} (xDim > 0) need per-unit "
+                "covariates — not refittable yet")
+        labels_by_level.append(labels)
+
+    # ---- build the grown model on the PARENT's scaled spaces -------------
+    import pandas as pd
+
+    study = pd.DataFrame({
+        name: list(hM.df_pi[r]) + labels_by_level[r]
+        for r, name in enumerate(hM.rl_names)}) if hM.nr else None
+    Xs_new = _scale_with(hM.x_scale_par, new_X) if hM.nc else new_X
+    grown = Hmsc(
+        Y=np.vstack([hM.Y, new_Y]),
+        X=np.vstack([np.asarray(hM.XScaled), Xs_new]),
+        x_scale=False,
+        y_scale=False,
+        Tr=hM.Tr,
+        tr_scale=False,
+        C=hM.C,
+        study_design=study,
+        ran_levels={name: hM.ranLevels[r]
+                    for r, name in enumerate(hM.rl_names)} or None,
+        ran_levels_used=list(hM.rl_names) or None,
+        distr=np.asarray(hM.distr),
+    )
+    # pin the parent's scaling / naming / priors (stream-defining: the
+    # carried Beta lives in the parent's scaled covariate space)
+    grown.X = np.vstack([hM.X, new_X]) if hM.nc else grown.X
+    grown.x_scale_par = np.asarray(hM.x_scale_par).copy()
+    grown.cov_names = list(hM.cov_names)
+    grown.x_intercept_ind = hM.x_intercept_ind
+    ym, ys = np.asarray(hM.y_scale_par)
+    grown.YScaled = np.vstack([hM.YScaled, (new_Y - ym) / ys])
+    grown.y_scale_par = np.asarray(hM.y_scale_par).copy()
+    grown.Tr = np.asarray(hM.Tr).copy()
+    grown.TrScaled = np.asarray(hM.TrScaled).copy()
+    grown.tr_scale_par = np.asarray(hM.tr_scale_par).copy()
+    grown.tr_intercept_ind = hM.tr_intercept_ind
+    grown.tr_names = list(hM.tr_names)
+    grown.sp_names = list(hM.sp_names)
+    for attr in ("V0", "f0", "mGamma", "UGamma", "aSigma", "bSigma",
+                 "rhopw", "nuRRR", "a1RRR", "b1RRR", "a2RRR", "b2RRR"):
+        setattr(grown, attr, getattr(hM, attr))
+    return grown

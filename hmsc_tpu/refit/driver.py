@@ -1,0 +1,359 @@
+"""``update_run`` — incremental refits of a fitted, checkpointed run.
+
+The streaming-refit contract (ROADMAP "models that live with their data"):
+new survey rows arrive, the model should NOT pay a full from-scratch
+burn-in.  The Gibbs structure makes warm restarts exact — chains resume
+from the parent epoch's committed carry state, which already sits in the
+(old-data) posterior's typical set — so the refit only needs an
+*abbreviated adaptive transient* to re-equilibrate to the appended
+dataset, not to find the posterior from a random start.
+
+One ``update_run`` call is one new manifest epoch:
+
+1. **Append + validate** — the new rows are persisted into the epoch
+   directory first (``new-data.npz``), then the grown model is built with
+   every stream-defining quantity pinned from the parent
+   (:func:`~hmsc_tpu.refit.data.append_data`).
+2. **Warm start** — every chain's carry re-shapes onto the grown data
+   (:func:`~hmsc_tpu.mcmc.sampler.grow_carry_state`).
+3. **Adaptive transient** — probe segments of ``probe_every`` sweeps run
+   under the parent's sampler configuration (thin=1, Beta-only recording)
+   into ``epoch-<k>/transient/``; after each probe the accumulated draws
+   feed :class:`~hmsc_tpu.obs.health.RunningDiagnostics`, and the warm-up
+   stops once split-R-hat and ESS clear their thresholds (bounded by
+   ``min_sweeps``/``max_sweeps``).  Probes are ordinary checkpointed runs,
+   so a killed refit resumes its warm-up bit-exactly and the stopping
+   decision — a deterministic function of the committed draws — replays
+   identically.
+4. **Refreshed draws** — the recorded sampling phase runs with every
+   stream-defining parameter pinned from the parent run's metadata
+   (thin / chains / updaters / dtype / RNG impl / precision policy /
+   record selection), checkpointing into the epoch directory itself.
+5. **Commit** — ``epoch.json`` then the atomic run-root registry flip
+   (:func:`~hmsc_tpu.refit.epochs.commit_epoch`); the serving engine's
+   ``reload()`` observes the flip, in-flight queries finish on the old
+   epoch.
+
+Every phase transition is persisted (``refit-state.json``), so
+``update_run`` called again on a killed refit continues exactly where it
+stopped: kill -> resume produces a final epoch bit-identical to an
+uninterrupted refit (asserted by ``tests/test_refit.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Any
+
+import numpy as np
+
+from ..obs import get_logger
+from ..obs.events import RunTelemetry, events_path
+from ..obs.health import RunningDiagnostics
+from ..utils.checkpoint import (CheckpointError, _atomic_write_bytes,
+                                checkpoint_files, committed_epochs,
+                                epoch_dir_path, gc_checkpoints,
+                                latest_valid_checkpoint, resume_run,
+                                spec_fingerprint)
+from .data import append_data, new_data_digest
+from .epochs import (NEW_DATA_FILE, REFIT_STATE_FILE, commit_epoch,
+                     load_new_data, rebuild_epoch_model, save_new_data)
+
+__all__ = ["update_run", "RefitResult", "RefitAborted"]
+
+
+class RefitAborted(RuntimeError):
+    """Deterministic mid-refit interruption (the kill/resume test hook —
+    raised only via ``update_run(..., _abort_after=...)``).  The refit is
+    left exactly as a SIGKILL at the same boundary would leave it;
+    ``update_run`` again continues it."""
+
+
+@dataclasses.dataclass
+class RefitResult:
+    """What one committed refit epoch produced."""
+    epoch: int
+    post: Any                    # the refreshed Posterior (appended dataset)
+    transient_sweeps: int        # adaptive warm-up length actually used
+    diagnostics: dict            # RunningDiagnostics summary at the stop
+    epoch_dir: str
+    committed: bool
+    wall_s: float
+
+
+def _read_state(epoch_dir: str) -> dict | None:
+    p = os.path.join(epoch_dir, REFIT_STATE_FILE)
+    if not os.path.exists(p):
+        return None
+    with open(p, "rb") as f:
+        return json.loads(f.read().decode())
+
+
+def _write_state(epoch_dir: str, st: dict) -> None:
+    _atomic_write_bytes(os.path.join(epoch_dir, REFIT_STATE_FILE),
+                        json.dumps(st, sort_keys=True).encode())
+
+
+def _transient_passed(summary: dict, rhat_threshold: float,
+                      ess_target: float) -> bool:
+    """The adaptive stopping rule: both running diagnostics must exist and
+    clear their thresholds (too-few-draws summaries report None and keep
+    the warm-up going)."""
+    rhat, ess = summary.get("rhat_max"), summary.get("ess_min")
+    return (rhat is not None and ess is not None
+            and rhat <= rhat_threshold and ess >= ess_target)
+
+
+def update_run(run_dir: str, new_Y=None, new_X=None, new_units=None, *,
+               hM=None, samples: int | None = None,
+               min_sweeps: int = 8, max_sweeps: int = 64,
+               probe_every: int = 8, rhat_threshold: float = 1.10,
+               ess_target: float | None = None, seed: int = 0,
+               checkpoint_every: int | None = None, verbose: int = 0,
+               _abort_after=None) -> RefitResult:
+    """Incrementally refit a run on appended survey rows (see the module
+    docstring for the phase protocol).
+
+    ``run_dir`` is a fitted, auto-checkpointed run directory (the run root
+    is epoch 0; prior ``update_run`` epochs stack on top).  ``new_Y`` /
+    ``new_X`` / ``new_units`` are the appended rows
+    (:func:`~hmsc_tpu.refit.data.append_data`); pass ``new_Y=None`` to
+    RESUME an interrupted refit (the epoch's persisted copy is used — and
+    when rows ARE passed again, they must digest-match it).
+
+    ``hM`` is the epoch-0 model for run directories not written by
+    ``python -m hmsc_tpu run`` (those rebuild it from ``model.json``).
+    ``samples`` defaults to the parent epoch's recorded draw count.  The
+    adaptive transient is bounded to ``[min_sweeps, max_sweeps]`` total
+    sweeps, probed every ``probe_every``; ``ess_target`` defaults to
+    ``4 x n_chains``.  Everything else stream-defining is pinned from the
+    parent run's metadata and cannot be overridden here."""
+    import jax.numpy as jnp
+
+    t0 = time.perf_counter()
+    run_dir = os.fspath(run_dir)
+    log = get_logger()
+    ks = committed_epochs(run_dir)
+    if not ks:
+        raise CheckpointError(
+            f"update_run: no fitted run under {run_dir!r} — refits grow an "
+            "auto-checkpointed run directory (sample_mcmc with "
+            "checkpoint_every=, or `python -m hmsc_tpu run`)")
+    parent_k = ks[-1]
+    k_new = parent_k + 1
+    d_new = epoch_dir_path(run_dir, k_new)
+    t_dir = os.path.join(d_new, "transient")
+
+    hM_parent = rebuild_epoch_model(run_dir, parent_k, hM0=hM)
+    ck = latest_valid_checkpoint(epoch_dir_path(run_dir, parent_k),
+                                 hM_parent)
+    meta = dict(ck.run_meta)
+    if not meta:
+        raise CheckpointError(
+            f"{ck.path}: no run metadata — update_run needs an "
+            "auto-checkpointed run (save_checkpoint snapshots cannot pin "
+            "the sampler configuration)")
+    if meta.get("local_rng"):
+        raise NotImplementedError(
+            "update_run: the parent run used shard-local RNG "
+            "(local_rng=True) — refits run replicated and would change "
+            "the key-stream layout; not supported yet")
+    good = np.asarray(ck.post.good_chain_mask())
+    if not good.all():
+        raise CheckpointError(
+            f"{ck.path}: {int((~good).sum())} chain(s) ended diverged — a "
+            "warm start would propagate the non-finite carry.  Heal the "
+            "parent first (retry_diverged=) or fit the grown dataset "
+            "fresh")
+
+    # ---- epoch scratch: persist/validate the appended rows ---------------
+    st = _read_state(d_new)
+    if st is not None and int(st.get("parent", -1)) != parent_k:
+        raise CheckpointError(
+            f"{d_new}: holds an abandoned refit of epoch "
+            f"{st.get('parent')} (current parent is {parent_k}) — remove "
+            "the directory to start over")
+    if st is None:
+        if new_Y is None:
+            if os.path.exists(os.path.join(d_new, NEW_DATA_FILE)):
+                new_Y, new_X, new_units = load_new_data(d_new)
+            else:
+                raise ValueError(
+                    "update_run: new_Y is required to start a refit "
+                    "(pass new_Y=None only to resume an interrupted one)")
+        os.makedirs(t_dir, exist_ok=True)
+        save_new_data(d_new, new_Y, new_X, new_units)
+        st = {
+            "phase": "transient", "parent": parent_k, "epoch": k_new,
+            "digest": new_data_digest(new_Y, new_X, new_units),
+            # the adaptive-transient configuration is pinned at refit
+            # start: a resumed refit must replay the same stopping rule
+            "config": {
+                "samples": int(samples if samples is not None
+                               else ck.post.samples),
+                "min_sweeps": int(min_sweeps),
+                "max_sweeps": int(max_sweeps),
+                "probe_every": int(probe_every),
+                "rhat_threshold": float(rhat_threshold),
+                "ess_target": float(ess_target if ess_target is not None
+                                    else 4.0 * int(meta["n_chains"])),
+                "seed": int(seed),
+                "checkpoint_every": (None if checkpoint_every is None
+                                     else int(checkpoint_every)),
+            },
+        }
+        _write_state(d_new, st)
+    else:
+        stored_Y, stored_X, stored_units = load_new_data(d_new)
+        if new_Y is not None:
+            if new_data_digest(new_Y, new_X, new_units) != st["digest"]:
+                raise CheckpointError(
+                    f"{d_new}: an interrupted refit holds DIFFERENT "
+                    "appended rows than the ones passed — resume with "
+                    "new_Y=None, or remove the epoch directory to refit "
+                    "the new rows instead")
+        new_Y, new_X, new_units = stored_Y, stored_X, stored_units
+    cfg = st["config"]
+    if cfg["min_sweeps"] < 1 or cfg["max_sweeps"] < cfg["min_sweeps"] \
+            or cfg["probe_every"] < 1:
+        raise ValueError(
+            f"update_run: need 1 <= min_sweeps <= max_sweeps and "
+            f"probe_every >= 1, got min={cfg['min_sweeps']} "
+            f"max={cfg['max_sweeps']} probe={cfg['probe_every']}")
+
+    hM2 = append_data(hM_parent, new_Y, new_X, new_units)
+    nf_cap = int(meta["nf_cap"])
+
+    # sampler configuration pinned from the parent run (stream-defining)
+    pinned = dict(
+        n_chains=int(meta["n_chains"]),
+        nf_cap=nf_cap,
+        adapt_nf=meta.get("adapt_nf"),
+        updater=meta.get("updater"),
+        dtype=getattr(jnp, meta.get("dtype", "float32")),
+        rng_impl=meta.get("rng_impl"),
+        precision_policy=meta.get("precision_policy"),
+        align_post=False, verbose=verbose,
+    )
+    # carried keys continue the parent's exact stream; a keyless parent
+    # snapshot falls back to a seeded, epoch-decorrelated fresh stream
+    init_keys = ck.keys
+    fresh_seed = (int(meta.get("seed") or 0) + 104729 * k_new
+                  if init_keys is None else meta.get("seed"))
+
+    from ..mcmc.sampler import grow_carry_state, sample_mcmc
+    from ..mcmc.structs import build_spec
+    diag_summary: dict = dict(st.get("diagnostics") or {})
+    transient_sweeps = int(st.get("transient_sweeps") or 0)
+
+    # ---- phase 1: adaptive transient ------------------------------------
+    if st["phase"] == "transient":
+        if not checkpoint_files(t_dir):
+            grown = grow_carry_state(ck.state, hM_parent, hM2,
+                                     seed=cfg["seed"], nf_cap=nf_cap)
+            post_t = sample_mcmc(
+                hM2, samples=cfg["min_sweeps"], transient=0, thin=1,
+                seed=fresh_seed, init_state=grown, init_keys=init_keys,
+                record=("Beta",), checkpoint_every=cfg["probe_every"],
+                checkpoint_path=t_dir, checkpoint_keep=2, **pinned)
+        else:
+            # finish any in-flight probe target first (no-op if complete)
+            post_t = resume_run(hM2, t_dir, verbose=verbose)
+        probes = 0
+        while True:
+            sweeps = int(post_t.samples)
+            diag = RunningDiagnostics(monitor=("Beta",))
+            diag.update({"Beta": np.asarray(post_t["Beta"])})
+            diag_summary = diag.summary()
+            probes += 1
+            log.info(
+                f"refit epoch {k_new}: transient probe at {sweeps} sweeps "
+                f"(rhat_max={diag_summary.get('rhat_max')}, "
+                f"ess_min={diag_summary.get('ess_min')})")
+            if _abort_after == ("transient", probes):
+                raise RefitAborted(
+                    f"aborted after transient probe {probes} (test hook)")
+            if sweeps >= cfg["max_sweeps"] or (
+                    sweeps >= cfg["min_sweeps"]
+                    and _transient_passed(diag_summary,
+                                          cfg["rhat_threshold"],
+                                          cfg["ess_target"])):
+                break
+            post_t = resume_run(
+                hM2, t_dir, verbose=verbose,
+                extra_samples=min(cfg["probe_every"],
+                                  cfg["max_sweeps"] - sweeps))
+        transient_sweeps = int(post_t.samples)
+        st.update(phase="sample", transient_sweeps=transient_sweeps,
+                  diagnostics=diag_summary)
+        _write_state(d_new, st)
+
+    if _abort_after == ("before_sample",):
+        raise RefitAborted("aborted before the sampling phase (test hook)")
+
+    # ---- phase 2: refreshed draws ---------------------------------------
+    if st["phase"] == "sample":
+        if checkpoint_files(d_new):
+            post = resume_run(hM2, d_new, verbose=verbose)
+        else:
+            ck_t = latest_valid_checkpoint(t_dir, hM2)
+            ck_every = cfg["checkpoint_every"]
+            if ck_every is None:
+                ck_every = int(meta.get("checkpoint_every") or 0) \
+                    or cfg["probe_every"]
+            post = sample_mcmc(
+                hM2, samples=cfg["samples"], transient=0,
+                thin=int(meta["thin"]), seed=fresh_seed,
+                init_state=ck_t.state, init_keys=ck_t.keys,
+                record=(tuple(meta["record"]) if meta.get("record")
+                        else None),
+                record_dtype=(getattr(jnp, meta["record_dtype"])
+                              if meta.get("record_dtype") else None),
+                retry_diverged=int(meta.get("retry_diverged", 0)),
+                checkpoint_every=ck_every, checkpoint_path=d_new,
+                checkpoint_keep=int(meta.get("checkpoint_keep", 3)),
+                **pinned)
+        if _abort_after == ("before_commit",):
+            raise RefitAborted("aborted before the epoch commit (test hook)")
+        # ---- phase 3: commit (atomic registry flip) ---------------------
+        commit_epoch(run_dir, k_new, {
+            "parent": parent_k,
+            "ny": int(hM2.ny), "ns": int(hM2.ns),
+            "new_rows": int(np.atleast_2d(np.asarray(new_Y)).shape[0]),
+            "n_chains": int(meta["n_chains"]),
+            "samples": int(cfg["samples"]), "thin": int(meta["thin"]),
+            "transient_sweeps": transient_sweeps,
+            "diagnostics": diag_summary,
+            "spec_sha256": spec_fingerprint(build_spec(hM2, nf_cap)),
+            "data_digest": st["digest"],
+        })
+        st.update(phase="done")
+        _write_state(d_new, st)
+        # the probe transient served its purpose: keep one resume slot,
+        # reclaim the rest (the committed epoch itself is untouched)
+        gc_checkpoints(t_dir, keep=1)
+        # epoch-tagged refit telemetry, appended to the epoch's own stream
+        telem = RunTelemetry(proc=0)
+        telem.emit("run", "refit_commit", epoch=k_new, parent=parent_k,
+                   ny=int(hM2.ny), new_rows=int(np.atleast_2d(
+                       np.asarray(new_Y)).shape[0]),
+                   transient_sweeps=transient_sweeps,
+                   rhat_max=diag_summary.get("rhat_max"),
+                   ess_min=diag_summary.get("ess_min"))
+        telem.attach_sink(events_path(d_new, 0))
+        telem.flush()
+    else:                                    # phase == "done": re-entry
+        from .epochs import load_epoch_posterior
+        post, _, _ = load_epoch_posterior(run_dir, k_new, hM0=hM)
+
+    n_new = int(np.atleast_2d(np.asarray(new_Y)).shape[0])
+    log.info(f"refit epoch {k_new} committed: +{n_new} rows, transient "
+             f"{transient_sweeps} sweeps, {int(post.samples)} refreshed "
+             "draws")
+    return RefitResult(
+        epoch=k_new, post=post, transient_sweeps=transient_sweeps,
+        diagnostics=diag_summary, epoch_dir=d_new, committed=True,
+        wall_s=time.perf_counter() - t0)

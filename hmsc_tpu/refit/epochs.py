@@ -1,0 +1,157 @@
+"""Epoch layout of a streaming-refit run directory.
+
+A refitted run is a sequence of *epochs*: epoch 0 is the original fit (the
+run root's ordinary append-only layout — old directories read as epoch 0
+with no migration), and each ``update_run`` commits one more
+``epoch-<k>/`` subdirectory holding
+
+- ``new-data.npz`` — the appended rows (responses, raw covariate rows,
+  per-level unit labels), persisted FIRST so a resumed refit revalidates
+  against exactly the data the epoch was started with, and so any reader
+  can rebuild the epoch's grown model deterministically
+  (:func:`rebuild_epoch_model` replays the appends on top of epoch 0);
+- ``transient/`` — the adaptive warm-up's probe layout (diagnostic draws,
+  checkpointed so a killed refit resumes its warm-up bit-exactly);
+- the epoch's own shards / state files / manifests — the refreshed
+  posterior on the appended dataset;
+- ``epoch.json`` — the epoch's metadata (parent, shapes, adaptive-
+  transient summary, spec fingerprint).
+
+The run-root ``epochs.json`` registry (:mod:`hmsc_tpu.utils.checkpoint`)
+is the COMMIT point: it is rewritten atomically only after the epoch's
+final manifest and ``epoch.json`` are durable, so a reader resolving
+through the registry can never open a half-written epoch.  Prior epochs
+are immutable and GC-pinned while the registry references them
+(``gc_checkpoints(pin_epochs=...)`` is the explicit unpin).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from ..utils.checkpoint import (CheckpointError, _atomic_savez,
+                                _atomic_write_bytes, committed_epochs,
+                                epoch_dir_path, latest_valid_checkpoint,
+                                read_epoch_registry, write_epoch_registry)
+from .data import append_data
+
+__all__ = ["EPOCH_META_FILE", "NEW_DATA_FILE", "REFIT_STATE_FILE",
+           "save_new_data", "load_new_data", "rebuild_epoch_model",
+           "commit_epoch", "load_epoch_posterior", "epoch_metadata"]
+
+EPOCH_META_FILE = "epoch.json"
+NEW_DATA_FILE = "new-data.npz"
+REFIT_STATE_FILE = "refit-state.json"
+
+
+def save_new_data(epoch_dir: str, new_Y, new_X, new_units) -> str:
+    """Persist one append's rows (atomic): the resumable ground truth the
+    epoch's grown model is rebuilt from."""
+    payload = {"Y": np.asarray(new_Y, dtype=float)}
+    if new_X is not None:
+        payload["X"] = np.asarray(new_X, dtype=float)
+    for name, labels in (new_units or {}).items():
+        payload[f"units:{name}"] = np.asarray([str(u) for u in labels])
+    path = os.path.join(os.fspath(epoch_dir), NEW_DATA_FILE)
+    _atomic_savez(path, payload)
+    return path
+
+
+def load_new_data(epoch_dir: str):
+    """``(new_Y, new_X, new_units)`` back from ``new-data.npz``."""
+    path = os.path.join(os.fspath(epoch_dir), NEW_DATA_FILE)
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            Y = np.asarray(z["Y"])
+            X = np.asarray(z["X"]) if "X" in z.files else None
+            units = {k[6:]: [str(u) for u in z[k]]
+                     for k in z.files if k.startswith("units:")}
+    except (OSError, ValueError, KeyError) as e:
+        raise CheckpointError(
+            f"{path}: unreadable appended-data record "
+            f"({type(e).__name__}: {e}) — the epoch cannot be rebuilt") \
+            from e
+    return Y, X, units
+
+
+def epoch_metadata(run_dir: str, epoch: int) -> dict | None:
+    """The parsed ``epoch.json`` for one epoch (``None`` for epoch 0 or a
+    not-yet-finalised epoch directory)."""
+    p = os.path.join(epoch_dir_path(run_dir, epoch), EPOCH_META_FILE)
+    if not os.path.exists(p):
+        return None
+    with open(p, "rb") as f:
+        return json.loads(f.read().decode())
+
+
+def rebuild_epoch_model(run_dir: str, epoch: int, hM0=None):
+    """The grown :class:`~hmsc_tpu.model.Hmsc` an epoch was fitted on,
+    rebuilt deterministically: epoch 0's model (``hM0``, or the run
+    driver's persisted ``model.json``) plus every committed append up to
+    ``epoch``, replayed through :func:`~hmsc_tpu.refit.data.append_data`
+    (scaling and priors pinned at every step, so the result is exactly the
+    model the refit sampled)."""
+    if hM0 is None:
+        from ..serve.artifact import _rebuild_run_model
+        hM0 = _rebuild_run_model(os.fspath(run_dir))
+    hM = hM0
+    for k in range(1, int(epoch) + 1):
+        d = epoch_dir_path(run_dir, k)
+        if not os.path.isdir(d):
+            raise CheckpointError(
+                f"{run_dir}: epoch {k} directory is missing — the epoch "
+                "chain up to the requested epoch cannot be rebuilt")
+        hM = append_data(hM, *load_new_data(d))
+    return hM
+
+
+def commit_epoch(run_dir: str, epoch: int, info: dict) -> None:
+    """Finalise one refit epoch: write its ``epoch.json``, then atomically
+    flip the run-root registry to include it — the serving layer's epoch
+    resolution observes the flip, never a partial epoch.  Creates the
+    registry (with the implicit epoch-0 entry) on the first refit."""
+    run_dir = os.fspath(run_dir)
+    k = int(epoch)
+    d = epoch_dir_path(run_dir, k)
+    info = dict(info, epoch=k)
+    _atomic_write_bytes(os.path.join(d, EPOCH_META_FILE),
+                        json.dumps(info, sort_keys=True).encode())
+    reg = read_epoch_registry(run_dir)
+    if reg is None:
+        reg = {"epochs": [{"epoch": 0}]}
+    entries = [e for e in reg["epochs"] if int(e["epoch"]) != k]
+    entries.append({"epoch": k,
+                    "dir": os.path.relpath(d, run_dir),
+                    "parent": int(info.get("parent", k - 1)),
+                    "ny": info.get("ny"),
+                    "spec_sha256": info.get("spec_sha256")})
+    reg["epochs"] = entries
+    write_epoch_registry(run_dir, reg)
+
+
+def load_epoch_posterior(run_dir: str, epoch: int | None = None, *,
+                         hM0=None, allow_legacy_pickle: bool = False):
+    """``(posterior, hM, epoch)`` for one committed epoch (default: the
+    newest).  Selection is fully deterministic — the registry picks the
+    epoch by INDEX and the layout picks the manifest by its encoded sample
+    index (never directory mtime), so concurrent refits can never make a
+    reader open a half-written epoch."""
+    run_dir = os.fspath(run_dir)
+    ks = committed_epochs(run_dir)
+    if not ks:
+        raise CheckpointError(f"no committed epochs under {run_dir!r}")
+    k = ks[-1] if epoch is None else int(epoch)
+    if k not in ks:
+        raise CheckpointError(
+            f"{run_dir}: epoch {k} is not committed (committed: {ks})")
+    hM = (rebuild_epoch_model(run_dir, k, hM0) if k > 0
+          else (hM0 if hM0 is not None else None))
+    if hM is None:
+        from ..serve.artifact import _rebuild_run_model
+        hM = _rebuild_run_model(run_dir)
+    ck = latest_valid_checkpoint(epoch_dir_path(run_dir, k), hM,
+                                 allow_legacy_pickle=allow_legacy_pickle)
+    return ck.post, hM, k

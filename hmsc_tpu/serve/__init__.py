@@ -18,7 +18,7 @@ queries at low latency:
 """
 
 from .artifact import (ServingArtifact, compact_posterior, load_artifact,
-                       load_run_posterior)
+                       load_run_posterior, resolve_run_epoch)
 from .engine import DEFAULT_BUCKETS, ServingEngine
 from .kernels import (linear_predictor, make_conditional_kernel,
                       make_predict_kernel)
@@ -26,6 +26,6 @@ from .kernels import (linear_predictor, make_conditional_kernel,
 __all__ = [
     "ServingEngine", "DEFAULT_BUCKETS",
     "ServingArtifact", "compact_posterior", "load_artifact",
-    "load_run_posterior",
+    "load_run_posterior", "resolve_run_epoch",
     "linear_predictor", "make_predict_kernel", "make_conditional_kernel",
 ]

@@ -35,7 +35,8 @@ from ..utils.checkpoint import (CheckpointCorruptError, CheckpointError,
                                 _atomic_write, _crc)
 
 __all__ = ["compact_posterior", "load_artifact", "ServingArtifact",
-           "ARTIFACT_VERSION", "compact_main"]
+           "ARTIFACT_VERSION", "compact_main", "load_run_posterior",
+           "resolve_run_epoch"]
 
 ARTIFACT_VERSION = 1
 _MANIFEST_NAME = "serving.json"
@@ -302,23 +303,61 @@ def _rebuild_run_model(run_dir: str):
     return _model(margs["ny"], margs["ns"], margs["nf"], seed=66)
 
 
-def load_run_posterior(run_dir: str, hM=None, *, mmap: bool = True):
-    """The newest valid posterior under a run directory, rebuilding the
-    model from ``model.json`` when ``hM`` is not given.  Append-layout
-    manifests load as lazily materialised mmap views by default (the
-    serving engine streams each parameter to the device exactly once);
-    corrupt slots fall back like ``latest_valid_checkpoint``.  Returns
-    ``(posterior, hM)``."""
+def resolve_run_epoch(run_dir: str, epoch: int | None = None):
+    """``(epoch, layout_dir)`` for a run directory — fully deterministic
+    selection: committed epochs come from the atomically flipped
+    ``epochs.json`` registry (a mid-flip reader can never see a
+    half-written epoch — the registry rewrite is the refit's LAST step),
+    the newest is the highest epoch INDEX, and within an epoch the
+    manifest ordering is by encoded sample index with manifests outranking
+    legacy snapshots at equal recency.  Directory mtime is never
+    consulted.  A registry-less directory is the single-epoch case:
+    epoch 0, the run root."""
+    from ..utils.checkpoint import committed_epochs, epoch_dir_path
+
+    run_dir = os.fspath(run_dir)
+    ks = committed_epochs(run_dir)
+    if epoch is None:
+        k = ks[-1] if ks else 0
+    else:
+        k = int(epoch)
+        if ks and k not in ks:
+            raise CheckpointError(
+                f"{run_dir}: epoch {k} is not committed "
+                f"(committed: {ks})")
+    return k, epoch_dir_path(run_dir, k)
+
+
+def load_run_posterior(run_dir: str, hM=None, *, mmap: bool = True,
+                       epoch: int | None = None):
+    """The newest COMMITTED posterior under a (possibly epoched) run
+    directory, rebuilding the model from ``model.json`` (plus any
+    committed appends) when ``hM`` is not given.  Epoch selection is
+    deterministic (see :func:`resolve_run_epoch`); within the chosen
+    epoch, append-layout manifests load as lazily materialised mmap views
+    by default (the serving engine streams each parameter to the device
+    exactly once); corrupt slots fall back like
+    ``latest_valid_checkpoint``.  Returns ``(posterior, hM)``."""
     import warnings
 
     from ..utils.checkpoint import (checkpoint_files, load_checkpoint_full,
                                     load_manifest_checkpoint)
 
+    k, layout_dir = resolve_run_epoch(run_dir, epoch)
     if hM is None:
-        hM = _rebuild_run_model(run_dir)
-    cands = checkpoint_files(run_dir)
+        if k > 0:
+            from ..refit.epochs import rebuild_epoch_model
+            hM = rebuild_epoch_model(run_dir, k)
+        else:
+            hM = _rebuild_run_model(run_dir)
+    elif k > 0:
+        # the caller's hM is the epoch-0 model; grow it to the epoch
+        from ..refit.epochs import rebuild_epoch_model
+        hM = rebuild_epoch_model(run_dir, k, hM0=hM)
+    cands = checkpoint_files(layout_dir)
     if not cands:
-        raise CheckpointError(f"no checkpoints found under {run_dir!r}")
+        raise CheckpointError(f"no checkpoints found under {run_dir!r} "
+                              f"(epoch {k})")
     failures = []
     for p in cands:
         try:

@@ -14,13 +14,25 @@ mechanisms keep the device-call count low and the compile count bounded:
   (asserted by ``benchmarks/bench_serving.py`` via the engine's
   compile-cache hit counters).
 - **An LRU compile cache.**  Kernels are keyed by (kind, bucket, static
-  config); entries beyond ``cache_size`` evict least-recently-used.
-  ``stats()["cache"]`` exposes hits/misses — the zero-recompile gate.
+  config, staged shapes); entries beyond ``cache_size`` evict
+  least-recently-used.  ``stats()["cache"]`` exposes hits/misses — the
+  zero-recompile gate.
 - **Micro-batching.**  Concurrent queries are coalesced within a bounded
   window (``coalesce_ms``, or until the largest bucket fills) into ONE
   device call per bucket; results are split back per request.  At 64
   concurrent single-site queries this is one kernel dispatch instead of
   64 (gated ≥5x the serial ``predict()`` path).
+
+**Epoch flips** (streaming refits): everything a query touches — staged
+device arrays, unit lookup tables, model metadata — lives in ONE
+immutable generation object, and :meth:`ServingEngine.reload` swaps the
+engine's reference to it atomically.  A request snapshots the generation
+at submit time and is dispatched against that same generation, so
+in-flight queries finish on the epoch they were validated against while
+new queries see the refreshed posterior; a same-shape flip (refit rows at
+existing units, same draw count) reuses every compiled kernel — zero
+recompiles, asserted by ``tests/test_refit.py``.  ``POST /flip`` exposes
+the reload over HTTP.
 
 Per-request telemetry rides the same :class:`~hmsc_tpu.obs.RunTelemetry`
 machinery as the sampler: ``queue_wait`` / ``pad`` / ``dispatch`` /
@@ -32,6 +44,7 @@ sink next to the artifact — ``python -m hmsc_tpu report`` renders it, and
 from __future__ import annotations
 
 import collections
+import os
 import queue as _queue
 import threading
 import time
@@ -40,7 +53,8 @@ from concurrent.futures import Future
 import numpy as np
 
 from ..obs import RunTelemetry, events_path
-from .artifact import ServingArtifact, load_artifact, load_run_posterior
+from .artifact import (ServingArtifact, load_artifact, load_run_posterior,
+                       resolve_run_epoch)
 from .kernels import make_conditional_kernel, make_predict_kernel
 
 __all__ = ["ServingEngine", "DEFAULT_BUCKETS"]
@@ -51,14 +65,28 @@ _STOP = object()
 
 
 class _Request:
-    __slots__ = ("config", "n_rows", "arrays", "future", "t_submit")
+    __slots__ = ("config", "n_rows", "arrays", "future", "t_submit",
+                 "staged")
 
-    def __init__(self, config, n_rows, arrays, future):
+    def __init__(self, config, n_rows, arrays, future, staged):
         self.config = config          # kernel config key (kind + statics)
         self.n_rows = n_rows
         self.arrays = arrays          # dict of per-row host arrays
         self.future = future
+        self.staged = staged          # the generation it was validated on
         self.t_submit = time.perf_counter()
+
+
+class _Staged:
+    """One immutable serving generation: the staged device arrays plus
+    every piece of model metadata a query resolves against.  Built once
+    per (re)load, swapped atomically — never mutated."""
+
+    __slots__ = ("gen", "epoch", "hM", "artifact", "ns", "nc", "nr",
+                 "n_draws", "fam", "any_probit", "any_normal",
+                 "any_poisson", "level_names", "unit_lut", "new_unit",
+                 "ym_host", "ys_host", "Beta", "sigma", "lams", "etas",
+                 "fam_d", "ym", "ys", "shape_key")
 
 
 class ServingEngine:
@@ -66,10 +94,12 @@ class ServingEngine:
 
     ``source`` is a :class:`~hmsc_tpu.post.Posterior`, a
     :class:`~hmsc_tpu.serve.artifact.ServingArtifact`, or a path (a
-    compacted artifact directory, or a run directory written by
-    ``python -m hmsc_tpu run``).  ``hM`` is required only when ``source``
-    does not carry the model itself (a run-directory path rebuilds it from
-    ``model.json``; an artifact is self-contained for raw-X queries).
+    compacted artifact directory, or a — possibly epoched — run directory
+    written by ``python -m hmsc_tpu run``; the newest COMMITTED epoch is
+    served).  ``hM`` is required only when ``source`` does not carry the
+    model itself (a run-directory path rebuilds it from ``model.json``
+    plus any committed appends; an artifact is self-contained for raw-X
+    queries).
 
     Serving scope (v1): shared-design models (``x_is_list=False``) without
     a reduced-rank term, random levels with unit loadings
@@ -104,7 +134,16 @@ class ServingEngine:
             self.telem.emit("run", "serve_start", buckets=list(self.buckets),
                             coalesce_ms=float(coalesce_ms))
 
-        self._stage(source, hM, int(draw_thin))
+        self._source = source
+        self._hM0 = hM
+        self._draw_thin = int(draw_thin)
+        # serialises reload(): two concurrent flips must not both build
+        # gen N+1 and race the swap (one fully-staged generation would be
+        # silently discarded while _source recorded the other)
+        self._reload_lock = threading.Lock()
+        # the ONE atomically-swapped reference: everything a query touches
+        # hangs off this generation object (see module docstring)
+        self._staged = self._build_staged(source, hM, self._draw_thin, 0)
 
         self._lock = threading.Lock()
         self._cache: collections.OrderedDict = collections.OrderedDict()
@@ -123,20 +162,80 @@ class ServingEngine:
         self._worker.start()
 
     # ------------------------------------------------------------------
+    # generation accessors (the staged snapshot is the source of truth)
+    # ------------------------------------------------------------------
+
+    @property
+    def hM(self):
+        return self._staged.hM
+
+    @property
+    def artifact(self):
+        return self._staged.artifact
+
+    @property
+    def epoch(self):
+        """The served epoch index (``None`` for non-run sources)."""
+        return self._staged.epoch
+
+    @property
+    def generation(self) -> int:
+        """Monotonic reload counter (0 = the initial staging)."""
+        return self._staged.gen
+
+    @property
+    def n_draws(self):
+        return self._staged.n_draws
+
+    @property
+    def ns(self):
+        return self._staged.ns
+
+    @property
+    def nc(self):
+        return self._staged.nc
+
+    @property
+    def nr(self):
+        return self._staged.nr
+
+    @property
+    def level_names(self):
+        return list(self._staged.level_names)
+
+    @property
+    def any_probit(self):
+        return self._staged.any_probit
+
+    @property
+    def any_normal(self):
+        return self._staged.any_normal
+
+    @property
+    def any_poisson(self):
+        return self._staged.any_poisson
+
+    # ------------------------------------------------------------------
     # posterior staging
     # ------------------------------------------------------------------
 
-    def _stage(self, source, hM, draw_thin) -> None:
+    def _build_staged(self, source, hM, draw_thin, gen) -> _Staged:
         import jax.numpy as jnp
 
+        st = _Staged()
+        st.gen = int(gen)
+        st.epoch = None
         if isinstance(source, str) or hasattr(source, "__fspath__"):
-            import os
             p = os.fspath(source)
             if os.path.exists(os.path.join(p, "serving.json")):
                 source = load_artifact(p)
             else:
-                source, hM = load_run_posterior(p, hM)
-        self.hM = hM
+                # resolve ONCE and pin the load to that epoch: a refit
+                # committing between a resolve and the load must not make
+                # the engine serve epoch k+1 while labelling it k
+                st.epoch, _ = resolve_run_epoch(p)
+                source, hM = load_run_posterior(p, hM, epoch=st.epoch)
+        st.hM = hM
 
         if isinstance(source, ServingArtifact):
             meta = source.meta["model"]
@@ -158,17 +257,17 @@ class ServingEngine:
                                    + [f"Eta_{r}" for r in range(len(levels))]
                                    + [f"Lambda_{r}"
                                       for r in range(len(levels))])}
-            self.ns = int(meta["ns"])
-            self.nc = int(meta["nc"])
-            self.fam = np.asarray(meta["distr"], dtype=np.int32)
+            st.ns = int(meta["ns"])
+            st.nc = int(meta["nc"])
+            st.fam = np.asarray(meta["distr"], dtype=np.int32)
             ym = np.asarray(meta["y_scale_m"], dtype=np.float32)
             ys = np.asarray(meta["y_scale_s"], dtype=np.float32)
-            self.level_names = [lv["name"] for lv in levels]
+            st.level_names = [lv["name"] for lv in levels]
             unit_lists = [lv["units"] for lv in levels]
-            self.artifact = source
+            st.artifact = source
         else:                               # a Posterior
             post = source
-            hM = self.hM = post.hM if hM is None else hM
+            hM = st.hM = post.hM if hM is None else hM
             spec = post.spec
             if hM.nc_rrr > 0 or hM.x_is_list:
                 raise NotImplementedError(
@@ -190,29 +289,29 @@ class ServingEngine:
                 # staging loop below
                 pooled[f"Lambda_{r}"] = post.pooled(f"Lambda_{r}",
                                                     thin=draw_thin)
-            self.ns = int(hM.ns)
-            self.nc = int(hM.nc)
-            self.fam = np.asarray(hM.distr[:, 0], dtype=np.int32)
+            st.ns = int(hM.ns)
+            st.nc = int(hM.nc)
+            st.fam = np.asarray(hM.distr[:, 0], dtype=np.int32)
             m, s = hM.y_scale_par
             ym = np.asarray(m, dtype=np.float32)
             ys = np.asarray(s, dtype=np.float32)
-            self.level_names = list(hM.rl_names)
+            st.level_names = list(hM.rl_names)
             unit_lists = [list(hM.pi_names[r]) for r in range(spec.nr)]
-            self.artifact = None
+            st.artifact = None
 
-        self.nr = len(self.level_names)
-        self.n_draws = int(pooled["Beta"].shape[0])
-        self.any_probit = bool((self.fam == 2).any())
-        self.any_normal = bool((self.fam == 1).any())
-        self.any_poisson = bool((self.fam == 3).any())
-        self._ym_host, self._ys_host = ym, ys
+        st.nr = len(st.level_names)
+        st.n_draws = int(pooled["Beta"].shape[0])
+        st.any_probit = bool((st.fam == 2).any())
+        st.any_normal = bool((st.fam == 1).any())
+        st.any_poisson = bool((st.fam == 3).any())
+        st.ym_host, st.ys_host = ym, ys
         # unit label -> Eta row; unknown labels get the appended zero row
         # (index np_r): the mean-field new-unit semantics
-        self._unit_lut = [{str(u): i for i, u in enumerate(us)}
-                          for us in unit_lists]
-        self._new_unit = [len(us) for us in unit_lists]
+        st.unit_lut = [{str(u): i for i, u in enumerate(us)}
+                       for us in unit_lists]
+        st.new_unit = [len(us) for us in unit_lists]
 
-        with self.telem.span("stage", n_draws=self.n_draws):
+        with self.telem.span("stage", n_draws=st.n_draws, gen=st.gen):
             f32 = jnp.float32
 
             def _stage_dtype(a):
@@ -223,12 +322,12 @@ class ServingEngine:
                     return jnp.bfloat16
                 return f32
 
-            self._Beta = jnp.asarray(pooled["Beta"],
-                                     _stage_dtype(pooled["Beta"]))
-            self._sigma = jnp.asarray(pooled["sigma"],
-                                      _stage_dtype(pooled["sigma"]))
+            st.Beta = jnp.asarray(pooled["Beta"],
+                                  _stage_dtype(pooled["Beta"]))
+            st.sigma = jnp.asarray(pooled["sigma"],
+                                   _stage_dtype(pooled["sigma"]))
             lams, etas = [], []
-            for r in range(self.nr):
+            for r in range(st.nr):
                 lam = pooled[f"Lambda_{r}"]
                 if lam.ndim == 4:
                     lam = lam[..., 0]
@@ -238,11 +337,74 @@ class ServingEngine:
                 zero = np.zeros((eta.shape[0], 1, eta.shape[2]), dtype=dt)
                 etas.append(jnp.asarray(np.concatenate([eta, zero],
                                                        axis=1)))
-            self._lams = tuple(lams)
-            self._etas = tuple(etas)
-            self._fam = jnp.asarray(self.fam)
-            self._ym = jnp.asarray(ym)
-            self._ys = jnp.asarray(ys)
+            st.lams = tuple(lams)
+            st.etas = tuple(etas)
+            st.fam_d = jnp.asarray(st.fam)
+            st.ym = jnp.asarray(ym)
+            st.ys = jnp.asarray(ys)
+        # the compile-cache facet of a generation: kernels retrace only
+        # when a staged shape/dtype (or a trace-time static) actually
+        # changed, so a same-shape epoch flip reuses every compiled
+        # kernel — zero recompiles
+        st.shape_key = (
+            (st.nr, st.any_probit, st.any_normal, st.any_poisson),
+        ) + tuple((tuple(a.shape), str(a.dtype))
+                  for a in (st.Beta, st.sigma, *st.lams, *st.etas))
+        return st
+
+    # ------------------------------------------------------------------
+    # epoch flip
+    # ------------------------------------------------------------------
+
+    def reload(self, source=None, *, warmup: bool = True) -> dict:
+        """Hot-reload the served posterior and flip to it atomically.
+
+        ``source=None`` re-resolves the engine's ORIGINAL source — for an
+        epoched run directory that picks up the newest committed epoch
+        (the streaming-refit serving flip); pass an explicit source to
+        re-point the engine.  The new generation is fully staged (and, by
+        default, its predict kernels pre-warmed when the staged shapes
+        changed) BEFORE the swap, so the flip itself is one reference
+        assignment: queries already submitted finish on the old
+        generation, queries submitted after see the new one, and nothing
+        ever observes a half-staged posterior.  Returns a summary dict
+        (old/new epoch, generation, whether shapes changed)."""
+        if self._closed:
+            raise RuntimeError("ServingEngine is closed")
+        import jax.numpy as jnp
+
+        with self._reload_lock:      # one flip at a time: concurrent
+            #                          reloads must not duplicate gen
+            #                          numbers or discard a staged build
+            old = self._staged
+            src = self._source if source is None else source
+            new = self._build_staged(
+                src, self._hM0 if source is None else None,
+                self._draw_thin, old.gen + 1)
+            shapes_changed = new.shape_key != old.shape_key
+            if shapes_changed and warmup:
+                # pre-warm OFF the query path: compile the new shapes'
+                # predict kernels before any query can reach them (counted
+                # as cache misses — they are real compiles — but paid
+                # here, not by the first post-flip query)
+                for b in self.buckets:
+                    fn = self._kernel(new, ("predict", True), b)
+                    args = self._device_args(
+                        new, ("predict", True),
+                        np.zeros((b, new.nc), np.float32),
+                        np.full((new.nr, b), 0, np.int32))
+                    jnp.asarray(fn(*args)[0]).block_until_ready()
+            self._staged = new                  # the atomic flip
+            if source is not None:
+                self._source = source
+                self._hM0 = None
+        self.telem.emit("run", "epoch_flip", gen=new.gen,
+                        old_epoch=old.epoch, epoch=new.epoch,
+                        n_draws=new.n_draws,
+                        shapes_changed=bool(shapes_changed))
+        return {"old_epoch": old.epoch, "epoch": new.epoch,
+                "generation": new.gen, "n_draws": new.n_draws,
+                "shapes_changed": bool(shapes_changed)}
 
     # ------------------------------------------------------------------
     # public API
@@ -262,44 +424,47 @@ class ServingEngine:
         returning the location parameter."""
         if self._closed:
             raise RuntimeError("ServingEngine is closed")
+        st = self._staged            # one generation per request, start to
+        #                              finish — an epoch flip mid-request
+        #                              cannot mix LUTs and arrays
         X = np.atleast_2d(np.asarray(X, dtype=np.float32))
         q = X.shape[0]
-        if X.shape[1] != self.nc:
+        if X.shape[1] != st.nc:
             raise ValueError(
                 f"query X has {X.shape[1]} columns, the model has "
-                f"nc={self.nc} covariates (intercept included)")
-        uidx = np.empty((self.nr, q), dtype=np.int32)
-        for r in range(self.nr):
-            lut, new = self._unit_lut[r], self._new_unit[r]
-            if units is None or self.level_names[r] not in units:
+                f"nc={st.nc} covariates (intercept included)")
+        uidx = np.empty((st.nr, q), dtype=np.int32)
+        for r in range(st.nr):
+            lut, new = st.unit_lut[r], st.new_unit[r]
+            if units is None or st.level_names[r] not in units:
                 uidx[r] = new
             else:
-                labels = units[self.level_names[r]]
+                labels = units[st.level_names[r]]
                 if len(labels) != q:
                     raise ValueError(
-                        f"units[{self.level_names[r]!r}] has {len(labels)} "
+                        f"units[{st.level_names[r]!r}] has {len(labels)} "
                         f"labels for {q} query rows")
                 uidx[r] = [lut.get(str(u), new) for u in labels]
         arrays = {"X": X, "uidx": uidx}
         if Yc is not None:
             Yc = np.atleast_2d(np.asarray(Yc, dtype=np.float32))
-            if Yc.shape != (q, self.ns):
+            if Yc.shape != (q, st.ns):
                 raise ValueError(
-                    f"Yc has shape {Yc.shape}, expected ({q}, {self.ns})")
-            if self.any_poisson:
+                    f"Yc has shape {Yc.shape}, expected ({q}, {st.ns})")
+            if st.any_poisson:
                 raise NotImplementedError(
                     "serving engine v1: conditional prediction conditions "
                     "on probit/normal cells only — Poisson models fall "
                     "back to hmsc_tpu.predict(Yc=...)")
             # to the model's (y-scaled) Z scale, NaNs masked out
-            Ycs = (Yc - self._ym_host[None, :]) / self._ys_host[None, :]
+            Ycs = (Yc - st.ym_host[None, :]) / st.ys_host[None, :]
             mask = (~np.isnan(Ycs)).astype(np.float32)
             arrays["Yc"] = np.nan_to_num(Ycs, nan=0.0).astype(np.float32)
             arrays["mask"] = mask
             config = ("cond", bool(expected), int(mcmc_step))
         else:
             config = ("predict", bool(expected))
-        req = _Request(config, q, arrays, Future())
+        req = _Request(config, q, arrays, Future(), st)
         with self._lock:
             self._n_requests += 1
         self._queue.put(req)
@@ -316,16 +481,17 @@ class ServingEngine:
         ``focal_variable``, answered through the bucketed predict kernels
         (new gradient units serve mean-field).  Returns
         ``{"grid", "mean", "sd"}``."""
-        if self.hM is None:
+        hM = self._staged.hM
+        if hM is None:
             raise ValueError(
                 "gradient queries need the fitted Hmsc model (formula + "
                 "training covariates); construct the engine with hM=")
         from ..predict.gradient import construct_gradient
         from ..utils.formula import design_matrix
 
-        grad = construct_gradient(self.hM, focal_variable,
+        grad = construct_gradient(hM, focal_variable,
                                   non_focal_variables, ngrid=ngrid)
-        Xn, _ = design_matrix(self.hM.x_formula, grad["XDataNew"])
+        Xn, _ = design_matrix(hM.x_formula, grad["XDataNew"])
         out = self.predict(np.asarray(Xn, dtype=np.float32),
                            expected=expected)
         out["grid"] = np.asarray(grad["XDataNew"][focal_variable])
@@ -338,6 +504,7 @@ class ServingEngine:
         dispatch, not a compile.  Returns the number of kernels built."""
         import jax.numpy as jnp
 
+        st = self._staged
         built = 0
         configs = [("predict", bool(expected))]
         if conditional:
@@ -345,21 +512,22 @@ class ServingEngine:
         for config in configs:
             for b in self.buckets:
                 with self._lock:
-                    fresh = (config, b) not in self._cache
-                fn = self._kernel(config, b)
+                    fresh = (config, b, st.shape_key) not in self._cache
+                fn = self._kernel(st, config, b)
                 if fresh:
                     built += 1
                     args = self._device_args(
-                        config, np.zeros((b, self.nc), np.float32),
-                        np.full((self.nr, b), 0, np.int32),
-                        np.zeros((b, self.ns), np.float32),
-                        np.zeros((b, self.ns), np.float32))
+                        st, config, np.zeros((b, st.nc), np.float32),
+                        np.full((st.nr, b), 0, np.int32),
+                        np.zeros((b, st.ns), np.float32),
+                        np.zeros((b, st.ns), np.float32))
                     # force the compile now (block on the result)
                     jnp.asarray(fn(*args)[0]).block_until_ready()
         return built
 
     def stats(self) -> dict:
         """Serving counters + compile-cache stats + span aggregates."""
+        st = self._staged
         with self._lock:
             cache = {"hits": self._hits, "misses": self._misses,
                      "size": len(self._cache),
@@ -369,7 +537,8 @@ class ServingEngine:
                       "device_calls": self._n_device_calls,
                       "rows_served": self._rows_served,
                       "rows_padded": self._rows_padded}
-        return {"n_draws": self.n_draws, "ns": self.ns,
+        return {"n_draws": st.n_draws, "ns": st.ns,
+                "epoch": st.epoch, "generation": st.gen,
                 "buckets": list(self.buckets),
                 "coalesce_ms": self.coalesce_s * 1e3,
                 "cache": cache, **counts,
@@ -405,10 +574,10 @@ class ServingEngine:
     # compile cache
     # ------------------------------------------------------------------
 
-    def _kernel(self, config, bucket: int):
+    def _kernel(self, st, config, bucket: int):
         import jax
 
-        key = (config, int(bucket))
+        key = (config, int(bucket), st.shape_key)
         with self._lock:
             fn = self._cache.get(key)
             if fn is not None:
@@ -420,15 +589,15 @@ class ServingEngine:
         # duplicate build is harmless — last one in wins the cache slot
         if config[0] == "predict":
             raw = make_predict_kernel(
-                nr=self.nr, expected=config[1],
-                any_probit=self.any_probit, any_poisson=self.any_poisson)
+                nr=st.nr, expected=config[1],
+                any_probit=st.any_probit, any_poisson=st.any_poisson)
         else:
             raw = make_conditional_kernel(
-                nr=self.nr, mcmc_step=config[2], expected=config[1],
-                any_probit=self.any_probit, any_normal=self.any_normal)
+                nr=st.nr, mcmc_step=config[2], expected=config[1],
+                any_probit=st.any_probit, any_normal=st.any_normal)
         fn = jax.jit(raw)
-        self.telem.emit("metric", "kernel_build", config=list(map(str, config)),
-                        bucket=int(bucket))
+        self.telem.emit("metric", "kernel_build",
+                        config=list(map(str, config)), bucket=int(bucket))
         with self._lock:
             self._cache[key] = fn
             self._cache.move_to_end(key)
@@ -442,12 +611,12 @@ class ServingEngine:
                 return b
         return self.max_bucket
 
-    def _device_args(self, config, Xpad, uidx, Yc=None, mask=None):
+    def _device_args(self, st, config, Xpad, uidx, Yc=None, mask=None):
         import jax
 
         key = jax.random.key(int(self._rng.integers(0, 2**31 - 1)))
-        base = (self._Beta, self._sigma, self._lams, self._etas, self._fam,
-                self._ym, self._ys, Xpad, uidx)
+        base = (st.Beta, st.sigma, st.lams, st.etas, st.fam_d,
+                st.ym, st.ys, Xpad, uidx)
         if config[0] == "predict":
             return base + (key,)
         return base + (Yc, mask, key)
@@ -479,7 +648,10 @@ class ServingEngine:
                 if nxt is _STOP:
                     stop = True
                     break
-                if nxt.config == item.config:
+                # same kernel config AND same generation: a batch must
+                # never mix requests validated against different epochs
+                if nxt.config == item.config \
+                        and nxt.staged is item.staged:
                     batch.append(nxt)
                     rows += nxt.n_rows
                 else:
@@ -501,6 +673,8 @@ class ServingEngine:
     def _dispatch(self, batch: list) -> None:
         import jax.numpy as jnp
 
+        st = batch[0].staged         # the generation every request in this
+        #                              batch was validated against
         config = batch[0].config
         now = time.perf_counter()
         for req in batch:
@@ -524,28 +698,28 @@ class ServingEngine:
                 n = min(self.max_bucket, total - c0)
                 b = self._bucket_for(n)
                 padded += b - n
-                Xp = np.zeros((b, self.nc), dtype=np.float32)
+                Xp = np.zeros((b, st.nc), dtype=np.float32)
                 Xp[:n] = X[c0:c0 + n]
-                up = np.empty((self.nr, b), dtype=np.int32)
-                up[:] = np.asarray(self._new_unit,
+                up = np.empty((st.nr, b), dtype=np.int32)
+                up[:] = np.asarray(st.new_unit,
                                    dtype=np.int32).reshape(-1, 1) \
-                    if self.nr else 0
+                    if st.nr else 0
                 up[:, :n] = uidx[:, c0:c0 + n]
                 Ycp = maskp = None
                 if conditional:
-                    Ycp = np.zeros((b, self.ns), dtype=np.float32)
+                    Ycp = np.zeros((b, st.ns), dtype=np.float32)
                     Ycp[:n] = Yc[c0:c0 + n]
-                    maskp = np.zeros((b, self.ns), dtype=np.float32)
+                    maskp = np.zeros((b, st.ns), dtype=np.float32)
                     maskp[:n] = mask[c0:c0 + n]
                 calls.append((n, b, Xp, up, Ycp, maskp))
             sp.fields["padded"] = padded
 
         outs = []
         for n, b, Xp, up, Ycp, maskp in calls:
-            fn = self._kernel(config, b)
+            fn = self._kernel(st, config, b)
             with self.telem.span("dispatch", bucket=b, rows=n):
-                mean_d, sd_d = fn(*self._device_args(config, Xp, up, Ycp,
-                                                     maskp))
+                mean_d, sd_d = fn(*self._device_args(st, config, Xp, up,
+                                                     Ycp, maskp))
             with self.telem.span("fetch", bucket=b):
                 outs.append((np.asarray(mean_d)[:n], np.asarray(sd_d)[:n]))
         mean = np.concatenate([m for m, _ in outs], axis=0)

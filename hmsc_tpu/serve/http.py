@@ -12,7 +12,12 @@ Endpoints::
                      "expected": true?, "mcmc_step": 1?}
                     -> {"mean": [[...]], "sd": [[...]], "n_draws": N}
     POST /gradient  {"focal": "x1", "ngrid": 20?, "expected": true?}
-    GET  /healthz   liveness + posterior shape
+    POST /flip      {"source": "<path>"?, "warmup": true?}  — admin: hot-
+                    reload the served posterior and flip to it atomically
+                    (source omitted = re-resolve the engine's run
+                    directory, i.e. pick up the newest committed refit
+                    epoch); in-flight queries finish on the old epoch
+    GET  /healthz   liveness + posterior shape + served epoch/generation
     GET  /statz     engine stats (counters, cache, span aggregates)
     GET  /metrics   Prometheus textfile export (obs.report machinery)
 
@@ -68,6 +73,8 @@ def make_server(engine, host: str = "127.0.0.1", port: int = 0):
             if self.path == "/healthz":
                 self._send(200, {"ok": True, "n_draws": engine.n_draws,
                                  "ns": engine.ns, "nc": engine.nc,
+                                 "epoch": engine.epoch,
+                                 "generation": engine.generation,
                                  "buckets": list(engine.buckets)})
             elif self.path == "/statz":
                 self._send(200, engine.stats())
@@ -101,6 +108,11 @@ def make_server(engine, host: str = "127.0.0.1", port: int = 0):
                         ngrid=int(doc.get("ngrid", 20)),
                         expected=bool(doc.get("expected", True)))
                     out["grid"] = np.asarray(out["grid"])
+                elif self.path == "/flip":
+                    self._send(200, engine.reload(
+                        doc.get("source"),
+                        warmup=bool(doc.get("warmup", True))))
+                    return
                 else:
                     self._send(404,
                                {"error": f"unknown path {self.path!r}"})

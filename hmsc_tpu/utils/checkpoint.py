@@ -40,6 +40,21 @@ in the file name is the designed-for basis of the multi-host checkpoint
 story (one shard stream per process + a coordinated manifest).  The legacy
 self-contained ``ckpt-<n>.npz`` format stays fully readable (and writable
 via ``sample_mcmc(checkpoint_layout="rotating")``) alongside.
+
+Epochs (streaming refits, :mod:`hmsc_tpu.refit`): a run directory may grow
+``epoch-<k>/`` subdirectories, each holding one refit's own append-only
+layout (shards + state files + manifests for the *appended* dataset).  The
+run root is epoch 0 — an old single-epoch directory reads as epoch 0 with
+no migration, and a fresh run writes nothing epoch-related, so the default
+single-epoch layout stays byte-identical to the pre-epoch format.  The
+``epochs.json`` registry at the run root is the epoch COMMIT point: it is
+rewritten atomically after an epoch's final manifest is durable, so a
+reader that resolves epochs through the registry
+(:func:`read_epoch_registry` / ``serve.artifact.resolve_run_epoch``) can
+never observe a half-written epoch.  Committed epochs are immutable and
+GC-pinned: :func:`gc_checkpoints` refuses to reclaim any file a surviving
+epoch's manifest references unless that epoch is explicitly unpinned via
+``pin_epochs=``.
 """
 
 from __future__ import annotations
@@ -69,6 +84,8 @@ __all__ = [
     "CheckpointError", "CheckpointCorruptError",
     "CheckpointSpecMismatchError", "PreemptedRun", "LoadedCheckpoint",
     "CKPT_VERSION", "MANIFEST_VERSION",
+    "EPOCHS_FILE", "EPOCHS_VERSION", "epoch_dir_path", "read_epoch_registry",
+    "write_epoch_registry", "committed_epochs",
 ]
 
 CKPT_VERSION = 2
@@ -89,6 +106,84 @@ _SHARD_RE = re.compile(r"seg-(\d+)-(\d+)-(\d+)(?:-r(\d+))?\.npz")
 # state-<tag>.npz: single-process carry; state-<tag>-p<proc>.npz: one
 # process's chain-slice carry on a multi-process mesh
 _STATE_RE = re.compile(r"state-(t?)(\d+)(?:-p(\d+))?\.npz")
+# streaming refits: epoch-<k>/ subdirectories each hold one refit's own
+# append-only layout; the run root is epoch 0 and epochs.json at the root
+# is the atomic epoch-commit registry
+EPOCHS_FILE = "epochs.json"
+EPOCHS_VERSION = 1
+_EPOCH_DIR_RE = re.compile(r"epoch-(\d+)")
+
+
+# ---------------------------------------------------------------------------
+# epoch registry (streaming refits)
+# ---------------------------------------------------------------------------
+
+def epoch_dir_path(run_dir: str, epoch: int) -> str:
+    """An epoch's layout directory: the run root for epoch 0 (old
+    single-epoch directories read as epoch 0 unchanged), ``epoch-<k>/``
+    for refit epochs."""
+    run_dir = os.fspath(run_dir)
+    k = int(epoch)
+    if k < 0:
+        raise ValueError(f"epoch must be >= 0, got {k}")
+    return run_dir if k == 0 else os.path.join(run_dir, f"epoch-{k}")
+
+
+def read_epoch_registry(run_dir: str) -> dict | None:
+    """The parsed ``epochs.json`` registry, or ``None`` for a single-epoch
+    (pre-refit) run directory.  A malformed registry raises
+    :class:`CheckpointCorruptError` — it is the epoch commit point, so a
+    torn registry must never be silently read as "no epochs"."""
+    path = os.path.join(os.fspath(run_dir), EPOCHS_FILE)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, "rb") as f:
+            reg = json.loads(f.read().decode())
+    except (OSError, ValueError, UnicodeDecodeError) as e:
+        raise CheckpointCorruptError(
+            f"{path}: unreadable epoch registry "
+            f"({type(e).__name__}: {e})") from e
+    if (not isinstance(reg, dict)
+            or reg.get("format") != "hmsc_tpu-epochs"
+            or not isinstance(reg.get("epochs"), list)):
+        raise CheckpointCorruptError(f"{path}: not an hmsc_tpu epoch "
+                                     "registry")
+    if int(reg.get("version", 1)) > EPOCHS_VERSION:
+        raise CheckpointError(
+            f"{path}: epoch registry version {reg['version']} is newer "
+            f"than this package reads (<= {EPOCHS_VERSION}) — upgrade "
+            "hmsc_tpu")
+    for e in reg["epochs"]:
+        if not isinstance(e, dict) or "epoch" not in e:
+            raise CheckpointCorruptError(
+                f"{path}: malformed epoch entry — corrupt registry")
+    return reg
+
+
+def write_epoch_registry(run_dir: str, registry: dict) -> str:
+    """Atomically (re)write the epoch registry — the refit commit point: a
+    kill before the rename leaves the previous registry (and every epoch it
+    lists) fully intact."""
+    registry = dict(registry)
+    registry["format"] = "hmsc_tpu-epochs"
+    registry["version"] = EPOCHS_VERSION
+    registry["epochs"] = sorted(
+        (dict(e) for e in registry.get("epochs", [])),
+        key=lambda e: int(e["epoch"]))
+    path = os.path.join(os.fspath(run_dir), EPOCHS_FILE)
+    _atomic_write_bytes(path, json.dumps(registry, sort_keys=True).encode())
+    return path
+
+
+def committed_epochs(run_dir: str) -> list[int]:
+    """Committed epoch indices for a run directory, oldest first.  A
+    registry-less directory is the single-epoch case: ``[0]`` when it holds
+    any resume candidate, else ``[]``."""
+    reg = read_epoch_registry(run_dir)
+    if reg is None:
+        return [0] if checkpoint_files(run_dir) else []
+    return sorted(int(e["epoch"]) for e in reg["epochs"])
 
 
 class CheckpointError(RuntimeError):
@@ -1253,7 +1348,8 @@ def _layout_bytes(path: str) -> int:
 
 def gc_checkpoints(path: str, keep: int, *, max_age_s: float | None = None,
                    max_bytes: int | None = None,
-                   protect_uncommitted: bool = False) -> None:
+                   protect_uncommitted: bool = False,
+                   pin_epochs=None) -> None:
     """Manifest-driven rotation for the append-only layout (also rotates
     any legacy self-contained snapshots sharing the directory).
 
@@ -1271,7 +1367,30 @@ def gc_checkpoints(path: str, keep: int, *, max_age_s: float | None = None,
     directory other processes append to) additionally spares unreferenced
     shard/state files at or beyond the newest manifest's boundary — a
     peer's durably-written-but-not-yet-committed newest files — and skips
-    the foreign tmp sweep (see :func:`_gc_orphans`)."""
+    the foreign tmp sweep (see :func:`_gc_orphans`).
+
+    Epoched runs (the directory carries an ``epochs.json`` registry):
+    every committed epoch is GC-PINNED by default — rotation and the byte
+    budget apply *within* each epoch's directory (the newest manifest of
+    every epoch always survives, so every committed epoch stays loadable),
+    and shards referenced by any surviving epoch manifest are never
+    reclaimed.  ``pin_epochs=`` is the explicit escape hatch: pass an
+    iterable of epoch indices to pin only those — an UNPINNED epoch's
+    whole layout may then be reclaimed (oldest epoch first) when the
+    ``max_bytes`` budget demands it, and the registry is rewritten without
+    it.  The newest committed epoch is always pinned regardless."""
+    try:
+        reg = read_epoch_registry(path)
+    except CheckpointError:
+        reg = None                 # corrupt registry: fall back to the
+                                   # single-directory policy; never let GC
+                                   # widen the damage by unpinning epochs
+    if reg is not None and reg.get("epochs"):
+        _gc_epoched(path, reg, keep, max_age_s=max_age_s,
+                    max_bytes=max_bytes,
+                    protect_uncommitted=protect_uncommitted,
+                    pin_epochs=pin_epochs)
+        return
     rotate_checkpoints(path, keep, max_age_s=max_age_s)
     _gc_orphans(path, protect_uncommitted=protect_uncommitted)
     if max_bytes is not None:
@@ -1305,6 +1424,76 @@ def gc_checkpoints(path: str, keep: int, *, max_age_s: float | None = None,
                 except OSError:
                     pass
                 _gc_orphans(path, protect_uncommitted=protect_uncommitted)
+
+
+def _epoch_dir_bytes(run_dir: str, k: int) -> int:
+    """One epoch's on-disk footprint for the budget loop: the layout files
+    plus the refit ancillary files (appended data, markers, the probe
+    transient) for ``epoch-<k>/`` subdirectories; the run root counts its
+    layout files only (matching the single-epoch accounting)."""
+    d = epoch_dir_path(run_dir, k)
+    if k == 0:
+        return _layout_bytes(d)
+    total = 0
+    for base, _dirs, fns in os.walk(d):
+        for fn in fns:
+            try:
+                total += os.path.getsize(os.path.join(base, fn))
+            except OSError:
+                pass
+    return total
+
+
+def _reclaim_epoch(run_dir: str, reg: dict, k: int) -> None:
+    """Drop one unpinned epoch: registry first (atomically — a reader can
+    never resolve an epoch whose files are mid-delete), then the files."""
+    reg["epochs"] = [e for e in reg["epochs"] if int(e["epoch"]) != k]
+    write_epoch_registry(run_dir, reg)
+    d = epoch_dir_path(run_dir, k)
+    if k == 0:
+        # the root cannot be removed wholesale: reclaim its layout files
+        # only (model.json / telemetry streams survive)
+        for p in _layout_files(d):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+    else:
+        import shutil
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def _gc_epoched(run_dir: str, reg: dict, keep: int, *,
+                max_age_s: float | None, max_bytes: int | None,
+                protect_uncommitted: bool, pin_epochs) -> None:
+    """Epoch-aware GC (see :func:`gc_checkpoints`): per-epoch rotation with
+    every epoch's newest manifest protected, then a run-level byte budget
+    that may reclaim whole UNPINNED epochs, oldest first, never the
+    newest."""
+    epochs = sorted(int(e["epoch"]) for e in reg["epochs"])
+    pinned = set(epochs) if pin_epochs is None else {int(k)
+                                                    for k in pin_epochs}
+    pinned.add(epochs[-1])           # the newest epoch is always pinned
+    for k in epochs:
+        d = epoch_dir_path(run_dir, k)
+        rotate_checkpoints(d, keep, max_age_s=max_age_s)
+        _gc_orphans(d, protect_uncommitted=protect_uncommitted)
+    if max_bytes is None:
+        return
+    total = sum(_epoch_dir_bytes(run_dir, k) for k in epochs)
+    victims = [k for k in epochs if k not in pinned]
+    while total > max_bytes and victims:
+        k = victims.pop(0)           # oldest unpinned epoch first
+        _reclaim_epoch(run_dir, reg, k)
+        epochs.remove(k)
+        total = sum(_epoch_dir_bytes(run_dir, kk) for kk in epochs)
+    if total > max_bytes:
+        warnings.warn(
+            "checkpoint_max_bytes is below the pinned epochs' combined "
+            "footprint; committed epochs are GC-pinned while referenced, "
+            "so they are kept loadable instead.  Unpin old epochs "
+            "explicitly via gc_checkpoints(pin_epochs=...) to reclaim "
+            "them", RuntimeWarning, stacklevel=3)
 
 
 def latest_valid_checkpoint(path: str, hM, *,

@@ -1,0 +1,113 @@
+"""ESS-per-second A/B of ``precision_policy="auto"`` chains vs f32 chains
+(ISSUE 14 satellite; closes the ROADMAP "perturbed-posterior trade" open
+item).
+
+PR 12 recorded a per-block cast tolerance and a one-sweep draw-stream
+agreement bound (``PRECISION_AGREEMENT_TOL``), but left open whether the
+bf16-perturbed chain *mixes* like the f32 chain — a policy that buys
+bytes by slowing mixing loses the trade.  This suite runs the two chains
+A/B on the same model/seed and compares mixing-quality diagnostics:
+
+- **Geweke z** (early-vs-late window means, pooled chains): both chains
+  must look stationary at the same threshold;
+- **split-R-hat / ESS** (:func:`hmsc_tpu.obs.rhat_ess`): the policy'd
+  chain's minimum Beta ESS must stay within a floor fraction of f32's —
+  ESS per draw is the hardware-independent half of ESS/sec, and per-draw
+  wall is the ledger-gated half (BENCHMARKS "precision"), so together
+  they decide the trade;
+- **ESS/sec** (recorded, informational at CI scale: on the CPU backend
+  bf16 dots are legalised through f32 upcasts, so the wall side is only
+  meaningful on real MXU hardware — re-measure there, ROADMAP).
+
+The tier-1 smoke runs reduced-scale; the ``slow`` variant tightens the
+thresholds at a scale where the diagnostics have power.
+"""
+
+import numpy as np
+import pytest
+
+from hmsc_tpu import sample_mcmc
+from hmsc_tpu.obs import rhat_ess
+
+from util import small_model
+
+pytestmark = pytest.mark.precision
+
+
+def _geweke_max_z(draws, first=0.25, last=0.5):
+    """Max |Geweke z| over parameter entries: early-window vs late-window
+    means, with each window's mean-variance scaled by its EFFECTIVE sample
+    size (Geweke's spectral-density correction, estimated via the repo's
+    autocorrelation-based :func:`effective_size` — a plain var/n would
+    over-reject every autocorrelated-but-stationary chain)."""
+    from hmsc_tpu import effective_size
+
+    x = np.asarray(draws, dtype=float)        # (chains, samples, ...)
+    n = x.shape[1]
+    a, b = x[:, : int(first * n)], x[:, int((1 - last) * n):]
+    za = []
+    for w in (a, b):
+        mean = w.reshape(-1, *w.shape[2:]).mean(axis=0)
+        var = w.reshape(-1, *w.shape[2:]).var(axis=0, ddof=1)
+        ess = np.maximum(np.asarray(effective_size(w), dtype=float), 2.0)
+        za.append((mean, var / ess))
+    (ma, va), (mb, vb) = za
+    z = np.abs(ma - mb) / np.sqrt(np.maximum(va + vb, 1e-12))
+    return float(z.max())
+
+
+def _ab_pair(ny, ns, samples, transient, chains, seed):
+    m = small_model(ny=ny, ns=ns, nc=2, distr="probit",
+                    n_units=max(6, ny // 5), seed=seed)
+    kw = dict(samples=samples, transient=transient, n_chains=chains,
+              seed=seed, nf_cap=2, align_post=False)
+    post_f32 = sample_mcmc(m, **kw)
+    post_auto = sample_mcmc(m, precision_policy="auto", **kw)
+    return post_f32, post_auto
+
+
+def _diag(post):
+    beta = np.asarray(post["Beta"], dtype=float)
+    d = rhat_ess(beta)
+    ess = np.asarray(d["ess"], dtype=float)
+    rhat = np.asarray(d["rhat"], dtype=float)
+    finite = np.isfinite(rhat)
+    run_s = float(post.timing.get("run_s", 0.0)) or 1e-9
+    return {
+        "ess_min": float(ess.min()),
+        "rhat_max": float(rhat[finite].max()),
+        "geweke_max_z": _geweke_max_z(beta),
+        "ess_per_s": float(ess.min()) / run_s,
+    }
+
+
+def _assert_trade(f32, auto, *, ess_floor, geweke_z, rhat_slack):
+    # stationarity: the policy'd chain passes the same Geweke bar as f32
+    assert f32["geweke_max_z"] <= geweke_z, f32
+    assert auto["geweke_max_z"] <= geweke_z, auto
+    # mixing: policy'd ESS within a floor fraction of the f32 chain's
+    assert auto["ess_min"] >= ess_floor * f32["ess_min"], (f32, auto)
+    # convergence: split-R-hat does not degrade beyond estimator noise
+    assert auto["rhat_max"] <= f32["rhat_max"] + rhat_slack, (f32, auto)
+    # the ESS/sec ratio is recorded (the CPU wall side is upcast-penalised
+    # — see the module docstring); it must at least be a real measurement
+    assert auto["ess_per_s"] > 0 and f32["ess_per_s"] > 0
+
+
+def test_precision_auto_ess_ab_smoke():
+    """Tier-1 reduced-scale smoke: the perturbed-posterior trade holds at
+    loose thresholds (the diagnostics are noisy with 2 x 60 draws)."""
+    post_f32, post_auto = _ab_pair(ny=40, ns=5, samples=60, transient=30,
+                                   chains=2, seed=5)
+    f32, auto = _diag(post_f32), _diag(post_auto)
+    _assert_trade(f32, auto, ess_floor=0.35, geweke_z=4.5, rhat_slack=0.5)
+
+
+@pytest.mark.slow
+def test_precision_auto_ess_ab_full():
+    """Full-scale A/B: at 4 x 300 draws the estimators have power — the
+    policy'd chain must mix at parity (ESS floor 0.6, tight Geweke)."""
+    post_f32, post_auto = _ab_pair(ny=120, ns=8, samples=300,
+                                   transient=150, chains=4, seed=5)
+    f32, auto = _diag(post_f32), _diag(post_auto)
+    _assert_trade(f32, auto, ess_floor=0.6, geweke_z=3.5, rhat_slack=0.15)

@@ -1,0 +1,454 @@
+"""Streaming refits (hmsc_tpu/refit): data-append validation, warm-start
+state growth, the adaptive-transient ``update_run`` driver, epoch-aware
+checkpoint GC, deterministic epoch selection, and the serving engine's
+atomic epoch flip.
+
+The acceptance bars under test (ISSUE 14):
+
+- kill -> resume mid-refit produces a final epoch BIT-IDENTICAL to an
+  uninterrupted refit (every phase boundary is a committed, resumable
+  checkpoint and the stopping rule is a deterministic replay);
+- a fresh run in an epoch-0 directory writes nothing epoch-related (the
+  pre-epoch layout is preserved exactly);
+- GC after a refit leaves epoch 0 loadable (epochs are pinned unless
+  explicitly unpinned via ``pin_epochs=``);
+- the serving engine answers queries continuously across an epoch flip
+  with zero failed requests, and a same-shape flip reuses every compiled
+  kernel (zero recompiles).
+"""
+
+import json
+import os
+import shutil
+import threading
+
+import numpy as np
+import pytest
+
+from hmsc_tpu import sample_mcmc, update_run
+from hmsc_tpu.mcmc.sampler import grow_carry_state
+from hmsc_tpu.refit import (RefitAborted, append_data, load_epoch_posterior,
+                            rebuild_epoch_model)
+from hmsc_tpu.serve import ServingEngine
+from hmsc_tpu.serve.artifact import load_run_posterior, resolve_run_epoch
+from hmsc_tpu.utils.checkpoint import (CheckpointError, committed_epochs,
+                                       epoch_dir_path, gc_checkpoints,
+                                       latest_valid_checkpoint,
+                                       read_epoch_registry)
+
+from util import small_model
+
+pytestmark = pytest.mark.refit
+
+
+def _fit(tmpdir, m, samples=8, transient=6, chains=2, seed=1):
+    return sample_mcmc(m, samples=samples, transient=transient,
+                       n_chains=chains, seed=seed, nf_cap=2,
+                       align_post=False, checkpoint_every=4,
+                       checkpoint_path=tmpdir)
+
+
+def _new_rows(m, n=6, seed=9, units=None):
+    rng = np.random.default_rng(seed)
+    X = np.column_stack([np.ones(n), rng.standard_normal(n)])
+    Y = (rng.standard_normal((n, m.ns)) > 0).astype(float)
+    if units is None:
+        units = {"lvl": [f"u{i % 6:02d}" for i in range(n)]}
+    return Y, X, units
+
+
+_REFIT_KW = dict(samples=8, min_sweeps=4, max_sweeps=12, probe_every=4,
+                 rhat_threshold=1.05, ess_target=4.0, seed=0)
+
+
+@pytest.fixture(scope="module")
+def parent(tmp_path_factory):
+    """One fitted parent run, COPIED per test that mutates it."""
+    m = small_model(ny=30, ns=4, nc=2, distr="probit", n_units=6, seed=3)
+    d = os.fspath(tmp_path_factory.mktemp("refit-parent"))
+    _fit(d, m)
+    return m, d
+
+
+def _clone(parent, tmp_path):
+    m, src = parent
+    dst = os.fspath(tmp_path / "run")
+    shutil.copytree(src, dst)
+    return m, dst
+
+
+# ---------------------------------------------------------------------------
+# append_data: validation + pinned scaling
+# ---------------------------------------------------------------------------
+
+def test_append_data_validation(parent):
+    m, _ = parent
+    Y, X, units = _new_rows(m)
+    with pytest.raises(ValueError, match="ns"):
+        append_data(m, Y[:, :2], X, units)
+    with pytest.raises(ValueError, match="new_units"):
+        append_data(m, Y, X, None)
+    with pytest.raises(ValueError, match="unknown level"):
+        append_data(m, Y, X, {"lvl": units["lvl"], "bogus": units["lvl"]})
+    with pytest.raises(ValueError, match="labels"):
+        append_data(m, Y, X, {"lvl": units["lvl"][:-1]})
+    with pytest.raises(ValueError, match="new_X"):
+        append_data(m, Y, X[:, :1], units)
+    bad = Y.copy()
+    bad[0, 0] = 2.0                    # non-binary probit response
+    with pytest.raises(ValueError, match="probit"):
+        append_data(m, bad, X, units)
+
+
+def test_append_data_rejects_new_units_on_spatial_level():
+    m = small_model(ny=24, ns=4, nc=2, distr="probit", n_units=6,
+                    spatial="Full", seed=4)
+    Y, X, _ = _new_rows(m, n=3)
+    with pytest.raises(NotImplementedError, match="spatial"):
+        append_data(m, Y, X, {"lvl": ["u00", "zz1", "u01"]})
+    # rows at EXISTING units of a spatial level are fine
+    grown = append_data(m, Y, X, {"lvl": ["u00", "u01", "u02"]})
+    assert grown.ny == m.ny + 3 and grown.np_[0] == m.np_[0]
+
+
+def test_append_data_pins_scaling_and_grows(parent):
+    m, _ = parent
+    Y, X, units = _new_rows(m, n=5, units={"lvl": ["u00", "u01", "zza",
+                                                   "zzb", "zza"]})
+    Y[0, 1] = np.nan                   # NA-imputed cells allowed
+    grown = append_data(m, Y, X, units)
+    assert grown.ny == m.ny + 5
+    assert grown.ns == m.ns and grown.nc == m.nc and grown.nr == m.nr
+    assert grown.np_[0] == m.np_[0] + 2          # zza, zzb
+    # the training block's scaled design is preserved bit-for-bit, and the
+    # new rows are scaled with the PARENT's recorded parameters
+    np.testing.assert_array_equal(np.asarray(grown.XScaled)[:m.ny],
+                                  np.asarray(m.XScaled))
+    mu, sd = np.asarray(m.x_scale_par)
+    np.testing.assert_allclose(np.asarray(grown.XScaled)[m.ny:],
+                               (X - mu) / sd)
+    np.testing.assert_array_equal(grown.x_scale_par, m.x_scale_par)
+    assert grown.cov_names == m.cov_names
+    assert bool(np.isnan(grown.Y).any())
+    # priors pinned verbatim
+    np.testing.assert_array_equal(grown.V0, m.V0)
+    assert grown.f0 == m.f0
+
+
+# ---------------------------------------------------------------------------
+# grow_carry_state: label-aligned Eta growth, untouched parameter blocks
+# ---------------------------------------------------------------------------
+
+def test_grow_carry_state_label_alignment(parent, tmp_path):
+    m, d = parent
+    ck = latest_valid_checkpoint(d, m)
+    # 'u01a' sorts BETWEEN existing labels -> the new unit order permutes
+    Y, X, units = _new_rows(m, n=4, units={"lvl": ["u00", "u01a", "u01a",
+                                                   "u05"]})
+    grown_m = append_data(m, Y, X, units)
+    st = grow_carry_state(ck.state, m, grown_m, seed=0, nf_cap=2)
+    eta_old = np.asarray(ck.state.levels[0].Eta)
+    eta_new = np.asarray(st.levels[0].Eta)
+    assert eta_new.shape[1] == eta_old.shape[1] + 1
+    for lbl in m.pi_names[0]:
+        i_old = m.pi_names[0].index(lbl)
+        i_new = grown_m.pi_names[0].index(lbl)
+        np.testing.assert_array_equal(eta_new[:, i_new], eta_old[:, i_old])
+    # every parameter block carries over untouched; Z keeps its old rows
+    np.testing.assert_array_equal(np.asarray(st.Beta),
+                                  np.asarray(ck.state.Beta))
+    np.testing.assert_array_equal(np.asarray(st.it),
+                                  np.asarray(ck.state.it))
+    np.testing.assert_array_equal(np.asarray(st.Z)[:, :m.ny],
+                                  np.asarray(ck.state.Z))
+    assert np.asarray(st.Z).shape[1] == grown_m.ny
+    assert np.isfinite(np.asarray(st.Z)).all()
+
+
+def test_grow_carry_state_rejects_structure_changes(parent):
+    m, d = parent
+    ck = latest_valid_checkpoint(d, m)
+    other = small_model(ny=30, ns=5, nc=2, distr="probit", n_units=6,
+                        seed=3)
+    with pytest.raises(ValueError, match="structure"):
+        grow_carry_state(ck.state, m, other, nf_cap=2)
+
+
+# ---------------------------------------------------------------------------
+# update_run: epoch commit, kill/resume bit-identity, data pinning
+# ---------------------------------------------------------------------------
+
+def test_update_run_commits_epoch(parent, tmp_path):
+    m, d = _clone(parent, tmp_path)
+    Y, X, units = _new_rows(m, units={"lvl": ["u00", "u01", "u02", "zz1",
+                                              "zz2", "zz2"]})
+    res = update_run(d, Y, X, units, hM=m, **_REFIT_KW)
+    assert res.epoch == 1 and res.committed
+    assert res.transient_sweeps >= _REFIT_KW["min_sweeps"]
+    assert np.isfinite(res.post["Beta"]).all()
+    assert committed_epochs(d) == [0, 1]
+    reg = read_epoch_registry(d)
+    assert [e["epoch"] for e in reg["epochs"]] == [0, 1]
+    # both epochs load; the refit epoch's model carries the appended rows
+    p0, _, k0 = load_epoch_posterior(d, 0, hM0=m)
+    p1, hM1, k1 = load_epoch_posterior(d, hM0=m)
+    assert (k0, k1) == (0, 1)
+    assert hM1.ny == m.ny + 6 and p1.samples == 8
+    # the refreshed posterior is a NEW draw stream, not the parent's
+    assert not np.array_equal(np.asarray(p1["Beta"]),
+                              np.asarray(p0["Beta"]))
+
+
+def test_update_run_kill_resume_bit_identical(parent, tmp_path):
+    mA, dA = _clone(parent, tmp_path / "A")
+    _, dB = _clone(parent, tmp_path / "B")
+    Y, X, units = _new_rows(mA, units={"lvl": ["u00", "u01", "u02", "zz1",
+                                               "zz2", "zz2"]})
+    kw = dict(_REFIT_KW, hM=mA)
+    update_run(dA, Y, X, units, **kw)
+    # three interruption points: mid-transient, between phases, and after
+    # sampling but before the registry flip
+    for abort in [("transient", 1), ("before_sample",), ("before_commit",)]:
+        with pytest.raises(RefitAborted):
+            update_run(dB, Y, X, units, _abort_after=abort, **kw)
+    res = update_run(dB, hM=mA)        # resume from the persisted rows
+    assert res.epoch == 1
+    pA, _, _ = load_epoch_posterior(dA, 1, hM0=mA)
+    pB, _, _ = load_epoch_posterior(dB, 1, hM0=mA)
+    assert sorted(pA.arrays) == sorted(pB.arrays)
+    for k in pA.arrays:
+        np.testing.assert_array_equal(np.asarray(pA.arrays[k]),
+                                      np.asarray(pB.arrays[k]),
+                                      err_msg=k)
+
+
+def test_update_run_rejects_mismatched_resume_rows(parent, tmp_path):
+    m, d = _clone(parent, tmp_path)
+    Y, X, units = _new_rows(m)
+    with pytest.raises(RefitAborted):
+        update_run(d, Y, X, units, hM=m, _abort_after=("transient", 1),
+                   **_REFIT_KW)
+    other = Y.copy()
+    other[0, 0] = 1.0 - other[0, 0]
+    with pytest.raises(CheckpointError, match="DIFFERENT"):
+        update_run(d, other, X, units, hM=m, **_REFIT_KW)
+
+
+def test_second_epoch_stacks_and_drift_reports(parent, tmp_path):
+    m, d = _clone(parent, tmp_path)
+    Y1, X1, u1 = _new_rows(m, n=4, seed=11,
+                           units={"lvl": ["u00", "u01", "zz1", "zz1"]})
+    update_run(d, Y1, X1, u1, hM=m, **_REFIT_KW)
+    hM1 = rebuild_epoch_model(d, 1, hM0=m)
+    Y2, X2, u2 = _new_rows(hM1, n=3, seed=12,
+                           units={"lvl": ["zz1", "u02", "zz9"]})
+    res2 = update_run(d, Y2, X2, u2, hM=m, **_REFIT_KW)
+    assert res2.epoch == 2
+    p2, hM2, _ = load_epoch_posterior(d, hM0=m)
+    assert hM2.ny == m.ny + 7
+    from hmsc_tpu.obs.report import epoch_drift_report, render_drift
+    drift = epoch_drift_report(d, hM0=m)
+    assert [e["epoch"] for e in drift["epochs"]] == [0, 1, 2]
+    assert len(drift["drift"]) == 2
+    for pair in drift["drift"]:
+        assert pair["params"]["Beta"]["max_z"] >= 0
+    assert "cross-epoch posterior drift" in render_drift(drift)
+
+
+# ---------------------------------------------------------------------------
+# satellite: GC pinning — epochs stay loadable unless explicitly unpinned
+# ---------------------------------------------------------------------------
+
+def test_gc_after_refit_leaves_epoch0_loadable(parent, tmp_path):
+    m, d = _clone(parent, tmp_path)
+    Y, X, units = _new_rows(m)
+    update_run(d, Y, X, units, hM=m, **_REFIT_KW)
+    with pytest.warns(RuntimeWarning, match="pinned"):
+        gc_checkpoints(d, keep=1, max_bytes=1)
+    # the regression: both epochs must still be fully loadable
+    p0, _, _ = load_epoch_posterior(d, 0, hM0=m)
+    p1, _, _ = load_epoch_posterior(d, 1, hM0=m)
+    assert p0.samples == 8 and p1.samples == 8
+    assert committed_epochs(d) == [0, 1]
+
+
+def test_gc_pin_epochs_escape_hatch(parent, tmp_path):
+    m, d = _clone(parent, tmp_path)
+    Y, X, units = _new_rows(m)
+    update_run(d, Y, X, units, hM=m, **_REFIT_KW)
+    # explicitly unpin epoch 0: the byte budget may now reclaim it
+    gc_checkpoints(d, keep=1, max_bytes=1, pin_epochs=[1])
+    assert committed_epochs(d) == [1]
+    with pytest.raises(CheckpointError):
+        load_epoch_posterior(d, 0, hM0=m)
+    # the newest epoch survives any budget (and still loads)
+    p1, _, _ = load_epoch_posterior(d, 1, hM0=m)
+    assert p1.samples == 8
+
+
+def test_fresh_run_writes_nothing_epoch_related(tmp_path):
+    """A fresh single-epoch run keeps the pre-epoch directory layout: no
+    registry, no epoch dirs — byte-identical file set to the pre-refit
+    format."""
+    m = small_model(ny=24, ns=4, nc=2, distr="probit", n_units=6, seed=7)
+    d = os.fspath(tmp_path / "fresh")
+    _fit(d, m, samples=8, transient=4)
+    names = set(os.listdir(d))
+    assert "epochs.json" not in names
+    assert not any(n.startswith("epoch-") for n in names)
+    allowed = ("manifest-", "seg-", "state-", "events-")
+    assert all(n.startswith(allowed) for n in names), names
+    # registry-less GC keeps the plain single-directory policy
+    gc_checkpoints(d, keep=1)
+    assert latest_valid_checkpoint(d, m).post.samples == 8
+
+
+# ---------------------------------------------------------------------------
+# satellite: deterministic epoch/manifest selection (not mtime)
+# ---------------------------------------------------------------------------
+
+def test_epoch_selection_ignores_mtime(parent, tmp_path):
+    m, d = _clone(parent, tmp_path)
+    Y, X, units = _new_rows(m)
+    update_run(d, Y, X, units, hM=m, **_REFIT_KW)
+    # make every epoch-0 file look fresher than the refit: selection must
+    # still pick the higher epoch INDEX
+    for fn in os.listdir(d):
+        p = os.path.join(d, fn)
+        if os.path.isfile(p):
+            os.utime(p, None)
+    k, layout = resolve_run_epoch(d)
+    assert k == 1 and layout.endswith("epoch-1")
+    post, hM = load_run_posterior(d, m)
+    assert hM.ny == m.ny + 6
+    with pytest.raises(CheckpointError, match="not committed"):
+        resolve_run_epoch(d, epoch=5)
+
+
+def test_uncommitted_epoch_is_never_served(parent, tmp_path):
+    m, d = _clone(parent, tmp_path)
+    Y, X, units = _new_rows(m)
+    with pytest.raises(RefitAborted):
+        update_run(d, Y, X, units, hM=m, _abort_after=("before_commit",),
+                   **_REFIT_KW)
+    # the epoch-1 layout exists on disk (fully sampled!) but is not
+    # committed: a mid-flip reader must keep resolving epoch 0
+    assert os.path.isdir(epoch_dir_path(d, 1))
+    k, _ = resolve_run_epoch(d)
+    assert k == 0
+    post, hM = load_run_posterior(d, m)
+    assert hM.ny == m.ny
+
+
+# ---------------------------------------------------------------------------
+# serving: atomic epoch flip, zero failed requests, zero recompiles
+# ---------------------------------------------------------------------------
+
+def test_serving_flip_continuity_and_zero_recompiles(parent, tmp_path):
+    m, d = _clone(parent, tmp_path)
+    X = np.column_stack([np.ones(3),
+                         np.linspace(-1.0, 1.0, 3)]).astype(np.float32)
+    with ServingEngine(d, m, coalesce_ms=1.0) as eng:
+        eng.warmup()
+        assert eng.epoch == 0 and eng.generation == 0
+        r0 = eng.predict(X)
+        misses_before = eng.stats()["cache"]["misses"]
+
+        # same-shape refit: rows at EXISTING units, same draw count
+        Y, Xn, units = _new_rows(m, units={"lvl": ["u00", "u01", "u02",
+                                                   "u03", "u04", "u05"]})
+        update_run(d, Y, Xn, units, hM=m, **_REFIT_KW)
+
+        # hammer the engine from a worker thread across the flip: every
+        # request must succeed, on whichever epoch it was submitted to
+        futures, stop = [], threading.Event()
+
+        def _pound():
+            while not stop.is_set():
+                futures.append(eng.submit(X))
+
+        t = threading.Thread(target=_pound)
+        t.start()
+        out = eng.reload()
+        stop.set()
+        t.join()
+        assert out == {"old_epoch": 0, "epoch": 1, "generation": 1,
+                       "n_draws": eng.n_draws, "shapes_changed": False}
+        r1 = eng.predict(X)
+        for f in futures:
+            res = f.result(timeout=30)
+            assert np.isfinite(res["mean"]).all()
+        # zero recompiles across a same-shape flip: every post-flip query
+        # hit the warmed kernel cache
+        assert eng.stats()["cache"]["misses"] == misses_before
+        assert eng.epoch == 1 and eng.generation == 1
+        # the flip actually changed the served posterior
+        assert not np.allclose(r0["mean"], r1["mean"])
+
+
+def test_http_flip_endpoint(parent, tmp_path):
+    import urllib.request
+
+    from hmsc_tpu.serve.http import make_server
+
+    m, d = _clone(parent, tmp_path)
+    Y, Xn, units = _new_rows(m, units={"lvl": ["u00", "u01", "u02", "u03",
+                                               "u04", "u05"]})
+    with ServingEngine(d, m, coalesce_ms=1.0) as eng:
+        server = make_server(eng, port=0)
+        host, port = server.server_address[:2]
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        try:
+            def _req(path, body=None):
+                if body is None:
+                    r = urllib.request.urlopen(
+                        f"http://{host}:{port}{path}", timeout=30)
+                else:
+                    r = urllib.request.urlopen(urllib.request.Request(
+                        f"http://{host}:{port}{path}",
+                        data=json.dumps(body).encode(),
+                        headers={"Content-Type": "application/json"}),
+                        timeout=30)
+                return json.loads(r.read().decode())
+
+            h0 = _req("/healthz")
+            assert h0["epoch"] == 0 and h0["generation"] == 0
+            update_run(d, Y, Xn, units, hM=m, **_REFIT_KW)
+            flip = _req("/flip", {})
+            assert flip["epoch"] == 1 and flip["old_epoch"] == 0
+            h1 = _req("/healthz")
+            assert h1["epoch"] == 1 and h1["generation"] == 1
+            out = _req("/predict", {"X": [[1.0, 0.3]]})
+            assert np.isfinite(np.asarray(out["mean"])).all()
+            assert _req("/statz")["epoch"] == 1
+        finally:
+            server.shutdown()
+            server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# CLI: python -m hmsc_tpu refit
+# ---------------------------------------------------------------------------
+
+def test_refit_cli_roundtrip(tmp_path, capsys):
+    from hmsc_tpu.bench_cli import run_main
+    from hmsc_tpu.refit.cli import refit_main
+
+    d = os.fspath(tmp_path / "clirun")
+    rc = run_main(["--ny", "24", "--ns", "4", "--nf", "2", "--samples",
+                   "8", "--transient", "4", "--checkpoint-dir", d])
+    assert rc == 0
+    capsys.readouterr()
+    rc = refit_main([d, "--new-rows", "4", "--samples", "8",
+                     "--min-sweeps", "4", "--max-sweeps", "8",
+                     "--probe-every", "4"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert out["epoch"] == 1 and out["finite"]
+    assert out["transient_sweeps"] >= 4 and out["samples"] == 8
+    # the drift report renders for the CLI-produced run (model.json path)
+    from hmsc_tpu.obs.report import report_main
+    assert report_main([d, "--drift"]) == 0
+    drift = capsys.readouterr().out
+    assert "cross-epoch posterior drift" in drift

@@ -96,11 +96,12 @@ def test_compacted_bf16_within_recorded_tolerance(fitted, engine, tmp_path):
         # kernels widen at entry, so predictions still match the recorded
         # tolerance below
         import jax.numpy as jnp
-        assert eng2._Beta.dtype == jnp.bfloat16
-        assert eng2._sigma.dtype == jnp.bfloat16
-        assert all(l.dtype == jnp.bfloat16 for l in eng2._lams)
-        assert all(e.dtype == jnp.bfloat16 for e in eng2._etas)
-        assert eng2._Beta.nbytes * 2 == np.asarray(
+        st2 = eng2._staged
+        assert st2.Beta.dtype == jnp.bfloat16
+        assert st2.sigma.dtype == jnp.bfloat16
+        assert all(l.dtype == jnp.bfloat16 for l in st2.lams)
+        assert all(e.dtype == jnp.bfloat16 for e in st2.etas)
+        assert st2.Beta.nbytes * 2 == np.asarray(
             post.pooled("Beta"), dtype=np.float32).nbytes
         a = engine.predict(X)
         b = eng2.predict(X)
