@@ -272,11 +272,14 @@ def _chaos_summary():
 def _shard_summary():
     """The within-model-sharding digest (`benchmarks/bench_shard.py
     --digest`): 8-shard weak-scaling efficiency, per-device vs replicated
-    state bytes, per-sweep collective counts from the committed comm
+    state bytes, the SITE-axis weak-scaling efficiency and reduced-scale
+    NNGP per-device state gate on the 2D (species x sites) mesh,
+    per-sweep collective counts (1D and 2D) from the committed comm
     ledger, and a reduced-scale many-species state-shrink check — run in
-    a CPU-pinned subprocess on the emulated 8-device mesh, so the
-    trajectory records the model-parallel path even on rounds where the
-    accelerator is unreachable."""
+    a CPU-pinned subprocess on the emulated 8-device mesh.  The digest's
+    `mesh` key records the mesh shape behind every number, so headline
+    AND skip records carry it; the trajectory records the model-parallel
+    path even on rounds where the accelerator is unreachable."""
     import os
     xla = (os.environ.get("XLA_FLAGS", "")
            + " --xla_force_host_platform_device_count=8").strip()

@@ -1,11 +1,12 @@
 """Within-model sharding bench: weak scaling + per-device memory of the
-species-sharded Gibbs sweep on the emulated 8-device CPU mesh.
+species- (and site-) sharded Gibbs sweep on the emulated 8-device CPU
+mesh.
 
-Two gates, both CPU-only (``XLA_FLAGS=--xla_force_host_platform_device_
+Gates, all CPU-only (``XLA_FLAGS=--xla_force_host_platform_device_
 count=8``; no accelerator needed):
 
-1. **Weak scaling** — for shards k in {1, 2, 4, 8} the model grows with
-   the mesh (``ns = ns0 * k``) and the gate is
+1. **Weak scaling (species)** — for shards k in {1, 2, 4, 8} the model
+   grows with the mesh (``ns = ns0 * k``) and the gate is
 
        efficiency_k = k * T_repl(ns0) / T_shard(k, k * ns0) >= 0.70
 
@@ -19,15 +20,26 @@ count=8``; no accelerator needed):
    partitioning, the psum/all_gather collectives, and the full-width RNG
    draws the draw-equality contract costs (see mcmc/partition.py).
 
-2. **Per-device state** — the sharded carry actually shrinks: per-device
+2. **Weak scaling (sites)** — the same contract on the 2D mesh's site
+   axis: rows/units grow with the site extent (``ny = np = ny0 * m`` at
+   fixed ns) on a ``(1, 1, m)`` mesh, gated at the same 0.70.
+
+3. **Per-device state** — the sharded carry actually shrinks: per-device
    placed state bytes <= (1/shards) * replicated + the replicated
    (non-species) remainder, and the compiled sweep's per-device
    ``memory_analysis()`` argument bytes shrink accordingly.  The
-   ``--tenk`` mode runs the acceptance gate: a 10k-species probit JSDM
-   builds, runs >= 2 sweeps on the 8-way mesh, and its per-device peak
-   state bytes are <= 1/4 of the replicated layout.
+   ``--tenk`` mode runs the species acceptance gate: a 10k-species
+   probit JSDM builds, runs >= 2 sweeps on the 8-way mesh, and its
+   per-device peak state bytes are <= 1/4 of the replicated layout.
+   The ``--np5k`` mode runs the SITE acceptance gate: a 5000-unit NNGP
+   spatial JSDM builds, runs >= 2 sweeps sharded over the 8-device
+   ``(1, 2, 4)`` species x sites mesh, and its per-device placed state
+   (incl. Eta) is <= 0.3x the replicated-SITE baseline (same species
+   sharding, site axis replicated) at 4 site shards.
 
-``--digest`` prints one reduced-scale JSON line for bench.py embedding.
+``--digest`` prints one reduced-scale JSON line for bench.py embedding
+(the digest records the mesh shapes it measured on, so the bench.py
+"shard" entry carries them in headline and skip records alike).
 """
 
 from __future__ import annotations
@@ -87,6 +99,36 @@ def _mesh(shards):
                 axis_names=("chains", "species"))
 
 
+def _mesh2(sp, st):
+    import jax
+    from jax.sharding import Mesh
+    return Mesh(np.array(jax.devices()[:sp * st]).reshape(1, sp, st),
+                axis_names=("chains", "species", "sites"))
+
+
+def _nngp_model(n_units, ns, nf, n_neighbours=8, seed=67):
+    """One-row-per-unit NNGP spatial JSDM (the np-dominated class the
+    site axis exists for)."""
+    import pandas as pd
+
+    from hmsc_tpu.model import Hmsc
+    from hmsc_tpu.random_level import (HmscRandomLevel,
+                                       set_priors_random_level)
+    rng = np.random.default_rng(seed)
+    units = [f"u{i:05d}" for i in range(n_units)]
+    xy = pd.DataFrame(rng.uniform(size=(n_units, 2)) * 20, index=units,
+                      columns=["x", "y"])
+    X = np.column_stack([np.ones(n_units), rng.standard_normal(n_units)])
+    Y = X @ (rng.standard_normal((2, ns)) * 0.5) \
+        + rng.standard_normal((n_units, ns))
+    study = pd.DataFrame({"plot": units})
+    rl = HmscRandomLevel(s_data=xy, s_method="NNGP",
+                         n_neighbours=n_neighbours)
+    set_priors_random_level(rl, nf_max=nf, nf_min=nf)
+    return Hmsc(Y=Y, X=X, distr="normal", study_design=study,
+                ran_levels={"plot": rl}, x_scale=False)
+
+
 def _time_sweeps(fn, data, state, key, n_sweeps, reps):
     """Best-of-reps wall for ``n_sweeps`` chained sweep applications
     (compile excluded)."""
@@ -107,13 +149,17 @@ def _time_sweeps(fn, data, state, key, n_sweeps, reps):
     return best
 
 
-def _per_device_state_bytes(state, mesh, spec):
+def _per_device_state_bytes(state, mesh, spec, sites=False):
     """Max per-device bytes of the placed carry (the donated steady-state
-    HBM a real device would hold)."""
+    HBM a real device would hold).  ``sites=True`` places Z/Eta rows over
+    the mesh's site axis too (the 2D layout)."""
     import jax
 
-    from hmsc_tpu.mcmc.partition import STATE_SPECIES_DIMS, place_on_mesh
-    placed = place_on_mesh(state, mesh, spec, "species", STATE_SPECIES_DIMS)
+    from hmsc_tpu.mcmc.partition import (STATE_SITE_DIMS,
+                                         STATE_SPECIES_DIMS, place_on_mesh)
+    placed = place_on_mesh(state, mesh, spec, "species", STATE_SPECIES_DIMS,
+                           site_axis="sites" if sites else None,
+                           site_dims=STATE_SITE_DIMS if sites else None)
     total = 0
     for leaf in jax.tree.leaves(placed):
         if hasattr(leaf, "addressable_shards"):
@@ -158,6 +204,112 @@ def run_weak_scaling(ny, ns0, nf, n_sweeps, reps, shard_counts=(1, 2, 4, 8)):
                      "state_bytes_replicated": state_nbytes(state)})
     out["rows"] = rows
     return out
+
+
+def run_site_weak_scaling(ny0, ns, nf, n_sweeps, reps,
+                          shard_counts=(1, 2, 4, 8)):
+    """Site-axis weak scaling: rows AND units grow with the site extent
+    (one unit per row in :func:`_model`, so ``ny = np = ny0 * m``) at
+    fixed ns on a ``(1, 1, m)`` mesh.  Same device-seconds efficiency
+    contract as the species axis, at the same work-dominated default
+    sizes (the per-unit nf x nf Eta solves and row-block grams are the
+    scaling work; the full-width segment reassembly, psums and the
+    draw-equality full-width RNG are the captured overhead).  The
+    NNGP-CG np gate (:func:`run_np5k`) is deliberately separate: CG's
+    replicated iterate algebra and size-dependent iteration counts are
+    a convergence property, not a sharding overhead, so the memory gate
+    — not this throughput gate — covers that class."""
+    import jax
+
+    from hmsc_tpu.mcmc.structs import state_nbytes
+    from hmsc_tpu.mcmc.sweep import make_sharded_sweep, make_sweep
+
+    out = {"ny0": ny0, "ns": ns, "nf": nf, "n_sweeps": n_sweeps,
+           "axis": "sites"}
+    key = jax.random.key(0, impl="threefry2x32")
+
+    spec0, data0, state0 = _built(_model(ny0, ns, nf), nf)
+    ones = tuple(0 for _ in range(spec0.nr))
+    t_base = _time_sweeps(make_sweep(spec0, None, ones), data0, state0, key,
+                          n_sweeps, reps)
+    out["t_repl_ny0_s"] = round(t_base, 4)
+
+    rows = []
+    for m in shard_counts:
+        spec, data, state = _built(_model(ny0 * m, ns, nf), nf)
+        if m == 1:
+            fn = make_sweep(spec, None, ones)
+            t = _time_sweeps(fn, data, state, key, n_sweeps, reps)
+            per_dev = state_nbytes(state)
+        else:
+            mesh = _mesh2(1, m)
+            fn = make_sharded_sweep(spec, mesh, None, ones)
+            t = _time_sweeps(fn, data, state, key, n_sweeps, reps)
+            per_dev = _per_device_state_bytes(state, mesh, spec,
+                                              sites=True)
+        eff = m * t_base / t
+        rows.append({"site_shards": m, "ny": ny0 * m,
+                     "t_sweeps_s": round(t, 4),
+                     "efficiency": round(eff, 3),
+                     "state_bytes_per_device": per_dev,
+                     "state_bytes_replicated": state_nbytes(state)})
+    out["rows"] = rows
+    return out
+
+
+def run_np5k(sp=2, st=4, n_units=5000, ns=16, nf=2, n_sweeps=2,
+             gate=0.3):
+    """SITE acceptance gate: an ``n_units``-unit NNGP spatial JSDM
+    builds, runs ``n_sweeps`` sweeps sharded over the (1, sp, st)
+    species x sites mesh, and its per-device placed state (incl. Eta)
+    is <= ``gate`` x the replicated-SITE baseline — the same species
+    sharding with the site axis replicated, i.e. exactly what PR 10's
+    v1 layout would hold per device."""
+    import jax
+
+    from hmsc_tpu.mcmc.structs import state_nbytes
+    from hmsc_tpu.mcmc.sweep import make_sharded_sweep
+
+    spec, data, state = _built(_nngp_model(n_units, ns, nf), nf)
+    mesh = _mesh2(sp, st)
+    ones = tuple(0 for _ in range(spec.nr))
+    fn = make_sharded_sweep(spec, mesh, None, ones)
+
+    from hmsc_tpu.mcmc.partition import (DATA_SITE_DIMS, DATA_SPECIES_DIMS,
+                                         STATE_SITE_DIMS,
+                                         STATE_SPECIES_DIMS, place_on_mesh)
+    data_p = place_on_mesh(data, mesh, spec, "species", DATA_SPECIES_DIMS,
+                           x_is_list=spec.x_is_list, site_axis="sites",
+                           site_dims=DATA_SITE_DIMS)
+    state_p = place_on_mesh(state, mesh, spec, "species",
+                            STATE_SPECIES_DIMS, site_axis="sites",
+                            site_dims=STATE_SITE_DIMS)
+    key = jax.random.key(0, impl="threefry2x32")
+
+    t0 = time.perf_counter()
+    st_c = state_p
+    for _ in range(n_sweeps):
+        key, sub = jax.random.split(key)
+        st_c = fn(data_p, st_c, sub)
+    jax.block_until_ready(st_c)
+    wall = time.perf_counter() - t0
+
+    per_dev = _per_device_state_bytes(state, mesh, spec, sites=True)
+    # the replicated-SITE baseline: same species sharding, sites
+    # replicated (the v1 per-device layout this PR exists to beat)
+    base = _per_device_state_bytes(state, mesh, spec, sites=False)
+    finite = all(bool(np.isfinite(np.asarray(x)).all())
+                 for x in jax.tree.leaves(st_c)
+                 if np.issubdtype(np.asarray(x).dtype, np.floating))
+    return {"n_units": n_units, "ns": ns, "nf": nf,
+            "mesh": {"species_shards": sp, "site_shards": st},
+            "n_sweeps": n_sweeps, "wall_s": round(wall, 2),
+            "finite": finite,
+            "state_bytes_replicated": state_nbytes(state),
+            "state_bytes_site_replicated_per_device": base,
+            "state_bytes_per_device": per_dev,
+            "site_shrink": round(per_dev / base, 4),
+            "gate": gate}
 
 
 def run_tenk(shards=8, ny=256, ns=10240, nf=2, n_sweeps=2):
@@ -225,6 +377,17 @@ def main(argv=None):
                     help="also run the 10k-species acceptance gate")
     ap.add_argument("--tenk-ns", type=int, default=10240)
     ap.add_argument("--tenk-ny", type=int, default=256)
+    ap.add_argument("--np5k", action="store_true",
+                    help="also run the 5000-unit NNGP site-axis "
+                         "acceptance gate on the (1, 2, 4) mesh")
+    ap.add_argument("--np5k-units", type=int, default=5000)
+    ap.add_argument("--site-ny0", type=int, default=256,
+                    help="per-shard unit/row count for site weak "
+                         "scaling (unstructured one-unit-per-row "
+                         "model: the per-unit Eta solves are the "
+                         "scaling work; the NNGP class rides the "
+                         "separate --np5k memory gate)")
+    ap.add_argument("--site-ns", type=int, default=8)
     ap.add_argument("--digest", action="store_true",
                     help="reduced-scale single-line JSON digest for "
                          "bench.py embedding")
@@ -240,7 +403,12 @@ def main(argv=None):
         ws = run_weak_scaling(ny=16, ns0=32, nf=args.nf, n_sweeps=4,
                               reps=2, shard_counts=(1, 8))
         tk = run_tenk(ny=64, ns=2048, nf=2, n_sweeps=2)
+        sws = run_site_weak_scaling(ny0=args.site_ny0, ns=args.site_ns,
+                                    nf=2, n_sweeps=2, reps=2,
+                                    shard_counts=(1, 4))
+        npk = run_np5k(n_units=1280, ns=args.site_ns, nf=2, n_sweeps=2)
         row8 = ws["rows"][-1]
+        site4 = sws["rows"][-1]
         # per-sweep collective counts from the committed comm ledger
         from hmsc_tpu.obs.profile import load_ledger
         led = load_ledger() or {"programs": {}}
@@ -248,19 +416,37 @@ def main(argv=None):
                  for m in ("base", "spatial", "rrr", "sel")
                  for e in [led["programs"].get(f"{m}/shard8:sweep", {})]
                  if e.get("collectives")}
+        colls2d = {m: e.get("collectives")
+                   for m in ("base", "spatial", "nngp", "gpp")
+                   for e in [led["programs"].get(f"{m}/shard4x2:sweep",
+                                                 {})]
+                   if e.get("collectives")}
         # same gates as the full run, at reduced scale — the digest's
         # exit code is what bench.py records as gates_ok (sibling
         # convention: bench_chaos/bench_serving exit nonzero on a miss)
         ok = (row8["efficiency"] >= args.eff_gate and tk["finite"]
-              and tk["state_shrink"] <= 0.25)
+              and tk["state_shrink"] <= 0.25
+              and site4["efficiency"] >= args.eff_gate
+              and npk["finite"] and npk["site_shrink"] <= npk["gate"])
         print(json.dumps({
+            # the mesh shapes each number was measured on ride the
+            # digest, so bench.py's headline AND skip records carry them
+            "mesh": {"species_weak_scaling": [1, 1, 8],
+                     "site_weak_scaling": [1, 1, 4],
+                     "np_gate": [1, npk["mesh"]["species_shards"],
+                                 npk["mesh"]["site_shards"]]},
             "efficiency_8shard": row8["efficiency"],
             "state_bytes_per_device": row8["state_bytes_per_device"],
             "state_bytes_replicated": row8["state_bytes_replicated"],
+            "site_efficiency_4shard": site4["efficiency"],
             "collective_counts": colls,
+            "collective_counts_2d": colls2d,
             "reduced_tenk": {"ns": tk["ns"],
                              "state_shrink": tk["state_shrink"],
                              "finite": tk["finite"]},
+            "reduced_np_gate": {"n_units": npk["n_units"],
+                                "site_shrink": npk["site_shrink"],
+                                "finite": npk["finite"]},
         }))
         return 0 if ok else 1
 
@@ -279,6 +465,20 @@ def main(argv=None):
                 print(f"  GATE FAIL: efficiency {row['efficiency']} < "
                       f"{args.eff_gate}")
                 ok = False
+    sws = run_site_weak_scaling(args.site_ny0, args.site_ns, nf=2,
+                                n_sweeps=args.sweeps, reps=args.reps)
+    print(json.dumps(sws, indent=1))
+    for row in sws["rows"]:
+        if row["site_shards"] > 1:
+            shrink = (row["state_bytes_per_device"]
+                      / row["state_bytes_replicated"])
+            print(f"site_shards={row['site_shards']:2d} "
+                  f"ny={row['ny']:6d} eff={row['efficiency']:.3f} "
+                  f"state/device={shrink:.3f}x replicated")
+            if row["efficiency"] < args.eff_gate:
+                print(f"  GATE FAIL: site efficiency "
+                      f"{row['efficiency']} < {args.eff_gate}")
+                ok = False
     if args.tenk:
         tk = run_tenk(ny=args.tenk_ny, ns=args.tenk_ns)
         print(json.dumps(tk, indent=1))
@@ -288,6 +488,16 @@ def main(argv=None):
         if tk["state_shrink"] > 0.25:
             print(f"  GATE FAIL: per-device state {tk['state_shrink']}x "
                   "replicated > 0.25")
+            ok = False
+    if args.np5k:
+        npk = run_np5k(n_units=args.np5k_units, ns=args.site_ns)
+        print(json.dumps(npk, indent=1))
+        if not npk["finite"]:
+            print("  GATE FAIL: non-finite state after 2D sharded sweeps")
+            ok = False
+        if npk["site_shrink"] > npk["gate"]:
+            print(f"  GATE FAIL: per-device state {npk['site_shrink']}x "
+                  f"site-replicated baseline > {npk['gate']}")
             ok = False
     return 0 if ok else 1
 

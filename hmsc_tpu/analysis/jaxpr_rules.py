@@ -177,6 +177,77 @@ def _shard_models():
             for name, fn in base.items()}
 
 
+# 2D (species x sites) audit mesh: the emulated 8 devices reshaped
+SITE_AUDIT_SP = 4
+SITE_AUDIT_ST = 2
+
+
+def _site_shard_models():
+    """Canonical factories for the 2D (species × sites) mesh: ``ns``
+    divides the species extent, and ``ny`` + every level's unit count
+    divide every emulated site extent (2 and 4) — the specs the 2D
+    sharded-sweep audits, the ``shard4x2`` ledger entries, and the
+    site-axis agreement tests in ``tests/test_shard.py`` all trace.
+    Covers the unstructured base class plus all three spatial methods
+    (Full + NNGP + GPP — the np-dominated classes the site axis is
+    for)."""
+    import numpy as np
+    import pandas as pd
+
+    from ..model import Hmsc
+    from ..random_level import HmscRandomLevel, set_priors_random_level
+
+    ny, ns, n_units = 16, SHARD_AUDIT_NS, 8
+
+    def _design(rng):
+        return np.column_stack([np.ones(ny),
+                                rng.standard_normal((ny, 1))])
+
+    def _units():
+        # round-robin: every unit appears, ny divides evenly
+        return [f"u{i % n_units:02d}" for i in range(ny)]
+
+    def _spatial(method, seed, **rl_kw):
+        def build():
+            rng = np.random.default_rng(seed)
+            X = _design(rng)
+            Y = rng.standard_normal((ny, ns))
+            units = _units()
+            s_df = pd.DataFrame(rng.uniform(size=(n_units, 2)) * 4,
+                                index=sorted(set(units)),
+                                columns=["x", "y"])
+            rl = HmscRandomLevel(s_data=s_df, s_method=method, **rl_kw)
+            set_priors_random_level(rl, nf_max=2, nf_min=2)
+            return Hmsc(Y=Y, X=X, distr="normal",
+                        study_design=pd.DataFrame({"lvl": units}),
+                        ran_levels={"lvl": rl})
+        return build
+
+    def base():
+        rng = np.random.default_rng(21)
+        X = _design(rng)
+        Y = (rng.standard_normal((ny, ns)) > 0).astype(float)
+        units = _units()
+        rl = HmscRandomLevel(units=pd.Series(units))
+        set_priors_random_level(rl, nf_max=2, nf_min=2)
+        from ..data.td import random_coalescent_corr
+        Tr = np.column_stack([np.ones(ns), rng.standard_normal(ns)])
+        return Hmsc(Y=Y, X=X, distr="probit",
+                    study_design=pd.DataFrame({"lvl": units}),
+                    ran_levels={"lvl": rl}, Tr=Tr,
+                    C=random_coalescent_corr(ns, rng))
+
+    rngk = np.random.default_rng(23)
+    knots = pd.DataFrame(rngk.uniform(size=(3, 2)) * 4,
+                         columns=["x", "y"])
+    return {
+        "base": base,
+        "spatial": _spatial("Full", 22),
+        "nngp": _spatial("NNGP", 23, n_neighbours=4),
+        "gpp": _spatial("GPP", 24, s_knot=knots),
+    }
+
+
 def _build(hM, nf_cap=2, seed=0):
     from ..precompute import compute_data_parameters
     from ..mcmc.structs import build_model_data, build_spec, build_state
@@ -445,6 +516,27 @@ def build_audit_context(expected_fingerprints=None) -> JaxprAudit:
                                                   _k())
             programs.append(AuditProgram(
                 name=f"sharded_sweep@{mname}@sp{SHARD_AUDIT_DEVICES}",
+                path="hmsc_tpu/mcmc/partition.py",
+                closed=closed, closed_x64=closed_x64, x64_error=err))
+
+        # 2D (species x sites) sharded sweep: the same 8 emulated devices
+        # reshaped to a (1, 4, 2) mesh, per site-capable canonical spec
+        # (base + the three spatial methods) — the committed
+        # `sharded_sweep@*@sp4x2` fingerprints record the 2D collective
+        # sequence additively; the v1 `@sp8` entries above are untouched
+        mesh2 = Mesh(
+            _np.array(jax.devices()[:SHARD_AUDIT_DEVICES]).reshape(
+                1, SITE_AUDIT_SP, SITE_AUDIT_ST),
+            axis_names=("chains", "species", "sites"))
+        for mname, fn in _site_shard_models().items():
+            spec_s, data_s, state_s = _build(fn())
+            sweep_s = make_sharded_sweep(
+                spec_s, mesh2, None, tuple(1 for _ in range(spec_s.nr)))
+            closed, closed_x64, err = _trace_pair(sweep_s, data_s, state_s,
+                                                  _k())
+            programs.append(AuditProgram(
+                name=(f"sharded_sweep@{mname}"
+                      f"@sp{SITE_AUDIT_SP}x{SITE_AUDIT_ST}"),
                 path="hmsc_tpu/mcmc/partition.py",
                 closed=closed, closed_x64=closed_x64, x64_error=err))
 

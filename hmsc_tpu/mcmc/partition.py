@@ -1,33 +1,46 @@
-"""Partition-spec tables and the shard context for the species-sharded
-Gibbs sweep (``shard_map`` over a ``(chains, species)`` device mesh).
+"""Partition-spec tables and the shard context for the sharded Gibbs
+sweep (``shard_map`` over a ``(chains, species)`` or
+``(chains, species, sites)`` device mesh).
 
 PR 8's named block schedule made every Gibbs block a seam; this module is
 the committed answer to "which axis does each array live on" when the
-sweep itself is sharded over the mesh's ``species`` axis:
+sweep itself is sharded over the mesh's ``species`` (and optionally
+``sites``) axes:
 
 - **Spec tables** (:data:`STATE_SPECIES_DIMS`, :data:`DATA_SPECIES_DIMS`,
   :data:`RECORD_SPECIES_DIMS`): the species dimension of every carry /
-  model-data / recorded-sample array, by field name.  Anything not listed
-  is **replicated** over the species axis (Eta and every per-unit array is
-  deliberately replicated in v1 — the site axis is the next frontier).
+  model-data / recorded-sample array, by field name.  Their site-axis
+  counterparts (:data:`STATE_SITE_DIMS`, :data:`DATA_SITE_DIMS`,
+  :data:`RECORD_SITE_DIMS`) name the SAMPLING-ROW / UNIT dimension
+  sharded over the mesh's ``sites`` axis: Z's rows, per-level ``Eta``
+  rows, the (ny,)-shaped row data (Y/Ymask/X/pi_row/x_row), and the
+  NNGP/GPP per-unit structure grids.  Anything not listed in either
+  table is replicated over that axis.
 - :class:`ShardCtx`: the static shard geometry handed to the updaters.
   Inside the ``shard_map`` body every updater sees a *local* spec
-  (``spec.ns == ns_local``) plus this context for the three operations
-  that must know about the mesh:
+  (``spec.ns == ns_local``, ``spec.ny == ny_local`` under site sharding;
+  per-level ``n_units`` stays GLOBAL — unit blocks are sliced
+  explicitly) plus this context for the operations that must know about
+  the mesh:
 
-  * ``psum`` — the explicit cross-species reductions (the factor grams in
-    updateEta, GammaV's ``B`` products, the rho/phylo quadratics, BetaSel
-    likelihood deltas, divergence tracking);
-  * ``gather_sp`` — all-gathers of *small* (O(ns·k)) per-species vectors
-    where bit-identical replicated compute is cheaper than a psum
-    (InvSigma's gamma shape vector, the DA-interweave truncation bounds);
-  * full-width RNG (``uniform`` / ``normal`` / ``slice_sp`` of a
-    full-width draw) — every random draw with a species dimension is
-    drawn at the GLOBAL width with the replicated key and sliced to the
-    local shard.  This keeps each shard's draws independent (a naive
-    local-shape draw would reuse the same key for different species on
-    every device) AND keeps the sharded draw stream equal to the
-    replicated sweep's, so the two programs are comparable draw-by-draw.
+  * ``psum`` / ``psum_site`` / ``psum_all`` — the explicit cross-species
+    reductions (the factor grams in updateEta, GammaV's ``B`` products,
+    the rho/phylo quadratics, BetaSel likelihood deltas) and the
+    cross-SITE reductions (the design grams summing over rows, updateZ's
+    per-species column statistics, the Alpha grid quadratics, divergence
+    tracking ``all_ok`` psum'd over both axes);
+  * ``gather_sp`` / ``gather_site`` — all-gathers of *small* per-species
+    vectors (InvSigma's gamma shape vector, the DA-interweave truncation
+    bounds) and of the (np, nf) ``Eta`` rows wherever a ``Pi`` row
+    gather must read units owned by another site shard (level loadings,
+    ``eta_star``, the NNGP neighbour reads);
+  * full-width RNG (``uniform`` / ``normal`` with a species ``dim``
+    and/or a ``site_dim``) — every random draw with a species or site
+    dimension is drawn at the GLOBAL width with the replicated key and
+    sliced to the local shard.  This keeps each shard's draws
+    independent AND keeps the sharded draw stream equal to the
+    replicated sweep's — on a 2D mesh the equality holds per (species,
+    site) block, so the two programs stay comparable draw-by-draw.
 
 **Tolerance contract** (:data:`SHARD_AGREEMENT_TOL`): the sharded sweep
 targets the replicated sweep's exact draw stream; the only divergence
@@ -35,7 +48,8 @@ sources are the ``psum`` reductions, whose partial-sum order differs from
 the replicated single-dot order by float rounding.  Agreement is
 therefore ULP-level per sweep and drifts slowly with chain length;
 ``tests/test_shard.py`` pins all four canonical specs × {1,2,4,8}
-emulated devices to this tolerance after a fixed sweep count.
+emulated devices (and the spatial canonical specs on the 2D
+species × sites meshes) to this tolerance after a fixed sweep count.
 """
 
 from __future__ import annotations
@@ -43,9 +57,12 @@ from __future__ import annotations
 import dataclasses
 
 __all__ = ["ShardCtx", "STATE_SPECIES_DIMS", "DATA_SPECIES_DIMS",
-           "RECORD_SPECIES_DIMS", "SHARD_AGREEMENT_TOL",
-           "shard_unsupported_reason", "tree_pspecs", "record_pspecs",
+           "RECORD_SPECIES_DIMS", "STATE_SITE_DIMS", "DATA_SITE_DIMS",
+           "RECORD_SITE_DIMS", "SHARD_AGREEMENT_TOL",
+           "shard_unsupported_reason", "site_shard_unsupported_reason",
+           "engaged_site_extent", "tree_pspecs", "record_pspecs",
            "place_on_mesh", "collective_bytes", "nearest_divisor",
+           "nearest_site_divisor",
            "force_emulated_device_count", "COLLECTIVE_PRIMS"]
 
 # tolerance for sharded-vs-replicated state agreement after a few sweeps
@@ -81,6 +98,31 @@ DATA_SPECIES_DIMS = {
 RECORD_SPECIES_DIMS = {
     "Beta": 1, "sigma": 0, "Lambda": 1, "Psi": 1,
 }
+
+# SITE-dimension index per CARRY field: the sampling-row dimension of Z
+# and the unit dimension of every per-level Eta, sharded over the mesh's
+# `sites` axis.  Guarded in tree_pspecs on the dim actually being
+# ny-sized ("row" kind) or that level's n_units ("unit" kind).
+STATE_SITE_DIMS = {"Z": 0, "Eta": 0}
+
+# SITE-dimension index per MODEL-DATA field.  Row data (Y/Ymask/X/
+# pi_row/x_row) shards by sampling row; the NNGP/GPP per-unit structure
+# grids shard by unit so the Vecchia apply / knot solves read local
+# blocks.  Deliberately replicated despite a site-sized dim: unit_count
+# and x_unit (tiny (np,)-shaped, consumed at full width by global
+# statistics), iWg (the Full-method dense precision needs both unit axes
+# — Full solves run replicated under site sharding).
+DATA_SITE_DIMS = {
+    "Y": 0, "Ymask": 0, "X": 0, "pi_row": 0, "x_row": 0,
+    "nn_idx": 0, "nn_coef": 1, "nn_D": 1, "idDg": 1, "idDW12g": 1,
+}
+
+# fields whose site dim is UNIT-sized (guarded against the owning
+# level's n_units); everything else in the site tables is row-sized
+_SITE_UNIT_NAMES = {"Eta", "nn_idx", "nn_coef", "nn_D", "idDg", "idDW12g"}
+
+# site-dimension index per RECORDED-SAMPLE key (per-level Eta rows)
+RECORD_SITE_DIMS = {"Eta": 0}
 
 # collective primitives counted by the static comm ledger and recorded in
 # the sharded jaxpr fingerprints
@@ -118,93 +160,227 @@ def nearest_divisor(n: int, k: int) -> int:
     return min(divs, key=lambda d: (abs(d - k), -d))
 
 
+def nearest_site_divisor(ny: int, np_r, k: int) -> int:
+    """The ``site_shards`` nearest to ``k`` that divides ny AND every
+    level's unit count (a site shard must hold an even block of rows and
+    of each level's units) — i.e. the nearest divisor of their gcd.
+    Used by the non-divisible fallback warning so the user is told a
+    working value, mirroring the species-axis message."""
+    import math
+    g = int(ny)
+    for n in np_r:
+        g = math.gcd(g, int(n))
+    return nearest_divisor(g, k)
+
+
 @dataclasses.dataclass(frozen=True)
 class ShardCtx:
-    """Static geometry of the species sharding, closed over by the
-    updaters inside the ``shard_map`` body.  ``ns`` is the GLOBAL species
-    count (the local spec's ``spec.ns`` is ``ns // n``).
+    """Static geometry of the sharding, closed over by the updaters
+    inside the ``shard_map`` body.  ``ns`` is the GLOBAL species count
+    (the local spec's ``spec.ns`` is ``ns // n``).
+
+    A 2D mesh adds the site axis: ``site_axis``/``m`` name the mesh's
+    second model-parallel axis and its extent, ``ny`` the GLOBAL
+    sampling-row count (the local spec's ``spec.ny`` is ``ny // m``) and
+    ``np_r`` the GLOBAL per-level unit counts (per-level ``n_units``
+    stays GLOBAL in the local spec — unit blocks are sliced explicitly
+    with :meth:`slice_site`).  ``site_axis=None`` (or ``m == 1``) is the
+    committed species-only geometry, byte-identical to every prior
+    release; every site helper is then the identity, so the v1
+    fingerprints are untouched.  ``n == 1`` likewise disables the
+    species collectives (a site-only mesh), keeping replicated values
+    from being multiply-counted over an axis the arrays never shard on.
 
     ``local_rng`` (opt-in, ``sample_mcmc(local_rng=True)``) switches
-    every species-dim random draw from the default full-width-and-slice
-    scheme to a LOCAL draw: the shard index is folded into the block's
-    key (distinct streams per shard by construction) and only
-    ``ns_local``-wide randoms are generated.  This trades the
-    replicated-draw equality contract — the sharded stream no longer
-    equals the replicated sweep's, so sharded-vs-replicated agreement
-    only holds in distribution — for O(ns_local) draw cost (the
-    full-width draws are the main weak-scaling overhead at RNG-bound
-    sizes).  Determinism is unchanged: the same mesh/seed reproduces the
-    same stream, and kill -> resume stays bit-identical
+    every species/site-dim random draw from the default
+    full-width-and-slice scheme to a LOCAL draw: the shard index of each
+    axis the drawn array actually shards over is folded into the block's
+    key (distinct streams per shard by construction, identical streams
+    across shards for dims the array replicates) and only local-width
+    randoms are generated.  This trades the replicated-draw equality
+    contract — the sharded stream no longer equals the replicated
+    sweep's, so sharded-vs-replicated agreement only holds in
+    distribution — for O(local) draw cost (the full-width draws are the
+    main weak-scaling overhead at RNG-bound sizes).  Determinism is
+    unchanged: the same mesh/seed reproduces the same stream, and
+    kill -> resume stays bit-identical — which is why resume pins BOTH
+    shard counts of the mesh tuple
     (``tests/test_shard.py::test_local_rng_resume_roundtrip``)."""
     axis: str                   # mesh axis name ("species")
-    n: int                      # number of shards
+    n: int                      # number of species shards
     ns: int                     # GLOBAL species count
     local_rng: bool = False     # fold shard index, draw at local width
+    site_axis: str | None = None  # second mesh axis ("sites"), if any
+    m: int = 1                  # number of site shards
+    ny: int = 0                 # GLOBAL sampling-row count (site mode)
+    np_r: tuple = ()            # GLOBAL per-level unit counts (site mode)
 
     @property
     def ns_local(self) -> int:
         return self.ns // self.n
+
+    @property
+    def has_sites(self) -> bool:
+        return self.site_axis is not None and self.m > 1
+
+    @property
+    def ny_local(self) -> int:
+        return self.ny // self.m
 
     # -- traced helpers -------------------------------------------------
     def offset(self):
         import jax
         return jax.lax.axis_index(self.axis) * self.ns_local
 
+    def site_offset(self, size: int):
+        """This site shard's block start within a ``size``-long global
+        dimension (rows or a level's units — both divide evenly)."""
+        import jax
+        return jax.lax.axis_index(self.site_axis) * (int(size) // self.m)
+
     def slice_sp(self, x, dim: int):
         """This shard's species block of a full-width array."""
         import jax
+        if self.n == 1:
+            return x
         return jax.lax.dynamic_slice_in_dim(x, self.offset(), self.ns_local,
                                             axis=dim)
 
+    def slice_site(self, x, dim: int):
+        """This shard's site block of a full-width array (rows or
+        units: the local width is ``x.shape[dim] // m``)."""
+        import jax
+        if not self.has_sites:
+            return x
+        width = int(x.shape[dim]) // self.m
+        return jax.lax.dynamic_slice_in_dim(
+            x, self.site_offset(x.shape[dim]), width, axis=dim)
+
     def psum(self, x):
         import jax
+        if self.n == 1:
+            return x
         return jax.lax.psum(x, self.axis)
+
+    def psum_site(self, x):
+        """Cross-SITE reduction (identity on a species-only mesh)."""
+        import jax
+        if not self.has_sites:
+            return x
+        return jax.lax.psum(x, self.site_axis)
+
+    def psum_all(self, x):
+        """Reduction over every model-parallel axis the mesh shards on
+        (one fused collective on a 2D mesh; exactly :meth:`psum` on the
+        committed species-only geometry)."""
+        import jax
+        axes = (() if self.n == 1 else (self.axis,)) \
+            + ((self.site_axis,) if self.has_sites else ())
+        if not axes:
+            return x
+        return jax.lax.psum(x, axes[0] if len(axes) == 1 else axes)
+
+    def pmax_site(self, x):
+        import jax
+        if not self.has_sites:
+            return x
+        return jax.lax.pmax(x, self.site_axis)
+
+    def pmin_site(self, x):
+        import jax
+        if not self.has_sites:
+            return x
+        return jax.lax.pmin(x, self.site_axis)
 
     def gather_sp(self, x, dim: int):
         """Full-width reassembly of a species-sharded array (tiled
         all-gather: shard i lands at block i, exactly the replicated
         layout)."""
         import jax
+        if self.n == 1:
+            return x
         return jax.lax.all_gather(x, self.axis, axis=dim, tiled=True)
 
+    def gather_site(self, x, dim: int):
+        """Full-width reassembly of a site-sharded array — the explicit
+        ``Pi`` row-gather collective: Eta rows (and the NNGP structure
+        grids on the dense path) reassemble to the replicated layout
+        wherever a row-indexed read may cross site shards."""
+        import jax
+        if not self.has_sites:
+            return x
+        return jax.lax.all_gather(x, self.site_axis, axis=dim, tiled=True)
+
     def all_ok(self, ok):
-        """Cross-shard AND of a boolean (divergence tracking)."""
+        """Cross-shard AND of a boolean (divergence tracking), psum'd
+        over BOTH mesh axes on a 2D mesh — a NaN on any (species, site)
+        block must mark the chain on every shard."""
         import jax.numpy as jnp
         bad = jnp.where(ok, 0, 1).astype(jnp.int32)
-        return self.psum(bad) == 0
+        return self.psum_all(bad) == 0
 
-    # -- species-dim RNG ------------------------------------------------
+    # -- species/site-dim RNG -------------------------------------------
     # default: drawn at the GLOBAL width with the replicated key and
     # sliced (replicated-draw equality); local_rng: shard-folded key,
-    # local width (O(ns_local) draw cost, streams differ from replicated)
+    # local width (O(local) draw cost, streams differ from replicated)
     def fold(self, key):
-        """The shard-local key for ``local_rng`` draws: the mesh axis
-        index folded into the replicated key."""
+        """The shard-local key for species-dim ``local_rng`` draws: the
+        species axis index folded into the replicated key."""
         import jax
         return jax.random.fold_in(key, jax.lax.axis_index(self.axis))
+
+    def fold_site(self, key):
+        """The shard-local key for site-dim ``local_rng`` draws (offset
+        past the species index range so a (species, site) pair never
+        collides with a pure species fold)."""
+        import jax
+        return jax.random.fold_in(
+            key, self.n + jax.lax.axis_index(self.site_axis))
 
     def local_shape(self, shape, dim: int) -> tuple:
         """``shape`` with the species dimension cut to this shard."""
         shape = tuple(shape)
         return shape[:dim] + (self.ns_local,) + shape[dim + 1:]
 
-    def uniform(self, key, shape, dtype, dim: int, **kw):
-        import jax
-        if self.local_rng:
-            return jax.random.uniform(self.fold(key),
-                                      self.local_shape(shape, dim),
-                                      dtype=dtype, **kw)
-        return self.slice_sp(jax.random.uniform(key, shape, dtype=dtype,
-                                                **kw), dim)
+    def _local_rng_draw(self, draw, key, shape, dim, site_dim, **kw):
+        shp = tuple(shape)
+        if dim is not None and self.n > 1:
+            key = self.fold(key)
+            shp = self.local_shape(shp, dim)
+        if site_dim is not None and self.has_sites:
+            key = self.fold_site(key)
+            shp = shp[:site_dim] + (shp[site_dim] // self.m,) \
+                + shp[site_dim + 1:]
+        return draw(key, shp, **kw)
 
-    def normal(self, key, shape, dtype, dim: int):
+    def _sliced_draw(self, draw, key, shape, dim, site_dim, **kw):
+        x = draw(key, tuple(shape), **kw)
+        if dim is not None:
+            x = self.slice_sp(x, dim)
+        if site_dim is not None:
+            x = self.slice_site(x, site_dim)
+        return x
+
+    def uniform(self, key, shape, dtype, dim: int | None,
+                site_dim: int | None = None, **kw):
         import jax
+
+        def draw(k, s, **kw2):
+            return jax.random.uniform(k, s, dtype=dtype, **kw2)
         if self.local_rng:
-            return jax.random.normal(self.fold(key),
-                                     self.local_shape(shape, dim),
-                                     dtype=dtype)
-        return self.slice_sp(jax.random.normal(key, shape, dtype=dtype),
-                             dim)
+            return self._local_rng_draw(draw, key, shape, dim, site_dim,
+                                        **kw)
+        return self._sliced_draw(draw, key, shape, dim, site_dim, **kw)
+
+    def normal(self, key, shape, dtype, dim: int | None,
+               site_dim: int | None = None):
+        import jax
+
+        def draw(k, s):
+            return jax.random.normal(k, s, dtype=dtype)
+        if self.local_rng:
+            return self._local_rng_draw(draw, key, shape, dim, site_dim)
+        return self._sliced_draw(draw, key, shape, dim, site_dim)
 
 
 def shard_unsupported_reason(spec, updater: dict | None) -> str | None:
@@ -224,6 +400,55 @@ def shard_unsupported_reason(spec, updater: dict | None) -> str | None:
     return None
 
 
+def site_shard_unsupported_reason(spec, updater: dict | None) -> str | None:
+    """Why this model class cannot shard the SITE axis (on top of every
+    species-axis reason), or ``None`` when eligible.  The sampler falls
+    back to species-only sharding with a warning; ``shard_sweep=True``
+    makes it an error."""
+    reason = shard_unsupported_reason(spec, updater)
+    if reason is not None:
+        return reason
+    if spec.x_is_list:
+        return ("per-species design matrices have no site-sharded row "
+                "layout")
+    if spec.ncsel > 0 or spec.nc_rrr > 0:
+        return ("the selection/RRR effective-design updaters have no "
+                "site-sharded formulation")
+    if any(ls.x_dim > 0 for ls in spec.levels):
+        return ("covariate-dependent random levels (xDim > 0) keep "
+                "per-unit designs the site axis cannot block")
+    return None
+
+
+def engaged_site_extent(spec, mesh, species_axis: str = "species",
+                        site_axis: str = "sites", updater: dict | None = None,
+                        has_policy: bool = False) -> int:
+    """The site-shard extent the sampler WOULD engage for this model on
+    this mesh — 1 whenever any of its fallbacks fire (no/extent-1 site
+    axis, missing species axis, a species-axis divisibility fallback
+    dragging the sites down with it, non-divisible ny/unit counts, a
+    site-ineligible model class, or an active precision policy).  The
+    decision mirror of ``sample_mcmc``'s site gating, used by
+    ``resume_run``'s local_rng mesh-tuple pinning so a continuation on a
+    mesh that falls back identically is not falsely rejected."""
+    axes = getattr(mesh, "axis_names", ()) if mesh is not None else ()
+    if site_axis not in axes or species_axis not in axes:
+        return 1
+    m = int(mesh.shape[site_axis])
+    if m < 2:
+        return 1
+    sp_ext = int(mesh.shape[species_axis])
+    if sp_ext > 1 and spec.ns % sp_ext:
+        return 1                  # species fallback replicates sites too
+    if spec.ny % m or any(ls.n_units % m for ls in spec.levels):
+        return 1
+    if site_shard_unsupported_reason(spec, updater) is not None:
+        return 1
+    if has_policy:
+        return 1
+    return m
+
+
 def _leaf_name(path) -> str | None:
     for p in reversed(path):
         n = getattr(p, "name", None)
@@ -235,11 +460,31 @@ def _leaf_name(path) -> str | None:
     return None
 
 
+def _level_index(path) -> int | None:
+    """The ``levels[r]`` tuple index along a tree path, if any (the
+    site-dim guards need the owning level's unit count)."""
+    prev_levels = False
+    for p in path:
+        if prev_levels:
+            idx = getattr(p, "idx", None)
+            return int(idx) if idx is not None else None
+        n = getattr(p, "name", None)
+        if n is None:
+            k = getattr(p, "key", None)
+            n = k if isinstance(k, str) else None
+        prev_levels = n == "levels"
+    return None
+
+
 def tree_pspecs(tree, spec, species_axis: str, dims: dict,
-                lead: str | None = None, x_is_list: bool = False):
+                lead: str | None = None, x_is_list: bool = False,
+                site_axis: str | None = None, site_dims: dict | None = None):
     """Per-leaf ``PartitionSpec`` pytree for a state/data tree: optional
     leading chain axis, species dims from ``dims`` (guarded on the dim
-    actually being ``spec.ns``-sized), everything else replicated."""
+    actually being ``spec.ns``-sized), site dims from ``site_dims`` when
+    a ``site_axis`` is given (guarded on the dim being ``spec.ny``-sized
+    for row arrays / the owning level's ``n_units`` for unit arrays),
+    everything else replicated."""
     import jax
     from jax.sharding import PartitionSpec as P
 
@@ -258,18 +503,34 @@ def tree_pspecs(tree, spec, species_axis: str, dims: dict,
         if d is not None and d + off < leaf.ndim \
                 and leaf.shape[d + off] == spec.ns:
             ax[d + off] = species_axis
+        if site_axis is not None and site_dims is not None:
+            ds = site_dims.get(name)
+            if name == "X" and x_is_list:
+                ds = None          # (ns, ny, nc) lists are site-gated off
+            if ds is not None and ds + off < leaf.ndim:
+                if name in _SITE_UNIT_NAMES:
+                    r = _level_index(path)
+                    want = (spec.levels[r].n_units
+                            if r is not None and r < len(spec.levels)
+                            else -1)
+                else:
+                    want = spec.ny
+                if leaf.shape[ds + off] == want and ax[ds + off] is None:
+                    ax[ds + off] = site_axis
         return P(*ax)
 
     return jax.tree_util.tree_map_with_path(one, tree)
 
 
-def record_pspecs(chain_axis: str, species_axis: str):
+def record_pspecs(chain_axis: str, species_axis: str,
+                  site_axis: str | None = None):
     """``name, rank -> PartitionSpec`` resolver for the runner's
     recorded-sample leaves: leading (chain, sample) axes then
-    :data:`RECORD_SPECIES_DIMS` (per-level names like ``Lambda_0``
-    resolve through their base name).  The caller enumerates the record
-    dict's keys/ranks (the runner abstract-evals ``record_sample`` with
-    its ``record=`` filter applied) and maps each through this."""
+    :data:`RECORD_SPECIES_DIMS` / :data:`RECORD_SITE_DIMS` (per-level
+    names like ``Lambda_0`` resolve through their base name).  The
+    caller enumerates the record dict's keys/ranks (the runner
+    abstract-evals ``record_sample`` with its ``record=`` filter
+    applied) and maps each through this."""
     from jax.sharding import PartitionSpec as P
 
     def spec_for(name, rank):
@@ -280,12 +541,17 @@ def record_pspecs(chain_axis: str, species_axis: str):
         d = RECORD_SPECIES_DIMS.get(base)
         if d is not None:
             ax[d + 2] = species_axis
+        if site_axis is not None:
+            ds = RECORD_SITE_DIMS.get(base)
+            if ds is not None and ax[ds + 2] is None:
+                ax[ds + 2] = site_axis
         return P(*ax)
     return spec_for
 
 
 def place_on_mesh(tree, mesh, spec, species_axis: str, dims: dict,
-                  lead: str | None = None, x_is_list: bool = False):
+                  lead: str | None = None, x_is_list: bool = False,
+                  site_axis: str | None = None, site_dims: dict | None = None):
     """Device-put a tree onto the mesh according to its spec table (the
     eager counterpart of the in_specs the sharded runner uses, so the
     first segment pays no resharding)."""
@@ -293,7 +559,8 @@ def place_on_mesh(tree, mesh, spec, species_axis: str, dims: dict,
     from jax.sharding import NamedSharding
 
     specs = tree_pspecs(tree, spec, species_axis, dims, lead=lead,
-                        x_is_list=x_is_list)
+                        x_is_list=x_is_list, site_axis=site_axis,
+                        site_dims=site_dims)
 
     def put(leaf, ps):
         if not hasattr(leaf, "ndim"):

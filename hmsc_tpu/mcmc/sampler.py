@@ -447,7 +447,7 @@ def grow_carry_state(state, hM_old: Hmsc, hM_new: Hmsc, *, seed: int = 0,
 def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin,
                      skip_init_z, record=None, nngp_dense_max=None,
                      mesh=None, chain_axis="chains", species_axis="species",
-                     precision=None, local_rng=False):
+                     precision=None, local_rng=False, site_axis="sites"):
     """One jitted chain-vmapped sampling program per static config.
 
     Keyed on the hashable (spec, updater toggles, scan lengths) so repeated
@@ -479,31 +479,50 @@ def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin,
     sharded sweep's species-dim draws to shard-local streams (see
     :class:`~hmsc_tpu.mcmc.partition.ShardCtx`).
 
-    ``mesh`` with a ``species_axis`` engages the SPECIES-SHARDED runner:
-    the whole chain-vmapped program is wrapped in ``shard_map`` over the
-    mesh with the in/out PartitionSpecs from :mod:`~hmsc_tpu.mcmc.
-    partition`, each Gibbs block runs on its local species columns with
-    explicit collectives at the cross-species reductions, and the donated
-    carry stays sharded (per-device state ~1/shards).  ``mesh=None`` (or a
-    chains-only mesh) is the historical replicated program, trace-
-    identical to every prior release (the committed fingerprints pin it)."""
+    ``mesh`` with a ``species_axis`` (and optionally a ``site_axis``)
+    engages the SHARDED runner: the whole chain-vmapped program is
+    wrapped in ``shard_map`` over the mesh with the in/out
+    PartitionSpecs from :mod:`~hmsc_tpu.mcmc.partition`, each Gibbs
+    block runs on its local species columns (and, on a 2D mesh, its
+    local row/unit blocks — Z and Eta rows shard over sites) with
+    explicit collectives at the cross-species and cross-site reductions,
+    and the donated carry stays sharded (per-device state ~1/shards per
+    engaged axis).  ``mesh=None`` (or a chains-only mesh) is the
+    historical replicated program, trace-identical to every prior
+    release (the committed fingerprints pin it)."""
     updater = dict(updater_items) if updater_items else None
     shard = None
     spec_run = spec
+    n_st = 1
     if mesh is not None and species_axis in getattr(mesh, "axis_names", ()):
         import dataclasses as _dc
 
         from .partition import ShardCtx
         n_sp = int(mesh.shape[species_axis])
-        if n_sp > 1:
+        if site_axis in getattr(mesh, "axis_names", ()):
+            n_st = int(mesh.shape[site_axis])
+        if n_sp > 1 or n_st > 1:
             if spec.ns % n_sp:
                 raise ValueError(
                     f"ns={spec.ns} is not divisible by the mesh's "
                     f"'{species_axis}' extent ({n_sp}); the sampler should "
                     "have fallen back to replication")
+            if n_st > 1 and (spec.ny % n_st
+                             or any(ls.n_units % n_st
+                                    for ls in spec.levels)):
+                raise ValueError(
+                    f"ny={spec.ny} / a level's unit count is not divisible "
+                    f"by the mesh's '{site_axis}' extent ({n_st}); the "
+                    "sampler should have fallen back to site replication")
             shard = ShardCtx(axis=species_axis, n=n_sp, ns=spec.ns,
-                             local_rng=bool(local_rng))
-            spec_run = _dc.replace(spec, ns=spec.ns // n_sp)
+                             local_rng=bool(local_rng),
+                             site_axis=site_axis if n_st > 1 else None,
+                             m=n_st if n_st > 1 else 1,
+                             ny=spec.ny if n_st > 1 else 0,
+                             np_r=tuple(ls.n_units for ls in spec.levels)
+                             if n_st > 1 else ())
+            spec_run = _dc.replace(spec, ns=spec.ns // n_sp,
+                                   ny=spec.ny // n_st)
     sweep = make_sweep(spec_run, updater, adapt_nf, shard, precision)
 
     def first_bad_update(state, bad_it):
@@ -571,16 +590,20 @@ def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin,
     from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
-    from .partition import (DATA_SPECIES_DIMS, STATE_SPECIES_DIMS,
+    from .partition import (DATA_SITE_DIMS, DATA_SPECIES_DIMS,
+                            STATE_SITE_DIMS, STATE_SPECIES_DIMS,
                             record_pspecs, tree_pspecs)
-    rec_spec_for = record_pspecs(chain_axis, species_axis)
+    st = site_axis if n_st > 1 else None
+    rec_spec_for = record_pspecs(chain_axis, species_axis, site_axis=st)
 
     def fn(data, states, keys, bad, *staged_args):
         in_specs = (
             tree_pspecs(data, spec, species_axis, DATA_SPECIES_DIMS,
-                        x_is_list=spec.x_is_list),
+                        x_is_list=spec.x_is_list, site_axis=st,
+                        site_dims=DATA_SITE_DIMS if st else None),
             tree_pspecs(states, spec, species_axis, STATE_SPECIES_DIMS,
-                        lead=chain_axis),
+                        lead=chain_axis, site_axis=st,
+                        site_dims=STATE_SITE_DIMS if st else None),
             P(chain_axis), P(chain_axis))
         if precision is not None:
             from .precision import staged_pspecs
@@ -770,7 +793,8 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 nf_cap: int = DEFAULT_NF_CAP, dtype=jnp.float32,
                 data_par=None, from_prior: bool = False,
                 align_post: bool = True, mesh=None, chain_axis: str = "chains",
-                species_axis: str = "species", shard_sweep=None,
+                species_axis: str = "species", site_axis: str = "sites",
+                shard_sweep=None,
                 return_state: bool = False, verbose: int = 0,
                 init_state=None, profile_dir: str | None = None,
                 rng_impl: str | None = None, record_dtype=None,
@@ -908,7 +932,8 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
       (requires ``init_state``); without it a resumed run draws a fresh
       stream seeded from (seed, carried iteration).
     - ``shard_sweep`` controls WITHIN-model parallelism when ``mesh`` names
-      a species axis of extent > 1.  The default (``None``, auto) wraps
+      a species axis (and optionally a ``site_axis``) of extent > 1.  The
+      default (``None``, auto) wraps
       the whole Gibbs sweep in ``jax.experimental.shard_map`` over the
       mesh: every species-dimensioned carry/data array is sharded per the
       committed PartitionSpec tables in :mod:`hmsc_tpu.mcmc.partition`,
@@ -917,15 +942,32 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
       GammaV's ``B``-products, the rho quadratic, Nf statistics,
       divergence tracking) are explicit psum/all_gather collectives — so
       per-device state shrinks ~1/shards and the one-chip ceiling on
-      ``ns`` breaks.  Every species-dimensioned random draw is taken at
+      ``ns`` breaks.  A 2D mesh (``make_mesh(species_shards=k,
+      site_shards=m)``) additionally shards the SITE axis: Z's rows,
+      per-level Eta rows, the row data, and the NNGP/GPP per-unit
+      structure grids split over ``m`` site shards, with per-unit
+      spatial solves on local unit blocks, explicit collectives at the
+      cross-site reductions (design grams, updateZ column statistics,
+      Alpha grid quadratics, GPP knot corrections, divergence tracking
+      over both axes), and explicit Eta row gathers wherever a ``Pi``
+      row read crosses shards — breaking the per-device Eta ceiling of
+      np-dominated spatial models.  Every species- and site-dimensioned
+      random draw is taken at
       the global width and sliced, keeping the sharded draw stream equal
       to the replicated sweep's; agreement is within the documented
       tolerance (``partition.SHARD_AGREEMENT_TOL``, psum rounding only).
       Models the sharded sweep cannot express (dense-phylo fallbacks, the
       opt-in collapsed updaters) auto-fall back to GSPMD placement with a
-      warning; ``True`` makes that an error, ``False`` always uses legacy
-      GSPMD placement.  Resume of a sharded run may re-shard freely — the
-      committed draws are layout-independent within the same tolerance.
+      warning — and classes without a site-sharded formulation
+      (per-species X lists, selection/RRR, xDim > 0 levels, an active
+      ``precision_policy``), or non-divisible ``ny``/unit counts, fall
+      back to species-only sharding naming the nearest valid site
+      divisor; ``True`` makes the fallbacks errors, ``False`` always
+      uses legacy GSPMD placement.  Resume of a sharded run may
+      re-shard freely — the
+      committed draws are layout-independent within the same tolerance
+      (checkpoint metadata records the engaged ``(species_shards,
+      site_shards)`` tuple; with ``local_rng=True`` resume pins BOTH).
     - ``coordinator`` scales chains across a multi-process mesh (the
       reference's SOCK-cluster ``nParallel``, re-architected): ``n_chains``
       is the GLOBAL count, process ``p`` of ``R`` samples the contiguous
@@ -1224,20 +1266,35 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
     updater_items = (tuple(sorted(updater.items())) if updater else None)
     sharding = None
     runner_mesh = None                    # engages the shard_map sweep path
+    runner_site_axis = None               # site axis engaged on that mesh
+    shard_meta = {"species_shards": None, "site_shards": None}
     if shard_sweep not in (None, True, False):
         raise ValueError(f"shard_sweep must be None (auto), True or False, "
                          f"got {shard_sweep!r}")
-    if shard_sweep is True and (
-            mesh is None
-            or species_axis not in getattr(mesh, "axis_names", ())
-            or int(mesh.shape[species_axis]) < 2):
+    _axes = getattr(mesh, "axis_names", ()) if mesh is not None else ()
+    _sp_ext = int(mesh.shape[species_axis]) if species_axis in _axes else 1
+    _st_ext = int(mesh.shape[site_axis]) if site_axis in _axes else 1
+    # a site axis only counts toward strict mode when the species axis
+    # exists alongside it (the 2D geometry hangs off the species ctx;
+    # make_mesh(site_shards=m) always emits both) — without this, a
+    # hand-built (chains, sites) mesh would pass the check here and
+    # then silently replicate when the site gate drops the orphan axis
+    if shard_sweep is True and mesh is not None and _sp_ext < 2 \
+            and (_st_ext < 2 or species_axis not in _axes):
         # strict mode needs something to shard OVER: silently replicating
         # here would defeat the 1/shards per-device state the caller
         # explicitly asked for
         raise ValueError(
             "shard_sweep=True requires a mesh with a "
-            f"'{species_axis}' axis of extent >= 2 (got "
-            f"{'no mesh' if mesh is None else tuple(mesh.shape.items())}) "
+            f"'{species_axis}' (or '{site_axis}') axis of extent >= 2 — a "
+            f"'{site_axis}' axis also needs the '{species_axis}' axis "
+            f"alongside it (got {tuple(mesh.shape.items())}) "
+            "— build one with make_mesh(species_shards=k) / "
+            "make_mesh(..., site_shards=m)")
+    if shard_sweep is True and mesh is None:
+        raise ValueError(
+            "shard_sweep=True requires a mesh with a "
+            f"'{species_axis}' axis of extent >= 2 (got no mesh) "
             "— build one with make_mesh(species_shards=k)")
     if mesh is not None:
         # chains are the data-parallel axis; if the mesh also names a
@@ -1285,7 +1342,58 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 f"{msg}; species arrays are replicated (chains-only "
                 "parallelism)")
             sp = None
-        want_shard = (sp is not None and int(mesh.shape[sp]) > 1
+        # the SITE axis (2D mesh): rows + per-level units must divide the
+        # extent, and the model class must have a site-sharded
+        # formulation; either failure falls back to species-only
+        # sharding with a warning (never silent replication)
+        st = site_axis if (site_axis in mesh.axis_names
+                           and int(mesh.shape[site_axis]) > 1
+                           and species_axis in mesh.axis_names) else None
+        if st is not None and sp is None and _sp_ext > 1:
+            # a species fallback leaves arrays replicated over a >1
+            # species axis — the shard_map geometry cannot express that,
+            # so the site axis falls back with it
+            st = None
+        if st is not None:
+            n_st = int(mesh.shape[st])
+            bad_np = [int(ls.n_units) for ls in spec.levels
+                      if ls.n_units % n_st]
+            if spec.ny % n_st or bad_np:
+                from .partition import nearest_site_divisor
+                what = (f"ny={spec.ny}" if spec.ny % n_st
+                        else f"a level's unit count np={bad_np[0]}")
+                near = nearest_site_divisor(
+                    spec.ny, [ls.n_units for ls in spec.levels], n_st)
+                msg = (f"mesh names a '{st}' axis of size {n_st} but "
+                       f"{what} is not divisible by site_shards={n_st}; "
+                       f"the nearest valid site_shards for this model is "
+                       f"{near}")
+                if shard_sweep is True:
+                    raise ValueError(f"shard_sweep=True but {msg}")
+                log.warn_once(
+                    "site-shard-divisibility",
+                    f"{msg}; site arrays are replicated (species-only "
+                    "model parallelism)")
+                st = None
+        if st is not None:
+            from .partition import site_shard_unsupported_reason
+            reason = site_shard_unsupported_reason(spec, updater)
+            if reason is None and policy is not None:
+                reason = ("the mixed-precision staged operands have no "
+                          "site-sharded layout yet — drop "
+                          "precision_policy or the site axis")
+            if reason is not None:
+                if shard_sweep is True and _sp_ext < 2:
+                    raise ValueError(
+                        f"shard_sweep=True but the site-sharded sweep "
+                        f"does not support this model: {reason}")
+                log.warn_once(
+                    "site-shard-unsupported",
+                    f"site-sharded sweep unavailable for this model "
+                    f"({reason}); falling back to species-only sharding")
+                st = None
+        want_shard = (((sp is not None and int(mesh.shape[sp]) > 1)
+                       or st is not None)
                       and shard_sweep is not False)
         if want_shard:
             from .partition import shard_unsupported_reason
@@ -1302,13 +1410,24 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 want_shard = False
         sharding = NamedSharding(mesh, P(chain_axis))
         if want_shard:
-            from .partition import (DATA_SPECIES_DIMS, STATE_SPECIES_DIMS,
+            from .partition import (DATA_SITE_DIMS, DATA_SPECIES_DIMS,
+                                    STATE_SITE_DIMS, STATE_SPECIES_DIMS,
                                     place_on_mesh)
             runner_mesh = mesh
-            state0 = place_on_mesh(state0, mesh, spec, sp,
-                                   STATE_SPECIES_DIMS, lead=chain_axis)
-            data = place_on_mesh(data, mesh, spec, sp, DATA_SPECIES_DIMS,
-                                 x_is_list=spec.x_is_list)
+            runner_site_axis = st
+            shard_meta = {
+                "species_shards": int(mesh.shape[sp]) if sp is not None
+                else 1,
+                "site_shards": int(mesh.shape[st]) if st is not None else 1,
+            }
+            state0 = place_on_mesh(state0, mesh, spec, sp or species_axis,
+                                   STATE_SPECIES_DIMS, lead=chain_axis,
+                                   site_axis=st,
+                                   site_dims=STATE_SITE_DIMS if st else None)
+            data = place_on_mesh(data, mesh, spec, sp or species_axis,
+                                 DATA_SPECIES_DIMS,
+                                 x_is_list=spec.x_is_list, site_axis=st,
+                                 site_dims=DATA_SITE_DIMS if st else None)
         else:
             state0 = _shard_species(state0, mesh, spec, sp, lead=chain_axis)
             if sp is not None:
@@ -1621,12 +1740,15 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                 "precision_policy": (policy.to_meta() if policy is not None
                                      else None),
                 "local_rng": bool(local_rng),
-                # a local_rng stream folds the shard index into the keys,
-                # so a continuation must re-shard over the SAME species
-                # extent — resume_run checks this
-                "species_shards": (int(runner_mesh.shape[species_axis])
-                                   if (local_rng and runner_mesh is not None)
-                                   else None),
+                # the engaged mesh tuple (species_shards, site_shards) is
+                # always recorded; a local_rng stream folds the shard
+                # indices into the keys, so a continuation must re-shard
+                # over the SAME extents on BOTH axes — resume_run checks
+                # this when local_rng is set
+                "species_shards": (shard_meta["species_shards"]
+                                   if runner_mesh is not None else None),
+                "site_shards": (shard_meta["site_shards"]
+                                if runner_mesh is not None else None),
             }
 
         # ALL snapshot-write/layout logic lives in CheckpointWriter
@@ -1685,7 +1807,8 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                                   spatial._NNGP_DENSE_MAX,
                                   mesh=runner_mesh, chain_axis=chain_axis,
                                   species_axis=species_axis,
-                                  precision=policy, local_rng=local_rng)
+                                  precision=policy, local_rng=local_rng,
+                                  site_axis=runner_site_axis)
             # a cache miss means this static config is new to the process:
             # the dispatch below pays XLA trace + compile synchronously —
             # name the span for what it spends its time on
@@ -1976,6 +2099,7 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                               verbose=verbose, mesh=sub_mesh,
                               chain_axis=chain_axis,
                               species_axis=species_axis,
+                              site_axis=site_axis,
                               shard_sweep=shard_sweep,
                               precision_policy=(policy.to_meta()
                                                 if policy is not None
@@ -2003,6 +2127,7 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
                               verbose=verbose,
                               mesh=sub_mesh, chain_axis=chain_axis,
                               species_axis=species_axis,
+                              site_axis=site_axis,
                               shard_sweep=shard_sweep,
                               precision_policy=(policy.to_meta()
                                                 if policy is not None

@@ -33,7 +33,7 @@ from .structs import GibbsState, LevelState, ModelData, ModelSpec
 from .updaters import _masked_level_gram, lambda_effective
 
 __all__ = ["update_eta_spatial", "update_alpha", "vecchia_ops",
-           "vecchia_cg_draw", "gpp_factor", "gpp_draw"]
+           "vecchia_ops_site", "vecchia_cg_draw", "gpp_factor", "gpp_draw"]
 
 # above this many (units x factors) coefficients, NNGP Eta switches from the
 # dense joint cholesky to the matrix-free CG sampler.  Overridable via
@@ -85,6 +85,52 @@ def vecchia_ops(nn, coef, sqD, LiSL):
     return riw_t, pmv
 
 
+def vecchia_ops_site(nn, coef, sqD, LiSL, npr: int, shard):
+    """Site-sharded counterpart of :func:`vecchia_ops`: the Vecchia
+    factor's rows (and the per-unit likelihood gram) are LOCAL unit
+    blocks, iterates stay full-width replicated, and each application
+    reassembles with ONE psum over the site axis — so the per-device
+    apply work is O(np_local · k · nf) while every shard agrees on the
+    full iterate.
+
+    ``nn`` (np_local, k) local neighbour rows holding GLOBAL unit
+    indices; ``coef`` (nf, np_local, k) / ``sqD`` (nf, np_local) the
+    local grid slices; ``LiSL`` (np_local, nf, nf) the local unit block
+    of the psum'd gram; ``npr`` the GLOBAL unit count.  Returns
+    ``(riw_t, pmv)`` where ``riw_t`` maps a LOCAL-row residual to the
+    full (np, nf) RiW' image and ``pmv`` maps a full iterate to the
+    full P x."""
+    np_l, k_nb = nn.shape
+    nf = LiSL.shape[-1]
+
+    def _scatter_local(local):
+        full = jnp.zeros((npr, nf), dtype=local.dtype)
+        return jax.lax.dynamic_update_slice_in_dim(
+            full, local, shard.site_offset(npr), axis=0)
+
+    def _riw_t_parts(t_l):
+        contrib = -jnp.einsum("fik,if->ikf", coef, t_l)   # (np_l, k, nf)
+        return jax.ops.segment_sum(contrib.reshape(np_l * k_nb, nf),
+                                   nn.reshape(-1), num_segments=npr)
+
+    def riw_t(u_l):
+        t_l = u_l / sqD.T
+        return shard.psum_site(_riw_t_parts(t_l) + _scatter_local(t_l))
+
+    def pmv(x):
+        x_l = jax.lax.dynamic_slice_in_dim(x, shard.site_offset(npr),
+                                           np_l, axis=0)
+        xg = x[nn]                                      # (np_l, k, nf)
+        red = jnp.einsum("fik,ikf->if", coef, xg)
+        r_l = (x_l - red) / sqD.T
+        t_l = r_l / sqD.T
+        lik = jnp.einsum("ufg,ug->uf", LiSL, x_l)
+        return shard.psum_site(_riw_t_parts(t_l)
+                               + _scatter_local(t_l + lik))
+
+    return riw_t, pmv
+
+
 def vecchia_cg_draw(riw_t, pmv, F, b_like, eps1, x0, tol=1e-5, maxiter=500):
     """Perturbation-optimisation draw x ~ N(P^{-1}(F), P^{-1}) via CG.
 
@@ -102,11 +148,15 @@ def vecchia_cg_draw(riw_t, pmv, F, b_like, eps1, x0, tol=1e-5, maxiter=500):
     return x, res
 
 
-def gpp_factor(LiSL, idD, M1, Fm):
+def gpp_factor(LiSL, idD, M1, Fm, shard=None):
     """Step-invariant factorisation of the GPP full-conditional
     ``P = A - M F_blk^{-1} M'`` with ``A = LiSL + unitdiag(idD)`` (reference
     updateEta.R:148-196).  ``idD`` (nf, np), ``M1`` (nf, np, nK), ``Fm``
-    (nf, nK, nK); returns the payload consumed by :func:`gpp_draw`."""
+    (nf, nK, nK); returns the payload consumed by :func:`gpp_draw`.
+    Site-sharded (``shard`` with sites): the per-unit A blocks are LOCAL,
+    and the knot-space correction ``M' iA M`` — a sum over every unit —
+    is completed by one psum over the site axis before the (nf·nK)
+    factorisation runs replicated."""
     npr, nf = LiSL.shape[0], LiSL.shape[-1]
     nK = M1.shape[2]
     A = LiSL + jnp.eye(nf, dtype=idD.dtype)[None] * idD.T[:, :, None]
@@ -126,6 +176,8 @@ def gpp_factor(LiSL, idD, M1, Fm):
             lower=False))(LA)                           # (np, nf, nf)
     # H = blockdiag(F_h) - M' iA M   over the (nf*nK) knot space
     MtAM = jnp.einsum("hum,uhg,gun->hmgn", M1, iA, M1)
+    if shard is not None and shard.has_sites:
+        MtAM = shard.psum_site(MtAM)      # cross-site unit sum
     H = -MtAM
     fi = jnp.arange(nf)
     H = H.at[fi, :, fi, :].add(Fm)
@@ -134,14 +186,19 @@ def gpp_factor(LiSL, idD, M1, Fm):
     return M1, iA, LiA, LH, nK
 
 
-def gpp_draw(payload, F, eps1, eps2):
+def gpp_draw(payload, F, eps1, eps2, shard=None):
     """Exact draw eta ~ N(P^{-1} F, P^{-1}) from a :func:`gpp_factor`
     payload: mean via double Woodbury, noise as LiA eps1 + iA M LH^{-T} eps2
-    (covariance exactly P^{-1})."""
+    (covariance exactly P^{-1}).  Site-sharded: the knot projection
+    ``M' iA F`` sums over units — one psum completes it; everything else
+    is per-unit local."""
     M1, iA, LiA, LH, nK = payload
     nf = iA.shape[-1]
     iA_rhs = jnp.einsum("uhg,ug->uh", iA, F)
-    Mt_iA_rhs = jnp.einsum("hum,uh->hm", M1, iA_rhs).reshape(-1)
+    Mt_iA_rhs = jnp.einsum("hum,uh->hm", M1, iA_rhs)
+    if shard is not None and shard.has_sites:
+        Mt_iA_rhs = shard.psum_site(Mt_iA_rhs)
+    Mt_iA_rhs = Mt_iA_rhs.reshape(-1)
     corr = solve_triangular(
         LH.T, solve_triangular(LH, Mt_iA_rhs, lower=True),
         lower=False).reshape(nf, nK)
@@ -153,16 +210,24 @@ def gpp_draw(payload, F, eps1, eps2):
     return mean + noise1 + jnp.einsum("uhg,ug->uh", iA, Mw)
 
 
-def _nngp_dense_iW(lvd, alpha_idx, npr, r: int = 0):
+def _nngp_dense_iW(lvd, alpha_idx, npr, r: int = 0, shard=None):
     """Densify the Vecchia precision iW = RiW' RiW for each factor's alpha.
 
     RiW rows: (e_i - sum_k A[i,k] e_{nn[i,k]}) / sqrt(D_i); built by scattering
     the neighbour coefficients into an (np, np) matrix per factor.
     Policy'd blocks gather from the staged bf16 neighbour grids (the
     dominant read); the densified factor and its gram stay f32.
+    Site-sharded: the neighbour grids are local unit slices — the dense
+    build (small np by the crossover's definition) gathers them full and
+    runs replicated.
     """
     coef = mx.staged_level("nn_coef", r, lvd.nn_coef)[alpha_idx]
     D = mx.staged_level("nn_D", r, lvd.nn_D)[alpha_idx]  # (nf, np)
+    nn_idx = lvd.nn_idx
+    if shard is not None and shard.has_sites:
+        coef = shard.gather_site(coef, 1)
+        D = shard.gather_site(D, 1)
+        nn_idx = shard.gather_site(nn_idx, 0)
     nf, _, k = coef.shape
     dt = lvd.nn_D.dtype                           # f32 build regardless
     if coef.dtype != dt:
@@ -172,7 +237,7 @@ def _nngp_dense_iW(lvd, alpha_idx, npr, r: int = 0):
     rows = jnp.broadcast_to(jnp.arange(npr)[None, :, None], (nf, npr, k))
     RiW = jnp.zeros((nf, npr, npr), dtype=coef.dtype)
     RiW = RiW.at[jnp.arange(nf)[:, None, None], rows,
-                 jnp.broadcast_to(lvd.nn_idx[None], (nf, npr, k))].add(-coef)
+                 jnp.broadcast_to(nn_idx[None], (nf, npr, k))].add(-coef)
     RiW = RiW + jnp.eye(npr, dtype=coef.dtype)[None]
     RiW = RiW / jnp.sqrt(D)[:, :, None]
     return jnp.einsum("fij,fik->fjk", RiW, RiW)
@@ -195,12 +260,16 @@ def update_eta_spatial(spec: ModelSpec, data: ModelData, state: GibbsState,
         # np) structure read is the block's dominant byte stream
         iW = mx.staged_level("iWg", r, lvd.iWg)[lv.alpha_idx]  # (nf, np, np)
     else:  # NNGP
-        iW = _nngp_dense_iW(lvd, lv.alpha_idx, npr, r)
+        iW = _nngp_dense_iW(lvd, lv.alpha_idx, npr, r, shard)
     if iW.dtype != F.dtype:
         iW = iW.astype(F.dtype)
 
     # big precision (nf*np)^2, factor-major: blockdiag(iW_h) + unit-diagonal
-    # factor coupling LiSL_u scattered at (h*np+u, g*np+u)
+    # factor coupling LiSL_u scattered at (h*np+u, g*np+u).  Site-sharded:
+    # the dense joint solve is inherently global (the Full/dense methods
+    # exist for SMALL np), so it runs replicated on the psum'd full-width
+    # grams with the replicated key — the draw stream equals the
+    # replicated sweep's — and only Eta's local unit block is kept.
     big = jnp.zeros((nf, npr, nf, npr), dtype=F.dtype)
     fi = jnp.arange(nf)
     big = big.at[fi, :, fi, :].add(iW)
@@ -213,6 +282,8 @@ def update_eta_spatial(spec: ModelSpec, data: ModelData, state: GibbsState,
     L = chol_spd(big)
     eps = jax.random.normal(key, rhs.shape, dtype=rhs.dtype)
     eta = sample_mvn_prec(L, rhs, eps).reshape(nf, npr).T
+    if shard is not None and shard.has_sites:
+        eta = shard.slice_site(eta, 0)
     return lv.replace(Eta=eta)
 
 
@@ -230,26 +301,42 @@ def _eta_nngp_cg(spec, data, state, r, key, S, tol: float = 1e-5,
     """
     lvd, lv, ls = data.levels[r], state.levels[r], spec.levels[r]
     npr, nf = ls.n_units, ls.nf_max
+    site = shard is not None and shard.has_sites
     LiSL, F = _masked_level_gram(spec, data, lvd, ls, lv, state.iSigma, S,
                                  shard)
     lam = lambda_effective(lv)[:, :, 0]               # (nf, ns)
-    coef = lvd.nn_coef[lv.alpha_idx]                  # (nf, np, k)
-    sqD = jnp.sqrt(lvd.nn_D[lv.alpha_idx])            # (nf, np)
-    riw_t, pmv = vecchia_ops(lvd.nn_idx, coef, sqD, LiSL)
+    coef = lvd.nn_coef[lv.alpha_idx]                  # (nf, np[_l], k)
+    sqD = jnp.sqrt(lvd.nn_D[lv.alpha_idx])            # (nf, np[_l])
+    if site:
+        # distributed Vecchia apply: rows local, iterate full-width
+        # replicated, one psum per application — per-device apply work
+        # scales 1/m while the CG scalars stay replicated
+        riw_t, pmv = vecchia_ops_site(lvd.nn_idx, coef, sqD,
+                                      shard.slice_site(LiSL, 0), npr,
+                                      shard)
+    else:
+        riw_t, pmv = vecchia_ops(lvd.nn_idx, coef, sqD, LiSL)
 
     k1, k2 = jax.random.split(key)
-    eps1 = jax.random.normal(k1, (npr, nf), dtype=F.dtype)
+    if site:
+        # local rows of the full-width prior perturbation (riw_t's input
+        # space is row-local in the distributed apply)
+        eps1 = shard.normal(k1, (npr, nf), F.dtype, dim=None, site_dim=0)
+    else:
+        eps1 = jax.random.normal(k1, (npr, nf), dtype=F.dtype)
     if shard is None:
         xi = jax.random.normal(k2, S.shape, dtype=F.dtype)
     else:
-        xi = shard.normal(k2, (spec.ny, shard.ns), F.dtype, dim=1)
+        xi = shard.normal(k2, ((shard.ny or spec.ny), shard.ns), F.dtype,
+                          dim=1, site_dim=0)
     w = xi * jnp.sqrt(state.iSigma)[None, :]
     if spec.has_na:
         w = w * data.Ymask
     b_like = jax.ops.segment_sum(w @ lam.T, lvd.pi_row, num_segments=npr)
     if shard is not None:                 # likelihood-noise gram psum
-        b_like = shard.psum(b_like)
-    eta, res = vecchia_cg_draw(riw_t, pmv, F, b_like, eps1, x0=lv.Eta,
+        b_like = shard.psum_all(b_like)
+    x0 = shard.gather_site(lv.Eta, 0) if site else lv.Eta
+    eta, res = vecchia_cg_draw(riw_t, pmv, F, b_like, eps1, x0=x0,
                                tol=tol, maxiter=maxiter)
     # cg returns its current iterate at maxiter with no signal; a stalled
     # solve would silently bias the chain.  Check the relative residual and
@@ -257,6 +344,8 @@ def _eta_nngp_cg(spec, data, state, r, key, S, tol: float = 1e-5,
     # then reports the chain and first bad sweep loudly.
     thresh = max(100.0 * tol, 1e-3)       # scales with the requested tol
     eta = jnp.where(res < thresh, eta, jnp.nan)
+    if site:
+        eta = shard.slice_site(eta, 0)
     return lv.replace(Eta=eta)
 
 
@@ -267,8 +356,14 @@ def _eta_gpp(spec, data, state, r, key, S, shard=None):
     LiA eps1 + (iA M R_H^{-1}) eps2 which has covariance exactly P^{-1}."""
     lvd, lv, ls = data.levels[r], state.levels[r], spec.levels[r]
     npr, nf, nK = ls.n_units, ls.nf_max, ls.n_knots
+    site = shard is not None and shard.has_sites
     LiSL, F = _masked_level_gram(spec, data, lvd, ls, lv, state.iSigma, S,
                                  shard)
+    if site:
+        # per-unit Woodbury blocks run on the LOCAL unit slice; the knot
+        # grids already arrive site-sharded, so only the grams slice
+        LiSL = shard.slice_site(LiSL, 0)
+        F = shard.slice_site(F, 0)
 
     # policy'd blocks gather from the staged bf16 knot grids — the
     # (G, np, nK) structure reads dominate the GPP block's bytes; the
@@ -281,22 +376,32 @@ def _eta_gpp(spec, data, state, r, key, S, shard=None):
     M1 = _f32(mx.staged_level("idDW12g", r, lvd.idDW12g)[lv.alpha_idx])
     M1 = jnp.where(alpha0[:, None, None], 0.0, M1)
     Fm = _f32(mx.staged_level("Fg", r, lvd.Fg)[lv.alpha_idx])  # (nf, nK, nK)
-    payload = gpp_factor(LiSL, idD, M1, Fm)
+    payload = gpp_factor(LiSL, idD, M1, Fm, shard=shard if site else None)
     k1, k2 = jax.random.split(key)
-    eps1 = jax.random.normal(k1, (npr, nf), dtype=F.dtype)
+    if site:
+        eps1 = shard.normal(k1, (npr, nf), F.dtype, dim=None, site_dim=0)
+    else:
+        eps1 = jax.random.normal(k1, (npr, nf), dtype=F.dtype)
     eps2 = jax.random.normal(k2, (nf * nK,), dtype=F.dtype)
-    eta = gpp_draw(payload, F, eps1, eps2)
+    eta = gpp_draw(payload, F, eps1, eps2, shard=shard if site else None)
     return lv.replace(Eta=eta)
 
 
 # ---------------------------------------------------------------------------
 
-def eta_quad_grid(lvd, ls, eta, r: int = 0):
+def eta_quad_grid(lvd, ls, eta, r: int = 0, shard=None):
     """(v, ld): per-factor prior quadratics eta_h' iW_g eta_h, both (nf, G),
     over the whole alpha grid.  Consumed by update_alpha; the interweaving
-    scale move uses the single-point :func:`eta_quad_at` instead."""
+    scale move uses the single-point :func:`eta_quad_at` instead.
+    Site-sharded: ``eta`` is the LOCAL unit block — the Alpha grid
+    quadratics are cross-site reductions (local partial sums over the
+    local units + structure grids, one psum each; the Full method's
+    dense grid is replicated, so it gathers eta and computes full)."""
+    site = shard is not None and getattr(shard, "has_sites", False)
     if ls.spatial == "Full":
         iWg = mx.staged_level("iWg", r, lvd.iWg)
+        if site:
+            eta = shard.gather_site(eta, 0)    # dense grid wants full eta
         if mx.layouts_active():
             # single-pass layout: one (G, np*np) x (np*np, nf)
             # contraction over the per-factor outer products instead of
@@ -308,39 +413,53 @@ def eta_quad_grid(lvd, ls, eta, r: int = 0):
             v = mx.einsum("hu,guv,hv->hg", eta.T, iWg, eta.T)
         ld = lvd.detWg[None, :]
     elif ls.spatial == "NNGP":
-        eta_nn = eta[lvd.nn_idx]                    # (np, k, nf)
+        eta_src = shard.gather_site(eta, 0) if site else eta
+        eta_nn = eta_src[lvd.nn_idx]                # (np[_l], k, nf)
         pred = mx.einsum("gik,ikh->hgi",
                          mx.staged_level("nn_coef", r, lvd.nn_coef),
                          eta_nn)                                # (nf, G, np)
         res = eta.T[:, None, :] - pred                          # (nf, G, np)
         v = (res**2 / mx.staged_level("nn_D", r, lvd.nn_D)[None]).sum(axis=2)
+        if site:
+            v = shard.psum_site(v)
         ld = lvd.detWg[None, :]
     else:  # GPP
         q_full = jnp.einsum("uh,uh->h", eta, eta)
         t1 = jnp.einsum("gu,uh->hg", lvd.idDg, eta**2)
         Et = jnp.einsum("uh,gum->hgm", eta, lvd.idDW12g)        # (nf, G, nK)
+        if site:
+            q_full = shard.psum_site(q_full)
+            t1 = shard.psum_site(t1)
+            Et = shard.psum_site(Et)
         t2 = jnp.einsum("hgm,gmn,hgn->hg", Et, lvd.iFg, Et)
         v = jnp.where(lvd.alphapw[None, :, 0] == 0, q_full[:, None], t1 - t2)
         ld = lvd.detDg[None, :]
     return v, ld
 
 
-def eta_quad_at(lvd, ls, eta, alpha_idx, r: int = 0):
+def eta_quad_at(lvd, ls, eta, alpha_idx, r: int = 0, shard=None):
     """(nf,) prior quadratic eta_h' iW(alpha_h) eta_h at each factor's
     *current* alpha only — same algebra as :func:`eta_quad_grid` with the
     grid axis gathered away up front (the interweaving move needs one point
     per factor; evaluating the whole 101-point grid for it roughly doubled
-    the update_alpha-scale prior cost per sweep)."""
+    the update_alpha-scale prior cost per sweep).  Site-sharded: local
+    partial quadratics psum'd over the site axis (Full gathers eta for
+    its replicated dense grid)."""
+    site = shard is not None and getattr(shard, "has_sites", False)
     if ls.spatial == "Full":
         iW = mx.staged_level("iWg", r, lvd.iWg)[alpha_idx]    # (nf, np, np)
+        if site:
+            eta = shard.gather_site(eta, 0)
         return mx.einsum("hu,huv,hv->h", eta.T, iW, eta.T)
     if ls.spatial == "NNGP":
         coef = mx.staged_level("nn_coef", r, lvd.nn_coef)[alpha_idx]
-        D = mx.staged_level("nn_D", r, lvd.nn_D)[alpha_idx]   # (nf, np)
-        eta_nn = eta[lvd.nn_idx]                              # (np, k, nf)
-        pred = mx.einsum("hik,ikh->hi", coef, eta_nn)         # (nf, np)
+        D = mx.staged_level("nn_D", r, lvd.nn_D)[alpha_idx]   # (nf, np[_l])
+        eta_src = shard.gather_site(eta, 0) if site else eta
+        eta_nn = eta_src[lvd.nn_idx]                          # (np[_l], k, nf)
+        pred = mx.einsum("hik,ikh->hi", coef, eta_nn)         # (nf, np[_l])
         res = eta.T - pred
-        return (res**2 / D).sum(axis=1)
+        q = (res**2 / D).sum(axis=1)
+        return shard.psum_site(q) if site else q
     # GPP — gathers count the full knot grids; staged bf16 halves them,
     # the gathered slices widen to eta's dtype before the small einsums
     _f32 = lambda a: a.astype(eta.dtype) if a.dtype != eta.dtype else a
@@ -349,19 +468,30 @@ def eta_quad_at(lvd, ls, eta, alpha_idx, r: int = 0):
     iF = _f32(mx.staged_level("iFg", r, lvd.iFg)[alpha_idx])  # (nf, nK, nK)
     t1 = jnp.einsum("hu,uh->h", idD, eta**2)
     Et = jnp.einsum("uh,hum->hm", eta, W12)                   # (nf, nK)
+    if site:
+        t1 = shard.psum_site(t1)
+        Et = shard.psum_site(Et)
     t2 = jnp.einsum("hm,hmn,hn->h", Et, iF, Et)
     q_full = jnp.einsum("uh,uh->h", eta, eta)
+    if site:
+        q_full = shard.psum_site(q_full)
     return jnp.where(lvd.alphapw[alpha_idx, 0] == 0, q_full, t1 - t2)
 
 
-def eta_ones_forms_at(lvd, ls, eta, alpha_idx, r: int = 0):
+def eta_ones_forms_at(lvd, ls, eta, alpha_idx, r: int = 0, shard=None):
     """``(1' iW_h 1, 1' iW_h eta_h)`` per factor at each factor's current
     alpha, with ONE gather of the level's prior structures (the location
     interweave needs both; three :func:`eta_quad_at` polarization calls
-    would triple the prior-quadratic cost)."""
-    npr = eta.shape[0]
+    would triple the prior-quadratic cost).  Site-sharded: local partial
+    forms psum'd over the site axis (Full gathers eta for its replicated
+    dense grid; the GLOBAL unit count comes from the spec — ``n_units``
+    stays global under site sharding)."""
+    site = shard is not None and getattr(shard, "has_sites", False)
+    npr = ls.n_units
     if ls.spatial == "Full":
         iW = mx.staged_level("iWg", r, lvd.iWg)[alpha_idx]    # (nf, np, np)
+        if site:
+            eta = shard.gather_site(eta, 0)
         if iW.dtype != eta.dtype:
             # staged bf16 gather: accumulate the row sums in f32 — the
             # policy never lets a reduction run at bf16
@@ -371,15 +501,21 @@ def eta_ones_forms_at(lvd, ls, eta, alpha_idx, r: int = 0):
         return w.sum(axis=1), jnp.einsum("hu,uh->h", w, eta)
     if ls.spatial == "NNGP":
         coef = mx.staged_level("nn_coef", r, lvd.nn_coef)[alpha_idx]
-        D = mx.staged_level("nn_D", r, lvd.nn_D)[alpha_idx]   # (nf, np)
+        D = mx.staged_level("nn_D", r, lvd.nn_D)[alpha_idx]   # (nf, np[_l])
         # RiW x rows: (x_i - sum_k A[i,k] x_nn[i,k]) / sqrt(D_i)
         sqD = jnp.sqrt(D)
         csum = (coef.sum(axis=2, dtype=eta.dtype)
                 if coef.dtype != eta.dtype else coef.sum(axis=2))
         r1 = (1.0 - csum) / sqD                               # RiW @ 1
-        pred = mx.einsum("hik,ikh->hi", coef, eta[lvd.nn_idx])
+        eta_src = shard.gather_site(eta, 0) if site else eta
+        pred = mx.einsum("hik,ikh->hi", coef, eta_src[lvd.nn_idx])
         re = (eta.T - pred) / sqD                             # RiW @ eta
-        return (r1**2).sum(axis=1), (r1 * re).sum(axis=1)
+        q1 = (r1**2).sum(axis=1)
+        s = (r1 * re).sum(axis=1)
+        if site:
+            q1 = shard.psum_site(q1)
+            s = shard.psum_site(s)
+        return q1, s
     # GPP: x' iW y = sum_u idD x y - (x' M1) iF (M1' y); alpha=0 -> I
     _f32g = lambda a: a.astype(eta.dtype) if a.dtype != eta.dtype else a
     idD = _f32g(mx.staged_level("idDg", r, lvd.idDg)[alpha_idx])
@@ -387,20 +523,32 @@ def eta_ones_forms_at(lvd, ls, eta, alpha_idx, r: int = 0):
     iF = _f32g(mx.staged_level("iFg", r, lvd.iFg)[alpha_idx])
     E1 = W12.sum(axis=1)                                      # 1' idDW12
     Ee = jnp.einsum("uh,hum->hm", eta, W12)
-    q1 = idD.sum(axis=1) - jnp.einsum("hm,hmn,hn->h", E1, iF, E1)
-    s = jnp.einsum("hu,uh->h", idD, eta) \
-        - jnp.einsum("hm,hmn,hn->h", E1, iF, Ee)
+    if site:
+        E1 = shard.psum_site(E1)
+        Ee = shard.psum_site(Ee)
+        t_d = shard.psum_site(idD.sum(axis=1))
+        t_e = shard.psum_site(jnp.einsum("hu,uh->h", idD, eta))
+        e_sum = shard.psum_site(eta.sum(axis=0))
+    else:
+        t_d = idD.sum(axis=1)
+        t_e = jnp.einsum("hu,uh->h", idD, eta)
+        e_sum = eta.sum(axis=0)
+    q1 = t_d - jnp.einsum("hm,hmn,hn->h", E1, iF, E1)
+    s = t_e - jnp.einsum("hm,hmn,hn->h", E1, iF, Ee)
     zero = lvd.alphapw[alpha_idx, 0] == 0
     return (jnp.where(zero, float(npr), q1),
-            jnp.where(zero, eta.sum(axis=0), s))
+            jnp.where(zero, e_sum, s))
 
 
 def update_alpha(spec: ModelSpec, data: ModelData, state: GibbsState, r: int,
-                 key) -> LevelState:
+                 key, shard=None) -> LevelState:
     """Per-factor categorical draw of the GP range on the alphapw grid:
-    log p_g  =  log prior_g - 0.5 log|W_g| - 0.5 eta' iW_g eta."""
+    log p_g  =  log prior_g - 0.5 log|W_g| - 0.5 eta' iW_g eta.
+    Sharded: the grid quadratics reduce over both mesh axes as needed
+    (see :func:`eta_quad_grid`); the categorical draw itself runs
+    replicated with the shared key, so alpha stays replicated state."""
     lvd, lv, ls = data.levels[r], state.levels[r], spec.levels[r]
-    v, ld = eta_quad_grid(lvd, ls, lv.Eta, r=r)
+    v, ld = eta_quad_grid(lvd, ls, lv.Eta, r=r, shard=shard)
     loglike = jnp.log(lvd.alphapw[None, :, 1]) - 0.5 * ld - 0.5 * v
     idx = jax.random.categorical(key, loglike, axis=-1).astype(jnp.int32)
     idx = jnp.where(lv.nf_mask > 0, idx, 0)

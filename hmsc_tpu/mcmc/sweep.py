@@ -91,11 +91,14 @@ def make_sweep_schedule(spec: ModelSpec, updater: dict | None = None,
     want = lambda name: updater.get(name, False) is True
 
     if shard is not None:
-        from .partition import shard_unsupported_reason
+        from .partition import (shard_unsupported_reason,
+                                site_shard_unsupported_reason)
         reason = shard_unsupported_reason(spec, updater)
+        if reason is None and getattr(shard, "has_sites", False):
+            reason = site_shard_unsupported_reason(spec, updater)
         if reason:
             raise NotImplementedError(
-                f"species-sharded sweep unsupported for this model: {reason}")
+                f"sharded sweep unsupported for this model: {reason}")
 
     def data_x_of(data, Xeff):
         return data if Xeff is None else data.replace(X=Xeff)
@@ -149,7 +152,7 @@ def make_sweep_schedule(spec: ModelSpec, updater: dict | None = None,
             state, Xeff, _, E_shared = carry
             if spec.nr > 0:
                 LRan_total = sum(
-                    U.level_loading(data.levels[r], state.levels[r])
+                    U.level_loading(data.levels[r], state.levels[r], shard)
                     for r in range(spec.nr))
             else:
                 LRan_total = jnp.zeros_like(state.Z)
@@ -207,7 +210,7 @@ def make_sweep_schedule(spec: ModelSpec, updater: dict | None = None,
         def _eta(data, carry, ks):
             state, Xeff, LRan_total, _ = carry
             LFix = U.linear_fixed(spec_x, data_x_of(data, Xeff), state.Beta)
-            LRan = [U.level_loading(data.levels[r], state.levels[r])
+            LRan = [U.level_loading(data.levels[r], state.levels[r], shard)
                     for r in range(spec.nr)]
             for r in range(spec.nr):
                 S = state.Z - LFix
@@ -224,7 +227,8 @@ def make_sweep_schedule(spec: ModelSpec, updater: dict | None = None,
                 levels = list(state.levels)
                 levels[r] = lv
                 state = state.replace(levels=tuple(levels))
-                LRan[r] = U.level_loading(data.levels[r], state.levels[r])
+                LRan[r] = U.level_loading(data.levels[r], state.levels[r],
+                                          shard)
             E_shared = LFix
             for r in range(spec.nr):
                 E_shared = E_shared + LRan[r]
@@ -243,7 +247,8 @@ def make_sweep_schedule(spec: ModelSpec, updater: dict | None = None,
             for r in range(spec.nr):
                 if spec.levels[r].spatial is not None:
                     lv = update_alpha(spec, data, state, r,
-                                      jax.random.fold_in(ks[5], r))
+                                      jax.random.fold_in(ks[5], r),
+                                      shard=shard)
                     levels = list(state.levels)
                     levels[r] = lv
                     state = state.replace(levels=tuple(levels))
@@ -384,12 +389,18 @@ def make_sweep(spec: ModelSpec, updater: dict | None = None,
 def make_sharded_sweep(spec: ModelSpec, mesh, updater: dict | None = None,
                        adapt_nf: tuple | None = None,
                        species_axis: str = "species", precision=None,
-                       local_rng: bool = False):
-    """The species-sharded sweep as a standalone ``shard_map`` program:
+                       local_rng: bool = False, site_axis: str = "sites"):
+    """The sharded sweep as a standalone ``shard_map`` program:
     one pure ``(data, state, key) -> state`` function for a CHAINLESS
     state, with the in/out PartitionSpecs from :mod:`.partition` made
     explicit at the boundary.  ``spec`` is the GLOBAL spec; inputs are
     global arrays placed (or re-placed by jit) per the spec tables.
+    A mesh naming a ``site_axis`` of extent > 1 engages the 2D
+    (species × sites) geometry: Z rows / Eta rows / the row data and the
+    NNGP-GPP unit grids shard over sites on top of the v1 species
+    layout (``ny`` and every level's unit count must divide the site
+    extent; the site-ineligible model classes raise like the species
+    gates do).
 
     This is the program the layer-2 jaxpr audits fingerprint (the
     collective sequence is part of the committed fingerprint), the
@@ -400,7 +411,8 @@ def make_sharded_sweep(spec: ModelSpec, mesh, updater: dict | None = None,
 
     from jax.experimental.shard_map import shard_map
 
-    from .partition import (DATA_SPECIES_DIMS, STATE_SPECIES_DIMS, ShardCtx,
+    from .partition import (DATA_SITE_DIMS, DATA_SPECIES_DIMS,
+                            STATE_SITE_DIMS, STATE_SPECIES_DIMS, ShardCtx,
                             tree_pspecs)
     from jax.sharding import PartitionSpec as P
 
@@ -408,17 +420,38 @@ def make_sharded_sweep(spec: ModelSpec, mesh, updater: dict | None = None,
     if spec.ns % n_sp:
         raise ValueError(f"ns={spec.ns} not divisible by the mesh's "
                          f"'{species_axis}' extent ({n_sp})")
+    axis_names = getattr(mesh, "axis_names", ())
+    n_st = int(mesh.shape[site_axis]) if site_axis in axis_names else 1
+    st = site_axis if n_st > 1 else None
+    site_dims_d = DATA_SITE_DIMS if st is not None else None
+    site_dims_s = STATE_SITE_DIMS if st is not None else None
+    if st is not None:
+        if spec.ny % n_st:
+            raise ValueError(f"ny={spec.ny} not divisible by the mesh's "
+                             f"'{site_axis}' extent ({n_st})")
+        bad = [ls.name for ls in spec.levels if ls.n_units % n_st]
+        if bad:
+            raise ValueError(
+                f"unit count(s) of level(s) {bad} not divisible by the "
+                f"mesh's '{site_axis}' extent ({n_st})")
     shard = ShardCtx(axis=species_axis, n=n_sp, ns=spec.ns,
-                     local_rng=bool(local_rng))
-    spec_l = _dc.replace(spec, ns=spec.ns // n_sp)
+                     local_rng=bool(local_rng),
+                     site_axis=st, m=n_st if st is not None else 1,
+                     ny=spec.ny if st is not None else 0,
+                     np_r=tuple(ls.n_units for ls in spec.levels)
+                     if st is not None else ())
+    spec_l = _dc.replace(spec, ns=spec.ns // n_sp,
+                         ny=spec.ny // (n_st if st is not None else 1))
     body = make_sweep(spec_l, updater, adapt_nf, shard, precision)
 
     if precision is None:
         def sharded(data: ModelData, state: GibbsState, key) -> GibbsState:
             in_specs = (
                 tree_pspecs(data, spec, species_axis, DATA_SPECIES_DIMS,
-                            x_is_list=spec.x_is_list),
-                tree_pspecs(state, spec, species_axis, STATE_SPECIES_DIMS),
+                            x_is_list=spec.x_is_list, site_axis=st,
+                            site_dims=site_dims_d),
+                tree_pspecs(state, spec, species_axis, STATE_SPECIES_DIMS,
+                            site_axis=st, site_dims=site_dims_s),
                 P())
             return shard_map(body, mesh=mesh, in_specs=in_specs,
                              out_specs=in_specs[1], check_rep=False)(
@@ -432,8 +465,10 @@ def make_sharded_sweep(spec: ModelSpec, mesh, updater: dict | None = None,
                    staged=None) -> GibbsState:
         in_specs = (
             tree_pspecs(data, spec, species_axis, DATA_SPECIES_DIMS,
-                        x_is_list=spec.x_is_list),
-            tree_pspecs(state, spec, species_axis, STATE_SPECIES_DIMS),
+                        x_is_list=spec.x_is_list, site_axis=st,
+                        site_dims=site_dims_d),
+            tree_pspecs(state, spec, species_axis, STATE_SPECIES_DIMS,
+                        site_axis=st, site_dims=site_dims_s),
             P(),
             staged_pspecs(staged or {}, spec, species_axis,
                           x_is_list=spec.x_is_list))

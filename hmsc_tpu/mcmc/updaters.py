@@ -55,28 +55,40 @@ def linear_fixed(spec: ModelSpec, data: ModelData, Beta: jnp.ndarray) -> jnp.nda
     return mx.matmul(mx.staged("X", data.X), Beta)
 
 
-def level_loading(data_lv, lv: LevelState) -> jnp.ndarray:
+def _eta_rows_src(lv: LevelState, shard=None) -> jnp.ndarray:
+    """Eta as a full-width (np, nf) table for row-indexed reads.  Under
+    site sharding Eta's rows are a local block while ``pi_row`` holds
+    GLOBAL unit indices — the explicit ``Pi`` row-gather collective
+    reassembles the table so any row may read any unit."""
+    if shard is not None and shard.has_sites:
+        return shard.gather_site(lv.Eta, 0)
+    return lv.Eta
+
+
+def level_loading(data_lv, lv: LevelState, shard=None) -> jnp.ndarray:
     """LRan_r = sum_k (Eta[pi,:] * x_row[:,k]) @ Lambda[:,:,k]."""
     lam = lambda_effective(lv)
-    eta_rows = lv.Eta[data_lv.pi_row]
+    eta_rows = _eta_rows_src(lv, shard)[data_lv.pi_row]
     return mx.einsum("yf,yk,fjk->yj", eta_rows, data_lv.x_row, lam)
 
 
-def total_loading(spec: ModelSpec, data: ModelData, state: GibbsState) -> jnp.ndarray:
+def total_loading(spec: ModelSpec, data: ModelData, state: GibbsState,
+                  shard=None) -> jnp.ndarray:
     E = linear_fixed(spec, data, state.Beta)
     for r in range(spec.nr):
-        E = E + level_loading(data.levels[r], state.levels[r])
+        E = E + level_loading(data.levels[r], state.levels[r], shard)
     return E
 
 
-def eta_star(spec: ModelSpec, data: ModelData, state: GibbsState) -> jnp.ndarray:
+def eta_star(spec: ModelSpec, data: ModelData, state: GibbsState,
+             shard=None) -> jnp.ndarray:
     """Stacked factor design (ny, K), K = sum_r nf_max_r * ncr_r; columns of
     inactive factors are zeroed.  Ordering per level is covariate-major
     (k * nf + h), mirroring the reference's stacking (updateBetaLambda.R:33-41)."""
     cols = []
     for r in range(spec.nr):
         lvd, lv = data.levels[r], state.levels[r]
-        eta_rows = lv.Eta[lvd.pi_row] * lv.nf_mask[None, :]
+        eta_rows = _eta_rows_src(lv, shard)[lvd.pi_row] * lv.nf_mask[None, :]
         block = jnp.einsum("yf,yk->ykf", eta_rows, lvd.x_row)
         cols.append(block.reshape(spec.ny, -1))
     if not cols:
@@ -134,11 +146,14 @@ def update_z(spec: ModelSpec, data: ModelData, state: GibbsState, key,
     columns, with every random draw taken at the GLOBAL width and sliced —
     see the partition module docstring for the draw-equality contract."""
     if E is None:
-        E = total_loading(spec, data, state)
+        E = total_loading(spec, data, state, shard)
     std = state.iSigma[None, :] ** -0.5
     fam = data.distr_family[None, :]
     k_tn, k_pg, k_pg2, k_na = jax.random.split(key, 4)
-    full = (spec.ny, spec.ns if shard is None else shard.ns)
+    # the GLOBAL draw shape: site sharding localises spec.ny too, so the
+    # full-width-and-slice contract reads the globals off the shard ctx
+    full = ((spec.ny, spec.ns) if shard is None
+            else ((shard.ny or spec.ny), shard.ns))
 
     Z = state.Z
     if spec.any_normal:
@@ -150,8 +165,8 @@ def update_z(spec: ModelSpec, data: ModelData, state: GibbsState, key,
         if shard is None:
             z_tn = truncated_normal_onesided(k_tn, 0.0, data.Y > 0.5, E, std)
         else:
-            u = shard.uniform(k_tn, full, E.dtype, dim=1, minval=_TINY,
-                              maxval=1.0)
+            u = shard.uniform(k_tn, full, E.dtype, dim=1, site_dim=0,
+                              minval=_TINY, maxval=1.0)
             # _u pre-drawn from k_tn above; the op only transforms it
             # hmsc: ignore[rng-key-reuse]
             z_tn = truncated_normal_onesided(k_tn, 0.0, data.Y > 0.5, E,
@@ -162,7 +177,7 @@ def update_z(spec: ModelSpec, data: ModelData, state: GibbsState, key,
         if shard is None:
             w = polya_gamma(k_pg, data.Y + _NB_R, state.Z - logr)
         else:
-            eps_pg = shard.normal(k_pg, full, E.dtype, dim=1)
+            eps_pg = shard.normal(k_pg, full, E.dtype, dim=1, site_dim=0)
             # _eps pre-drawn from k_pg above; the op only transforms it
             # hmsc: ignore[rng-key-reuse]
             w = polya_gamma(k_pg, data.Y + _NB_R, state.Z - logr,
@@ -175,7 +190,7 @@ def update_z(spec: ModelSpec, data: ModelData, state: GibbsState, key,
                                                         dtype=mu.dtype)
         else:
             z_p = mu + jnp.sqrt(s2) * shard.normal(k_pg2, full, mu.dtype,
-                                                   dim=1)
+                                                   dim=1, site_dim=0)
         # NaN guard: keep the previous Z for any non-finite cell (reference
         # prints "Fail in Poisson Z update" and aborts the cell, updateZ.R:84-86)
         z_p = jnp.where(jnp.isfinite(z_p), z_p, state.Z)
@@ -184,7 +199,7 @@ def update_z(spec: ModelSpec, data: ModelData, state: GibbsState, key,
         if shard is None:
             eps_na = jax.random.normal(k_na, E.shape, dtype=E.dtype)
         else:
-            eps_na = shard.normal(k_na, full, E.dtype, dim=1)
+            eps_na = shard.normal(k_na, full, E.dtype, dim=1, site_dim=0)
         z_na = E + std * eps_na
         Z = jnp.where(data.Ymask > 0, Z, z_na)
     return state.replace(Z=Z)
@@ -216,8 +231,11 @@ def update_beta_lambda(spec: ModelSpec, data: ModelData, state: GibbsState,
     return state
 
 
-def _per_species_design_gram(spec, data, XE, mask):
-    """Gram matrices XE' diag(mask_j) XE per species: (ns, P, P)."""
+def _per_species_design_gram(spec, data, XE, mask, shard=None):
+    """Gram matrices XE' diag(mask_j) XE per species: (ns, P, P).
+    Site-sharded: the row contraction is partial per site shard — one
+    psum completes it (on the shared (P, P) gram before the broadcast in
+    the mask-free case)."""
     if spec.x_is_list:
         Es = XE  # (ny, K) factor part shared
         def gram(Xj, mj):
@@ -226,14 +244,19 @@ def _per_species_design_gram(spec, data, XE, mask):
         G, _ = jax.vmap(gram, in_axes=(0, 1))(data.X, mask)
         return G
     if spec.has_na:
-        return mx.einsum("ip,ij,iq->jpq", XE, mask, XE)
+        G = mx.einsum("ip,ij,iq->jpq", XE, mask, XE)
+        if shard is not None:
+            G = shard.psum_site(G)
+        return G
     G = mx.matmul(XE.T, XE)
+    if shard is not None:
+        G = shard.psum_site(G)
     return jnp.broadcast_to(G, (spec.ns,) + G.shape)
 
 
 def _beta_lambda_joint(spec, data, state, key, shard=None):
     P = spec.nc + spec.nf_total
-    XE_factor = eta_star(spec, data, state)
+    XE_factor = eta_star(spec, data, state, shard)
     if spec.x_is_list:
         XE = None
     else:
@@ -251,11 +274,13 @@ def _beta_lambda_joint(spec, data, state, key, shard=None):
             return G, rhs_lik
         G, rhs_lik = jax.vmap(per_species, in_axes=(0, 1, 1))(data.X, mask, state.Z)
     else:
-        G = _per_species_design_gram(spec, data, XE, mask)
+        G = _per_species_design_gram(spec, data, XE, mask, shard)
         if spec.has_na:
             rhs_lik = mx.einsum("ip,ij,ij->jp", XE, mask, state.Z)
         else:
             rhs_lik = mx.matmul(XE.T, state.Z).T          # (ns, P)
+        if shard is not None:             # cross-site row contraction
+            rhs_lik = shard.psum_site(rhs_lik)
 
     # per-species posterior precision = blkdiag(iV, diag(psi*tau)) + iSigma_j*G_j
     eyeP = jnp.eye(P, dtype=G.dtype)
@@ -284,7 +309,7 @@ def _lambda_given_beta(spec, data, state, key, shard=None):
     K = spec.nf_total
     if K == 0:
         return state
-    Es = eta_star(spec, data, state)                      # (ny, K)
+    Es = eta_star(spec, data, state, shard)               # (ny, K)
     S = state.Z - linear_fixed(spec, data, state.Beta)
     prior_lam = _stacked_lambda_prior(spec, state)        # (K, ns)
     mask = data.Ymask
@@ -293,8 +318,14 @@ def _lambda_given_beta(spec, data, state, key, shard=None):
         rhs_lik = mx.einsum("ip,ij,ij->jp", Es, mask, S)
     else:
         G0 = mx.matmul(Es.T, Es)
+        if shard is not None:             # cross-site row gram
+            G0 = shard.psum_site(G0)
         G = jnp.broadcast_to(G0, (spec.ns,) + G0.shape)
         rhs_lik = mx.matmul(Es.T, S).T
+    if shard is not None:
+        if spec.has_na:
+            G = shard.psum_site(G)
+        rhs_lik = shard.psum_site(rhs_lik)
     prec = state.iSigma[:, None, None] * G \
         + jnp.eye(K, dtype=G.dtype)[None] * prior_lam.T[:, :, None]
     rhs = state.iSigma[:, None] * rhs_lik
@@ -322,7 +353,7 @@ def _beta_given_lambda_phylo(spec, data, state, key, shard=None):
     ``Gt @ U.T`` lands directly on the local species columns.  The dense
     general path has no sharded formulation (the sampler gates it).
     """
-    S = state.Z - sum(level_loading(data.levels[r], state.levels[r])
+    S = state.Z - sum(level_loading(data.levels[r], state.levels[r], shard)
                       for r in range(spec.nr)) if spec.nr else state.Z
     e = data.Qeig[state.rho_idx]                          # (ns,) eigvals of Q
     M = state.Gamma @ data.Tr.T                           # prior mean (nc, ns)
@@ -333,6 +364,8 @@ def _beta_given_lambda_phylo(spec, data, state, key, shard=None):
         Xs = mx.staged("X", data.X)
         Us = mx.staged("U", data.U)
         XtX = mx.matmul(Xs.T, Xs)
+        if shard is not None:             # X rows are site-local blocks
+            XtX = shard.psum_site(XtX)
         Lv = chol_spd(state.iV)
         B = solve_triangular(Lv, solve_triangular(Lv, XtX, lower=True).T, lower=True)
         g, R = jnp.linalg.eigh((B + B.T) / 2)
@@ -341,7 +374,11 @@ def _beta_given_lambda_phylo(spec, data, state, key, shard=None):
         R0 = S - mx.matmul(Xs, M)
         T = mx.matmul(mx.matmul(XW.T, R0), Us)            # (nc, ns)
         if shard is not None:
-            T = shard.psum(T)
+            # the projection is partial over the species-sharded U rows
+            # AND (on a 2D mesh) the site-sharded design rows: one
+            # reduction over every model-parallel axis (exactly the v1
+            # species psum on a species-only mesh)
+            T = shard.psum_all(T)
         prec = 1.0 / e[None, :] + isig * g[:, None]
         mean = (isig * T) / prec
         eps = jax.random.normal(key, mean.shape, dtype=mean.dtype)
@@ -568,7 +605,13 @@ def _masked_level_gram(spec, data, lvd, ls, lv, iSigma, S, shard=None):
     returns (LiSL (np, nf, nf), F (np, nf)).  Sharded: both are
     cross-species reductions (the factor grams), completed by explicit
     psums; the (np, nf)-shaped outputs are then replicated on every
-    shard — exactly what the per-unit Eta solves need."""
+    shard — exactly what the per-unit Eta solves need.  Site-sharded:
+    the segment sums run over the shard's LOCAL rows into the GLOBAL
+    unit space (``ls.n_units`` stays global), so the same psum — fused
+    over both mesh axes — completes the cross-site row reduction too;
+    callers slice their local unit block afterwards.  The mask-free
+    LiSL needs no site reduction: ``unit_count`` is replicated global
+    data, already counting every shard's rows."""
     npr, nf = ls.n_units, ls.nf_max
     if ls.x_dim == 0:
         lam = lambda_effective(lv)[:, :, 0]                # (nf, ns)
@@ -576,7 +619,7 @@ def _masked_level_gram(spec, data, lvd, ls, lv, iSigma, S, shard=None):
             rows = mx.einsum("fj,gj,j,ij->ifg", lam, lam, iSigma, data.Ymask)
             LiSL = jax.ops.segment_sum(rows, lvd.pi_row, num_segments=npr)
             if shard is not None:
-                LiSL = shard.psum(LiSL)
+                LiSL = shard.psum_all(LiSL)
             Fr = mx.matmul(S * iSigma[None, :] * data.Ymask, lam.T)
         else:
             shared = mx.matmul(lam * iSigma[None, :], lam.T)
@@ -586,7 +629,7 @@ def _masked_level_gram(spec, data, lvd, ls, lv, iSigma, S, shard=None):
             Fr = mx.matmul(S * iSigma[None, :], lam.T)
         F = jax.ops.segment_sum(Fr, lvd.pi_row, num_segments=npr)
         if shard is not None:
-            F = shard.psum(F)
+            F = shard.psum_all(F)
         return LiSL, F
     lam = lambda_effective(lv)                              # (nf, ns, ncr)
     lam_u = mx.einsum("fjk,uk->ufj", lam, lvd.x_unit)       # (np, nf, ns)
@@ -605,11 +648,21 @@ def update_eta_nonspatial(spec, data, state, r: int, key, S, shard=None):
     """Eta_r | rest for one unstructured level: per-unit nf x nf batched
     cholesky; inactive factors fall back to their N(0,1) prior.  Sharded:
     the grams psum; the (np, nf) draw is species-free, so it runs
-    replicated on every shard."""
+    replicated on every shard.  Site-sharded: each shard slices its
+    local unit block out of the psum'd full-width grams and solves only
+    that block, with the draw taken full-width and sliced (the 2D
+    draw-equality contract) — Eta's rows stay local."""
     lvd, lv, ls = data.levels[r], state.levels[r], spec.levels[r]
     LiSL, F = _masked_level_gram(spec, data, lvd, ls, lv, state.iSigma, S,
                                  shard)
     prec = LiSL + jnp.eye(ls.nf_max, dtype=F.dtype)[None]
+    if shard is not None and shard.has_sites:
+        prec = shard.slice_site(prec, 0)
+        F_l = shard.slice_site(F, 0)
+        eps = shard.normal(key, (ls.n_units, ls.nf_max), F.dtype,
+                           dim=None, site_dim=0)
+        eta = sample_mvn_prec_batched(prec, F_l, eps)       # (np_l, nf)
+        return lv.replace(Eta=eta)
     eps = jax.random.normal(key, F.shape, dtype=F.dtype)
     eta = sample_mvn_prec_batched(prec, F, eps)             # (np, nf)
     return lv.replace(Eta=eta)
@@ -621,15 +674,20 @@ def update_eta_nonspatial(spec, data, state, r: int, key, S, shard=None):
 # model; Liu & Sabatti 2000 generalized Gibbs / Yu & Meng 2011 interweaving)
 # ---------------------------------------------------------------------------
 
-def _eta_prior_quad(lvd, lv, ls, r: int = 0) -> jnp.ndarray:
+def _eta_prior_quad(lvd, lv, ls, r: int = 0, shard=None) -> jnp.ndarray:
     """(nf,) quadratic form eta_h' iW(alpha_h) eta_h under the level's actual
     factor prior (identity for unstructured levels; the spatial precision at
     each factor's current alpha for Full/NNGP/GPP — same grid algebra as
-    updateAlpha, gathered at alpha_idx)."""
+    updateAlpha, gathered at alpha_idx).  Site-sharded: the unit sums are
+    cross-site reductions (psum'd; the spatial forms handle their own
+    structure gathers)."""
     if ls.spatial is None:
-        return (lv.Eta ** 2).sum(axis=0)
+        A = (lv.Eta ** 2).sum(axis=0)
+        if shard is not None:
+            A = shard.psum_site(A)
+        return A
     from .spatial import eta_quad_at
-    return eta_quad_at(lvd, ls, lv.Eta, lv.alpha_idx, r=r)
+    return eta_quad_at(lvd, ls, lv.Eta, lv.alpha_idx, r=r, shard=shard)
 
 
 def interweave_scale(spec: ModelSpec, data: ModelData, state: GibbsState,
@@ -651,7 +709,7 @@ def interweave_scale(spec: ModelSpec, data: ModelData, state: GibbsState,
         lvd, lv, ls = data.levels[r], state.levels[r], spec.levels[r]
         kr1, kr2 = jax.random.split(jax.random.fold_in(key, r))
         mask = lv.nf_mask                                 # (nf,)
-        A = _eta_prior_quad(lvd, lv, ls, r=r)
+        A = _eta_prior_quad(lvd, lv, ls, r=r, shard=shard)
         delta = jnp.where(mask[:, None] > 0, lv.Delta, 1.0)
         tau = jnp.cumprod(delta, axis=0)                  # (nf, ncr)
         B = (lv.Psi * tau[:, None, :] * lv.Lambda ** 2).sum(axis=(1, 2))
@@ -752,9 +810,12 @@ def interweave_location(spec: ModelSpec, data: ModelData, state: GibbsState,
                     data.tenant.levels[r].n_units.astype(lam.dtype),
                     (ls.nf_max,))
             s = lv.Eta.sum(axis=0)                        # 1' eta_h
+            if shard is not None:         # cross-site unit sum
+                s = shard.psum_site(s)
         else:
             from .spatial import eta_ones_forms_at
-            q1, s = eta_ones_forms_at(lvd, ls, lv.Eta, lv.alpha_idx, r=r)
+            q1, s = eta_ones_forms_at(lvd, ls, lv.Eta, lv.alpha_idx, r=r,
+                                      shard=shard)
         Us = mx.staged("U", data.U) if spec.has_phylo else None
         if spec.has_phylo and shard is None:
             e = data.Qeig[state.rho_idx]                  # (ns,)
@@ -843,6 +904,9 @@ def interweave_da_intercept(spec: ModelSpec, data: ModelData,
     inf = jnp.asarray(jnp.inf, dtype=R.dtype)
     lo = jnp.where(one, negR, -inf).max(axis=0)       # (ns,)
     hi = jnp.where(zero, negR, inf).min(axis=0)
+    if shard is not None:                 # cross-site row extrema
+        lo = shard.pmax_site(lo)
+        hi = shard.pmin_site(hi)
     # Gaussian prior conditional of the intercept given the other rows of
     # Beta_j (precision form): mean b0 - u / iV[ii,ii], var 1 / iV[ii,ii]
     Mu = jnp.einsum("ct,jt->cj", state.Gamma, data.Tr)
@@ -875,10 +939,16 @@ def update_inv_sigma(spec: ModelSpec, data: ModelData, state: GibbsState,
                      key, E=None, shard=None) -> GibbsState:
     if not spec.any_estimated_sigma:
         return state
-    Eps = state.Z - (total_loading(spec, data, state) if E is None else E)
+    Eps = state.Z - (total_loading(spec, data, state, shard)
+                     if E is None else E)
     n_obs = data.Ymask.sum(axis=0)
+    if shard is not None:                 # cross-site column statistics
+        n_obs = shard.psum_site(n_obs)
     shape = data.aSigma + 0.5 * n_obs
-    rate = data.bSigma + 0.5 * ((Eps * data.Ymask) ** 2).sum(axis=0)
+    sq = ((Eps * data.Ymask) ** 2).sum(axis=0)
+    if shard is not None:
+        sq = shard.psum_site(sq)
+    rate = data.bSigma + 0.5 * sq
     if shard is None:
         draw = standard_gamma(key, shape) / rate
     elif shard.local_rng:
@@ -965,7 +1035,15 @@ def update_nf(spec: ModelSpec, data: ModelData, state: GibbsState, r: int,
     onehot = jax.nn.one_hot(slot, ls.nf_max, dtype=mask.dtype)
     do_add = adapt & add_ok
     sel = jnp.where(do_add, onehot, 0.0)
-    new_eta_col = jax.random.normal(k_eta, (ls.n_units,), dtype=lv.Eta.dtype)
+    if shard is not None and shard.has_sites:
+        # site-dim draw: full-width-and-sliced (local_rng: site-folded,
+        # local width) so the appended factor column matches the
+        # replicated stream per unit block
+        new_eta_col = shard.normal(k_eta, (ls.n_units,), lv.Eta.dtype,
+                                   dim=None, site_dim=0)
+    else:
+        new_eta_col = jax.random.normal(k_eta, (ls.n_units,),
+                                        dtype=lv.Eta.dtype)
     Eta = lv.Eta * (1 - sel)[None, :] + new_eta_col[:, None] * sel[None, :]
     if shard is None:
         new_psi = standard_gamma(k_psi, jnp.broadcast_to(

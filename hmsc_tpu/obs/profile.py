@@ -199,6 +199,31 @@ def build_shard_ledger(devices: int = 8, models=None, only=None) -> dict:
             entry.update(collective_bytes(
                 jax.make_jaxpr(sweep_s)(data, state, _k())))
             programs[name] = entry
+
+    # 2D (species x sites) whole-sweep entries: the same emulated devices
+    # reshaped to a (1, SITE_AUDIT_SP, SITE_AUDIT_ST) mesh over the
+    # site-capable canonical specs (base + Full/NNGP/GPP) — per-device
+    # SPMD cost columns plus the 2D collective byte ledger (the site-axis
+    # psums, Eta row gathers, and both-axis reductions all land in
+    # comm_bytes/collectives, drift-checked by `profile --check`)
+    from ..analysis.jaxpr_rules import (SITE_AUDIT_SP, SITE_AUDIT_ST,
+                                        _site_shard_models)
+    mesh2 = Mesh(np.array(jax.devices()[:SITE_AUDIT_SP * SITE_AUDIT_ST])
+                 .reshape(1, SITE_AUDIT_SP, SITE_AUDIT_ST),
+                 axis_names=("chains", "species", "sites"))
+    tag2 = f"shard{SITE_AUDIT_SP}x{SITE_AUDIT_ST}"
+    for mname, fn in _site_shard_models().items():
+        name = f"{mname}/{tag2}:sweep"
+        if not _keep(name, only):
+            continue
+        spec, data, state = _build(fn())
+        ones = tuple(1 for _ in range(spec.nr))
+        sweep_s = make_sharded_sweep(spec, mesh2, None, ones)
+        entry = _cost_entry(
+            jax.jit(sweep_s).lower(data, state, _k()).compile())
+        entry.update(collective_bytes(
+            jax.make_jaxpr(sweep_s)(data, state, _k())))
+        programs[name] = entry
     return programs
 
 
@@ -483,9 +508,12 @@ def ledger_digest(ledger: dict) -> dict:
         d["programs"] += 1
         if prog.startswith("shard"):
             # per-device SPMD numbers roll up separately: the whole-sweep
-            # comm bytes and per-device argument footprint
-            sh = d.setdefault("shard", {"comm_bytes": None,
-                                        "arg_bytes_per_device": None})
+            # comm bytes and per-device argument footprint (the 2D
+            # species x sites mesh rolls into its own "shard2d" slot so
+            # the v1 species-only numbers keep their meaning)
+            key2d = "shard2d" if "x" in prog.split(":", 1)[0] else "shard"
+            sh = d.setdefault(key2d, {"comm_bytes": None,
+                                      "arg_bytes_per_device": None})
             if prog.endswith(":sweep"):
                 sh["comm_bytes"] = entry.get("comm_bytes", 0)
                 sh["arg_bytes_per_device"] = entry.get("arg_bytes")

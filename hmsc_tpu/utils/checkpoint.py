@@ -2094,7 +2094,7 @@ def resume_run(hM, checkpoint_path: str, *, verbose: int = 0,
                checkpoint_layout: str | None = None,
                allow_legacy_pickle: bool = False, mesh=None,
                chain_axis: str = "chains", species_axis: str = "species",
-               shard_sweep=None,
+               site_axis: str = "sites", shard_sweep=None,
                pipeline: bool = True, coordinator=None, telemetry=None):
     """Continue an auto-checkpointed ``sample_mcmc`` run to completion.
 
@@ -2232,11 +2232,13 @@ def resume_run(hM, checkpoint_path: str, *, verbose: int = 0,
     # (the shard index is folded into every species draw's key)
     stored_local_rng = bool(meta.get("local_rng", False))
     if stored_local_rng:
+        # the full mesh tuple is pinned: shard-folded key streams fold
+        # BOTH axis indices, so a continuation must re-shard over the
+        # same species AND site extents
+        axes = getattr(mesh, "axis_names", ()) if mesh is not None else ()
         want_sp = meta.get("species_shards")
         have_sp = (int(mesh.shape[species_axis])
-                   if (mesh is not None
-                       and species_axis in getattr(mesh, "axis_names", ()))
-                   else None)
+                   if species_axis in axes else None)
         if want_sp is not None and have_sp != want_sp:
             raise CheckpointError(
                 f"{ck.path}: run used local_rng over {want_sp} species "
@@ -2244,6 +2246,25 @@ def resume_run(hM, checkpoint_path: str, *, verbose: int = 0,
                 f"'{species_axis}' extent (got "
                 f"{have_sp if have_sp is not None else 'no species axis'}) "
                 "— the shard-local key streams are not layout-invariant")
+        want_st = meta.get("site_shards")
+        # compare ENGAGED extents, not raw mesh extents: a run whose site
+        # axis fell back (stored site_shards == 1) must stay resumable on
+        # the very mesh that produced it — the continuation falls back
+        # identically, so the folded key streams match
+        from ..mcmc.partition import engaged_site_extent
+        from ..mcmc.structs import build_spec
+        have_st = (engaged_site_extent(
+            build_spec(hM, int(meta.get("nf_cap", 16))), mesh,
+            species_axis, site_axis, meta.get("updater"),
+            has_policy=meta.get("precision_policy") is not None)
+            if mesh is not None else 1)
+        if want_st is not None and have_st != want_st:
+            raise CheckpointError(
+                f"{ck.path}: run used local_rng over "
+                f"(species_shards={want_sp}, site_shards={want_st}); "
+                f"resume must pass a mesh with the same '{site_axis}' "
+                f"extent (got {have_st}) — the shard-local key streams "
+                "are not layout-invariant")
     from ..mcmc.sampler import sample_mcmc
     cont = sample_mcmc(
         hM, samples=total - done, transient=remaining_t,
@@ -2272,7 +2293,7 @@ def resume_run(hM, checkpoint_path: str, *, verbose: int = 0,
         local_rng=stored_local_rng,
         align_post=False, verbose=verbose, mesh=mesh,
         chain_axis=chain_axis, species_axis=species_axis,
-        shard_sweep=shard_sweep,
+        site_axis=site_axis, shard_sweep=shard_sweep,
         progress_callback=progress_callback,
         checkpoint_every=ck_every,
         checkpoint_path=ckdir,

@@ -1,17 +1,20 @@
-"""Device-mesh construction for chain / species parallelism.
+"""Device-mesh construction for chain / species / site parallelism.
 
 The reference's only parallelism is a SOCK cluster fanning chains over OS
 processes (``R/sampleMcmc.R:329-345``).  Here the equivalent is a
 ``jax.sharding.Mesh``: chains are the data-parallel axis (no collectives
-during sampling — chains are independent), and an optional second axis
-shards the species dimension of every site x species array model-parallel,
-with XLA inserting the cross-species collectives over ICI.
+during sampling — chains are independent), an optional second axis
+shards the species dimension of every site x species array
+model-parallel, and an optional third axis shards the SITE dimension
+(sampling rows + per-level units: Z rows, Eta rows, the NNGP/GPP unit
+grids) so np-dominated spatial models stop replicating their per-unit
+state, with explicit collectives at the cross-site reductions.
 
 Multi-host: under ``jax.distributed``, ``jax.devices()`` returns the global
 device list, so the same helper lays the mesh over all hosts; chains ride
-DCN-free (pure replication) and only the species axis communicates — place
-it within a host (the default device order does this) so its collectives
-stay on ICI.
+DCN-free (pure replication) and only the species/site axes communicate —
+place them within a host (the default device order does this) so their
+collectives stay on ICI.
 """
 
 from __future__ import annotations
@@ -22,16 +25,22 @@ __all__ = ["make_mesh"]
 
 
 def make_mesh(n_chains: int | None = None, species_shards: int = 1,
-              devices=None, chain_axis: str = "chains",
-              species_axis: str = "species"):
-    """Build a 1-D ``(chains,)`` or 2-D ``(chains, species)`` Mesh.
+              site_shards: int = 1, devices=None,
+              chain_axis: str = "chains", species_axis: str = "species",
+              site_axis: str = "sites"):
+    """Build a 1-D ``(chains,)``, 2-D ``(chains, species)`` or 3-D
+    ``(chains, species, sites)`` Mesh.
 
-    ``n_chains = None`` uses every available device on the chain axis (after
-    dividing out ``species_shards``).  Raises if the device count cannot be
-    factored as requested.  Pass the result as ``sample_mcmc(mesh=...)``;
-    chains need not equal the mesh's chain extent (they are laid out over
-    it), but the species extent must divide ``ns`` to engage model
-    parallelism (the sampler warns and replicates otherwise).
+    ``n_chains = None`` uses every available device on the chain axis
+    (after dividing out ``species_shards * site_shards``).  Raises if the
+    device count cannot be factored as requested.  Pass the result as
+    ``sample_mcmc(mesh=...)``; chains need not equal the mesh's chain
+    extent (they are laid out over it), but the species extent must
+    divide ``ns`` — and the site extent must divide ``ny`` and every
+    level's unit count — to engage model parallelism (the sampler warns
+    and replicates the failing axis otherwise).  ``site_shards > 1``
+    always emits the 3-D mesh (the species axis rides along at extent 1
+    when unused, so the shard context's axis names stay uniform).
     """
     import jax
     from jax.sharding import Mesh
@@ -40,26 +49,48 @@ def make_mesh(n_chains: int | None = None, species_shards: int = 1,
     n = len(devices)
     if species_shards < 1:
         raise ValueError(f"species_shards={species_shards} must be >= 1")
+    if site_shards < 1:
+        raise ValueError(f"site_shards={site_shards} must be >= 1")
+    model_shards = species_shards * site_shards
     if n_chains is None:
         # derive the chain extent from the device count; needs divisibility
-        if n % species_shards:
+        if n % model_shards:
             from ..mcmc.partition import nearest_divisor
+            if site_shards == 1:
+                hint = (f"the nearest valid species_shards for {n} "
+                        f"device(s) is {nearest_divisor(n, species_shards)}")
+            elif n % site_shards == 0:
+                # the hinted species count must stay valid JOINTLY with
+                # the requested site count: divisors of n//site_shards
+                hint = (f"with site_shards={site_shards} the nearest "
+                        f"valid species_shards for {n} device(s) is "
+                        f"{nearest_divisor(n // site_shards, species_shards)}")
+            else:
+                hint = (f"no species_shards works: site_shards="
+                        f"{site_shards} does not divide {n} device(s) — "
+                        f"the nearest valid site_shards is "
+                        f"{nearest_divisor(n, site_shards)}")
             raise ValueError(
+                f"species_shards*site_shards="
+                f"{species_shards}*{site_shards}={model_shards} "
+                f"must divide the device count {n}; {hint} "
+                "(or pass n_chains explicitly)"
+                if site_shards > 1 else
                 f"species_shards={species_shards} must divide the device "
-                f"count {n}; the nearest valid species_shards for "
-                f"{n} device(s) is {nearest_divisor(n, species_shards)} "
-                "(or pass n_chains explicitly)")
-        n_chain_devs = n // species_shards
+                f"count {n}; {hint} (or pass n_chains explicitly)")
+        n_chain_devs = n // model_shards
     else:
         n_chain_devs = int(n_chains)
         if n_chain_devs < 1:
             raise ValueError(f"n_chains={n_chains} must be >= 1")
-    if n_chain_devs * species_shards > n:
+    if n_chain_devs * model_shards > n:
         raise ValueError(
-            f"{n_chain_devs} chain-devices x {species_shards} species shards "
-            f"> {n} devices")
-    grid = np.array(devices[:n_chain_devs * species_shards]).reshape(
-        n_chain_devs, species_shards)
+            f"{n_chain_devs} chain-devices x {species_shards} species "
+            f"shards x {site_shards} site shards > {n} devices")
+    grid = np.array(devices[:n_chain_devs * model_shards]).reshape(
+        n_chain_devs, species_shards, site_shards)
+    if site_shards > 1:
+        return Mesh(grid, axis_names=(chain_axis, species_axis, site_axis))
     if species_shards == 1:
-        return Mesh(grid[:, 0], axis_names=(chain_axis,))
-    return Mesh(grid, axis_names=(chain_axis, species_axis))
+        return Mesh(grid[:, 0, 0], axis_names=(chain_axis,))
+    return Mesh(grid[:, :, 0], axis_names=(chain_axis, species_axis))

@@ -19,9 +19,11 @@ import pytest
 
 import jax
 
-from hmsc_tpu.analysis.jaxpr_rules import _build, _shard_models
+from hmsc_tpu.analysis.jaxpr_rules import (_build, _shard_models,
+                                           _site_shard_models)
 from hmsc_tpu.mcmc.partition import (SHARD_AGREEMENT_TOL, ShardCtx,
-                                     collective_bytes, nearest_divisor)
+                                     collective_bytes, nearest_divisor,
+                                     nearest_site_divisor)
 from hmsc_tpu.mcmc.sweep import make_sharded_sweep, make_sweep
 from hmsc_tpu.mcmc.sampler import sample_mcmc
 from hmsc_tpu.utils.mesh import make_mesh
@@ -36,6 +38,12 @@ def _mesh(shards):
     from jax.sharding import Mesh
     return Mesh(np.array(jax.devices()[:shards]).reshape(1, shards),
                 axis_names=("chains", "species"))
+
+
+def _mesh2(sp, st):
+    from jax.sharding import Mesh
+    return Mesh(np.array(jax.devices()[:sp * st]).reshape(1, sp, st),
+                axis_names=("chains", "species", "sites"))
 
 
 def _chain(fn, data, state, key, n):
@@ -90,6 +98,61 @@ def test_sharded_sweep_agrees_with_replicated(model, shards):
     _assert_state_close(ref, got)
 
 
+# ---------------------------------------------------------------------------
+# 2D (species x sites) mesh agreement: base + the three spatial methods
+# (Full / NNGP / GPP — the np-dominated classes the site axis is for)
+# ---------------------------------------------------------------------------
+
+# tier-1 runs every site-capable spec on the full 8-device (4, 2) mesh
+# plus one site-dominant layout; the inner layouts ride the slow tier
+_FAST2 = {(m, 4, 2) for m in ("base", "spatial", "nngp", "gpp")} \
+    | {("nngp", 1, 4)}
+_MATRIX2 = [pytest.param(m, sp, st, id=f"{m}-sp{sp}x{st}",
+                         marks=() if (m, sp, st) in _FAST2
+                         else (pytest.mark.slow,))
+            for m in ("base", "spatial", "nngp", "gpp")
+            for sp, st in ((4, 2), (2, 2), (1, 4), (2, 4))]
+
+
+@pytest.mark.parametrize("model,sp,st", _MATRIX2)
+def test_site_sharded_sweep_agrees_with_replicated(model, sp, st):
+    spec, data, state = _build(_site_shard_models()[model]())
+    ones = tuple(0 for _ in range(spec.nr))
+    key = jax.random.key(7, impl="threefry2x32")
+    ref = _chain(make_sweep(spec, None, ones), data, state, key, _SWEEPS)
+    fn = make_sharded_sweep(spec, _mesh2(sp, st), None, ones)
+    got = _chain(fn, data, state, key, _SWEEPS)
+    _assert_state_close(ref, got)
+
+
+def test_site_sharded_nngp_dense_cg_crossover_agrees(monkeypatch):
+    """The NNGP dense<->CG crossover re-asserted under site sharding:
+    both paths of the same model agree with the replicated sweep on the
+    2D mesh (the crossover is forced each way via _NNGP_DENSE_MAX, like
+    the replicated crossover test)."""
+    import hmsc_tpu.mcmc.spatial as _sp
+    spec, data, state = _build(_site_shard_models()["nngp"]())
+    ones = tuple(0 for _ in range(spec.nr))
+    key = jax.random.key(13, impl="threefry2x32")
+    for dense_max in (10**9, 0):          # force dense, then force CG
+        monkeypatch.setattr(_sp, "_NNGP_DENSE_MAX", dense_max)
+        ref = _chain(make_sweep(spec, None, ones), data, state, key,
+                     _SWEEPS)
+        fn = make_sharded_sweep(spec, _mesh2(2, 4), None, ones)
+        got = _chain(fn, data, state, key, _SWEEPS)
+        _assert_state_close(ref, got)
+
+
+def test_site_sharded_sweep_with_nf_adaptation_agrees():
+    spec, data, state = _build(_site_shard_models()["base"]())
+    adapt = tuple(5 for _ in range(spec.nr))
+    key = jax.random.key(11, impl="threefry2x32")
+    ref = _chain(make_sweep(spec, None, adapt), data, state, key, _SWEEPS)
+    fn = make_sharded_sweep(spec, _mesh2(2, 2), None, adapt)
+    got = _chain(fn, data, state, key, _SWEEPS)
+    _assert_state_close(ref, got)
+
+
 def test_sharded_sweep_with_nf_adaptation_agrees():
     spec, data, state = _build(_shard_models()["base"]())
     adapt = tuple(5 for _ in range(spec.nr))
@@ -134,6 +197,148 @@ def test_sharded_checkpoint_resume_roundtrip(tmp_path):
                                       np.asarray(post_s[k]))
         # and both agree with the replicated run within tolerance
         assert _max_rel(post_r[k], post_l[k]) <= SHARD_AGREEMENT_TOL, k
+
+
+def test_site_sharded_sample_mcmc_draws_agree():
+    """sample_mcmc on the 2D (species x sites) mesh agrees with the
+    replicated run within the shared tolerance — Eta (site-sharded rows)
+    included."""
+    hM = _site_shard_models()["gpp"]()
+    kw = dict(samples=3, transient=2, n_chains=2, seed=3, align_post=False,
+              nf_cap=2)
+    post_r = sample_mcmc(hM, **kw)
+    post_s = sample_mcmc(hM, mesh=make_mesh(n_chains=1, species_shards=2,
+                                            site_shards=4), **kw)
+    for k in post_r.arrays:
+        assert _max_rel(post_r[k], post_s[k]) <= SHARD_AGREEMENT_TOL, k
+
+
+def test_site_sharded_checkpoint_resume_roundtrip(tmp_path):
+    """A 2D-sharded checkpointed run commits draws the replicated run
+    agrees with, and resume_run round-trips the completed run exactly."""
+    from hmsc_tpu.utils.checkpoint import resume_run
+    hM = _site_shard_models()["nngp"]()
+    mesh = make_mesh(n_chains=1, species_shards=2, site_shards=2)
+    kw = dict(samples=4, transient=2, n_chains=2, seed=5, align_post=False,
+              nf_cap=2)
+    post_r = sample_mcmc(hM, **kw)
+    ck = os.fspath(tmp_path / "run")
+    post_s = sample_mcmc(hM, mesh=mesh, checkpoint_every=2,
+                         checkpoint_path=ck, **kw)
+    post_l = resume_run(hM, ck)
+    for k in post_r.arrays:
+        np.testing.assert_array_equal(np.asarray(post_l[k]),
+                                      np.asarray(post_s[k]))
+        assert _max_rel(post_r[k], post_l[k]) <= SHARD_AGREEMENT_TOL, k
+
+
+def test_site_meta_records_mesh_tuple(tmp_path):
+    """The checkpoint meta stores the full engaged mesh tuple
+    (species_shards, site_shards) for every sharded run."""
+    from hmsc_tpu.utils.checkpoint import latest_valid_checkpoint
+    hM = _site_shard_models()["base"]()
+    ck = os.fspath(tmp_path / "run")
+    sample_mcmc(hM, mesh=make_mesh(n_chains=1, species_shards=2,
+                                   site_shards=4),
+                samples=2, transient=1, n_chains=1, seed=2,
+                align_post=False, nf_cap=2,
+                checkpoint_every=2, checkpoint_path=ck)
+    meta = latest_valid_checkpoint(ck, hM).run_meta
+    assert meta["species_shards"] == 2
+    assert meta["site_shards"] == 4
+
+
+def test_site_local_rng_resume_rejects_changed_site_count(tmp_path):
+    """local_rng streams fold BOTH shard indices: a continuation over a
+    different SITE extent is rejected with a clear error (the species
+    pinning alone would let the stream silently fork)."""
+    from hmsc_tpu.utils.checkpoint import CheckpointError, resume_run
+    hM = _site_shard_models()["base"]()
+    ck = os.fspath(tmp_path / "run")
+    try:
+        sample_mcmc(hM, mesh=make_mesh(n_chains=1, species_shards=2,
+                                       site_shards=4),
+                    local_rng=True, samples=4, transient=1, n_chains=2,
+                    seed=5, align_post=False, nf_cap=2, checkpoint_every=2,
+                    checkpoint_path=ck, progress_callback=_kill_after(1))
+    except RuntimeError:
+        pass
+    with pytest.raises(CheckpointError, match="local_rng"):
+        resume_run(hM, ck, mesh=make_mesh(n_chains=1, species_shards=2,
+                                          site_shards=2))
+
+
+def test_nondivisible_sites_warn_and_fall_back_to_species():
+    """ny/np not divisible by the site extent: the documented warn-once
+    fallback names the values and the nearest valid site divisor, and the
+    run continues species-sharded — agreeing with the replicated run."""
+    hM = _shard_models()["base"]()          # np = 5: no site divisor > 1
+    kw = dict(samples=2, transient=1, n_chains=1, seed=9, align_post=False,
+              nf_cap=2)
+    post_r = sample_mcmc(hM, **kw)
+    mesh = make_mesh(n_chains=1, species_shards=2, site_shards=4)
+    with pytest.warns(RuntimeWarning) as rec:
+        post_s = sample_mcmc(hM, mesh=mesh, **kw)
+    msgs = [str(w.message) for w in rec]
+    hit = [m for m in msgs if "site_shards" in m]
+    assert hit, msgs
+    assert "not divisible" in hit[0]
+    assert "nearest valid site_shards" in hit[0]
+    assert "is 1" in hit[0]                 # gcd(12, 5) = 1
+    for k in post_r.arrays:
+        assert _max_rel(post_r[k], post_s[k]) <= SHARD_AGREEMENT_TOL, k
+
+
+def test_nondivisible_sites_strict_mode_raises():
+    """shard_sweep=True on a site-only mesh must never silently replicate
+    the site axis."""
+    hM = _shard_models()["base"]()          # np = 5
+    mesh = make_mesh(n_chains=1, species_shards=1, site_shards=4)
+    with pytest.raises(ValueError, match="shard_sweep=True"):
+        sample_mcmc(hM, samples=1, transient=0, n_chains=1, seed=9,
+                    align_post=False, nf_cap=2, mesh=mesh,
+                    shard_sweep=True)
+
+
+def test_site_local_rng_resume_accepts_fallback_mesh(tmp_path):
+    """A local_rng run whose SITE axis fell back (non-divisible units,
+    stored site_shards=1) must stay resumable on the very mesh that
+    produced it: the pinning compares ENGAGED extents, and a resume on
+    the same mesh falls back identically."""
+    from hmsc_tpu.utils.checkpoint import resume_run
+    hM = _shard_models()["base"]()          # np = 5: site axis falls back
+    mesh = make_mesh(n_chains=1, species_shards=2, site_shards=4)
+    ck = os.fspath(tmp_path / "run")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        post = sample_mcmc(hM, mesh=mesh, local_rng=True, samples=4,
+                           transient=1, n_chains=2, seed=5,
+                           align_post=False, nf_cap=2,
+                           checkpoint_every=2, checkpoint_path=ck)
+        post_l = resume_run(hM, ck, mesh=mesh)
+    for k in post.arrays:
+        np.testing.assert_array_equal(np.asarray(post[k]),
+                                      np.asarray(post_l[k]))
+
+
+def test_strict_mode_rejects_orphan_site_mesh():
+    """shard_sweep=True on a hand-built (chains, sites) mesh with no
+    species axis must raise, not silently replicate (the 2D geometry
+    hangs off the species ctx)."""
+    from jax.sharding import Mesh
+    hM = _site_shard_models()["base"]()
+    orphan = Mesh(np.array(jax.devices()[:4]).reshape(1, 4),
+                  axis_names=("chains", "sites"))
+    with pytest.raises(ValueError, match="shard_sweep=True requires"):
+        sample_mcmc(hM, mesh=orphan, shard_sweep=True, samples=1,
+                    n_chains=1, nf_cap=2, align_post=False)
+
+
+def test_nearest_site_divisor():
+    assert nearest_site_divisor(16, [8], 4) == 4
+    assert nearest_site_divisor(16, [8], 3) == 4      # ties prefer larger
+    assert nearest_site_divisor(12, [5], 4) == 1      # gcd(12, 5) = 1
+    assert nearest_site_divisor(16, [8, 6], 4) == 2   # gcd = 2
 
 
 def test_local_rng_resume_roundtrip(tmp_path):
@@ -327,10 +532,30 @@ def test_sharded_fingerprints_committed():
     with open(FINGERPRINTS_PATH) as f:
         fps = json.load(f)["programs"]
     names = [k for k in fps if k.startswith("sharded_sweep@")]
-    assert len(names) == 4, names
+    sp1d = [k for k in names if k.endswith("@sp8")]
+    sp2d = [k for k in names if k.endswith("@sp4x2")]
+    assert len(sp1d) == 4, names            # v1 species-only entries
+    assert len(sp2d) == 4, names            # additive 2D entries
     for k in names:
         assert fps[k]["prims"].get("psum", 0) > 0, \
             f"{k}: fingerprint records no collective sequence"
+    for k in sp2d:
+        # the Pi row gathers of the site axis are part of the committed
+        # 2D collective sequence
+        assert fps[k]["prims"].get("all_gather", 0) > 0, \
+            f"{k}: 2D fingerprint records no site gathers"
+
+
+def test_comm_ledger_has_2d_entries():
+    from hmsc_tpu.obs.profile import LEDGER_PATH
+    with open(LEDGER_PATH) as f:
+        led = json.load(f)
+    for m in ("base", "spatial", "nngp", "gpp"):
+        entry = led["programs"].get(f"{m}/shard4x2:sweep")
+        assert entry is not None, f"{m}/shard4x2:sweep missing from ledger"
+        assert entry["comm_bytes"] > 0
+        assert "psum" in entry["collectives"]
+        assert "all_gather" in entry["collectives"]
 
 
 def test_nearest_divisor():
