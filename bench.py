@@ -349,6 +349,19 @@ def _multitenant_summary():
         ["benchmarks/bench_multitenant.py", "--digest"], timeout=1800)
 
 
+def _autopilot_summary():
+    """The continuous-learning chaos-drill digest
+    (`benchmarks/bench_autopilot.py --light`): a reduced drop stream
+    (2 good + 1 bad) through the full autopilot daemon — supervised
+    refit surviving a mid-refit SIGKILL, a flip-phase daemon kill,
+    quarantine accounting, serving-on-newest + zero-draws-lost + zero
+    failed in-flight queries gates — CPU-only subprocess, so the
+    autonomous-operation path rides the trajectory on every round."""
+    return _digest_subprocess(
+        ["benchmarks/bench_autopilot.py", "--drops", "2", "--bad-drops",
+         "1", "--light"], timeout=1800)
+
+
 def _skip(reason: str):
     """Emit a parseable skip record instead of a bare nonzero exit: the
     bench trajectory must distinguish "chip unreachable this round" from "a
@@ -376,6 +389,7 @@ def _skip(reason: str):
         "precision": _precision_summary(),
         "multitenant": _multitenant_summary(),
         "refit": _refit_summary(),
+        "autopilot": _autopilot_summary(),
     }))
     raise SystemExit(0)
 
@@ -551,6 +565,12 @@ def main():
         # appended dataset (benchmarks/bench_refit.py) — models that live
         # with their data ride the trajectory
         "refit": _refit_summary(),
+        # autopilot chaos-drill digest (CPU subprocess): the continuous-
+        # learning daemon surviving seeded kills with serving-on-newest,
+        # zero-draws-lost and zero-failed-queries gates
+        # (benchmarks/bench_autopilot.py) — autonomous operation rides
+        # the trajectory alongside throughput
+        "autopilot": _autopilot_summary(),
     }))
 
 

@@ -1,0 +1,346 @@
+#!/usr/bin/env python
+"""Autopilot chaos drill: the closed continuous-learning loop under fire.
+
+The autopilot daemon's invariant — **the loop always converges: serving
+ends on the newest committed epoch, zero committed draws lost, zero
+failed in-flight queries, every bad drop quarantined with a reason** —
+gated end-to-end on CPU:
+
+1. fit a parent run and start an in-process serving engine + HTTP front
+   end, with a query thread pounding ``POST /predict`` for the entire
+   drill (its failure counter feeds the zero-failed-queries gate);
+2. seed the drop directory with a stream of data batches — good appends
+   interleaved with deliberately bad ones (non-binary probit responses,
+   wrong species width, a torn npz);
+3. run ``python -m hmsc_tpu autopilot`` as a subprocess under a seeded
+   :class:`~hmsc_tpu.testing.chaos.PipelineChaos` schedule injecting
+   SIGKILL/SIGTERM/heartbeat-freeze/disk-full faults mid-validate,
+   mid-refit, mid-flip and mid-compact; the bench re-launches the daemon
+   whenever a daemon-phase fault takes it down (the chaos state file
+   guarantees each fault fires exactly once across restarts);
+4. gate the end state: every epoch in the registry loads with its full
+   committed draw count (manifest audit), serving reports the newest
+   epoch at an advanced generation, the query thread saw zero failures,
+   and ``rejected/`` accounts for exactly the injected-bad drops with
+   machine-readable reasons.
+
+Prints one JSON digest line (embedded by ``bench.py`` into headline and
+skip records); exits nonzero on any gate miss.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _write_drop(path, rng, ns, n_units, rows, bad=None):
+    """One drop npz; ``bad`` injects a specific append-contract violation
+    the validator must catch (``None`` = a valid append)."""
+    X = np.column_stack([np.ones(rows), rng.standard_normal(rows)])
+    Y = (rng.standard_normal((rows, ns)) > 0).astype(float)
+    units = np.array([f"u{j % n_units:02d}" for j in range(rows)])
+    if bad == "nonbinary":
+        Y[0, 0] = 7.0                       # probit responses take 0/1
+    elif bad == "width":
+        Y = Y[:, : ns - 1]                  # wrong species count
+    if bad == "torn":
+        with open(path, "wb") as f:
+            f.write(b"PK\x03\x04 torn npz payload")
+        return
+    np.savez(path, Y=Y, X=X, **{"units:lvl": units})
+
+
+def _full_matrix(good):
+    """Faults at every pipeline phase, spread over the GOOD drops of the
+    stream (bad drops never reach refit/flip/compact, and a drop whose
+    flip-phase fault kills the daemon is already ledgered on restart — so
+    its compact-phase strike would never be revisited; each daemon-killing
+    post-commit fault gets its own drop)."""
+    events = [
+        # pre-commit faults can stack on one drop: the validate kill lands
+        # before the ledger, so the restarted daemon reprocesses the drop
+        # and the armed refit kill still fires
+        {"action": "sigkill", "drop": good[0], "phase": "validate"},
+        {"action": "sigkill", "drop": good[0], "phase": "refit"},
+        {"action": "freeze", "drop": good[1 % len(good)], "phase": "refit"},
+        # disk_full never kills the daemon, so refit- and compact-phase
+        # write failures can share a drop too
+        {"action": "disk_full", "drop": good[2 % len(good)],
+         "phase": "refit"},
+        {"action": "disk_full", "drop": good[2 % len(good)],
+         "phase": "compact"},
+        {"action": "sigterm", "drop": good[3 % len(good)], "phase": "flip"},
+        {"action": "sigkill", "drop": good[4 % len(good)], "phase": "flip"},
+        {"action": "sigkill", "drop": good[5 % len(good)],
+         "phase": "compact"},
+    ]
+    seen, out = set(), []
+    flip_killed = {e["drop"] for e in events
+                   if e["phase"] == "flip"
+                   and e["action"] in ("sigkill", "sigterm")}
+    for e in events:                      # tiny streams fold drops together:
+        key = (e["drop"], e["phase"])     # keep one fault per (drop, phase),
+        if key in seen:                   # and drop compact faults orphaned
+            continue                      # by a flip-phase daemon kill (the
+        if e["phase"] == "compact" and e["drop"] in flip_killed:
+            continue                      # restarted daemon never revisits
+        seen.add(key)                     # a ledgered drop's compact strike)
+        out.append(e)
+    return out
+
+
+def _light_matrix(good):
+    return [{"action": "sigkill", "drop": good[0], "phase": "refit"},
+            {"action": "sigkill", "drop": good[1 % len(good)],
+             "phase": "flip"}][: len(good)]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--drops", type=int, default=6,
+                    help="valid data drops in the stream")
+    ap.add_argument("--bad-drops", type=int, default=2,
+                    help="deliberately invalid drops interleaved")
+    ap.add_argument("--rows", type=int, default=5, help="rows per drop")
+    ap.add_argument("--ny", type=int, default=30)
+    ap.add_argument("--ns", type=int, default=4)
+    ap.add_argument("--n-units", type=int, default=6)
+    ap.add_argument("--samples", type=int, default=8,
+                    help="parent-run draws (epoch 0)")
+    ap.add_argument("--transient", type=int, default=6)
+    ap.add_argument("--chains", type=int, default=2)
+    ap.add_argument("--refit-samples", type=int, default=8)
+    ap.add_argument("--max-sweeps", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=0,
+                    help="seeds the drop stream AND the runs — the whole "
+                         "drill is deterministic per seed")
+    ap.add_argument("--light", action="store_true",
+                    help="reduced fault matrix (2 events) for CI digests; "
+                         "default is the full every-phase matrix")
+    ap.add_argument("--max-daemon-restarts", type=int, default=12)
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON digest here")
+    args = ap.parse_args(argv)
+
+    from hmsc_tpu.mcmc.sampler import sample_mcmc
+    from hmsc_tpu.pipeline.drops import rejected_reasons
+    from hmsc_tpu.serve.artifact import load_run_posterior
+    from hmsc_tpu.serve.engine import ServingEngine
+    from hmsc_tpu.serve.http import make_server
+    from hmsc_tpu.testing.chaos import PipelineChaos
+    from hmsc_tpu.testing.multiproc import (_pkg_root, build_worker_model,
+                                            worker_env)
+    from hmsc_tpu.utils.checkpoint import committed_epochs
+
+    model_kw = {"ny": args.ny, "ns": args.ns, "nc": 2, "distr": "probit",
+                "n_units": args.n_units, "seed": 3}
+    refit_kw = {"samples": args.refit_samples, "min_sweeps": 4,
+                "max_sweeps": args.max_sweeps, "probe_every": 4,
+                "seed": args.seed}
+    t_start = time.perf_counter()
+
+    with tempfile.TemporaryDirectory() as td:
+        run = os.path.join(td, "run")
+        drop_dir = os.path.join(td, "drops")
+        work = os.path.join(td, "work")
+        os.makedirs(drop_dir)
+
+        hM = build_worker_model(**model_kw)
+        sample_mcmc(hM, samples=args.samples, transient=args.transient,
+                    n_chains=args.chains, seed=args.seed, nf_cap=2,
+                    align_post=False, checkpoint_every=4,
+                    checkpoint_path=run)
+
+        # the drop stream: bad drops interleaved at fixed positions, each a
+        # DIFFERENT contract violation
+        total = args.drops + args.bad_drops
+        bad_kinds = ["nonbinary", "width", "torn"]
+        bad_at = {}
+        for b in range(args.bad_drops):
+            # spread the bad drops through the stream, never first (the
+            # first drop carries the mid-validate daemon kill)
+            bad_at[1 + b * max(2, total // max(args.bad_drops, 1))
+                   % max(total, 1)] = bad_kinds[b % len(bad_kinds)]
+        rng = np.random.default_rng(args.seed + 9)
+        names = []
+        for i in range(total):
+            name = f"drop-{i:03d}.npz"
+            names.append((name, bad_at.get(i)))
+            _write_drop(os.path.join(drop_dir, name), rng, args.ns,
+                        args.n_units, args.rows, bad=bad_at.get(i))
+        bad_names = [n for n, b in names if b]
+
+        # serving: in-process engine + HTTP front end the daemon flips
+        engine = ServingEngine(run, hM=hM)
+        server = make_server(engine)
+        host, port = server.server_address[:2]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        url = f"http://{host}:{port}"
+
+        # in-flight queries: pound /predict for the whole drill; EVERY
+        # request must succeed — flips are atomic from a caller's view
+        stop = threading.Event()
+        qstats = {"total": 0, "failed": 0, "errors": []}
+        Xq = [[1.0, 0.25 * r] for r in range(3)]
+
+        def _pound():
+            body = json.dumps({"X": Xq}).encode()
+            while not stop.is_set():
+                qstats["total"] += 1
+                try:
+                    req = urllib.request.Request(
+                        url + "/predict", data=body,
+                        headers={"Content-Type": "application/json"})
+                    with urllib.request.urlopen(req, timeout=30.0) as r:
+                        if r.status != 200:
+                            raise OSError(f"http {r.status}")
+                except Exception as e:   # noqa: BLE001 — every failure
+                    qstats["failed"] += 1    # mode counts against the gate
+                    if len(qstats["errors"]) < 5:
+                        qstats["errors"].append(f"{type(e).__name__}: {e}")
+                time.sleep(0.1)
+
+        qthread = threading.Thread(target=_pound, daemon=True)
+        qthread.start()
+
+        cfg = {"run_dir": run, "drop_dir": drop_dir, "work_dir": work,
+               "refit_kw": refit_kw, "model_kw": model_kw,
+               "serve_url": url, "dispatch": "worker",
+               "max_drops": total, "poll_s": 0.05,
+               "heartbeat_interval_s": 0.25, "heartbeat_timeout_s": 6.0,
+               "startup_grace_s": 240.0, "wall_timeout_s": 600.0,
+               "restart_budget": 4, "backoff_base_s": 0.25,
+               "backoff_max_s": 2.0,
+               "retention": {"compact": True, "keep": 2}}
+        cfg_path = os.path.join(td, "autopilot.json")
+        with open(cfg_path, "w") as f:
+            json.dump(cfg, f)
+
+        good = [i for i in range(total) if i not in bad_at]
+        events = (_light_matrix(good) if args.light
+                  else _full_matrix(good))
+        chaos_state = os.path.join(td, "chaos-state.json")
+        daemon_cmd = [sys.executable, "-m", "hmsc_tpu", "autopilot",
+                      cfg_path, "--chaos", json.dumps(events),
+                      "--chaos-state", chaos_state]
+
+        # supervise the daemon itself: chaos kills it mid-validate /
+        # mid-flip / mid-compact, and every relaunch must reconcile and
+        # converge — the chaos state file makes each fault fire once
+        restarts = -1
+        rcs = []
+        summary = {}
+        for _ in range(args.max_daemon_restarts + 1):
+            restarts += 1
+            r = subprocess.run(daemon_cmd, cwd=_pkg_root(),
+                               env=worker_env(), capture_output=True,
+                               text=True, timeout=1800)
+            rcs.append(r.returncode)
+            if r.returncode == 0:
+                summary = json.loads(r.stdout.strip().splitlines()[-1])
+                break
+        else:
+            summary = {"status": "daemon-never-converged"}
+
+        time.sleep(0.3)                       # a last few queries land
+        stop.set()
+        qthread.join(timeout=5.0)
+
+        # cumulative supervision counters come from the pipeline event
+        # stream, not the last daemon's summary — a chaos-killed daemon
+        # takes its in-memory counters with it
+        from hmsc_tpu.obs.report import load_fleet_events
+        pevs = [e for e in load_fleet_events(run)
+                if e.get("kind") == "pipeline"]
+        n_backoffs = sum(1 for e in pevs if e.get("name") == "backoff")
+        n_flips = sum(1 for e in pevs if e.get("name") == "flip")
+        n_compact = sum(1 for e in pevs if e.get("name") == "compact")
+
+        # ---- the end-state audit --------------------------------------
+        ks = committed_epochs(run)
+        expect_epochs = list(range(args.drops + 1))
+        # zero committed draws lost: every registry epoch loads in full
+        draws_lost = 0
+        epoch_draws = {}
+        for k in ks:
+            want = args.samples if k == 0 else args.refit_samples
+            try:
+                post, _ = load_run_posterior(run, hM, epoch=k)
+                got = int(post.samples)
+            except Exception as e:   # noqa: BLE001 — an unloadable epoch
+                got = 0                  # is lost draws, not a crash
+                epoch_draws[f"err_{k}"] = f"{type(e).__name__}: {e}"
+            epoch_draws[k] = got
+            draws_lost += max(0, want - got)
+
+        h = json.loads(urllib.request.urlopen(
+            url + "/healthz", timeout=10.0).read().decode())
+        rejected = rejected_reasons(os.path.join(drop_dir, "rejected"))
+        chaos_left = int(PipelineChaos(events,
+                                       state_path=chaos_state).remaining())
+
+        server.shutdown()
+        engine.close()
+
+        gates = {
+            "daemon_converged": bool(summary.get("ok")),
+            "all_epochs_committed": ks == expect_epochs,
+            "zero_draws_lost": draws_lost == 0,
+            "serving_on_newest": (h.get("epoch") == (ks[-1] if ks else None)
+                                  and h.get("last_flip_wall") is not None),
+            "zero_failed_queries": (qstats["failed"] == 0
+                                    and qstats["total"] > 0),
+            "all_bad_drops_quarantined": (
+                sorted(rejected) == sorted(bad_names)
+                and all(r.get("exit_code") == 79 and r.get("kind")
+                        and r.get("detail") for r in rejected.values())),
+            "all_faults_fired": chaos_left == 0,
+        }
+        digest = {
+            "bench": "autopilot",
+            "model": model_kw, "refit": refit_kw,
+            "drops": args.drops, "bad_drops": args.bad_drops,
+            "chaos": {"events": len(events),
+                      "light": bool(args.light),
+                      "unfired": chaos_left},
+            "daemon_restarts": restarts,
+            "daemon_rcs": rcs,
+            "worker_restarts": n_backoffs,
+            "flips": n_flips,
+            "compactions": n_compact,
+            "epochs": ks,
+            "epoch_draws": epoch_draws,
+            "draws_lost": draws_lost,
+            "serving_epoch": h.get("epoch"),
+            "serving_generation": h.get("generation"),
+            "queries": {"total": qstats["total"],
+                        "failed": qstats["failed"],
+                        "errors": qstats["errors"] or None},
+            "rejected": {n: r.get("kind") for n, r in rejected.items()},
+            "wall_s": round(time.perf_counter() - t_start, 2),
+            "gates": gates,
+            "gates_ok": all(gates.values()),
+        }
+    line = json.dumps(digest)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0 if digest["gates_ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -18,8 +18,11 @@ supervisor: spawn R worker ranks, heartbeat liveness, backoff restarts,
 shrink/grow degradation — see README "Elastic fleet runs"), and ``refit``
 (streaming refits: append new survey rows to a fitted run, warm-start
 chains, adaptive abbreviated transient, commit a new serving epoch — see
-README "Streaming refits").  Bare arguments keep the historical bench
-behaviour: ``python -m hmsc_tpu --ns 50`` still works.
+README "Streaming refits"), and ``autopilot`` (the continuous-learning
+daemon: watch a drop directory, validate/quarantine data batches, drive
+supervised refits, flip serving, retain/compact epochs — see README
+"Continuous learning (autopilot)").  Bare arguments keep the historical
+bench behaviour: ``python -m hmsc_tpu --ns 50`` still works.
 """
 
 import sys
@@ -53,6 +56,9 @@ def main(argv=None):
     if argv[:1] == ["refit"]:
         from .refit.cli import refit_main
         return refit_main(argv[1:])
+    if argv[:1] == ["autopilot"]:
+        from .pipeline.cli import autopilot_main
+        return autopilot_main(argv[1:])
     if argv[:1] == ["bench"]:
         argv = argv[1:]
     return bench_main(argv)

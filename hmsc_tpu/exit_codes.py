@@ -20,6 +20,10 @@ fleet supervisor (``hmsc_tpu.fleet``) all use THIS module's values.
   slot corrupt, or the directory mismatches the model): restarting will
   not help without operator intervention, so the supervisor treats it as
   fatal for that run directory.
+- ``EXIT_DROP_REJECTED`` (79) — an autopilot data drop failed append
+  validation against the run's pinned stream-defining parameters and was
+  quarantined to ``rejected/`` with a machine-readable reason; the run
+  itself is untouched, so processing continues with the next drop.
 """
 
 from __future__ import annotations
@@ -30,9 +34,11 @@ EXIT_PREEMPTED = 75          # EX_TEMPFAIL: resumable, try again
 EXIT_COORDINATION = 76       # a peer died/stalled; checkpoints intact
 EXIT_DIVERGED = 77           # completed with unhealed diverged chains
 EXIT_CKPT_CORRUPT = 78       # no usable checkpoint to resume from
+EXIT_DROP_REJECTED = 79      # data drop failed validation; quarantined
 
 __all__ = ["EXIT_OK", "EXIT_FAILURE", "EXIT_PREEMPTED", "EXIT_COORDINATION",
-           "EXIT_DIVERGED", "EXIT_CKPT_CORRUPT", "describe"]
+           "EXIT_DIVERGED", "EXIT_CKPT_CORRUPT", "EXIT_DROP_REJECTED",
+           "describe"]
 
 _NAMES = {
     EXIT_OK: "ok",
@@ -41,6 +47,7 @@ _NAMES = {
     EXIT_COORDINATION: "coordination",
     EXIT_DIVERGED: "diverged",
     EXIT_CKPT_CORRUPT: "checkpoint-corrupt",
+    EXIT_DROP_REJECTED: "drop-rejected",
 }
 
 

@@ -44,15 +44,31 @@ import time
 from ..exit_codes import (EXIT_CKPT_CORRUPT, EXIT_COORDINATION,
                           EXIT_DIVERGED, EXIT_OK, EXIT_PREEMPTED, describe)
 
-__all__ = ["FleetSupervisor", "fleet_events_path", "FLEET_EVENTS_FILE"]
+__all__ = ["FleetSupervisor", "fleet_events_path", "FLEET_EVENTS_FILE",
+           "log_tail"]
 
 FLEET_EVENTS_FILE = "fleet-events.jsonl"
 
 
 def fleet_events_path(run_dir: str) -> str:
     """The supervisor's event stream, next to the run's per-rank streams
-    (``report`` renders it as the fleet timeline)."""
+    (``report`` renders it as the fleet timeline).  The autopilot
+    (:mod:`hmsc_tpu.pipeline`) appends its ``kind="pipeline"`` decisions
+    to the SAME file, so one stream tells a run's whole operational
+    story."""
     return os.path.join(os.fspath(run_dir), FLEET_EVENTS_FILE)
+
+
+def log_tail(path: str, nbytes: int = 1500) -> str:
+    """Last ``nbytes`` of a worker log file (best-effort) — attached to
+    failure events so the timeline carries the evidence, not a pointer."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            f.seek(max(0, f.tell() - nbytes))
+            return f.read().decode(errors="replace")
+    except OSError:
+        return ""
 
 
 class FleetSupervisor:
@@ -113,13 +129,7 @@ class FleetSupervisor:
         return p, log_path
 
     def _log_tail(self, path: str, nbytes: int = 1500) -> str:
-        try:
-            with open(path, "rb") as f:
-                f.seek(0, os.SEEK_END)
-                f.seek(max(0, f.tell() - nbytes))
-                return f.read().decode(errors="replace")
-        except OSError:
-            return ""
+        return log_tail(path, nbytes)
 
     def _attempt(self, attempt: int, nprocs: int, action: str) -> dict:
         cfg = self.cfg

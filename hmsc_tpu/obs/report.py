@@ -190,9 +190,11 @@ def load_fleet_events(run_dir: str) -> list:
 def build_report(run_dir: str) -> dict:
     """Structured report over every rank's event stream."""
     streams = load_run_events(run_dir)
+    ops = load_fleet_events(run_dir)   # shared stream: fleet + pipeline
     report = {"run_dir": os.fspath(run_dir),
               "ranks": sorted(streams), "per_rank": {}, "skew": [],
-              "fleet": _fleet_section(load_fleet_events(run_dir)),
+              "fleet": _fleet_section(ops),
+              "pipeline": _pipeline_section(ops),
               "status": "no-events" if not streams else "unknown"}
     for proc, events in streams.items():
         # per-epoch clock re-basing: ``t`` restarts at ~0 in each appended
@@ -296,7 +298,13 @@ def build_report(run_dir: str) -> dict:
 def _fleet_section(events: list) -> dict | None:
     """Structured fleet timeline from the supervisor's event stream:
     per-attempt outcomes plus the supervision decisions (restarts with
-    backoff, heartbeat kills, chaos injections, shrink/grow steps)."""
+    backoff, heartbeat kills, chaos injections, shrink/grow steps).
+
+    ``fleet-events.jsonl`` is shared with the autopilot's
+    ``kind="pipeline"`` stream, and several event NAMES collide (backoff,
+    heartbeat_silent, chaos, attempt_timeout) — so the section must select
+    on kind, not name."""
+    events = [e for e in events if e.get("kind") == "fleet"]
     if not events:
         return None
     attempts: dict = {}
@@ -321,6 +329,63 @@ def _fleet_section(events: list) -> dict | None:
                                     "name")}
     return {"attempts": [attempts[a] for a in sorted(attempts)],
             "decisions": decisions, "summary": summary}
+
+
+def _pipeline_section(events: list) -> dict | None:
+    """Structured autopilot timeline from the daemon's ``kind="pipeline"``
+    stream: per-drop lifecycle (seen -> accepted/rejected -> committed ->
+    flipped), the supervision decisions taken along the way (worker
+    restarts with backoff, heartbeat kills, chaos strikes, compaction
+    retries), and the terminal summary."""
+    events = [e for e in events if e.get("kind") == "pipeline"]
+    if not events:
+        return None
+
+    def _strip(ev):
+        return {k: v for k, v in ev.items()
+                if v is not None and k not in ("seq", "wall", "proc",
+                                               "kind")}
+
+    drops: dict = {}
+    decisions, flips, retention = [], [], []
+    summary = None
+    for ev in events:
+        name, idx = ev.get("name"), ev.get("drop")
+        if name == "drop_seen":
+            drops[idx] = {"drop": idx, "file": ev.get("file"),
+                          "status": "validating", "attempts": 0}
+        elif name == "drop_accepted" and idx in drops:
+            drops[idx].update(status="accepted", rows=ev.get("rows"))
+        elif name == "drop_rejected" and idx in drops:
+            drops[idx].update(status="rejected", reason=ev.get("reason"),
+                              why=ev.get("detail"))
+        elif name == "drop_already_committed" and idx in drops:
+            drops[idx].update(status="committed", epoch=ev.get("epoch"),
+                              deduplicated=True)
+        elif name == "refit_dispatch" and idx in drops:
+            drops[idx]["attempts"] = ev.get("attempt", 0)
+        elif name == "epoch_committed" and idx in drops:
+            drops[idx].update(status="committed", epoch=ev.get("epoch"),
+                              samples=ev.get("samples"))
+        elif name == "flip":
+            flips.append(_strip(ev))
+            if idx in drops:
+                drops[idx]["flipped_to"] = ev.get("epoch")
+        elif name == "retention":
+            retention.append(_strip(ev))
+        elif name in ("backoff", "heartbeat_silent", "attempt_timeout",
+                      "chaos", "refit_exit", "compact", "compact_failed",
+                      "drift_skipped", "flip_verified",
+                      "pipeline_preempted", "pipeline_abort"):
+            decisions.append(_strip(ev))
+        elif name == "pipeline_end":
+            summary = {k: v for k, v in ev.items()
+                       if k not in ("seq", "t", "wall", "proc", "kind",
+                                    "name")}
+    return {"drops": [drops[i] for i in sorted(drops,
+                                               key=lambda i: (i is None, i))],
+            "decisions": decisions, "flips": flips,
+            "retention": retention, "summary": summary}
 
 
 def _bar(frac: float, width: int = 24) -> str:
@@ -440,6 +505,48 @@ def render_report(report: dict) -> str:
                 f"{s.get('shrinks')} shrink(s), {s.get('grows')} grow(s); "
                 f"fleet {s.get('fleet_size')}, draws lost "
                 f"{s.get('draws_lost')}, wall {s.get('wall_s')}s")
+    pipe = report.get("pipeline")
+    if pipe:
+        lines.append("")
+        lines.append("== autopilot timeline (pipeline) ==")
+        for d in pipe["drops"]:
+            extra = ""
+            if d["status"] == "committed":
+                extra = f" -> epoch {d.get('epoch')}"
+                if d.get("deduplicated"):
+                    extra += " (already committed; deduplicated)"
+                if d.get("flipped_to") is not None:
+                    extra += ", flipped to serving"
+            elif d["status"] == "rejected":
+                extra = f" ({d.get('reason')}: {d.get('why')})"
+            att = (f" [{d['attempts']} attempt(s)]"
+                   if d.get("attempts", 0) > 1 else "")
+            lines.append(f"  drop {d['drop']}: {d.get('file')} "
+                         f"{d['status']}{att}{extra}")
+        for d in pipe["decisions"]:
+            name = d.get("name", "?")
+            t = d.get("t")
+            detail = ", ".join(f"{k}={v}" for k, v in d.items()
+                               if k not in ("name", "t", "log_tail"))
+            stamp = f" t={t:.2f}s" if isinstance(t, float) else ""
+            lines.append(f"  [{name}]{stamp} {detail}")
+        for r in pipe["retention"]:
+            lines.append(
+                f"  [retention] epochs={r.get('epochs')}"
+                + (f" unpinned={r['unpinned']}" if r.get("unpinned") else "")
+                + (f" reclaimed={r['reclaimed']}"
+                   if r.get("reclaimed") else ""))
+        s = pipe.get("summary")
+        if s:
+            lines.append(
+                f"  outcome: {s.get('status')}; drops "
+                f"{s.get('drops_committed')} committed / "
+                f"{s.get('drops_rejected')} rejected of "
+                f"{s.get('drops_seen')} seen; epochs committed "
+                f"{s.get('epochs_committed')}, flips {s.get('flips')}, "
+                f"worker restarts {s.get('worker_restarts')}, compactions "
+                f"{s.get('compactions')}, epochs reclaimed "
+                f"{s.get('epochs_reclaimed')}, wall {s.get('wall_s')}s")
     return "\n".join(lines)
 
 
@@ -673,7 +780,8 @@ def report_main(argv=None) -> int:
     if args.prom:
         with open(args.prom, "w") as f:
             f.write(prometheus_textfile(report))
-    return 0 if (report["ranks"] or report.get("fleet")) else 1
+    return 0 if (report["ranks"] or report.get("fleet")
+                 or report.get("pipeline")) else 1
 
 
 if __name__ == "__main__":

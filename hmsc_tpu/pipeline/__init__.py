@@ -1,0 +1,21 @@
+"""Autonomous continuous learning: the autopilot daemon.
+
+``python -m hmsc_tpu autopilot <config.json>`` closes the loop the rest
+of the stack leaves open: data batches dropped into a watched directory
+are validated against the run's pinned stream contract (bad drops
+quarantined with machine-readable reasons), appended via a supervised
+:func:`~hmsc_tpu.refit.driver.update_run` worker (heartbeat liveness,
+backoff restarts resuming from refit phase boundaries), rolled out to
+serving with a generation-checked flip, and retained under an
+epoch-aware compaction + drift-driven GC policy — every decision logged
+as ``kind="pipeline"`` events in ``fleet-events.jsonl``.
+"""
+
+from .autopilot import Autopilot, AutopilotStop
+from .config import PipelineConfig
+from .drops import DropRejected, list_drops, load_drop, quarantine_drop, \
+    rejected_reasons, validate_drop
+
+__all__ = ["Autopilot", "AutopilotStop", "PipelineConfig", "DropRejected",
+           "list_drops", "load_drop", "quarantine_drop",
+           "rejected_reasons", "validate_drop"]

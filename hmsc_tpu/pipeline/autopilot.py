@@ -1,0 +1,599 @@
+"""The autopilot daemon: the closed continuous-learning loop.
+
+One :class:`Autopilot` owns one fitted run directory and runs the full
+cycle for every data batch that lands in the watched drop directory:
+
+1. **validate** — replay the append contract against the current epoch
+   model; malformed/incompatible drops are atomically quarantined to
+   ``rejected/`` with a machine-readable reason
+   (:mod:`hmsc_tpu.pipeline.drops`) and the loop continues;
+2. **refit** — dispatch :func:`~hmsc_tpu.refit.driver.update_run` as a
+   supervised worker subprocess (:mod:`hmsc_tpu.pipeline.worker`):
+   heartbeat liveness + exit-code taxonomy exactly like the fleet
+   supervisor's ranks, exponential-backoff restarts that resume from the
+   refit's persisted phase boundaries, terminal stop on exit 78;
+3. **flip** — roll the committed epoch out to serving
+   (``ServingEngine.reload()`` in-process, or ``POST /flip`` +
+   ``GET /healthz`` re-verification against a remote engine) —
+   generation-checked, so a crashed flip is detected and re-issued on
+   restart, never left torn;
+4. **retention** — compact the superseded epoch into a serving artifact
+   (``compact --epoch`` semantics, registry-driven selection), release
+   drift-redundant epochs from the GC pin set (``report --drift``'s
+   z-statistics: an epoch whose drift to its successor is pure MC wobble
+   carries no information its successor lacks), and run the epoch-aware
+   byte-budget GC.
+
+**Crash safety by construction.**  Every state transition the daemon
+depends on is either atomic on disk (registry flip, drop quarantine,
+ledger write) or idempotent to repeat (validation, flip verification,
+compaction, GC) — so the daemon itself can be SIGKILLed at ANY point and
+simply re-runs the interrupted step on restart: an unfinished refit
+digest-matches its persisted ``new-data.npz`` and resumes; a committed
+epoch whose drop file survived is recognised by its ``data_digest`` and
+not re-appended; a serving engine behind the registry is re-flipped.
+``benchmarks/bench_autopilot.py`` proves exactly this under a seeded
+fault schedule.
+
+Every decision lands in the run's ``fleet-events.jsonl`` as
+``kind="pipeline"`` events (appended — the stream shares the file with a
+fleet supervisor's ``kind="fleet"`` timeline) and ``python -m hmsc_tpu
+report`` renders the autopilot timeline from them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import time
+
+import numpy as np
+
+from ..exit_codes import (EXIT_CKPT_CORRUPT, EXIT_DROP_REJECTED, EXIT_OK,
+                          describe)
+from ..fleet.supervisor import fleet_events_path, log_tail
+from .drops import (DropRejected, list_drops, load_drop, quarantine_drop,
+                    validate_drop)
+
+__all__ = ["Autopilot", "AutopilotStop", "LEDGER_FILE"]
+
+# the processed-drop ledger: names of drops fully handled (committed or
+# rejected), in order — its length is the stable drop index chaos events
+# key on, and its content closes the commit-vs-consume torn window
+LEDGER_FILE = "processed.json"
+
+
+class AutopilotStop(Exception):
+    """Terminal condition: the daemon must stop with this status."""
+
+    def __init__(self, status: str, detail: str | None = None):
+        super().__init__(status if detail is None else f"{status}: {detail}")
+        self.status = status
+        self.detail = detail
+
+
+class _Preempted(Exception):
+    """SIGTERM unwind: finish the current atomic step, then exit 75."""
+
+
+class Autopilot:
+    """Run the continuous-learning loop (see module docstring).
+
+    ``engine`` is an optional in-process
+    :class:`~hmsc_tpu.serve.ServingEngine` to flip (tests); the daemon CLI
+    uses ``cfg.serve_url`` instead.  ``chaos`` is an optional
+    :class:`~hmsc_tpu.testing.chaos.PipelineChaos`.  ``hM0`` is the
+    epoch-0 model for run directories not written by ``python -m hmsc_tpu
+    run`` (those rebuild it from ``model.json``)."""
+
+    def __init__(self, config, *, engine=None, chaos=None, hM0=None):
+        from ..obs import RunTelemetry
+        self.cfg = config
+        self.engine = engine
+        self.chaos = chaos
+        if hM0 is None and config.model_kw is not None:
+            from ..testing.multiproc import build_worker_model
+            hM0 = build_worker_model(**config.model_kw)
+        self._hM0 = hM0
+        self.telem = RunTelemetry(proc=0)
+        self.counters = {"drops_seen": 0, "drops_committed": 0,
+                         "drops_rejected": 0, "epochs_committed": 0,
+                         "worker_restarts": 0, "flips": 0,
+                         "compactions": 0, "epochs_reclaimed": 0}
+        self._t0 = time.monotonic()
+
+    # -- event plumbing ----------------------------------------------------
+
+    def _emit(self, name: str, **fields) -> None:
+        self.telem.emit("pipeline", name, **fields)
+        self.telem.flush()            # the stream must be tailable live
+
+    # -- the processed-drop ledger -----------------------------------------
+
+    def _ledger_path(self) -> str:
+        return os.path.join(os.fspath(self.cfg.work_dir), LEDGER_FILE)
+
+    def _ledger(self) -> list:
+        try:
+            with open(self._ledger_path()) as f:
+                doc = json.load(f)
+            return list(doc.get("done", []))
+        except (OSError, ValueError):
+            return []
+
+    def _ledger_add(self, name: str, status: str) -> None:
+        done = self._ledger()
+        done.append({"file": name, "status": status,
+                     "wall": round(time.time(), 3)})
+        p = self._ledger_path()
+        tmp = f"{p}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"done": done}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, p)
+
+    # -- chaos -------------------------------------------------------------
+
+    def _chaos_strike(self, drop_idx: int, phase: str) -> list:
+        """Execute due daemon-phase faults; events the daemon cannot
+        execute itself (worker-armed refit faults, the compact write-path
+        fault) are returned to the caller to arm."""
+        if self.chaos is None:
+            return []
+        leftover = []
+        for ev in self.chaos.due(drop_idx, phase):
+            self._emit("chaos", action=ev["action"], phase=phase,
+                       drop=drop_idx)
+            if phase != "refit" and ev["action"] == "sigkill":
+                os.kill(os.getpid(), signal.SIGKILL)
+            elif phase != "refit" and ev["action"] == "sigterm":
+                raise _Preempted(f"chaos sigterm at {phase}")
+            else:
+                leftover.append(ev)
+        return leftover
+
+    # -- model / epoch helpers ---------------------------------------------
+
+    def _current_model(self):
+        from ..refit.epochs import rebuild_epoch_model
+        from ..utils.checkpoint import CheckpointError, committed_epochs
+        ks = committed_epochs(self.cfg.run_dir)
+        if not ks:
+            raise AutopilotStop(
+                "no-run", f"{self.cfg.run_dir}: no fitted run to grow")
+        hM0 = self._hM0
+        if hM0 is None:
+            from ..serve.artifact import _rebuild_run_model
+            try:
+                hM0 = _rebuild_run_model(self.cfg.run_dir)
+            except CheckpointError as e:
+                # a user-authored run dir carries no model.json: a clean
+                # abort naming the two supported recipes, not a traceback
+                raise AutopilotStop(
+                    "no-model",
+                    f"{self.cfg.run_dir}: cannot rebuild the epoch-0 "
+                    "model — set config model_kw (the "
+                    "testing.multiproc.build_worker_model recipe) or "
+                    "embed the daemon with Autopilot(cfg, hM0=your_model)"
+                    f" ({e})") from e
+        return ks[-1], rebuild_epoch_model(self.cfg.run_dir, ks[-1],
+                                           hM0=hM0)
+
+    # -- the watch loop ----------------------------------------------------
+
+    def run(self) -> dict:
+        cfg = self.cfg
+        os.makedirs(cfg.work_dir, exist_ok=True)
+        os.makedirs(cfg.drop_dir, exist_ok=True)
+        os.makedirs(cfg.rejected_dir, exist_ok=True)
+        # APPEND to the shared operational stream: restarts must not
+        # erase the history that explains them
+        self.telem.attach_sink(fleet_events_path(cfg.run_dir))
+        self._emit("pipeline_start", config=cfg.to_dict(),
+                   chaos=(self.chaos.summary() if self.chaos else None))
+        prev_term = None
+
+        def _on_term(signum, frame):   # noqa: ARG001 — signal API
+            raise _Preempted("SIGTERM")
+
+        try:
+            prev_term = signal.signal(signal.SIGTERM, _on_term)
+        except ValueError:
+            prev_term = None           # non-main thread (in-process tests)
+        status = "ok"
+        try:
+            # recover anything a previous incarnation left half-rolled-out
+            # (the refit itself self-recovers through the drop loop)
+            self._flip(reconcile=True)
+            self._retention([])
+            idle_t0 = time.monotonic()
+            while True:
+                done = self._ledger()
+                if cfg.max_drops is not None \
+                        and len(done) >= int(cfg.max_drops):
+                    break
+                pending = list_drops(cfg.drop_dir)
+                if not pending:
+                    if cfg.idle_exit_s is not None and \
+                            time.monotonic() - idle_t0 > cfg.idle_exit_s:
+                        break
+                    time.sleep(cfg.poll_s)
+                    continue
+                idle_t0 = time.monotonic()
+                self._process_drop(pending[0], len(done))
+        except _Preempted as e:
+            status = "preempted"
+            self._emit("pipeline_preempted", reason=str(e))
+        except AutopilotStop as e:
+            status = e.status
+            self._emit("pipeline_abort", status=e.status, detail=e.detail)
+        finally:
+            if prev_term is not None:
+                signal.signal(signal.SIGTERM, prev_term)
+        summary = dict(self.counters)
+        summary.update(status=status,
+                       ok=status == "ok",
+                       wall_s=round(time.monotonic() - self._t0, 3))
+        self._emit("pipeline_end", **summary)
+        return summary
+
+    # -- one drop ----------------------------------------------------------
+
+    def _process_drop(self, name: str, idx: int) -> None:
+        cfg = self.cfg
+        path = os.path.join(os.fspath(cfg.drop_dir), name)
+        self.counters["drops_seen"] += 1
+        try:
+            nbytes = os.path.getsize(path)
+        except OSError:
+            nbytes = None
+        self._emit("drop_seen", file=name, drop=idx, nbytes=nbytes)
+        self._chaos_strike(idx, "validate")
+        try:
+            new_Y, new_X, new_units = load_drop(path)
+            _k, hM_cur = self._current_model()
+            digest = validate_drop(hM_cur, new_Y, new_X, new_units)
+        except DropRejected as e:
+            self._quarantine(path, name, idx, e)
+            return
+        rows = int(np.atleast_2d(np.asarray(new_Y)).shape[0])
+        self._emit("drop_accepted", file=name, drop=idx, rows=rows,
+                   digest=digest)
+
+        # the commit-vs-consume torn window: a previous incarnation may
+        # have committed this drop's epoch and died before consuming the
+        # file — the epoch's recorded data digest is the tie-breaker
+        from ..refit.epochs import epoch_metadata
+        from ..utils.checkpoint import committed_epochs
+        ks = committed_epochs(cfg.run_dir)
+        meta = epoch_metadata(cfg.run_dir, ks[-1]) if ks[-1] > 0 else None
+        if meta is not None and meta.get("data_digest") == digest:
+            self._emit("drop_already_committed", file=name, drop=idx,
+                       epoch=ks[-1])
+        else:
+            try:
+                self._refit(path, idx)
+            except DropRejected as e:   # mutated after pre-validation
+                self._quarantine(path, name, idx, e)
+                return
+        # consume the drop, then roll out (both idempotent on re-entry)
+        self._ledger_add(name, "committed")
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        self.counters["drops_committed"] += 1
+        self._chaos_strike(idx, "flip")
+        self._flip(drop=idx)
+        faults = self._chaos_strike(idx, "compact")
+        self._retention(faults, drop=idx)
+        self._emit("drop_done", file=name, drop=idx)
+
+    def _quarantine(self, path: str, name: str, idx: int,
+                    e: DropRejected) -> None:
+        quarantine_drop(path, self.cfg.rejected_dir, e.reason)
+        self._ledger_add(name, "rejected")
+        self.counters["drops_rejected"] += 1
+        self._emit("drop_rejected", file=name, drop=idx,
+                   code=EXIT_DROP_REJECTED, reason=e.reason["kind"],
+                   detail=e.reason["detail"])
+
+    # -- supervised refit --------------------------------------------------
+
+    def _refit(self, drop_path: str, idx: int) -> None:
+        cfg = self.cfg
+        if cfg.dispatch == "inline":
+            from ..refit.driver import update_run
+            from ..utils.checkpoint import CheckpointError
+            try:
+                res = update_run(cfg.run_dir, hM=self._hM0, **cfg.refit_kw)\
+                    if drop_path is None else update_run(
+                        cfg.run_dir, *load_drop(drop_path), hM=self._hM0,
+                        **cfg.refit_kw)
+            except CheckpointError as e:
+                raise AutopilotStop("checkpoint-corrupt", str(e)) from e
+            except (ValueError, NotImplementedError) as e:
+                raise DropRejected("incompatible",
+                                   f"{type(e).__name__}: {e}") from e
+            self.counters["epochs_committed"] += 1
+            self._emit("epoch_committed", drop=idx, epoch=int(res.epoch),
+                       samples=int(res.post.samples),
+                       transient_sweeps=int(res.transient_sweeps),
+                       attempts=1)
+            return
+
+        from ..testing.multiproc import _pkg_root, worker_env
+        from ..utils.coordination import heartbeat_path, read_heartbeats
+        from .worker import worker_cmd
+        hb_dir = os.path.join(cfg.work_dir, "hb")
+        os.makedirs(hb_dir, exist_ok=True)
+        armed = self._chaos_strike(idx, "refit")   # worker-armed faults
+        attempt = 0
+        budget = int(cfg.restart_budget)
+        consecutive = 0
+        while True:
+            attempt += 1
+            arm = armed.pop(0) if armed else None
+            try:                       # a SIGKILLed worker leaves its old
+                os.unlink(heartbeat_path(hb_dir, 0))
+            except OSError:            # heartbeat behind; sweep or it
+                pass                   # reads as instantly-silent
+            out = os.path.join(cfg.work_dir,
+                               f"refit-{idx:03d}-a{attempt:02d}.json")
+            logp = os.path.join(cfg.work_dir,
+                                f"refit-{idx:03d}-a{attempt:02d}.log")
+            cmd = worker_cmd(
+                cfg.run_dir,
+                drop=(drop_path if drop_path is not None
+                      and os.path.exists(drop_path) else None),
+                refit_kw=cfg.refit_kw, model_kw=cfg.model_kw,
+                heartbeat_dir=hb_dir,
+                heartbeat_interval_s=cfg.heartbeat_interval_s,
+                chaos_action=(arm["action"] if arm else None),
+                out=out)
+            logf = open(logp, "w")
+            p = subprocess.Popen(cmd, cwd=_pkg_root(), env=worker_env(),
+                                 stdout=logf, stderr=subprocess.STDOUT)
+            logf.close()
+            self._emit("refit_dispatch", drop=idx, attempt=attempt,
+                       pid=p.pid, chaos=(arm["action"] if arm else None))
+            t_att = time.monotonic()
+            hb_killed = False
+            while True:
+                rc = p.poll()
+                if rc is not None:
+                    break
+                elapsed = time.monotonic() - t_att
+                rec = read_heartbeats(hb_dir).get(0)
+                if rec is None:
+                    silent = elapsed > cfg.startup_grace_s
+                    age = None
+                else:
+                    age = rec["age_s"]
+                    silent = age > cfg.heartbeat_timeout_s
+                if silent and not hb_killed:
+                    self._emit("heartbeat_silent", drop=idx,
+                               attempt=attempt, age_s=age, pid=p.pid)
+                    hb_killed = True
+                    p.kill()
+                elif elapsed > cfg.wall_timeout_s and not hb_killed:
+                    self._emit("attempt_timeout", drop=idx, attempt=attempt,
+                               elapsed_s=round(elapsed, 1))
+                    hb_killed = True
+                    p.kill()
+                time.sleep(cfg.poll_s)
+            rc = int(rc)
+            self._emit("refit_exit", drop=idx, attempt=attempt, rc=rc,
+                       outcome=describe(rc),
+                       log_tail=(log_tail(logp)
+                                 if rc not in (EXIT_OK,) else None))
+            if rc == EXIT_OK:
+                try:
+                    with open(out) as f:
+                        rec = json.load(f)
+                except (OSError, ValueError):
+                    rec = {}
+                self.counters["epochs_committed"] += 1
+                self._emit("epoch_committed", drop=idx,
+                           epoch=rec.get("epoch"),
+                           samples=rec.get("samples"),
+                           transient_sweeps=rec.get("transient_sweeps"),
+                           attempts=attempt)
+                return
+            if rc == EXIT_CKPT_CORRUPT:
+                raise AutopilotStop(
+                    "checkpoint-corrupt",
+                    f"refit worker exit 78 on drop {idx}")
+            if rc == EXIT_DROP_REJECTED:
+                raise DropRejected(
+                    "incompatible",
+                    "the refit worker rejected the append (the drop "
+                    "changed after pre-validation)")
+            budget -= 1
+            if budget <= 0:
+                raise AutopilotStop(
+                    "budget-exhausted",
+                    f"drop {idx}: {attempt} attempt(s), last outcome "
+                    f"{describe(rc)}")
+            consecutive += 1
+            self.counters["worker_restarts"] += 1
+            backoff = min(cfg.backoff_base_s
+                          * cfg.backoff_factor ** (consecutive - 1),
+                          cfg.backoff_max_s)
+            self._emit("backoff", drop=idx, seconds=round(backoff, 3),
+                       consecutive_failures=consecutive, budget=budget)
+            time.sleep(backoff)
+
+    # -- serving rollout ---------------------------------------------------
+
+    def _http(self, path: str, body: dict | None = None) -> dict:
+        import urllib.request
+        url = self.cfg.serve_url.rstrip("/") + path
+        data = None if body is None else json.dumps(body).encode()
+        req = urllib.request.Request(
+            url, data=data,
+            headers=({"Content-Type": "application/json"} if data else {}))
+        with urllib.request.urlopen(req, timeout=30.0) as r:
+            return json.loads(r.read().decode())
+
+    def _flip(self, drop: int | None = None, reconcile: bool = False):
+        """Roll the newest committed epoch out to serving, generation-
+        checked: issue the flip, then re-read the serving state and verify
+        it reports the target epoch at an advanced generation — a crashed
+        flip (ours or the server's) is detected here and re-issued, so an
+        engine is never LEFT behind the registry (and the registry itself
+        is atomic, so a torn epoch is unservable by construction)."""
+        from ..utils.checkpoint import committed_epochs
+        cfg = self.cfg
+        if self.engine is None and not cfg.serve_url:
+            return
+        ks = committed_epochs(cfg.run_dir)
+        if not ks:
+            return
+        target = ks[-1]
+        deadline = time.monotonic() + float(cfg.flip_timeout_s)
+        last_err = None
+        while time.monotonic() < deadline:
+            try:
+                if self.engine is not None:
+                    if self.engine.epoch == target:
+                        if not reconcile:
+                            break      # flip already landed (re-entry)
+                        self._emit("flip_verified", drop=drop,
+                                   epoch=target,
+                                   generation=self.engine.generation,
+                                   reconcile=True)
+                        return
+                    res = self.engine.reload()
+                    ok = (res["epoch"] == target
+                          and self.engine.generation == res["generation"])
+                else:
+                    h = self._http("/healthz")
+                    if h.get("epoch") == target:
+                        if not reconcile:
+                            break
+                        self._emit("flip_verified", drop=drop,
+                                   epoch=target,
+                                   generation=h.get("generation"),
+                                   reconcile=True)
+                        return
+                    res = self._http("/flip", body={})
+                    h = self._http("/healthz")
+                    ok = (res.get("epoch") == target
+                          and h.get("epoch") == target
+                          and h.get("generation") == res.get("generation")
+                          and h.get("last_flip_wall") is not None)
+                if not ok:
+                    raise AutopilotStop(
+                        "flip-failed",
+                        f"serving reports epoch {res.get('epoch')} after "
+                        f"flip to {target}")
+                self.counters["flips"] += 1
+                self._emit("flip", drop=drop, epoch=target,
+                           old_epoch=res.get("old_epoch"),
+                           generation=res.get("generation"),
+                           shapes_changed=res.get("shapes_changed"))
+                return
+            except (OSError, ValueError) as e:  # server briefly away
+                last_err = f"{type(e).__name__}: {e}"
+                time.sleep(cfg.poll_s)
+        if last_err is not None:
+            raise AutopilotStop("flip-failed", last_err)
+        # serving already on target (non-reconcile re-entry): nothing to do
+        self._emit("flip_verified", drop=drop, epoch=target)
+
+    # -- retention ---------------------------------------------------------
+
+    def _retention(self, faults: list, drop: int | None = None) -> None:
+        from ..utils.checkpoint import committed_epochs, gc_checkpoints
+        cfg = self.cfg
+        r = cfg.retention
+        ks = committed_epochs(cfg.run_dir)
+        if not ks:
+            return
+        # compact the epoch the flip just superseded into a standalone
+        # serving artifact (idempotent: an existing manifest is kept)
+        if r.get("compact") and len(ks) >= 2:
+            self._compact_epoch(ks[-2], faults, drop=drop)
+        # drift-driven unpin: epochs statistically redundant with their
+        # successor are released to the byte-budget GC
+        pin = None
+        unpinned = []
+        zmax = r.get("drift_unpin_z")
+        if zmax is not None and len(ks) > int(r["min_pinned"]):
+            from ..obs.report import epoch_drift_report
+            try:
+                rep = epoch_drift_report(cfg.run_dir, hM0=self._hM0)
+            except Exception as e:  # noqa: BLE001 — drift is advisory: a
+                # failed report must never stop the loop
+                self._emit("drift_skipped", drop=drop,
+                           error=f"{type(e).__name__}: {e}")
+                rep = None
+            if rep is not None:
+                protected = set(ks[-int(r["min_pinned"]):])
+                pin = set(ks)
+                for pair in rep["drift"]:
+                    zs = [d.get("max_z") for d in pair["params"].values()
+                          if d.get("max_z") is not None]
+                    if not zs:
+                        continue
+                    z = max(zs)
+                    if pair["from"] not in protected and z <= float(zmax):
+                        pin.discard(int(pair["from"]))
+                        unpinned.append({"epoch": int(pair["from"]),
+                                         "max_z": z})
+        gc_checkpoints(cfg.run_dir, keep=int(r["keep"]),
+                       max_bytes=r.get("max_bytes"),
+                       pin_epochs=(sorted(pin) if pin is not None else None))
+        after = committed_epochs(cfg.run_dir)
+        reclaimed = sorted(set(ks) - set(after))
+        self.counters["epochs_reclaimed"] += len(reclaimed)
+        self._emit("retention", drop=drop, epochs=after,
+                   unpinned=unpinned or None, reclaimed=reclaimed or None)
+
+    def _compact_epoch(self, k: int, faults: list,
+                       drop: int | None = None) -> None:
+        from ..serve.artifact import _MANIFEST_NAME
+        cfg = self.cfg
+        out = os.path.join(cfg.compact_dir, f"epoch-{int(k):04d}")
+        if os.path.exists(os.path.join(out, _MANIFEST_NAME)):
+            return                      # already compacted (re-entry)
+        disk_full = any(ev["action"] == "disk_full" for ev in faults)
+        for attempt in (1, 2):
+            try:
+                if disk_full and attempt == 1:
+                    from ..utils import checkpoint as _ckmod
+                    real = _ckmod._atomic_write
+                    try:
+                        def _failing(path, cb, fsync_dir=True):
+                            raise OSError(28, "No space left on device "
+                                              "(chaos disk_full)")
+                        _ckmod._atomic_write = _failing
+                        self._compact_once(k, out)
+                    finally:
+                        _ckmod._atomic_write = real
+                else:
+                    self._compact_once(k, out)
+                self.counters["compactions"] += 1
+                self._emit("compact", drop=drop, epoch=int(k), out_dir=out,
+                           attempts=attempt)
+                return
+            except OSError as e:
+                # a failed compaction never loses draws (the epoch layout
+                # is untouched); log and retry once, then leave it for the
+                # next cycle
+                self._emit("compact_failed", drop=drop, epoch=int(k),
+                           attempt=attempt,
+                           error=f"{type(e).__name__}: {e}")
+        return
+
+    def _compact_once(self, k: int, out: str) -> None:
+        from ..serve.artifact import compact_posterior, load_run_posterior
+        r = self.cfg.retention
+        post, _hM = load_run_posterior(self.cfg.run_dir, self._hM0,
+                                       epoch=int(k))
+        compact_posterior(post, out, thin=int(r["thin"]),
+                          dtype=str(r["dtype"]))

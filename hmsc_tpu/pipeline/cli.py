@@ -1,0 +1,89 @@
+"""``python -m hmsc_tpu autopilot <config.json>`` — the daemon entry.
+
+Runs :class:`~hmsc_tpu.pipeline.autopilot.Autopilot` until a terminal
+condition and maps its status onto the worker exit-code taxonomy so a
+process supervisor (systemd, the fleet scheduler, the chaos bench) can
+branch on the daemon exactly like on a rank:
+
+========================  ====
+status                    exit
+========================  ====
+``ok``                    0
+``preempted`` (SIGTERM)   75
+``checkpoint-corrupt``    78
+anything else             1
+========================  ====
+
+The final summary record is printed as one JSON line on stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+__all__ = ["autopilot_main"]
+
+
+def autopilot_main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="hmsc_tpu autopilot",
+        description="continuous-learning daemon: watch a drop directory, "
+                    "validate/quarantine, refit under supervision, flip "
+                    "serving, retain/compact epochs")
+    ap.add_argument("config", help="autopilot config JSON "
+                                   "(hmsc_tpu.pipeline.PipelineConfig)")
+    ap.add_argument("--max-drops", type=int, default=None,
+                    help="stop after this many drops are fully processed")
+    ap.add_argument("--idle-exit-s", type=float, default=None,
+                    help="exit cleanly after this many drop-less seconds")
+    ap.add_argument("--serve-url", default=None,
+                    help="serving endpoint to flip (overrides the config)")
+    ap.add_argument("--dispatch", default=None,
+                    choices=("worker", "inline"),
+                    help="override the refit dispatch mode")
+    ap.add_argument("--chaos", default=None,
+                    help="JSON list of pipeline chaos events "
+                         "({action, drop, phase}) — drills only")
+    ap.add_argument("--chaos-state", default=None,
+                    help="fired-marks persistence path for --chaos "
+                         "(default <work_dir>/chaos-state.json)")
+    args = ap.parse_args(argv)
+
+    from ..exit_codes import (EXIT_CKPT_CORRUPT, EXIT_FAILURE, EXIT_OK,
+                              EXIT_PREEMPTED)
+    from .autopilot import Autopilot
+    from .config import PipelineConfig
+
+    try:
+        cfg = PipelineConfig.from_json(
+            args.config, max_drops=args.max_drops,
+            idle_exit_s=args.idle_exit_s, serve_url=args.serve_url,
+            dispatch=args.dispatch)
+    except (OSError, ValueError, TypeError) as e:
+        # hmsc: ignore[bare-print] — CLI contract: usage error on stderr
+        print(f"autopilot: bad config: {e}", file=sys.stderr)
+        return EXIT_FAILURE
+
+    chaos = None
+    if args.chaos:
+        import os
+
+        from ..testing.chaos import PipelineChaos
+        state = args.chaos_state or os.path.join(
+            os.fspath(cfg.work_dir), "chaos-state.json")
+        os.makedirs(os.fspath(cfg.work_dir), exist_ok=True)
+        chaos = PipelineChaos(json.loads(args.chaos), state_path=state)
+
+    summary = Autopilot(cfg, chaos=chaos).run()
+    # hmsc: ignore[bare-print] — CLI contract: one JSON summary line
+    print(json.dumps(summary, sort_keys=True))
+    status = summary.get("status")
+    if status == "ok":
+        return EXIT_OK
+    if status == "preempted":
+        return EXIT_PREEMPTED
+    if status == "checkpoint-corrupt":
+        return EXIT_CKPT_CORRUPT
+    return EXIT_FAILURE

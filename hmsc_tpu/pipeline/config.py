@@ -1,0 +1,163 @@
+"""Autopilot configuration.
+
+One autopilot run is described by one JSON document (the ``python -m
+hmsc_tpu autopilot <config.json>`` argument) mapping 1:1 onto
+:class:`PipelineConfig`.  Everything has a usable default except the
+three directories, so a minimal config is::
+
+    {"run_dir": "/data/run-1/ck", "drop_dir": "/data/run-1/drops",
+     "work_dir": "/data/run-1/autopilot",
+     "refit_kw": {"samples": 100, "min_sweeps": 8, "max_sweeps": 32}}
+
+``refit_kw`` is passed verbatim to
+:func:`~hmsc_tpu.refit.driver.update_run` (whitelisted keys only — the
+stream-defining sampler configuration is pinned from the parent run's
+checkpoint metadata and cannot be overridden from here).
+
+``retention`` configures the epoch-aware GC that runs after every flip:
+
+- ``keep`` — per-epoch manifest rotation depth (default 2);
+- ``max_bytes`` — run-level byte budget; unpinned epochs are reclaimed
+  oldest-first when exceeded (``None`` = unbounded);
+- ``compact``/``compact_dir``/``thin``/``dtype`` — compact each epoch the
+  serving flip just superseded into a standalone serving artifact under
+  ``compact_dir`` (defaults off / ``<work_dir>/compact``);
+- ``drift_unpin_z`` — the drift-driven unpin policy: an epoch whose
+  parameter drift to its successor has ``max_z <= drift_unpin_z``
+  (``report --drift``'s z-statistics, ~1 for pure Monte-Carlo wobble) is
+  released from the GC pin set — its draws are statistically redundant
+  with its successor's (``None`` = every committed epoch stays pinned);
+- ``min_pinned`` — the newest N epochs are always pinned regardless of
+  drift (default 2, never below 1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+__all__ = ["PipelineConfig", "REFIT_KW_KEYS", "RETENTION_KEYS"]
+
+# update_run knobs the autopilot may set; everything else stream-defining
+# is pinned from the parent checkpoint by update_run itself
+REFIT_KW_KEYS = ("samples", "min_sweeps", "max_sweeps", "probe_every",
+                 "rhat_threshold", "ess_target", "seed", "checkpoint_every",
+                 "verbose")
+
+RETENTION_KEYS = ("keep", "max_bytes", "compact", "compact_dir", "thin",
+                  "dtype", "drift_unpin_z", "min_pinned")
+
+
+@dataclasses.dataclass
+class PipelineConfig:
+    """Everything the autopilot daemon needs to run one continuous-learning
+    loop: watch ``drop_dir``, validate/quarantine, refit ``run_dir`` under
+    supervision, flip serving, retain/compact epochs."""
+
+    run_dir: str
+    drop_dir: str
+    work_dir: str
+    refit_kw: dict = dataclasses.field(default_factory=dict)
+    # epoch-0 model recipe: kwargs for
+    # testing.multiproc.build_worker_model, rebuilt identically by the
+    # daemon AND every refit-worker subprocess (the same contract the
+    # fleet workers use).  None = the run directory carries a
+    # ``model.json`` (run-driver dirs) and workers rebuild from that.
+    model_kw: dict | None = None
+    # refit-worker liveness (the supervised update_run subprocess)
+    heartbeat_interval_s: float = 0.25
+    heartbeat_timeout_s: float = 20.0
+    startup_grace_s: float = 240.0       # import + first compile headroom
+    wall_timeout_s: float = 600.0        # per refit attempt
+    # restart policy (exponential backoff, same shape as FleetConfig's)
+    restart_budget: int = 3
+    backoff_base_s: float = 0.25
+    backoff_factor: float = 2.0
+    backoff_max_s: float = 30.0
+    # watch loop
+    poll_s: float = 0.25
+    idle_exit_s: float | None = None     # exit after drop-less idle (None =
+    #                                      run forever)
+    max_drops: int | None = None         # stop after N drops (tests/bench)
+    # serving rollout: POST /flip + GET /healthz against a running
+    # `python -m hmsc_tpu serve` (in-process engines are passed to
+    # Autopilot(engine=...) directly and need no URL)
+    serve_url: str | None = None
+    flip_timeout_s: float = 60.0
+    # epoch retention (see module docstring)
+    retention: dict = dataclasses.field(default_factory=dict)
+    # dispatch="inline" calls update_run in-process (no supervision; fast
+    # tests only) instead of the default supervised worker subprocess
+    dispatch: str = "worker"
+
+    def __post_init__(self):
+        self.refit_kw = dict(self.refit_kw or {})
+        unknown = sorted(set(self.refit_kw) - set(REFIT_KW_KEYS))
+        if unknown:
+            raise ValueError(
+                f"unknown refit_kw key(s) {unknown}; the autopilot may "
+                f"only set {sorted(REFIT_KW_KEYS)} — everything else "
+                "stream-defining is pinned from the parent checkpoint")
+        r = dict(self.retention or {})
+        unknown = sorted(set(r) - set(RETENTION_KEYS))
+        if unknown:
+            raise ValueError(f"unknown retention key(s) {unknown}; valid "
+                             f"keys: {sorted(RETENTION_KEYS)}")
+        r.setdefault("keep", 2)
+        r.setdefault("max_bytes", None)
+        r.setdefault("compact", False)
+        r.setdefault("compact_dir", None)
+        r.setdefault("thin", 1)
+        r.setdefault("dtype", "float32")
+        r.setdefault("drift_unpin_z", None)
+        r.setdefault("min_pinned", 2)
+        if int(r["keep"]) < 1:
+            raise ValueError("retention.keep must be >= 1")
+        if int(r["min_pinned"]) < 1:
+            raise ValueError("retention.min_pinned must be >= 1 (the "
+                             "newest epoch is always pinned)")
+        if r["dtype"] not in ("float32", "bfloat16"):
+            raise ValueError(f"retention.dtype must be float32 or "
+                             f"bfloat16, got {r['dtype']!r}")
+        self.retention = r
+        if self.dispatch not in ("worker", "inline"):
+            raise ValueError(f"dispatch must be 'worker' or 'inline', got "
+                             f"{self.dispatch!r}")
+        if int(self.restart_budget) < 1:
+            raise ValueError("restart_budget must be >= 1")
+        for k in ("heartbeat_interval_s", "heartbeat_timeout_s",
+                  "startup_grace_s", "wall_timeout_s", "poll_s",
+                  "backoff_base_s", "backoff_factor", "backoff_max_s"):
+            if float(getattr(self, k)) <= 0:
+                raise ValueError(f"{k} must be > 0")
+
+    @property
+    def rejected_dir(self) -> str:
+        """Quarantine directory for invalid drops (inside ``drop_dir`` so
+        the atomic ``os.replace`` stays on one filesystem)."""
+        return os.path.join(os.fspath(self.drop_dir), "rejected")
+
+    @property
+    def compact_dir(self) -> str:
+        return (os.fspath(self.retention["compact_dir"])
+                if self.retention.get("compact_dir")
+                else os.path.join(os.fspath(self.work_dir), "compact"))
+
+    @classmethod
+    def from_json(cls, path: str, **overrides) -> "PipelineConfig":
+        with open(os.fspath(path)) as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict):
+            raise ValueError(f"{path}: autopilot config must be a JSON "
+                             "object")
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = sorted(set(doc) - known)
+        if unknown:
+            raise ValueError(f"{path}: unknown autopilot config key(s) "
+                             f"{unknown}; valid keys: {sorted(known)}")
+        doc.update({k: v for k, v in overrides.items() if v is not None})
+        return cls(**doc)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)

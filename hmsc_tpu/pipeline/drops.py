@@ -1,0 +1,138 @@
+"""Data-drop discovery, validation and quarantine.
+
+A *drop* is one batch of newly surveyed rows, produced by whatever feeds
+the deployment, as a ``drop-<id>.npz`` file in the watched directory —
+the same keys ``new-data.npz`` uses (``Y``, optional ``X``, optional
+``units:<level>`` label arrays), written with the usual tmp+rename
+protocol so the watcher never reads a half-written file.
+
+Validation replays :func:`~hmsc_tpu.refit.data.append_data` against the
+run's CURRENT epoch model without committing anything: a drop the append
+contract rejects (shape mismatch, non-binary probit responses, unknown
+random levels, new units on spatial levels, …) is *quarantined* — moved
+atomically into ``rejected/`` next to a machine-readable
+``<name>.reason.json`` — and the loop continues with the next drop.  The
+reason file carries the new ``EXIT_DROP_REJECTED`` (79) classification so
+external tooling can branch on it exactly like on worker exit codes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+
+import numpy as np
+
+from ..exit_codes import EXIT_DROP_REJECTED
+
+__all__ = ["DropRejected", "DROP_FILE_RE", "REASON_SUFFIX", "list_drops",
+           "load_drop", "validate_drop", "quarantine_drop",
+           "rejected_reasons"]
+
+DROP_FILE_RE = re.compile(r"drop-[A-Za-z0-9_.+-]+\.npz")
+REASON_SUFFIX = ".reason.json"
+
+
+class DropRejected(Exception):
+    """A drop failed validation; ``reason`` is the machine-readable record
+    the quarantine writes (``kind`` is a stable short code, ``detail`` the
+    human-readable explanation)."""
+
+    def __init__(self, kind: str, detail: str):
+        super().__init__(f"{kind}: {detail}")
+        self.reason = {"kind": kind, "detail": detail,
+                       "exit_code": EXIT_DROP_REJECTED}
+
+
+def list_drops(drop_dir: str) -> list:
+    """Pending drop basenames, deterministically ordered (lexicographic —
+    producers encode arrival order in the name, e.g. zero-padded
+    sequence numbers or timestamps)."""
+    try:
+        names = os.listdir(os.fspath(drop_dir))
+    except OSError:
+        return []
+    return sorted(n for n in names if DROP_FILE_RE.fullmatch(n))
+
+
+def load_drop(path: str):
+    """``(new_Y, new_X, new_units)`` from one drop file.
+
+    Raises :class:`DropRejected` (kind ``"unreadable"``) for anything that
+    is not a well-formed drop npz — a torn write that skipped the rename
+    protocol, a pickle-bearing archive, a missing ``Y`` key."""
+    path = os.fspath(path)
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            if "Y" not in z.files:
+                raise KeyError("no 'Y' array")
+            Y = np.asarray(z["Y"])
+            X = np.asarray(z["X"]) if "X" in z.files else None
+            units = {k[6:]: [str(u) for u in z[k]]
+                     for k in z.files if k.startswith("units:")}
+    except (OSError, ValueError, KeyError, EOFError) as e:
+        raise DropRejected(
+            "unreadable", f"{type(e).__name__}: {e}") from e
+    return Y, X, units or None
+
+
+def validate_drop(hM, new_Y, new_X, new_units):
+    """Replay the append contract against the current epoch model; returns
+    the digest of a valid drop, raises :class:`DropRejected` (kind
+    ``"incompatible"``) otherwise.  Nothing is committed — the supervised
+    refit worker re-runs the same append on its own copy."""
+    from ..refit.data import append_data, new_data_digest
+    try:
+        append_data(hM, new_Y, new_X, new_units)
+    except (ValueError, NotImplementedError, KeyError, TypeError) as e:
+        raise DropRejected(
+            "incompatible", f"{type(e).__name__}: {e}") from e
+    return new_data_digest(new_Y, new_X, new_units)
+
+
+def quarantine_drop(path: str, rejected_dir: str, reason: dict) -> str:
+    """Atomically move one rejected drop into ``rejected/`` with its
+    machine-readable reason.
+
+    The reason file is written (tmp+rename) BEFORE the drop file moves, so
+    every file in ``rejected/`` is accounted for from the instant it
+    appears; a crash between the two steps leaves the drop in the watch
+    directory, where the restarted daemon re-validates it and repeats the
+    (idempotent) quarantine."""
+    path = os.fspath(path)
+    rejected_dir = os.fspath(rejected_dir)
+    os.makedirs(rejected_dir, exist_ok=True)
+    name = os.path.basename(path)
+    rec = dict(reason)
+    rec.update(file=name, wall=round(time.time(), 3))
+    rpath = os.path.join(rejected_dir, name + REASON_SUFFIX)
+    tmp = f"{rpath}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(rec, f, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, rpath)
+    dest = os.path.join(rejected_dir, name)
+    os.replace(path, dest)
+    return dest
+
+
+def rejected_reasons(rejected_dir: str) -> dict:
+    """``{drop name: reason record}`` for every quarantined drop — the
+    chaos drill's every-rejection-accounted-for audit."""
+    out = {}
+    try:
+        names = os.listdir(os.fspath(rejected_dir))
+    except OSError:
+        return out
+    for n in sorted(names):
+        if not n.endswith(REASON_SUFFIX):
+            continue
+        try:
+            with open(os.path.join(rejected_dir, n)) as f:
+                out[n[:-len(REASON_SUFFIX)]] = json.load(f)
+        except (OSError, ValueError):
+            continue
+    return out

@@ -1,0 +1,175 @@
+"""The autopilot's supervised refit worker — one ``update_run`` per
+subprocess.
+
+The daemon never refits in-process: a refit is minutes of JAX compute
+that can be SIGKILLed, wedge, or hit a full disk, and the phase protocol
+(``refit-state.json``) makes every one of those restartable — so the
+natural unit of supervision is a subprocess the daemon watches exactly
+like the fleet supervisor watches its ranks: heartbeat file + exit-code
+taxonomy (:mod:`hmsc_tpu.exit_codes`).
+
+Exit codes: 0 committed, 75 preempted at a resumable boundary (SIGTERM /
+the armed graceful-preemption chaos), 78 unusable checkpoint state
+(terminal — the daemon stops), 79 the append itself was rejected (the
+daemon quarantines the drop; only reachable if a drop mutated after the
+daemon's pre-validation), 1 anything else (restartable with backoff).
+
+Chaos arming (``--chaos-action``): deterministic mid-refit faults keyed
+on the refit's own transient-probe counter (machine-speed independent,
+like the fleet workers' ``--kill-at``): ``sigkill`` SIGKILLs the worker
+at the probe boundary, ``sigterm`` exits 75 there (the graceful unwind),
+``freeze`` stops heartbeating and wedges (the daemon must detect the
+silence and SIGKILL it), ``disk_full`` makes checkpoint writes raise
+``OSError`` once the armed write count trips.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+__all__ = ["refit_worker_main", "worker_cmd"]
+
+
+def worker_cmd(run_dir: str, *, drop: str | None = None,
+               refit_kw: dict | None = None, model_kw: dict | None = None,
+               heartbeat_dir: str | None = None,
+               heartbeat_interval_s: float = 0.25,
+               chaos_action: str | None = None,
+               chaos_at: int = 1, out: str | None = None) -> list:
+    """The argv for one refit-worker subprocess (``-c``, not ``-m`` — same
+    double-import rationale as the fleet workers')."""
+    cmd = [sys.executable, "-c",
+           "from hmsc_tpu.pipeline.worker import refit_worker_main; "
+           "raise SystemExit(refit_worker_main())",
+           "--run-dir", os.fspath(run_dir)]
+    if drop is not None:
+        cmd += ["--drop", os.fspath(drop)]
+    if model_kw is not None:
+        cmd += ["--model", json.dumps(model_kw)]
+    if refit_kw:
+        cmd += ["--refit", json.dumps(refit_kw)]
+    if heartbeat_dir is not None:
+        cmd += ["--heartbeat-dir", heartbeat_dir,
+                "--heartbeat-interval", str(heartbeat_interval_s)]
+    if chaos_action is not None:
+        cmd += ["--chaos-action", chaos_action, "--chaos-at", str(chaos_at)]
+    if out is not None:
+        cmd += ["--out", out]
+    return cmd
+
+
+def refit_worker_main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="hmsc_tpu-refit-worker")
+    ap.add_argument("--run-dir", required=True)
+    ap.add_argument("--drop", default=None,
+                    help="drop npz to append; omitted = resume the "
+                         "in-flight refit from new-data.npz")
+    ap.add_argument("--model", default=None,
+                    help="JSON kwargs for "
+                         "testing.multiproc.build_worker_model (the "
+                         "epoch-0 model recipe); omitted = the run dir "
+                         "carries model.json")
+    ap.add_argument("--refit", default="{}",
+                    help="JSON update_run kwargs (whitelisted knobs)")
+    ap.add_argument("--heartbeat-dir", default=None)
+    ap.add_argument("--heartbeat-interval", type=float, default=0.25)
+    ap.add_argument("--chaos-action", default=None,
+                    choices=("sigkill", "sigterm", "freeze", "disk_full"))
+    ap.add_argument("--chaos-at", type=int, default=1,
+                    help="transient probe (or checkpoint-write count for "
+                         "disk_full) the armed fault triggers at")
+    ap.add_argument("--out", default=None,
+                    help="write the result record here as well as stdout")
+    args = ap.parse_args(argv)
+
+    from ..exit_codes import (EXIT_CKPT_CORRUPT, EXIT_DROP_REJECTED,
+                              EXIT_FAILURE, EXIT_OK, EXIT_PREEMPTED)
+    from ..utils.coordination import HeartbeatWriter
+
+    hb = None
+    if args.heartbeat_dir:
+        hb = HeartbeatWriter(args.heartbeat_dir, 0,
+                             interval_s=args.heartbeat_interval)
+        hb.start()
+    try:
+        if args.chaos_action == "disk_full":
+            # checkpoint writes start failing once the armed count trips —
+            # the same write-path hook the fleet chaos workers use
+            from ..utils import checkpoint as _ckmod
+            real_savez = _ckmod._atomic_savez
+            trip = {"n": 0}
+
+            def _failing_savez(path, payload, *a, **kw):
+                trip["n"] += 1
+                if trip["n"] > max(1, int(args.chaos_at)):
+                    raise OSError(28, "No space left on device "
+                                      "(chaos disk_full)")
+                return real_savez(path, payload, *a, **kw)
+
+            _ckmod._atomic_savez = _failing_savez
+
+        new_Y = new_X = new_units = None
+        if args.drop:
+            from .drops import DropRejected, load_drop
+            try:
+                new_Y, new_X, new_units = load_drop(args.drop)
+            except DropRejected:
+                return EXIT_DROP_REJECTED
+
+        abort = None
+        if args.chaos_action in ("sigkill", "sigterm", "freeze"):
+            abort = ("transient", max(1, int(args.chaos_at)))
+
+        from ..refit.driver import RefitAborted, update_run
+        from ..utils.checkpoint import CheckpointError, PreemptedRun
+        hM = None
+        if args.model is not None:
+            from ..testing.multiproc import build_worker_model
+            hM = build_worker_model(**json.loads(args.model))
+        kw = json.loads(args.refit)
+        try:
+            res = update_run(args.run_dir, new_Y, new_X, new_units,
+                             hM=hM, _abort_after=abort, **kw)
+        except RefitAborted:
+            # the armed fault strikes at the probe boundary the hook
+            # stopped at — state on disk is exactly what a real fault at
+            # that boundary would leave
+            if args.chaos_action == "sigkill":
+                os.kill(os.getpid(), signal.SIGKILL)
+            if args.chaos_action == "freeze":
+                if hb is not None:
+                    hb.freeze()
+                while True:              # wedged, heartbeat-silent, alive
+                    time.sleep(3600)
+            return EXIT_PREEMPTED        # sigterm: the graceful unwind
+        except PreemptedRun:
+            return EXIT_PREEMPTED
+        except CheckpointError:
+            return EXIT_CKPT_CORRUPT
+        except (ValueError, NotImplementedError):
+            # the append itself was rejected — only reachable when a drop
+            # changed after the daemon's pre-validation
+            return EXIT_DROP_REJECTED
+        except OSError:
+            return EXIT_FAILURE
+
+        rec = {"epoch": int(res.epoch), "committed": bool(res.committed),
+               "samples": int(res.post.samples),
+               "transient_sweeps": int(res.transient_sweeps),
+               "wall_s": round(float(res.wall_s), 3)}
+        if args.out:
+            tmp = f"{args.out}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(rec, f)
+            os.replace(tmp, args.out)
+        # hmsc: ignore[bare-print] — worker contract: one JSON record
+        print(json.dumps(rec))
+        return EXIT_OK
+    finally:
+        if hb is not None:
+            hb.stop()

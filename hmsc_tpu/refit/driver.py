@@ -113,6 +113,8 @@ def update_run(run_dir: str, new_Y=None, new_X=None, new_units=None, *,
                probe_every: int = 8, rhat_threshold: float = 1.10,
                ess_target: float | None = None, seed: int = 0,
                checkpoint_every: int | None = None, verbose: int = 0,
+               mesh=None, chain_axis: str = "chains",
+               species_axis: str = "species", site_axis: str = "sites",
                _abort_after=None) -> RefitResult:
     """Incrementally refit a run on appended survey rows (see the module
     docstring for the phase protocol).
@@ -130,7 +132,15 @@ def update_run(run_dir: str, new_Y=None, new_X=None, new_units=None, *,
     adaptive transient is bounded to ``[min_sweeps, max_sweeps]`` total
     sweeps, probed every ``probe_every``; ``ess_target`` defaults to
     ``4 x n_chains``.  Everything else stream-defining is pinned from the
-    parent run's metadata and cannot be overridden here."""
+    parent run's metadata and cannot be overridden here.
+
+    ``mesh`` shards the refit's sweeps like ``sample_mcmc``'s.  A parent
+    fitted with ``local_rng=True`` REQUIRES it: the shard-folded key
+    streams pin the engaged ``(species_shards, site_shards)`` tuple from
+    the checkpoint metadata, so the refit must re-shard over the same
+    extents (``make_mesh(species_shards=..., site_shards=...)``) or a
+    clear ``CheckpointError`` is raised before any epoch state is
+    written."""
     import jax.numpy as jnp
 
     t0 = time.perf_counter()
@@ -156,11 +166,23 @@ def update_run(run_dir: str, new_Y=None, new_X=None, new_units=None, *,
             f"{ck.path}: no run metadata — update_run needs an "
             "auto-checkpointed run (save_checkpoint snapshots cannot pin "
             "the sampler configuration)")
-    if meta.get("local_rng"):
-        raise NotImplementedError(
-            "update_run: the parent run used shard-local RNG "
-            "(local_rng=True) — refits run replicated and would change "
-            "the key-stream layout; not supported yet")
+    local_rng = bool(meta.get("local_rng", False))
+    if local_rng:
+        # shard-folded key streams pin the mesh tuple (like resume_run):
+        # the species extent is checked raw here, the engaged site extent
+        # below once the grown model exists
+        axes = getattr(mesh, "axis_names", ()) if mesh is not None else ()
+        want_sp = meta.get("species_shards")
+        have_sp = (int(mesh.shape[species_axis])
+                   if species_axis in axes else None)
+        if want_sp is not None and have_sp != want_sp:
+            raise CheckpointError(
+                f"update_run: the parent run used local_rng over "
+                f"{want_sp} species shard(s); the refit must pass a mesh "
+                f"pinning the same '{species_axis}' extent (got "
+                f"{have_sp if have_sp is not None else 'no mesh'}) — "
+                f"e.g. make_mesh(species_shards={want_sp}, "
+                f"site_shards={meta.get('site_shards') or 1})")
     good = np.asarray(ck.post.good_chain_mask())
     if not good.all():
         raise CheckpointError(
@@ -226,6 +248,27 @@ def update_run(run_dir: str, new_Y=None, new_X=None, new_units=None, *,
 
     hM2 = append_data(hM_parent, new_Y, new_X, new_units)
     nf_cap = int(meta["nf_cap"])
+    if local_rng:
+        # the ENGAGED site extent of the GROWN model must match the
+        # parent's: appended rows can break ny/unit divisibility and drag
+        # the site axis into a fallback the parent never took
+        from ..mcmc.partition import engaged_site_extent
+        from ..mcmc.structs import build_spec as _build_spec
+        want_st = meta.get("site_shards")
+        have_st = (engaged_site_extent(
+            _build_spec(hM2, nf_cap), mesh, species_axis, site_axis,
+            meta.get("updater"),
+            has_policy=meta.get("precision_policy") is not None)
+            if mesh is not None else 1)
+        if want_st is not None and have_st != want_st:
+            raise CheckpointError(
+                f"update_run: the parent run used local_rng over "
+                f"(species_shards={meta.get('species_shards')}, "
+                f"site_shards={want_st}); the grown model engages "
+                f"'{site_axis}' extent {have_st} on this mesh — the "
+                "shard-local key streams are not layout-invariant, so "
+                "the refit mesh must reproduce the parent's engaged "
+                "extents")
 
     # sampler configuration pinned from the parent run (stream-defining)
     pinned = dict(
@@ -236,6 +279,8 @@ def update_run(run_dir: str, new_Y=None, new_X=None, new_units=None, *,
         dtype=getattr(jnp, meta.get("dtype", "float32")),
         rng_impl=meta.get("rng_impl"),
         precision_policy=meta.get("precision_policy"),
+        local_rng=local_rng, mesh=mesh, chain_axis=chain_axis,
+        species_axis=species_axis, site_axis=site_axis,
         align_post=False, verbose=verbose,
     )
     # carried keys continue the parent's exact stream; a keyless parent
@@ -261,7 +306,10 @@ def update_run(run_dir: str, new_Y=None, new_X=None, new_units=None, *,
                 checkpoint_path=t_dir, checkpoint_keep=2, **pinned)
         else:
             # finish any in-flight probe target first (no-op if complete)
-            post_t = resume_run(hM2, t_dir, verbose=verbose)
+            post_t = resume_run(hM2, t_dir, verbose=verbose, mesh=mesh,
+                                chain_axis=chain_axis,
+                                species_axis=species_axis,
+                                site_axis=site_axis)
         probes = 0
         while True:
             sweeps = int(post_t.samples)
@@ -283,7 +331,9 @@ def update_run(run_dir: str, new_Y=None, new_X=None, new_units=None, *,
                                           cfg["ess_target"])):
                 break
             post_t = resume_run(
-                hM2, t_dir, verbose=verbose,
+                hM2, t_dir, verbose=verbose, mesh=mesh,
+                chain_axis=chain_axis, species_axis=species_axis,
+                site_axis=site_axis,
                 extra_samples=min(cfg["probe_every"],
                                   cfg["max_sweeps"] - sweeps))
         transient_sweeps = int(post_t.samples)
@@ -297,7 +347,10 @@ def update_run(run_dir: str, new_Y=None, new_X=None, new_units=None, *,
     # ---- phase 2: refreshed draws ---------------------------------------
     if st["phase"] == "sample":
         if checkpoint_files(d_new):
-            post = resume_run(hM2, d_new, verbose=verbose)
+            post = resume_run(hM2, d_new, verbose=verbose, mesh=mesh,
+                              chain_axis=chain_axis,
+                              species_axis=species_axis,
+                              site_axis=site_axis)
         else:
             ck_t = latest_valid_checkpoint(t_dir, hM2)
             ck_every = cfg["checkpoint_every"]

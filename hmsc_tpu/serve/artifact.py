@@ -397,16 +397,22 @@ def compact_main(argv=None) -> int:
     ap.add_argument("--params", default=None,
                     help="comma-separated parameter names (default: what "
                          "the serving engine reads)")
+    ap.add_argument("--epoch", type=int, default=None,
+                    help="compact a specific COMMITTED epoch of an epoched "
+                         "run (default: the newest; selection is via the "
+                         "atomic epochs.json registry, so a mid-flip "
+                         "reader can never compact a torn epoch)")
     args = ap.parse_args(argv)
 
-    post, _ = load_run_posterior(args.run_dir)
+    post, _ = load_run_posterior(args.run_dir, epoch=args.epoch)
+    epoch, _dir = resolve_run_epoch(args.run_dir, args.epoch)
     man = compact_posterior(
         post, args.out_dir, thin=args.thin, dtype=args.dtype,
         params=args.params.split(",") if args.params else None)
     total = sum(e["nbytes"] for e in man["params"].values())
     # hmsc: ignore[bare-print] — CLI contract: one JSON record on stdout
     print(json.dumps({
-        "out_dir": args.out_dir, "n_draws": man["n_draws"],
+        "out_dir": args.out_dir, "epoch": epoch, "n_draws": man["n_draws"],
         "dtype": man["dtype"], "params": sorted(man["params"]),
         "total_bytes": total,
         "max_abs_err": max((e.get("cast", {}).get("max_abs_err", 0.0)

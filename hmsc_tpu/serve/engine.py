@@ -144,6 +144,10 @@ class ServingEngine:
         # the ONE atomically-swapped reference: everything a query touches
         # hangs off this generation object (see module docstring)
         self._staged = self._build_staged(source, hM, self._draw_thin, 0)
+        # wall-clock of the last generation swap (initial staging counts):
+        # /healthz exposes it so an external probe can confirm a flip
+        # landed without scraping the event log
+        self._last_flip_wall = time.time()
 
         self._lock = threading.Lock()
         self._cache: collections.OrderedDict = collections.OrderedDict()
@@ -182,6 +186,12 @@ class ServingEngine:
     def generation(self) -> int:
         """Monotonic reload counter (0 = the initial staging)."""
         return self._staged.gen
+
+    @property
+    def last_flip_wall(self) -> float:
+        """Wall-clock (``time.time()``) of the last generation swap — the
+        initial staging for a never-flipped engine."""
+        return self._last_flip_wall
 
     @property
     def n_draws(self):
@@ -395,6 +405,7 @@ class ServingEngine:
                         np.full((new.nr, b), 0, np.int32))
                     jnp.asarray(fn(*args)[0]).block_until_ready()
             self._staged = new                  # the atomic flip
+            self._last_flip_wall = time.time()
             if source is not None:
                 self._source = source
                 self._hM0 = None
@@ -404,7 +415,8 @@ class ServingEngine:
                         shapes_changed=bool(shapes_changed))
         return {"old_epoch": old.epoch, "epoch": new.epoch,
                 "generation": new.gen, "n_draws": new.n_draws,
-                "shapes_changed": bool(shapes_changed)}
+                "shapes_changed": bool(shapes_changed),
+                "last_flip_wall": self._last_flip_wall}
 
     # ------------------------------------------------------------------
     # public API
@@ -539,6 +551,7 @@ class ServingEngine:
                       "rows_padded": self._rows_padded}
         return {"n_draws": st.n_draws, "ns": st.ns,
                 "epoch": st.epoch, "generation": st.gen,
+                "last_flip_wall": self._last_flip_wall,
                 "buckets": list(self.buckets),
                 "coalesce_ms": self.coalesce_s * 1e3,
                 "cache": cache, **counts,

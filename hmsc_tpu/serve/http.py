@@ -75,6 +75,7 @@ def make_server(engine, host: str = "127.0.0.1", port: int = 0):
                                  "ns": engine.ns, "nc": engine.nc,
                                  "epoch": engine.epoch,
                                  "generation": engine.generation,
+                                 "last_flip_wall": engine.last_flip_wall,
                                  "buckets": list(engine.buckets)})
             elif self.path == "/statz":
                 self._send(200, engine.stats())

@@ -30,10 +30,15 @@ from __future__ import annotations
 import dataclasses
 
 __all__ = ["ChaosEvent", "ChaosPlan", "poisson_schedule",
-           "SIGNAL_ACTIONS", "ARMED_ACTIONS"]
+           "SIGNAL_ACTIONS", "ARMED_ACTIONS", "PIPELINE_PHASES",
+           "PipelineChaos"]
 
 SIGNAL_ACTIONS = ("sigkill", "sigterm")
 ARMED_ACTIONS = ("sigkill", "sigterm", "freeze", "disk_full")
+
+# the autopilot's per-drop phase boundaries (hmsc_tpu.pipeline): a
+# PipelineChaos event strikes at the matching boundary of the matching drop
+PIPELINE_PHASES = ("validate", "refit", "flip", "compact")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -116,6 +121,94 @@ class ChaosPlan:
                              if e.at_samples is not None),
                 "wall_clock": sum(1 for e in self.events
                                   if e.at_s is not None)}
+
+
+class PipelineChaos:
+    """Phase-keyed chaos for the autopilot daemon (``hmsc_tpu.pipeline``).
+
+    Events are plain dicts ``{"action", "drop", "phase"}``: the fault
+    strikes when the autopilot reaches ``phase`` (one of
+    :data:`PIPELINE_PHASES`) while processing the ``drop``-th accepted
+    drop (0-based).  ``sigkill``/``sigterm`` are valid at every phase
+    (the daemon kills ITSELF at the boundary — restart-recovery is the
+    property under test); ``freeze`` and ``disk_full`` are armed onto the
+    supervised refit worker, or — for ``disk_full`` — into the compact
+    step's write path, so they are only valid at ``refit`` (and
+    ``compact`` for ``disk_full``).
+
+    Fired-marks are persisted to ``state_path`` BEFORE the fault executes
+    (atomic tmp+rename), so a daemon an event SIGKILLs does not re-fire
+    the same event after its supervisor restarts it — exactly-once
+    delivery across restarts, like :class:`ChaosPlan`'s arm-once rule."""
+
+    def __init__(self, events, state_path: str | None = None):
+        self.events = []
+        for ev in events:
+            action, phase = str(ev["action"]), str(ev["phase"])
+            if action not in ARMED_ACTIONS:
+                raise ValueError(f"unknown chaos action {action!r} "
+                                 f"(valid: {ARMED_ACTIONS})")
+            if phase not in PIPELINE_PHASES:
+                raise ValueError(f"unknown pipeline phase {phase!r} "
+                                 f"(valid: {PIPELINE_PHASES})")
+            if action == "freeze" and phase != "refit":
+                raise ValueError(
+                    "freeze is a worker heartbeat fault — only the "
+                    "'refit' phase has a supervised worker to freeze")
+            if action == "disk_full" and phase not in ("refit", "compact"):
+                raise ValueError(
+                    "disk_full is a write-path fault — valid at 'refit' "
+                    "(worker checkpoint writes) and 'compact' only")
+            self.events.append(
+                {"action": action, "drop": int(ev["drop"]), "phase": phase})
+        self.state_path = state_path
+        self._fired: set = set(self._load_state())
+
+    def _load_state(self) -> list:
+        if self.state_path is None:
+            return []
+        import json
+        import os
+        try:
+            with open(self.state_path) as f:
+                return [int(i) for i in json.load(f)]
+        except (OSError, ValueError):
+            return []
+
+    def _save_state(self) -> None:
+        if self.state_path is None:
+            return
+        import json
+        import os
+        tmp = f"{self.state_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(sorted(self._fired), f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.state_path)
+
+    def due(self, drop: int, phase: str) -> list:
+        """Events striking at this (drop, phase) boundary, marked fired
+        (and persisted) before they are returned."""
+        due = [(i, ev) for i, ev in enumerate(self.events)
+               if i not in self._fired
+               and ev["drop"] == int(drop) and ev["phase"] == str(phase)]
+        if due:
+            self._fired.update(i for i, _ in due)
+            self._save_state()
+        return [ev for _, ev in due]
+
+    def remaining(self) -> int:
+        return len(self.events) - len(self._fired)
+
+    def summary(self) -> dict:
+        by_action: dict = {}
+        by_phase: dict = {}
+        for ev in self.events:
+            by_action[ev["action"]] = by_action.get(ev["action"], 0) + 1
+            by_phase[ev["phase"]] = by_phase.get(ev["phase"], 0) + 1
+        return {"events": len(self.events), "by_action": by_action,
+                "by_phase": by_phase, "fired": len(self._fired)}
 
 
 def poisson_schedule(seed: int, rate_per_s: float, horizon_s: float,

@@ -1,0 +1,490 @@
+"""Autopilot continuous-learning suite (hmsc_tpu/pipeline): config
+parsing, drop discovery/validation/quarantine, the seeded pipeline chaos
+schedule's exactly-once persistence, the inline end-to-end loop
+(validate -> refit -> generation-checked flip -> compact/retention), the
+supervised-worker dispatch surviving a mid-refit SIGKILL, restart
+idempotence, and the satellite robustness bars (ISSUE 16):
+
+- ``update_run`` on a ``local_rng`` parent accepts a mesh pinning the
+  checkpointed ``(species_shards, site_shards)`` and rejects anything
+  else with a clear :class:`CheckpointError`;
+- ``/healthz`` / ``/statz`` report served epoch, generation counter and
+  last-flip timestamp;
+- a kill injected between ``epochs.json``'s tmp-write and rename leaves
+  readers on the previous registry bit-exactly, for every writer that
+  flips it (fresh-run first commit, refit append, GC reclaim).
+
+The full every-phase chaos matrix lives in
+``benchmarks/bench_autopilot.py`` (run here under ``slow``)."""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+from hmsc_tpu.mcmc.sampler import sample_mcmc
+from hmsc_tpu.pipeline import (Autopilot, DropRejected, PipelineConfig,
+                               list_drops, load_drop, quarantine_drop,
+                               rejected_reasons, validate_drop)
+from hmsc_tpu.pipeline.worker import worker_cmd
+from hmsc_tpu.refit.driver import update_run
+from hmsc_tpu.serve.engine import ServingEngine
+from hmsc_tpu.testing.chaos import PipelineChaos
+from hmsc_tpu.testing.multiproc import build_worker_model
+from hmsc_tpu.utils.checkpoint import (CheckpointError, committed_epochs,
+                                       latest_valid_checkpoint,
+                                       read_epoch_registry,
+                                       write_epoch_registry)
+from hmsc_tpu.utils.mesh import make_mesh
+
+pytestmark = pytest.mark.autopilot
+
+MODEL = dict(ny=24, ns=4, nc=2, distr="probit", n_units=6, seed=3)
+REFIT_KW = dict(samples=6, min_sweeps=4, max_sweeps=4, probe_every=4,
+                seed=0)
+_REGISTRY = "epochs.json"
+
+
+def _write_drop(path, seed=11, rows=4, ns=4, bad=None):
+    rng = np.random.default_rng(seed)
+    X = np.column_stack([np.ones(rows), rng.standard_normal(rows)])
+    Y = (rng.standard_normal((rows, ns)) > 0).astype(float)
+    units = np.array([f"u{j % 6:02d}" for j in range(rows)])
+    if bad == "nonbinary":
+        Y[0, 0] = 7.0
+    elif bad == "width":
+        Y = Y[:, :-1]
+    np.savez(path, Y=Y, X=X, **{"units:lvl": units})
+
+
+@pytest.fixture(scope="module")
+def parent(tmp_path_factory):
+    """One fitted parent run; tests that mutate it work on copies."""
+    m = build_worker_model(**MODEL)
+    d = os.fspath(tmp_path_factory.mktemp("ap-parent"))
+    sample_mcmc(m, samples=8, transient=4, n_chains=2, seed=1, nf_cap=2,
+                align_post=False, checkpoint_every=4, checkpoint_path=d)
+    return m, d
+
+
+@pytest.fixture(scope="module")
+def piloted(parent, tmp_path_factory):
+    """One full inline autopilot pass over 2 good + 1 bad drop: the
+    shared end-state every loop-behaviour test asserts against (and the
+    epoched [0, 1, 2] run directory the torn-registry tests copy)."""
+    m, src = parent
+    d = os.fspath(tmp_path_factory.mktemp("ap-piloted"))
+    run = os.path.join(d, "run")
+    shutil.copytree(src, run)
+    drops = os.path.join(d, "drops")
+    os.makedirs(drops)
+    _write_drop(os.path.join(drops, "drop-000.npz"), seed=11)
+    _write_drop(os.path.join(drops, "drop-001.npz"), seed=12,
+                bad="nonbinary")
+    _write_drop(os.path.join(drops, "drop-002.npz"), seed=13)
+    cfg = PipelineConfig(run_dir=run, drop_dir=drops,
+                         work_dir=os.path.join(d, "work"),
+                         refit_kw=REFIT_KW, dispatch="inline",
+                         max_drops=3, poll_s=0.02,
+                         retention={"compact": True, "keep": 2})
+    engine = ServingEngine(run, hM=m)
+    summary = Autopilot(cfg, engine=engine, hM0=m).run()
+    yield {"m": m, "run": run, "cfg": cfg, "engine": engine,
+           "summary": summary}
+    engine.close()
+
+
+# ---------------------------------------------------------------------------
+# config + drop plumbing + chaos schedule (pure fast units)
+# ---------------------------------------------------------------------------
+
+def test_pipeline_config_validation(tmp_path):
+    base = dict(run_dir="r", drop_dir="d", work_dir="w")
+    cfg = PipelineConfig(**base)
+    assert cfg.rejected_dir == os.path.join("d", "rejected")
+    assert cfg.compact_dir == os.path.join("w", "compact")
+    assert cfg.retention["keep"] == 2 and cfg.retention["min_pinned"] == 2
+    with pytest.raises(ValueError, match="refit_kw"):
+        PipelineConfig(**base, refit_kw={"transient": 10})
+    with pytest.raises(ValueError, match="retention"):
+        PipelineConfig(**base, retention={"nope": 1})
+    with pytest.raises(ValueError, match="dtype"):
+        PipelineConfig(**base, retention={"dtype": "float64"})
+    with pytest.raises(ValueError, match="dispatch"):
+        PipelineConfig(**base, dispatch="thread")
+    with pytest.raises(ValueError, match="keep"):
+        PipelineConfig(**base, retention={"keep": 0})
+    # JSON round trip + unknown-key rejection + None overrides ignored
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps(dict(base, poll_s=0.1)))
+    cfg = PipelineConfig.from_json(p, max_drops=None, serve_url="http://x")
+    assert cfg.poll_s == 0.1 and cfg.max_drops is None
+    assert cfg.serve_url == "http://x"
+    p.write_text(json.dumps(dict(base, watch_dir="oops")))
+    with pytest.raises(ValueError, match="watch_dir"):
+        PipelineConfig.from_json(p)
+
+
+def test_drop_discovery_load_and_quarantine(tmp_path, parent):
+    m, _ = parent
+    d = os.fspath(tmp_path)
+    _write_drop(os.path.join(d, "drop-002.npz"), seed=1)
+    _write_drop(os.path.join(d, "drop-001.npz"), seed=2)
+    (tmp_path / "notadrop.npz").write_bytes(b"x")     # ignored by the regex
+    (tmp_path / "drop-003.npz").write_bytes(b"PK torn")
+    assert list_drops(d) == ["drop-001.npz", "drop-002.npz",
+                             "drop-003.npz"]
+    Y, X, units = load_drop(os.path.join(d, "drop-001.npz"))
+    assert Y.shape == (4, 4) and units == {"lvl": [f"u{j % 6:02d}"
+                                                   for j in range(4)]}
+    assert validate_drop(m, Y, X, units)              # digest, truthy
+    with pytest.raises(DropRejected) as ei:
+        load_drop(os.path.join(d, "drop-003.npz"))
+    assert ei.value.reason["kind"] == "unreadable"
+    bad = Y.copy()
+    bad[0, 0] = 5.0
+    with pytest.raises(DropRejected) as ei:
+        validate_drop(m, bad, X, units)
+    assert ei.value.reason["kind"] == "incompatible"
+    assert ei.value.reason["exit_code"] == 79
+    # quarantine: reason lands atomically BEFORE the drop moves
+    rej = os.path.join(d, "rejected")
+    quarantine_drop(os.path.join(d, "drop-003.npz"), rej,
+                    ei.value.reason)
+    assert not os.path.exists(os.path.join(d, "drop-003.npz"))
+    reasons = rejected_reasons(rej)
+    assert set(reasons) == {"drop-003.npz"}
+    assert reasons["drop-003.npz"]["exit_code"] == 79
+    assert reasons["drop-003.npz"]["detail"]
+
+
+def test_pipeline_chaos_validation_and_exactly_once(tmp_path):
+    with pytest.raises(ValueError, match="action"):
+        PipelineChaos([{"action": "nuke", "drop": 0, "phase": "refit"}])
+    with pytest.raises(ValueError, match="phase"):
+        PipelineChaos([{"action": "sigkill", "drop": 0, "phase": "later"}])
+    with pytest.raises(ValueError, match="freeze"):
+        PipelineChaos([{"action": "freeze", "drop": 0, "phase": "flip"}])
+    with pytest.raises(ValueError, match="disk_full"):
+        PipelineChaos([{"action": "disk_full", "drop": 0,
+                        "phase": "validate"}])
+    events = [{"action": "sigkill", "drop": 0, "phase": "refit"},
+              {"action": "sigkill", "drop": 1, "phase": "flip"}]
+    state = os.fspath(tmp_path / "chaos.json")
+    c = PipelineChaos(events, state_path=state)
+    assert [e["action"] for e in c.due(0, "refit")] == ["sigkill"]
+    assert c.due(0, "refit") == [] and c.remaining() == 1
+    # a restarted daemon reloads the fired marks: the same fault can
+    # never strike twice (no infinite kill loop across restarts)
+    c2 = PipelineChaos(events, state_path=state)
+    assert c2.due(0, "refit") == [] and c2.remaining() == 1
+    assert [e["phase"] for e in c2.due(1, "flip")] == ["flip"]
+    assert c2.remaining() == 0 and c2.summary()["fired"] == 2
+
+
+def test_exit_code_drop_rejected():
+    from hmsc_tpu.exit_codes import EXIT_DROP_REJECTED, describe
+    assert EXIT_DROP_REJECTED == 79
+    assert describe(79) == "drop-rejected"
+
+
+def test_worker_cmd_flags():
+    cmd = worker_cmd("/r", drop="/d/drop-0.npz", refit_kw={"samples": 4},
+                     model_kw={"ny": 8}, heartbeat_dir="/hb",
+                     chaos_action="freeze", chaos_at=2, out="/o.json")
+    s = " ".join(cmd)
+    assert cmd[0] == sys.executable and "-c" in cmd
+    assert "--drop /d/drop-0.npz" in s and "--model" in s
+    assert "--chaos-action freeze" in s and "--chaos-at 2" in s
+    assert "--heartbeat-dir /hb" in s and "--out /o.json" in s
+
+
+# ---------------------------------------------------------------------------
+# the inline end-to-end loop (shared piloted end state)
+# ---------------------------------------------------------------------------
+
+def test_inline_loop_end_state(piloted):
+    s = piloted["summary"]
+    assert s["status"] == "ok" and s["ok"]
+    assert s["drops_seen"] == 3 and s["drops_committed"] == 2
+    assert s["drops_rejected"] == 1 and s["epochs_committed"] == 2
+    assert s["flips"] == 2 and s["compactions"] == 2
+    assert committed_epochs(piloted["run"]) == [0, 1, 2]
+    # generation-checked serving flip landed on the newest epoch
+    eng = piloted["engine"]
+    assert eng.epoch == 2 and eng.generation == 2
+    # the watch directory drained; the bad drop moved to quarantine
+    assert list_drops(piloted["cfg"].drop_dir) == []
+    reasons = rejected_reasons(piloted["cfg"].rejected_dir)
+    assert set(reasons) == {"drop-001.npz"}
+    assert reasons["drop-001.npz"]["kind"] == "incompatible"
+    assert "probit" in reasons["drop-001.npz"]["detail"]
+
+
+def test_inline_loop_ledger_and_retention(piloted):
+    with open(os.path.join(piloted["cfg"].work_dir, "processed.json")) as f:
+        done = json.load(f)["done"]
+    assert [(e["file"], e["status"]) for e in done] == [
+        ("drop-000.npz", "committed"), ("drop-001.npz", "rejected"),
+        ("drop-002.npz", "committed")]
+    # retention compacted each superseded epoch into a serving artifact
+    from hmsc_tpu.serve.artifact import load_artifact
+    for k in (0, 1):
+        art = load_artifact(os.path.join(piloted["cfg"].compact_dir,
+                                         f"epoch-{k:04d}"))
+        # pooled draws: samples x 2 chains
+        assert art.n_draws == 2 * (8 if k == 0 else REFIT_KW["samples"])
+
+
+def test_pipeline_events_and_report(piloted):
+    from hmsc_tpu.obs.report import build_report, render_report
+    rep = build_report(piloted["run"])
+    # the shared fleet-events stream holds ONLY pipeline events here: the
+    # fleet section must stay empty (kind filtering, not name filtering)
+    assert rep["fleet"] is None
+    pipe = rep["pipeline"]
+    assert [d["status"] for d in pipe["drops"]] == ["committed",
+                                                    "rejected",
+                                                    "committed"]
+    assert [f["epoch"] for f in pipe["flips"]] == [1, 2]
+    assert pipe["summary"]["status"] == "ok"
+    text = render_report(rep)
+    assert "autopilot timeline (pipeline)" in text
+    assert "drop-001.npz rejected" in text
+
+
+def test_restart_is_idempotent(piloted):
+    """A daemon relaunched over a fully-processed stream reconciles and
+    exits clean: nothing re-refits, the flip verifies in place."""
+    eng = piloted["engine"]
+    gen_before = eng.generation
+    s = Autopilot(piloted["cfg"], engine=eng,
+                  hM0=piloted["m"]).run()
+    assert s["status"] == "ok" and s["drops_seen"] == 0
+    assert s["epochs_committed"] == 0
+    assert eng.epoch == 2 and eng.generation == gen_before  # no re-flip
+    assert committed_epochs(piloted["run"]) == [0, 1, 2]
+
+
+def test_no_model_is_a_clean_abort(parent, tmp_path):
+    """A user-authored run dir (no model.json) with no model_kw/hM0 must
+    abort with status "no-model" naming the supported recipes — not an
+    unhandled CheckpointError traceback."""
+    _, src = parent
+    run = os.fspath(tmp_path / "run")
+    shutil.copytree(src, run)
+    drops = os.fspath(tmp_path / "drops")
+    os.makedirs(drops)
+    _write_drop(os.path.join(drops, "drop-000.npz"), seed=41)
+    cfg = PipelineConfig(run_dir=run, drop_dir=drops,
+                         work_dir=os.fspath(tmp_path / "work"),
+                         dispatch="inline", max_drops=1, poll_s=0.05)
+    s = Autopilot(cfg).run()
+    assert s["status"] == "no-model" and not s["ok"]
+    # the drop survives in the watch directory for a fixed relaunch
+    assert list_drops(drops) == ["drop-000.npz"]
+
+
+def test_autopilot_cli(tmp_path, capsys):
+    from hmsc_tpu.pipeline.cli import autopilot_main
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"run_dir": "r", "nope": 1}))
+    assert autopilot_main([os.fspath(bad)]) == 1
+    # a zero-drop run converges immediately (no fitted run required)
+    os.makedirs(tmp_path / "run")
+    cfg = tmp_path / "cfg.json"
+    cfg.write_text(json.dumps({
+        "run_dir": os.fspath(tmp_path / "run"),
+        "drop_dir": os.fspath(tmp_path / "drops"),
+        "work_dir": os.fspath(tmp_path / "work"),
+        "dispatch": "inline", "max_drops": 0}))
+    capsys.readouterr()
+    assert autopilot_main([os.fspath(cfg)]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["status"] == "ok" and out["drops_seen"] == 0
+
+
+# ---------------------------------------------------------------------------
+# supervised worker dispatch: the single-drop SIGKILL drill (tier-1)
+# ---------------------------------------------------------------------------
+
+def test_worker_dispatch_survives_refit_sigkill(parent, tmp_path):
+    """The armed mid-refit SIGKILL: the supervised worker dies at a
+    transient probe boundary, the daemon detects the exit, backs off,
+    relaunches, and the resumed refit commits from the phase boundary —
+    one drop, one restart, zero committed draws lost."""
+    m, src = parent
+    run = os.fspath(tmp_path / "run")
+    shutil.copytree(src, run)
+    drops = os.fspath(tmp_path / "drops")
+    os.makedirs(drops)
+    _write_drop(os.path.join(drops, "drop-000.npz"), seed=21)
+    cfg = PipelineConfig(run_dir=run, drop_dir=drops,
+                         work_dir=os.fspath(tmp_path / "work"),
+                         refit_kw=REFIT_KW, model_kw=MODEL,
+                         dispatch="worker", max_drops=1, poll_s=0.05,
+                         heartbeat_timeout_s=10.0, restart_budget=3,
+                         backoff_base_s=0.1, backoff_max_s=0.5)
+    chaos = PipelineChaos(
+        [{"action": "sigkill", "drop": 0, "phase": "refit"}],
+        state_path=os.fspath(tmp_path / "chaos.json"))
+    s = Autopilot(cfg, chaos=chaos).run()
+    assert s["status"] == "ok" and s["drops_committed"] == 1
+    assert s["worker_restarts"] == 1
+    assert committed_epochs(run) == [0, 1]
+    from hmsc_tpu.serve.artifact import load_run_posterior
+    post, _ = load_run_posterior(run, m, epoch=1)
+    assert int(post.samples) == REFIT_KW["samples"]
+    assert chaos.remaining() == 0
+
+
+@pytest.mark.slow
+def test_full_chaos_matrix_drill():
+    """The every-phase fault matrix end-to-end (the ISSUE 16 acceptance
+    drill): 6 good + 2 bad drops under seeded kills/freezes/disk-full at
+    validate/refit/flip/compact — serving must end on the newest epoch
+    with zero draws lost, zero failed queries, every bad drop
+    quarantined."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "benchmarks",
+                                      "bench_autopilot.py")],
+        capture_output=True, text=True, timeout=1800,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=root)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    digest = json.loads(r.stdout.strip().splitlines()[-1])
+    assert digest["gates_ok"] and digest["draws_lost"] == 0
+
+
+# ---------------------------------------------------------------------------
+# satellite: update_run on a local_rng parent (mesh pinning)
+# ---------------------------------------------------------------------------
+
+def test_update_run_local_rng_mesh_pinning(tmp_path):
+    """A local_rng parent's shard-folded key streams are not
+    layout-invariant: the refit must pin the checkpointed species extent
+    via an explicit mesh — same extent proceeds, no mesh / wrong extent
+    raise a clear CheckpointError instead of the old blanket refusal."""
+    hM = build_worker_model(**MODEL)
+    mesh = make_mesh(n_chains=1, species_shards=2)
+    run = os.fspath(tmp_path / "run")
+    sample_mcmc(hM, mesh=mesh, local_rng=True, samples=8, transient=4,
+                n_chains=2, seed=5, align_post=False, nf_cap=2,
+                checkpoint_every=4, checkpoint_path=run)
+    rng = np.random.default_rng(31)
+    X = np.column_stack([np.ones(4), rng.standard_normal(4)])
+    Y = (rng.standard_normal((4, 4)) > 0).astype(float)
+    units = {"lvl": [f"u{j % 6:02d}" for j in range(4)]}
+    with pytest.raises(CheckpointError, match="local_rng"):
+        update_run(run, Y, X, units, hM=hM, **REFIT_KW)       # no mesh
+    with pytest.raises(CheckpointError, match="local_rng"):
+        update_run(run, Y, X, units, hM=hM,
+                   mesh=make_mesh(n_chains=1, species_shards=4),
+                   **REFIT_KW)                                # wrong extent
+    res = update_run(run, Y, X, units, hM=hM, mesh=mesh, **REFIT_KW)
+    assert res.committed and res.epoch == 1
+    assert committed_epochs(run) == [0, 1]
+    assert int(res.post.samples) == REFIT_KW["samples"]
+
+
+# ---------------------------------------------------------------------------
+# satellite: /healthz + /statz serving introspection
+# ---------------------------------------------------------------------------
+
+def test_healthz_statz_report_epoch_generation_flip_time(piloted):
+    from hmsc_tpu.serve.http import make_server
+    eng = piloted["engine"]
+    assert eng.last_flip_wall is not None
+    server = make_server(eng)
+    host, port = server.server_address[:2]
+    import threading
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        h = json.loads(urllib.request.urlopen(
+            f"http://{host}:{port}/healthz", timeout=10).read().decode())
+        assert h["epoch"] == 2 and h["generation"] == eng.generation
+        assert h["last_flip_wall"] == pytest.approx(eng.last_flip_wall)
+        st = json.loads(urllib.request.urlopen(
+            f"http://{host}:{port}/statz", timeout=10).read().decode())
+        assert st["epoch"] == 2 and st["generation"] == eng.generation
+        assert st["last_flip_wall"] == pytest.approx(eng.last_flip_wall)
+    finally:
+        server.shutdown()
+    # reload() stamps a fresh flip time and reports it
+    before = eng.last_flip_wall
+    res = eng.reload()
+    assert res["last_flip_wall"] >= before
+    assert eng.stats()["last_flip_wall"] == res["last_flip_wall"]
+
+
+# ---------------------------------------------------------------------------
+# satellite: torn epochs.json writes leave readers on the previous registry
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def _torn_rename(monkeypatch):
+    """Injected kill between the registry's tmp-write and rename."""
+    import hmsc_tpu.utils.checkpoint as ckmod
+    real = os.replace
+
+    def patched(src, dst, *a, **kw):
+        if os.path.basename(os.fspath(dst)) == _REGISTRY:
+            raise OSError(5, "injected kill before registry rename")
+        return real(src, dst, *a, **kw)
+
+    monkeypatch.setattr(ckmod.os, "replace", patched)
+
+
+def _registry_bytes(run):
+    with open(os.path.join(run, _REGISTRY), "rb") as f:
+        return f.read()
+
+
+def test_torn_registry_fresh_run_writer(parent, tmp_path, _torn_rename):
+    """First registry creation: a kill before the rename leaves the run
+    a registry-less single-epoch directory, fully loadable."""
+    m, src = parent
+    run = os.fspath(tmp_path / "run")
+    shutil.copytree(src, run)
+    assert read_epoch_registry(run) is None
+    with pytest.raises(OSError, match="injected"):
+        write_epoch_registry(run, {"epochs": [{"epoch": 0},
+                                              {"epoch": 1}]})
+    assert read_epoch_registry(run) is None
+    assert committed_epochs(run) == [0]
+    assert latest_valid_checkpoint(run, m).post.samples == 8
+
+
+def test_torn_registry_refit_writer(piloted, tmp_path, _torn_rename):
+    """Epoch append: readers stay on the previous registry bit-exactly."""
+    run = os.fspath(tmp_path / "run")
+    shutil.copytree(piloted["run"], run)
+    before = _registry_bytes(run)
+    reg = read_epoch_registry(run)
+    reg["epochs"].append({"epoch": 3})
+    with pytest.raises(OSError, match="injected"):
+        write_epoch_registry(run, reg)
+    assert _registry_bytes(run) == before
+    assert committed_epochs(run) == [0, 1, 2]
+
+
+def test_torn_registry_compact_writer(piloted, tmp_path, _torn_rename):
+    """GC reclaim is registry-FIRST: a kill before the rename must leave
+    both the registry bytes and the victim epoch's files intact."""
+    from hmsc_tpu.serve.artifact import load_run_posterior
+    from hmsc_tpu.utils.checkpoint import gc_checkpoints
+    run = os.fspath(tmp_path / "run")
+    shutil.copytree(piloted["run"], run)
+    before = _registry_bytes(run)
+    with pytest.raises(OSError, match="injected"):
+        # byte budget of 1 forces a reclaim of epoch 0 (the only unpinned)
+        gc_checkpoints(run, 5, max_bytes=1, pin_epochs=[1, 2])
+    assert _registry_bytes(run) == before
+    assert committed_epochs(run) == [0, 1, 2]
+    post, _ = load_run_posterior(run, piloted["m"], epoch=0)
+    assert int(post.samples) == 8       # the victim's draws survived
