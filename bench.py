@@ -287,6 +287,24 @@ def _shard_summary():
                               env_extra={"XLA_FLAGS": xla})
 
 
+def _serve_mesh_summary():
+    """The mesh-sharded-serving digest (`benchmarks/bench_serve_mesh.py
+    --digest`): aggregate query throughput of the draw-sharded engine on
+    the emulated 8-device mesh vs the single-device engine at 64-way
+    concurrency, in device-seconds accounting (the emulation serialises
+    per-device work onto the host, so wall/devices is the real per-device
+    time), plus the single-vs-sharded agreement bound — run in a
+    CPU-pinned subprocess.  The digest's `mesh` + `n_devices` keys record
+    the geometry behind every number, so headline AND skip records carry
+    it."""
+    import os
+    xla = (os.environ.get("XLA_FLAGS", "")
+           + " --xla_force_host_platform_device_count=8").strip()
+    return _digest_subprocess(
+        ["benchmarks/bench_serve_mesh.py", "--digest"], line=0,
+        env_extra={"XLA_FLAGS": xla})
+
+
 def _precision_summary():
     """The mixed-precision digest: the committed per-class policy
     selections (ledger-driven targeted blocks), the scaled-shape bytes
@@ -386,6 +404,7 @@ def _skip(reason: str):
         "chaos": _chaos_summary(),
         "cost_ledger": _cost_ledger_summary(),
         "shard": _shard_summary(),
+        "serve_mesh": _serve_mesh_summary(),
         "precision": _precision_summary(),
         "multitenant": _multitenant_summary(),
         "refit": _refit_summary(),
@@ -554,6 +573,12 @@ def main():
         # per-sweep collective counts (benchmarks/bench_shard.py) — the
         # model-parallel axis rides the trajectory
         "shard": _shard_summary(),
+        # mesh-sharded serving digest (CPU subprocess, emulated 8-device
+        # mesh): draw-sharded vs single-device aggregate q/s at 64-way
+        # concurrency in device-seconds accounting + agreement bound
+        # (benchmarks/bench_serve_mesh.py) — the serve-side of the mesh
+        # rides the trajectory next to the sweep-side shard digest
+        "serve_mesh": _serve_mesh_summary(),
         # mixed-precision digest (committed artifacts): per-class policy'd
         # blocks, scaled-shape bytes saved, measured agreement bound
         # (hmsc_tpu/mcmc/precision.py) — the hot-path precision assault

@@ -52,7 +52,7 @@ def _fit(ny, ns, nf, samples, chains):
 
 
 def serving_digest(ny=120, ns=20, nf=2, samples=50, chains=2, reps=200,
-                   seed=0):
+                   seed=0, draw_shards=None):
     """Run the full synthetic-traffic measurement; returns the digest
     dict (gates evaluated by the caller).  Importable so ``bench.py`` can
     embed the digest into its headline record."""
@@ -68,8 +68,13 @@ def serving_digest(ny=120, ns=20, nf=2, samples=50, chains=2, reps=200,
 
     digest = {"ny": ny, "ns": ns, "n_draws": n_draws,
               "concurrent": CONCURRENT}
-    with ServingEngine(post, coalesce_ms=2.0,
+    with ServingEngine(post, coalesce_ms=2.0, draw_shards=draw_shards,
                        buckets=(1, 2, 4, 8, 16, 32, 64)) as eng:
+        # a digest without the device/mesh geometry is ambiguous between
+        # a single-device and a draw-sharded engine — record it up front
+        st0 = eng.stats()
+        digest.update(n_devices=st0["n_devices"],
+                      draw_shards=st0["draw_shards"], mesh=st0["mesh"])
         eng.warmup()
         base_cache = eng.stats()["cache"]
 
@@ -136,10 +141,13 @@ def main():
     ap.add_argument("--ns", type=int, default=20)
     ap.add_argument("--samples", type=int, default=50)
     ap.add_argument("--reps", type=int, default=200)
+    ap.add_argument("--draw-shards", type=int, default=None,
+                    help="run the engine draw-sharded over this many "
+                         "local devices (recorded in the digest)")
     args = ap.parse_args()
 
     d = serving_digest(ny=args.ny, ns=args.ns, samples=args.samples,
-                       reps=args.reps)
+                       reps=args.reps, draw_shards=args.draw_shards)
     print(json.dumps(d))
 
     gates = {

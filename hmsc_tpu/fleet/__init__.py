@@ -1,4 +1,5 @@
-"""Elastic fleet supervision for preemptible capacity.
+"""Elastic fleet supervision for preemptible capacity — and replicated
+posterior serving.
 
 ``python -m hmsc_tpu fleet <config.json>`` runs a
 :class:`~hmsc_tpu.fleet.supervisor.FleetSupervisor`: R worker ranks under
@@ -6,9 +7,18 @@ a ``FileCoordinator``, heartbeat liveness detection, exponential-backoff
 restarts under per-rank budgets, and shrink/grow degradation at committed
 manifest boundaries — zero committed draws lost, ever.  See the
 supervisor module docstring and README "Elastic fleet runs".
+
+``python -m hmsc_tpu serve --fleet <config.json>`` runs a
+:class:`~hmsc_tpu.fleet.serving.ServingFleet`: the same supervision
+machinery (heartbeats, exit-code taxonomy, backoff budgets) promoted to
+the query side — N ``ServingEngine`` replica processes behind one
+least-loaded front end, with coordinated generation-checked epoch flips.
+See README "Serving at scale".
 """
 
 from .config import FleetConfig
+from .serving import ServeFleetConfig, ServingFleet, serve_fleet_main
 from .supervisor import FleetSupervisor, fleet_events_path
 
-__all__ = ["FleetConfig", "FleetSupervisor", "fleet_events_path"]
+__all__ = ["FleetConfig", "FleetSupervisor", "fleet_events_path",
+           "ServeFleetConfig", "ServingFleet", "serve_fleet_main"]

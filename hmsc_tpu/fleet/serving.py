@@ -1,0 +1,716 @@
+"""Replicated posterior serving fleet: N engine replicas, one front end.
+
+``python -m hmsc_tpu serve --fleet <config.json>`` promotes the fleet
+supervisor's machinery to the QUERY side: it spawns ``replicas`` ordinary
+``python -m hmsc_tpu serve`` processes (each its own
+:class:`~hmsc_tpu.serve.engine.ServingEngine`, optionally draw-sharded
+over its local devices) and puts one stdlib front end in front of them:
+
+- **Dispatch** is least-loaded with round-robin tiebreak: every proxied
+  query picks the live replica with the fewest in-flight requests.  A
+  replica that dies mid-query answers with a connection error, and the
+  front end transparently retries the query on another live replica — a
+  chaos-killed replica drops ZERO queries.
+- **Liveness** rides the existing machinery: each replica beats a
+  :class:`~hmsc_tpu.utils.coordination.HeartbeatWriter` file (whose
+  payload also carries the bound port — how a ``--port 0`` replica is
+  discovered), exits are classified by the
+  :mod:`hmsc_tpu.exit_codes` taxonomy, and a dead or heartbeat-silent
+  replica is restarted with exponential backoff under a per-slot budget
+  (exhausted slots leave the rotation; the fleet serves degraded).
+- **Drain before kill**: a planned stop takes the replica out of the
+  rotation first, waits for its in-flight queries to finish (bounded by
+  ``drain_timeout_s``), then SIGTERMs it — the replica's own shutdown
+  path flushes telemetry exactly like single-engine ``serve``.
+- **Fleet-wide epoch flips** (``POST /flip`` on the front end): a
+  rolling, generation-checked ``reload()`` on every replica — each
+  replica's flip response must advance ITS generation by exactly one —
+  and the flip is acknowledged only when every rotation member reports
+  the target epoch from ``/healthz``.  A replica chaos-killed mid-flip
+  is restarted by the watcher; the restarted process re-resolves the
+  source and stages the newest committed epoch, so the coordinator just
+  waits for it to report the target.  In-flight queries are never
+  dropped and never mix generations: every response is computed against
+  exactly one staged generation (engine contract) and is tagged with it.
+
+Every decision is a ``kind="fleet"`` event in the work dir's
+``fleet-events.jsonl`` (``report`` renders the serving-fleet timeline
+with per-replica qps and queue-wait skew).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+from ..exit_codes import EXIT_OK, describe
+from .supervisor import fleet_events_path, log_tail
+
+__all__ = ["ServeFleetConfig", "ServingFleet", "serve_fleet_main"]
+
+
+@dataclasses.dataclass
+class ServeFleetConfig:
+    """Serving-fleet configuration (``serve --fleet config.json``).
+
+    ``source`` is what each replica serves (a run directory or compacted
+    artifact — exactly the single-engine ``serve`` positional);
+    ``work_dir`` holds heartbeats, per-replica logs, and the fleet event
+    stream.  Engine knobs (``buckets``/``coalesce_ms``/``draw_thin``/
+    ``draw_shards``/``no_warmup``) are passed through to every replica.
+    Supervision knobs mirror :class:`~hmsc_tpu.fleet.config.FleetConfig`:
+    heartbeat cadence/timeout, per-slot restart budgets, exponential
+    backoff ``min(base * factor**(fails-1), max)``.
+    """
+
+    source: str
+    work_dir: str
+    replicas: int = 3
+    host: str = "127.0.0.1"
+    port: int = 8080
+    draw_shards: int | None = None
+    buckets: str | None = None
+    coalesce_ms: float = 2.0
+    draw_thin: int = 1
+    no_warmup: bool = False
+    heartbeat_interval_s: float = 0.25
+    heartbeat_timeout_s: float = 20.0
+    startup_grace_s: float = 240.0
+    restart_budget: int = 3
+    backoff_base_s: float = 0.25
+    backoff_factor: float = 2.0
+    backoff_max_s: float = 30.0
+    drain_timeout_s: float = 10.0
+    request_timeout_s: float = 120.0
+    flip_timeout_s: float = 240.0
+    stats_interval_s: float = 5.0
+    poll_s: float = 0.05
+
+    def __post_init__(self):
+        if int(self.replicas) < 1:
+            raise ValueError(f"replicas={self.replicas} must be >= 1")
+        if self.backoff_factor < 1.0:
+            raise ValueError(
+                f"backoff_factor={self.backoff_factor} must be >= 1")
+        for f in ("heartbeat_interval_s", "heartbeat_timeout_s",
+                  "startup_grace_s", "backoff_base_s", "backoff_max_s",
+                  "drain_timeout_s", "request_timeout_s", "flip_timeout_s",
+                  "poll_s"):
+            if getattr(self, f) <= 0:
+                raise ValueError(f"{f}={getattr(self, f)} must be > 0")
+        if int(self.restart_budget) < 0:
+            raise ValueError(
+                f"restart_budget={self.restart_budget} must be >= 0")
+
+    @classmethod
+    def from_json(cls, path: str, **overrides) -> "ServeFleetConfig":
+        """Load a config file, rejecting unknown keys loudly (a typo'd
+        knob must not silently fall back to its default)."""
+        with open(path) as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict):
+            raise ValueError(f"{path}: fleet config must be a JSON object")
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = sorted(set(doc) - known)
+        if unknown:
+            raise ValueError(
+                f"{path}: unknown serve-fleet config key(s) {unknown}; "
+                f"known keys: {sorted(known)}")
+        doc.update(overrides)
+        return cls(**doc)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class _Replica:
+    """One supervised replica slot.  ``inflight``/``state`` are shared
+    between the front-end handler threads and the watcher."""
+
+    __slots__ = ("rank", "proc", "port", "inflight", "state", "fails",
+                 "log_path", "next_spawn", "spawned_at", "pid",
+                 "pre_flip_gen")
+
+    def __init__(self, rank: int):
+        self.rank = rank
+        self.proc = None
+        self.port = None
+        self.inflight = 0
+        self.state = "init"     # init|starting|live|backoff|dead|stopping
+        self.fails = 0
+        self.log_path = None
+        self.next_spawn = 0.0
+        self.spawned_at = 0.0
+        self.pid = None
+        self.pre_flip_gen = None
+
+
+class ServingFleet:
+    """Run a replicated serving fleet (see module docstring).
+
+    Lifecycle: :meth:`start` spawns the replicas, the watcher, and the
+    front end (bound to ``cfg.host:cfg.port``; the bound address is
+    :attr:`url`); :meth:`flip` coordinates a fleet-wide epoch flip;
+    :meth:`stop` drains and terminates everything.  Use as a context
+    manager in tests."""
+
+    # handler threads, the watcher, and flip() share the slot table;
+    # `hmsc_tpu lint` (lock-discipline) enforces the declaration below
+    # hmsc: guarded-by[_lock]: _n_proxied, _n_retried, _n_rejected
+
+    def __init__(self, config: ServeFleetConfig):
+        from ..obs import RunTelemetry
+        self.cfg = config
+        self.telem = RunTelemetry(proc=0)
+        self.slots = [_Replica(r) for r in range(int(config.replicas))]
+        self._lock = threading.Lock()
+        self._flip_lock = threading.Lock()
+        self._stop_evt = threading.Event()
+        self._watcher = None
+        self._server = None
+        self._server_thread = None
+        self._rr = 0                  # round-robin tiebreak cursor
+        self._n_proxied = 0
+        self._n_retried = 0
+        self._n_rejected = 0
+        self._hb_dir = os.path.join(config.work_dir, "hb")
+        self._last_stats = 0.0
+
+    # -- event plumbing ----------------------------------------------------
+
+    def _emit(self, name: str, **fields) -> None:
+        self.telem.emit("fleet", name, **fields)
+        self.telem.flush()            # the stream must be tailable live
+
+    # -- replica lifecycle -------------------------------------------------
+
+    def _spawn(self, slot: _Replica) -> None:
+        cfg = self.cfg
+        from ..utils.coordination import heartbeat_path
+        # a SIGKILLed replica leaves its old heartbeat behind; spawning
+        # over it would read a stale port — sweep before spawn
+        try:
+            os.unlink(heartbeat_path(self._hb_dir, slot.rank))
+        except OSError:
+            pass
+        cmd = [sys.executable, "-m", "hmsc_tpu", "serve", cfg.source,
+               "--host", cfg.host, "--port", "0",
+               "--replica-rank", str(slot.rank),
+               "--heartbeat-dir", self._hb_dir,
+               "--heartbeat-interval-s", str(cfg.heartbeat_interval_s),
+               "--coalesce-ms", str(cfg.coalesce_ms),
+               "--draw-thin", str(cfg.draw_thin)]
+        if cfg.buckets:
+            cmd += ["--buckets", str(cfg.buckets)]
+        if cfg.draw_shards:
+            cmd += ["--draw-shards", str(cfg.draw_shards)]
+        if cfg.no_warmup:
+            cmd += ["--no-warmup"]
+        slot.log_path = os.path.join(cfg.work_dir,
+                                     f"replica-r{slot.rank}.log")
+        # replica output goes to a file, not a pipe: a full pipe would
+        # wedge a healthy replica while its heartbeat keeps beating
+        logf = open(slot.log_path, "a")
+        # the replica must import hmsc_tpu no matter where the parent's
+        # cwd is (a user driving the fleet from a scratch dir imported
+        # the package off sys.path, which children don't inherit)
+        pkg_parent = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = (pkg_parent + os.pathsep + env["PYTHONPATH"]
+                             if env.get("PYTHONPATH") else pkg_parent)
+        slot.proc = subprocess.Popen(cmd, stdout=logf,
+                                     stderr=subprocess.STDOUT, env=env)
+        logf.close()                  # the child holds its own descriptor
+        slot.pid = slot.proc.pid
+        slot.port = None
+        slot.state = "starting"
+        slot.spawned_at = time.monotonic()
+        self._emit("replica_spawn", rank=slot.rank, pid=slot.pid,
+                   fails=slot.fails)
+
+    def _url(self, slot: _Replica) -> str:
+        return f"http://{self.cfg.host}:{slot.port}"
+
+    def _healthz(self, slot: _Replica, timeout: float = 2.0):
+        """Best-effort /healthz read; ``None`` when unreachable."""
+        import urllib.request
+        if slot.port is None:
+            return None
+        try:
+            with urllib.request.urlopen(self._url(slot) + "/healthz",
+                                        timeout=timeout) as r:
+                return json.loads(r.read().decode())
+        except Exception:             # noqa: BLE001 — liveness probe
+            return None
+
+    def _on_exit(self, slot: _Replica, rc: int) -> None:
+        cfg = self.cfg
+        slot.proc = None
+        slot.port = None
+        self._emit("replica_exit", rank=slot.rank, rc=int(rc),
+                   outcome=describe(rc),
+                   log_tail=(log_tail(slot.log_path)
+                             if rc != EXIT_OK else None))
+        if self._stop_evt.is_set():
+            slot.state = "stopping"
+            return
+        slot.fails += 1
+        if slot.fails > cfg.restart_budget:
+            slot.state = "dead"
+            self._emit("replica_abandoned", rank=slot.rank,
+                       fails=slot.fails, budget=cfg.restart_budget)
+            return
+        backoff = min(cfg.backoff_base_s
+                      * cfg.backoff_factor ** (slot.fails - 1),
+                      cfg.backoff_max_s)
+        slot.state = "backoff"
+        slot.next_spawn = time.monotonic() + backoff
+        self._emit("replica_backoff", rank=slot.rank, fails=slot.fails,
+                   backoff_s=round(backoff, 3))
+
+    def _watch(self) -> None:
+        from ..utils.coordination import read_heartbeats
+        cfg = self.cfg
+        while not self._stop_evt.is_set():
+            now = time.monotonic()
+            beats = read_heartbeats(self._hb_dir)
+            for slot in self.slots:
+                p = slot.proc
+                if p is not None:
+                    rc = p.poll()
+                    if rc is not None and slot.state != "stopping":
+                        self._on_exit(slot, rc)
+                        continue
+                if slot.state == "backoff" and now >= slot.next_spawn:
+                    self._spawn(slot)
+                    continue
+                if slot.state == "starting":
+                    hb = beats.get(slot.rank)
+                    # the heartbeat file must postdate this spawn: a
+                    # stale beat from the previous incarnation must not
+                    # resurrect a dead port
+                    if hb and "port" in hb \
+                            and hb["mtime"] >= time.time() - (
+                                now - slot.spawned_at) - 1.0:
+                        slot.port = int(hb["port"])
+                        if self._healthz(slot) is not None:
+                            slot.state = "live"
+                            self._emit("replica_ready", rank=slot.rank,
+                                       port=slot.port, pid=slot.pid)
+                        else:
+                            slot.port = None
+                    elif now - slot.spawned_at > cfg.startup_grace_s:
+                        self._emit("replica_heartbeat_silent",
+                                   rank=slot.rank, phase="startup",
+                                   age_s=round(now - slot.spawned_at, 2))
+                        self._kill(slot)
+                elif slot.state == "live":
+                    hb = beats.get(slot.rank)
+                    if hb is None or hb["age_s"] > cfg.heartbeat_timeout_s:
+                        self._emit("replica_heartbeat_silent",
+                                   rank=slot.rank, phase="serving",
+                                   age_s=(None if hb is None
+                                          else round(hb["age_s"], 2)))
+                        self._kill(slot)
+            if now - self._last_stats >= cfg.stats_interval_s:
+                self._last_stats = now
+                self._emit_replica_stats()
+            self._stop_evt.wait(cfg.poll_s)
+
+    def _kill(self, slot: _Replica) -> None:
+        p = slot.proc
+        if p is not None and p.poll() is None:
+            try:
+                p.kill()
+                p.wait(timeout=10.0)
+            except OSError:
+                pass
+
+    def _emit_replica_stats(self) -> None:
+        """Per-replica load sample for the report's qps/queue-wait skew:
+        request counters plus the queue_wait span aggregate from each
+        live replica's /statz."""
+        import urllib.request
+        for slot in self.slots:
+            if slot.state != "live":
+                continue
+            try:
+                with urllib.request.urlopen(self._url(slot) + "/statz",
+                                            timeout=2.0) as r:
+                    st = json.loads(r.read().decode())
+            except Exception:         # noqa: BLE001 — stats are best-effort
+                continue
+            qw = (st.get("spans") or {}).get("queue_wait") or {}
+            self._emit("replica_stats", rank=slot.rank,
+                       requests=st.get("requests"),
+                       rows_served=st.get("rows_served"),
+                       generation=st.get("generation"),
+                       epoch=st.get("epoch"),
+                       queue_wait_s=qw.get("total_s"),
+                       queue_wait_n=qw.get("count"),
+                       inflight=slot.inflight)
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _pick(self) -> _Replica | None:
+        """Least-loaded live replica, round-robin on ties."""
+        with self._lock:
+            live = [s for s in self.slots if s.state == "live"]
+            if not live:
+                return None
+            lo = min(s.inflight for s in live)
+            cands = [s for s in live if s.inflight == lo]
+            slot = cands[self._rr % len(cands)]
+            self._rr += 1
+            slot.inflight += 1
+            return slot
+
+    def _release(self, slot: _Replica) -> None:
+        with self._lock:
+            slot.inflight -= 1
+
+    def _forward(self, method: str, path: str, body: bytes | None):
+        """Proxy one query; retries connection-level failures on another
+        live replica (an HTTP error status is a real answer and is
+        forwarded as-is).  Returns ``(status, body_bytes)``."""
+        import http.client
+        import urllib.error
+        import urllib.request
+        cfg = self.cfg
+        deadline = time.monotonic() + cfg.request_timeout_s
+        attempt = 0
+        while time.monotonic() < deadline:
+            slot = self._pick()
+            if slot is None:
+                time.sleep(cfg.poll_s)  # mid-restart: wait for a replica
+                continue
+            attempt += 1
+            try:
+                req = urllib.request.Request(
+                    self._url(slot) + path, data=body, method=method,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(
+                        req, timeout=cfg.request_timeout_s) as r:
+                    data = r.read()
+                with self._lock:
+                    self._n_proxied += 1
+                return r.status, data
+            except urllib.error.HTTPError as e:
+                # the replica ANSWERED (4xx/5xx): forward, don't retry —
+                # a bad query is bad on every replica
+                data = e.read()
+                with self._lock:
+                    self._n_proxied += 1
+                return e.code, data
+            except (urllib.error.URLError, http.client.HTTPException,
+                    ConnectionError, TimeoutError, OSError):
+                # connection-level failure: the replica died under us
+                # (chaos kill) — retry the query on another live replica
+                with self._lock:
+                    self._n_retried += 1
+            finally:
+                self._release(slot)
+        with self._lock:
+            self._n_rejected += 1
+        return 503, json.dumps(
+            {"error": "no live replica within request_timeout_s"}).encode()
+
+    # -- front end ---------------------------------------------------------
+
+    def _make_front(self):
+        import http.server
+        fleet = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: ARG002 — BaseHTTP
+                pass
+
+            def _send(self, code, payload):
+                body = (payload if isinstance(payload, bytes)
+                        else json.dumps(payload).encode())
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — BaseHTTP API
+                if self.path == "/healthz":
+                    self._send(200, fleet.health())
+                elif self.path == "/statz":
+                    self._send(200, fleet.stats())
+                else:   # per-replica reads (e.g. /metrics) proxy through
+                    self._send(*fleet._forward("GET", self.path, None))
+
+            def do_POST(self):  # noqa: N802 — BaseHTTP API
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n) if n else b"{}"
+                if self.path == "/flip":
+                    try:
+                        doc = json.loads(body.decode() or "{}")
+                    except ValueError:
+                        self._send(400, {"error": "invalid JSON"})
+                        return
+                    try:
+                        self._send(200, fleet.flip(
+                            source=doc.get("source"),
+                            warmup=bool(doc.get("warmup", True))))
+                    except Exception as e:  # noqa: BLE001 — a failed flip
+                        # answers 500; the fleet keeps serving the old epoch
+                        self._send(500,
+                                   {"error": f"{type(e).__name__}: {e}"})
+                    return
+                self._send(*fleet._forward("POST", self.path, body))
+
+        return http.server.ThreadingHTTPServer(
+            (self.cfg.host, int(self.cfg.port)), Handler)
+
+    # -- public lifecycle --------------------------------------------------
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self, wait_live: bool = True) -> "ServingFleet":
+        cfg = self.cfg
+        os.makedirs(cfg.work_dir, exist_ok=True)
+        os.makedirs(self._hb_dir, exist_ok=True)
+        self.telem.attach_sink(fleet_events_path(cfg.work_dir),
+                               truncate=True)
+        self._emit("serve_fleet_start", replicas=cfg.replicas,
+                   source=str(cfg.source), draw_shards=cfg.draw_shards,
+                   config=self.cfg.to_dict())
+        for slot in self.slots:
+            self._spawn(slot)
+        self._watcher = threading.Thread(target=self._watch, daemon=True,
+                                         name="hmsc-serve-fleet-watch")
+        self._watcher.start()
+        self._server = self._make_front()
+        self._server_thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="hmsc-serve-fleet-front")
+        self._server_thread.start()
+        if wait_live:
+            deadline = time.monotonic() + cfg.startup_grace_s
+            while time.monotonic() < deadline:
+                if all(s.state in ("live", "dead") for s in self.slots) \
+                        and any(s.state == "live" for s in self.slots):
+                    break
+                time.sleep(cfg.poll_s)
+            else:
+                self.stop()
+                raise TimeoutError(
+                    f"serving fleet: no live replica within "
+                    f"startup_grace_s={cfg.startup_grace_s}")
+        return self
+
+    def health(self) -> dict:
+        """Fleet liveness + per-replica state (the front end's
+        /healthz)."""
+        reps = []
+        for slot in self.slots:
+            h = self._healthz(slot) if slot.state == "live" else None
+            reps.append({"rank": slot.rank, "state": slot.state,
+                         "port": slot.port, "pid": slot.pid,
+                         "inflight": slot.inflight,
+                         "generation": (h or {}).get("generation"),
+                         "epoch": (h or {}).get("epoch")})
+        return {"ok": any(s.state == "live" for s in self.slots),
+                "replicas": reps, "fleet": True}
+
+    def stats(self) -> dict:
+        """Front-end counters + each live replica's engine stats."""
+        with self._lock:
+            counts = {"proxied": self._n_proxied,
+                      "retried": self._n_retried,
+                      "rejected": self._n_rejected}
+        import urllib.request
+        reps = {}
+        for slot in self.slots:
+            if slot.state != "live":
+                continue
+            try:
+                with urllib.request.urlopen(self._url(slot) + "/statz",
+                                            timeout=2.0) as r:
+                    reps[str(slot.rank)] = json.loads(r.read().decode())
+            except Exception:         # noqa: BLE001 — stats best-effort
+                pass
+        return {"fleet": counts, "replicas": reps}
+
+    # -- fleet-wide flip ---------------------------------------------------
+
+    def flip(self, source=None, warmup: bool = True) -> dict:
+        """Rolling, generation-checked epoch flip across the fleet.
+
+        Calls ``reload()`` on every rotation member in turn; each
+        replica's flip response must advance its generation by exactly
+        one (anything else is a coordination error).  The flip is
+        acknowledged only when EVERY replica — including any that died
+        and restarted mid-flip — reports the target epoch from
+        ``/healthz``.  Returns the per-replica outcome summary."""
+        import urllib.request
+        cfg = self.cfg
+        with self._flip_lock:         # one fleet-wide flip at a time
+            t0 = time.monotonic()
+            self._emit("flip_start", source=source)
+            target_epoch = None
+            outcomes = {}
+            for slot in list(self.slots):
+                if slot.state != "live":
+                    outcomes[slot.rank] = slot.state
+                    continue
+                h0 = self._healthz(slot)
+                pre_gen = None if h0 is None else h0.get("generation")
+                payload = json.dumps(
+                    {"source": source, "warmup": warmup}
+                    if source is not None else
+                    {"warmup": warmup}).encode()
+                try:
+                    req = urllib.request.Request(
+                        self._url(slot) + "/flip", data=payload,
+                        headers={"Content-Type": "application/json"})
+                    with urllib.request.urlopen(
+                            req, timeout=cfg.flip_timeout_s) as r:
+                        res = json.loads(r.read().decode())
+                except Exception as e:  # noqa: BLE001 — a replica dying
+                    # mid-flip is the chaos case: the watcher restarts it
+                    # on the NEW epoch; the ack phase below waits for it
+                    outcomes[slot.rank] = f"died ({type(e).__name__})"
+                    self._emit("flip_replica", rank=slot.rank, ok=False,
+                               error=type(e).__name__)
+                    continue
+                gen = res.get("generation")
+                if pre_gen is not None and gen != pre_gen + 1:
+                    raise RuntimeError(
+                        f"replica {slot.rank}: flip answered generation "
+                        f"{gen}, expected {pre_gen + 1} — a concurrent "
+                        "flip raced this one")
+                if res.get("epoch") is not None:
+                    if target_epoch is not None \
+                            and res["epoch"] != target_epoch:
+                        raise RuntimeError(
+                            f"replica {slot.rank} flipped to epoch "
+                            f"{res['epoch']}, the fleet target is "
+                            f"{target_epoch} — the source moved mid-flip")
+                    target_epoch = res["epoch"]
+                outcomes[slot.rank] = "flipped"
+                self._emit("flip_replica", rank=slot.rank, ok=True,
+                           generation=gen, epoch=res.get("epoch"),
+                           shapes_changed=res.get("shapes_changed"))
+            # ack phase: every slot that is (or comes back) live must
+            # serve the target epoch before the flip is acknowledged
+            deadline = time.monotonic() + cfg.flip_timeout_s
+            pending = {s.rank for s in self.slots if s.state != "dead"}
+            while pending and time.monotonic() < deadline:
+                for slot in self.slots:
+                    if slot.rank not in pending:
+                        continue
+                    if slot.state == "dead":
+                        pending.discard(slot.rank)
+                        continue
+                    h = self._healthz(slot)
+                    if h is None:
+                        continue
+                    if target_epoch is None or h.get("epoch") \
+                            == target_epoch:
+                        pending.discard(slot.rank)
+                time.sleep(cfg.poll_s)
+            ok = not pending
+            self._emit("flip_done", ok=ok, epoch=target_epoch,
+                       outcomes={str(k): v for k, v in outcomes.items()},
+                       pending=sorted(pending),
+                       wall_s=round(time.monotonic() - t0, 3))
+            if not ok:
+                raise TimeoutError(
+                    f"fleet flip not acknowledged: replicas {sorted(pending)} "
+                    f"did not reach epoch {target_epoch} within "
+                    f"flip_timeout_s={cfg.flip_timeout_s}")
+            return {"ok": True, "epoch": target_epoch,
+                    "outcomes": {str(k): v for k, v in outcomes.items()},
+                    "wall_s": round(time.monotonic() - t0, 3)}
+
+    # -- shutdown ----------------------------------------------------------
+
+    def stop(self) -> None:
+        """Drain and terminate: rotation out first, bounded in-flight
+        drain, SIGTERM (the replica's clean unwind), SIGKILL as the
+        backstop."""
+        if self._stop_evt.is_set():
+            return
+        self._stop_evt.set()
+        if self._watcher is not None:
+            self._watcher.join(timeout=10.0)
+        for slot in self.slots:
+            was = slot.state
+            slot.state = "stopping"   # out of the rotation: no new queries
+            if slot.proc is None or slot.proc.poll() is not None:
+                continue
+            deadline = time.monotonic() + self.cfg.drain_timeout_s
+            while slot.inflight > 0 and time.monotonic() < deadline:
+                time.sleep(self.cfg.poll_s)
+            self._emit("replica_drain", rank=slot.rank, was=was,
+                       inflight=slot.inflight)
+            try:
+                slot.proc.terminate()
+                slot.proc.wait(timeout=10.0)
+            except (OSError, subprocess.TimeoutExpired):
+                self._kill(slot)
+        if self._server is not None:
+            self._server.shutdown()
+            self._server_thread.join(timeout=10.0)
+            self._server.server_close()
+        self._emit_replica_stats()
+        with self._lock:
+            proxied, retried, rejected = (self._n_proxied, self._n_retried,
+                                          self._n_rejected)
+        self._emit("serve_fleet_end",
+                   proxied=proxied, retried=retried, rejected=rejected)
+        self.telem.flush()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stop()
+        return False
+
+
+def serve_fleet_main(config_path: str, source_override=None) -> int:
+    """``python -m hmsc_tpu serve --fleet config.json`` — run the fleet
+    until SIGTERM/Ctrl-C."""
+    import signal
+
+    from ..obs import get_logger
+    log = get_logger()
+    overrides = {}
+    if source_override is not None:
+        overrides["source"] = source_override
+    cfg = ServeFleetConfig.from_json(config_path, **overrides)
+    fleet = ServingFleet(cfg)
+    fleet.start(wait_live=True)
+    host, port = fleet._server.server_address[:2]
+    live = sum(s.state == "live" for s in fleet.slots)
+    log.info(f"serve fleet: {live}/{cfg.replicas} replicas live behind "
+             f"http://{host}:{port} (POST /predict, /flip; GET /healthz, "
+             f"/statz) — events in "
+             f"{fleet_events_path(cfg.work_dir)}")
+
+    def _term(signum, frame):  # noqa: ARG001 — signal API
+        raise KeyboardInterrupt
+    old_term = signal.signal(signal.SIGTERM, _term)
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        log.info("serve fleet: interrupted, draining")
+    finally:
+        signal.signal(signal.SIGTERM, old_term)
+        fleet.stop()
+    return 0

@@ -58,9 +58,10 @@ import dataclasses
 
 __all__ = ["ShardCtx", "STATE_SPECIES_DIMS", "DATA_SPECIES_DIMS",
            "RECORD_SPECIES_DIMS", "STATE_SITE_DIMS", "DATA_SITE_DIMS",
-           "RECORD_SITE_DIMS", "SHARD_AGREEMENT_TOL",
+           "RECORD_SITE_DIMS", "SERVE_DRAW_DIMS", "SHARD_AGREEMENT_TOL",
            "shard_unsupported_reason", "site_shard_unsupported_reason",
            "engaged_site_extent", "tree_pspecs", "record_pspecs",
+           "serve_draw_pspec", "serve_draw_pspecs",
            "place_on_mesh", "collective_bytes", "nearest_divisor",
            "nearest_site_divisor",
            "force_emulated_device_count", "COLLECTIVE_PRIMS"]
@@ -123,6 +124,14 @@ _SITE_UNIT_NAMES = {"Eta", "nn_idx", "nn_coef", "nn_D", "idDg", "idDW12g"}
 
 # site-dimension index per RECORDED-SAMPLE key (per-level Eta rows)
 RECORD_SITE_DIMS = {"Eta": 0}
+
+# DRAW-dimension index per staged SERVING param (serve/engine.py's
+# ``_Staged``): every pooled posterior tensor leads with the draw axis,
+# embarrassingly parallel at query time.  Per-level names ("Lambda_0")
+# resolve through their base name like the record tables.  Anything not
+# listed (fam/ym/ys and the per-request X/unit_idx/key operands) is
+# replicated across the draw mesh.
+SERVE_DRAW_DIMS = {"Beta": 0, "sigma": 0, "Lambda": 0, "Eta": 0}
 
 # collective primitives counted by the static comm ledger and recorded in
 # the sharded jaxpr fingerprints
@@ -547,6 +556,45 @@ def record_pspecs(chain_axis: str, species_axis: str,
                 ax[ds + 2] = site_axis
         return P(*ax)
     return spec_for
+
+
+def serve_draw_pspec(name: str, axis: str = "draws"):
+    """``PartitionSpec`` for one staged serving param by name: the draw
+    dim from :data:`SERVE_DRAW_DIMS` carries the mesh axis (per-level
+    names like ``Lambda_0`` resolve through their base name), anything
+    unlisted is replicated."""
+    from jax.sharding import PartitionSpec as P
+    head, _, tail = name.rpartition("_")
+    base = head if tail.isdigit() else name
+    d = SERVE_DRAW_DIMS.get(base)
+    if d is None:
+        return P()
+    ax = [None] * (d + 1)
+    ax[d] = axis
+    return P(*ax)
+
+
+def serve_draw_pspecs(nr: int, axis: str = "draws", *,
+                      conditional: bool = False):
+    """``in_specs`` tuple for the sharded serving kernels, matching the
+    positional arg order of ``serve/kernels.py`` factories:
+    ``(Beta, sigma, lams, etas, fam, ym, ys, X, unit_idx[, Yc, mask],
+    key)``.  Posterior params shard on their leading draw dim via
+    :data:`SERVE_DRAW_DIMS`; the per-request operands and the RNG key
+    are replicated (every shard sees the full query batch)."""
+    from jax.sharding import PartitionSpec as P
+    draw = serve_draw_pspec("Beta", axis)
+    specs = (draw,                      # Beta   (n, nc, ns)
+             draw,                      # sigma  (n, ns)
+             (draw,) * nr,              # lams   [(n, nf_r, ns)]
+             (draw,) * nr,              # etas   [(n, np_r+1, nf_r)]
+             P(),                       # fam
+             P(), P(),                  # ym, ys
+             P(),                       # X
+             P())                       # unit_idx
+    if conditional:
+        specs = specs + (P(), P())      # Yc, mask
+    return specs + (P(),)               # key
 
 
 def place_on_mesh(tree, mesh, spec, species_axis: str, dims: dict,

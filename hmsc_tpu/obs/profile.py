@@ -85,17 +85,24 @@ def _keep(name: str, only) -> bool:
     return not only or any(s in name for s in only)
 
 
-def _carry_pspecs(carry, spec, species_axis):
+def _carry_pspecs(carry, spec, species_axis, site_axis=None):
     """PartitionSpecs for a block-chain carry (state, Xeff, LRan_total,
     E_shared): the state from the committed table, the aux linear-predictor
     arrays by shape (ny, ns) -> species on dim 1, a per-species design
-    list -> dim 0."""
+    list -> dim 0.  A ``site_axis`` engages the 2D tables on top: the
+    state's row/unit blocks and the aux arrays' sampling-row dim (Xeff
+    rows, the (ny, ns) linear-predictor terms) additionally shard over
+    sites — matching the layout the 2D sweep body produces between
+    blocks."""
     import jax
     from jax.sharding import PartitionSpec as P
 
-    from ..mcmc.partition import STATE_SPECIES_DIMS, tree_pspecs
+    from ..mcmc.partition import (STATE_SITE_DIMS, STATE_SPECIES_DIMS,
+                                  tree_pspecs)
     state, Xeff, LRan, E = carry
-    st = tree_pspecs(state, spec, species_axis, STATE_SPECIES_DIMS)
+    st = tree_pspecs(state, spec, species_axis, STATE_SPECIES_DIMS,
+                     site_axis=site_axis,
+                     site_dims=STATE_SITE_DIMS if site_axis else None)
 
     def aux(a):
         if a is None or not hasattr(a, "ndim"):
@@ -103,7 +110,9 @@ def _carry_pspecs(carry, spec, species_axis):
         if a.ndim == 3 and a.shape[0] == spec.ns:
             return P(species_axis, None, None)
         if a.ndim == 2 and a.shape == (spec.ny, spec.ns):
-            return P(None, species_axis)
+            return P(site_axis, species_axis)
+        if site_axis is not None and a.ndim == 2 and a.shape[0] == spec.ny:
+            return P(site_axis, None)
         return P(*([None] * a.ndim))
 
     return (st, aux(Xeff), aux(LRan), aux(E))
@@ -200,30 +209,70 @@ def build_shard_ledger(devices: int = 8, models=None, only=None) -> dict:
                 jax.make_jaxpr(sweep_s)(data, state, _k())))
             programs[name] = entry
 
-    # 2D (species x sites) whole-sweep entries: the same emulated devices
-    # reshaped to a (1, SITE_AUDIT_SP, SITE_AUDIT_ST) mesh over the
-    # site-capable canonical specs (base + Full/NNGP/GPP) — per-device
-    # SPMD cost columns plus the 2D collective byte ledger (the site-axis
-    # psums, Eta row gathers, and both-axis reductions all land in
-    # comm_bytes/collectives, drift-checked by `profile --check`)
+    # 2D (species x sites) entries: the same emulated devices reshaped to
+    # a (1, SITE_AUDIT_SP, SITE_AUDIT_ST) mesh over the site-capable
+    # canonical specs (base + Full/NNGP/GPP) — per-device SPMD cost
+    # columns plus the 2D collective byte ledger (the site-axis psums,
+    # Eta row gathers, and both-axis reductions all land in
+    # comm_bytes/collectives, drift-checked by `profile --check`).
+    # Alongside the whole ``:sweep`` program, every schedule block gets
+    # its own ``:block:<name>`` row (same pattern as the 1D species
+    # chain above), so a comm regression is attributable to the Gibbs
+    # block that grew it, not just the sweep total.
     from ..analysis.jaxpr_rules import (SITE_AUDIT_SP, SITE_AUDIT_ST,
                                         _site_shard_models)
+    from ..mcmc.partition import DATA_SITE_DIMS
     mesh2 = Mesh(np.array(jax.devices()[:SITE_AUDIT_SP * SITE_AUDIT_ST])
                  .reshape(1, SITE_AUDIT_SP, SITE_AUDIT_ST),
                  axis_names=("chains", "species", "sites"))
     tag2 = f"shard{SITE_AUDIT_SP}x{SITE_AUDIT_ST}"
     for mname, fn in _site_shard_models().items():
-        name = f"{mname}/{tag2}:sweep"
-        if not _keep(name, only):
-            continue
         spec, data, state = _build(fn())
         ones = tuple(1 for _ in range(spec.nr))
-        sweep_s = make_sharded_sweep(spec, mesh2, None, ones)
-        entry = _cost_entry(
-            jax.jit(sweep_s).lower(data, state, _k()).compile())
-        entry.update(collective_bytes(
-            jax.make_jaxpr(sweep_s)(data, state, _k())))
-        programs[name] = entry
+        ctx2 = ShardCtx(axis="species", n=SITE_AUDIT_SP, ns=spec.ns,
+                        site_axis="sites", m=SITE_AUDIT_ST, ny=spec.ny,
+                        np_r=tuple(ls.n_units for ls in spec.levels))
+        spec_l2 = _dc.replace(spec, ns=spec.ns // SITE_AUDIT_SP,
+                              ny=spec.ny // SITE_AUDIT_ST)
+        steps_g = make_sweep_schedule(spec, None, ones)
+        steps_l2 = make_sweep_schedule(spec_l2, None, ones, shard=ctx2)
+        cand = [f"{mname}/{tag2}:block:{b}" for b, _ in steps_g]
+        cand.append(f"{mname}/{tag2}:sweep")
+        if only and not any(_keep(n, only) for n in cand):
+            continue
+        data_specs2 = tree_pspecs(data, spec, "species", DATA_SPECIES_DIMS,
+                                  x_is_list=spec.x_is_list,
+                                  site_axis="sites",
+                                  site_dims=DATA_SITE_DIMS)
+        state_it, ks = jax.jit(sweep_prologue)(state, _k())
+        carry = (state_it, None, None, None)
+        for (bname, block_g), (_, block_l) in zip(steps_g, steps_l2):
+            carry_next = jax.jit(block_g)(data, carry, ks)
+            name = f"{mname}/{tag2}:block:{bname}"
+            if _keep(name, only):
+                sm = shard_map(block_l, mesh=mesh2,
+                               in_specs=(data_specs2,
+                                         _carry_pspecs(carry, spec,
+                                                       "species", "sites"),
+                                         P()),
+                               out_specs=_carry_pspecs(carry_next, spec,
+                                                       "species", "sites"),
+                               check_rep=False)
+                entry = _cost_entry(
+                    jax.jit(sm).lower(data, carry, ks).compile())
+                entry.update(collective_bytes(
+                    jax.make_jaxpr(sm)(data, carry, ks)))
+                programs[name] = entry
+            carry = carry_next
+
+        name = f"{mname}/{tag2}:sweep"
+        if _keep(name, only):
+            sweep_s = make_sharded_sweep(spec, mesh2, None, ones)
+            entry = _cost_entry(
+                jax.jit(sweep_s).lower(data, state, _k()).compile())
+            entry.update(collective_bytes(
+                jax.make_jaxpr(sweep_s)(data, state, _k())))
+            programs[name] = entry
     return programs
 
 

@@ -18,7 +18,14 @@ line is tolerated) and prints:
 - **fleet timeline** — for supervised runs (``python -m hmsc_tpu fleet``),
   the supervisor's ``fleet-events.jsonl``: per-attempt spawn/exit
   outcomes, heartbeat kills, chaos injections, backoff/shrink/grow
-  decisions, and the final supervision summary.
+  decisions, and the final supervision summary;
+- **serving-fleet timeline** — for replicated serving runs
+  (``python -m hmsc_tpu serve --fleet``), the front end's
+  ``fleet-events.jsonl``: per-replica lifecycle (spawns, exits,
+  backoff restarts), fleet-wide generation-checked epoch flips, the
+  front end's proxied/retried/rejected counters, and per-replica load
+  skew (queries/sec + mean queue wait from the periodic
+  ``replica_stats`` samples).
 
 ``--json`` emits the structured report instead of text; ``--prom FILE``
 writes a Prometheus textfile-collector export of the final gauges (point
@@ -194,6 +201,7 @@ def build_report(run_dir: str) -> dict:
     report = {"run_dir": os.fspath(run_dir),
               "ranks": sorted(streams), "per_rank": {}, "skew": [],
               "fleet": _fleet_section(ops),
+              "serve_fleet": _serve_fleet_section(ops),
               "pipeline": _pipeline_section(ops),
               "status": "no-events" if not streams else "unknown"}
     for proc, events in streams.items():
@@ -327,8 +335,92 @@ def _fleet_section(events: list) -> dict | None:
             summary = {k: v for k, v in ev.items()
                        if k not in ("seq", "t", "wall", "proc", "kind",
                                     "name")}
+    if not attempts and not decisions and summary is None:
+        return None          # e.g. a serving-fleet stream (same kind)
     return {"attempts": [attempts[a] for a in sorted(attempts)],
             "decisions": decisions, "summary": summary}
+
+
+def _serve_fleet_section(events: list) -> dict | None:
+    """Structured serving-fleet timeline from the front end's
+    ``fleet-events.jsonl`` (``python -m hmsc_tpu serve --fleet``):
+    per-replica lifecycle (spawns, exits with outcome, backoffs), the
+    fleet-wide generation-checked epoch flips, and per-replica load skew
+    — queries/sec and mean queue-wait derived from the periodic
+    ``replica_stats`` samples, so a hot or lagging replica is visible
+    without scraping any live /statz."""
+    events = [e for e in events if e.get("kind") == "fleet"
+              and str(e.get("name", "")).startswith(
+                  ("serve_fleet", "replica_", "flip_"))]
+    if not events:
+        return None
+    replicas: dict = {}
+    flips, decisions = [], []
+    start = summary = None
+
+    def _rep(rank):
+        return replicas.setdefault(rank, {
+            "rank": rank, "spawns": 0, "exits": [], "stats": []})
+
+    for ev in events:
+        name, rank = ev.get("name"), ev.get("rank")
+        if name == "serve_fleet_start":
+            start = {"replicas": ev.get("replicas"),
+                     "source": ev.get("source"),
+                     "draw_shards": ev.get("draw_shards")}
+        elif name == "replica_spawn":
+            _rep(rank)["spawns"] += 1
+        elif name == "replica_exit":
+            _rep(rank)["exits"].append({"rc": ev.get("rc"),
+                                        "outcome": ev.get("outcome")})
+        elif name == "replica_stats":
+            _rep(rank)["stats"].append(
+                {k: ev.get(k) for k in ("t", "requests", "rows_served",
+                                        "queue_wait_s", "queue_wait_n",
+                                        "inflight", "epoch",
+                                        "generation")})
+        elif name in ("replica_backoff", "replica_abandoned",
+                      "replica_heartbeat_silent", "replica_drain"):
+            decisions.append({k: v for k, v in ev.items()
+                              if v is not None
+                              and k not in ("seq", "wall", "proc",
+                                            "kind", "log_tail")})
+        elif name == "flip_replica":
+            decisions.append({k: v for k, v in ev.items()
+                              if v is not None
+                              and k not in ("seq", "wall", "proc",
+                                            "kind")})
+        elif name in ("flip_start", "flip_done"):
+            flips.append({k: v for k, v in ev.items()
+                          if v is not None
+                          and k not in ("seq", "wall", "proc", "kind")})
+        elif name == "serve_fleet_end":
+            summary = {k: ev.get(k)
+                       for k in ("proxied", "retried", "rejected")}
+
+    # per-replica load skew over the sampled window: qps from the first
+    # vs last request counter, queue-wait mean from the span aggregate
+    for r in replicas.values():
+        st = [s for s in r["stats"] if s.get("requests") is not None]
+        r["qps"] = r["queue_wait_ms"] = None
+        if len(st) >= 2 and st[-1]["t"] > st[0]["t"]:
+            r["qps"] = round((st[-1]["requests"] - st[0]["requests"])
+                             / (st[-1]["t"] - st[0]["t"]), 2)
+        last = next((s for s in reversed(st)
+                     if s.get("queue_wait_n")), None)
+        if last:
+            r["queue_wait_ms"] = round(
+                1e3 * last["queue_wait_s"] / last["queue_wait_n"], 3)
+        r["final"] = {k: st[-1].get(k) for k in ("epoch", "generation",
+                                                 "requests")} if st else None
+        del r["stats"]
+    qps = [r["qps"] for r in replicas.values() if r["qps"]]
+    skew = (round(max(qps) / max(min(qps), 1e-9), 2)
+            if len(qps) >= 2 else None)
+    return {"start": start,
+            "replicas": [replicas[r] for r in sorted(replicas)],
+            "qps_skew": skew, "flips": flips, "decisions": decisions,
+            "summary": summary}
 
 
 def _pipeline_section(events: list) -> dict | None:
@@ -505,6 +597,50 @@ def render_report(report: dict) -> str:
                 f"{s.get('shrinks')} shrink(s), {s.get('grows')} grow(s); "
                 f"fleet {s.get('fleet_size')}, draws lost "
                 f"{s.get('draws_lost')}, wall {s.get('wall_s')}s")
+    sf = report.get("serve_fleet")
+    if sf:
+        lines.append("")
+        lines.append("== serving fleet timeline (front end) ==")
+        if sf.get("start"):
+            s0 = sf["start"]
+            lines.append(f"  fleet of {s0.get('replicas')} replica(s) on "
+                         f"{s0.get('source')}"
+                         + (f", draw_shards={s0['draw_shards']}"
+                            if s0.get("draw_shards") else ""))
+        for r in sf["replicas"]:
+            exits = ", ".join(e["outcome"] or f"rc={e['rc']}"
+                              for e in r["exits"]) or "none"
+            fin = r.get("final") or {}
+            lines.append(
+                f"  replica {r['rank']}: {r['spawns']} spawn(s), "
+                f"exits: {exits}; "
+                f"qps={r['qps'] if r['qps'] is not None else '?'} "
+                f"queue_wait_ms="
+                f"{r['queue_wait_ms'] if r['queue_wait_ms'] is not None else '?'}"
+                + (f"  (epoch {fin.get('epoch')}, gen "
+                   f"{fin.get('generation')}, {fin.get('requests')} "
+                   f"requests)" if fin else ""))
+        if sf.get("qps_skew") is not None:
+            lines.append(f"  qps skew (max/min replica): {sf['qps_skew']}x")
+        for d in sf["decisions"]:
+            name = d.get("name", "?")
+            t = d.get("t")
+            detail = ", ".join(f"{k}={v}" for k, v in d.items()
+                               if k not in ("name", "t"))
+            stamp = f" t={t:.2f}s" if isinstance(t, float) else ""
+            lines.append(f"  [{name}]{stamp} {detail}")
+        for fl in sf["flips"]:
+            if fl.get("name") == "flip_done":
+                lines.append(
+                    f"  flip -> epoch {fl.get('epoch')}: "
+                    f"{'acknowledged' if fl.get('ok') else 'FAILED'} "
+                    f"in {fl.get('wall_s')}s "
+                    f"({json.dumps(fl.get('outcomes'))})")
+        s = sf.get("summary")
+        if s:
+            lines.append(f"  front end: {s.get('proxied')} proxied, "
+                         f"{s.get('retried')} retried, "
+                         f"{s.get('rejected')} rejected")
     pipe = report.get("pipeline")
     if pipe:
         lines.append("")
@@ -781,6 +917,7 @@ def report_main(argv=None) -> int:
         with open(args.prom, "w") as f:
             f.write(prometheus_textfile(report))
     return 0 if (report["ranks"] or report.get("fleet")
+                 or report.get("serve_fleet")
                  or report.get("pipeline")) else 1
 
 

@@ -55,7 +55,9 @@ import numpy as np
 from ..obs import RunTelemetry, events_path
 from .artifact import (ServingArtifact, load_artifact, load_run_posterior,
                        resolve_run_epoch)
-from .kernels import make_conditional_kernel, make_predict_kernel
+from .kernels import (make_conditional_kernel, make_predict_kernel,
+                      make_sharded_conditional_kernel,
+                      make_sharded_predict_kernel)
 
 __all__ = ["ServingEngine", "DEFAULT_BUCKETS"]
 
@@ -86,7 +88,7 @@ class _Staged:
                  "n_draws", "fam", "any_probit", "any_normal",
                  "any_poisson", "level_names", "unit_lut", "new_unit",
                  "ym_host", "ys_host", "Beta", "sigma", "lams", "etas",
-                 "fam_d", "ym", "ys", "shape_key")
+                 "fam_d", "ym", "ys", "shape_key", "mesh", "draw_shards")
 
 
 class ServingEngine:
@@ -107,6 +109,15 @@ class ServingEngine:
     Eta rows; unknown/new units use the mean-field zero row (the
     ``predict_eta_mean`` semantics).  Richer structures fall back to the
     offline :func:`hmsc_tpu.predict` path.
+
+    ``draw_shards > 1`` stages the posterior's draw axis over a 1-D
+    device mesh (:data:`~hmsc_tpu.mcmc.partition.SERVE_DRAW_DIMS`) and
+    answers every query with the draw-sharded kernels: per-device HBM
+    drops to ``1/k`` of the posterior and the per-query draw work fans
+    out ``k``-wide with one psum per query; answers agree with the
+    single-device engine within ``SHARD_AGREEMENT_TOL``.  Widths that
+    don't divide the draw count (or exceed the device count) fall back
+    to the nearest valid width with a warning.
     """
 
     # the submit path (any caller thread) and the coalescing worker share
@@ -116,7 +127,8 @@ class ServingEngine:
 
     def __init__(self, source, hM=None, *, buckets=DEFAULT_BUCKETS,
                  coalesce_ms: float = 2.0, cache_size: int = 32,
-                 draw_thin: int = 1, telemetry=None, seed: int = 0):
+                 draw_thin: int = 1, draw_shards: int | None = None,
+                 telemetry=None, seed: int = 0):
         self.buckets = tuple(sorted(int(b) for b in buckets))
         if not self.buckets or self.buckets[0] < 1:
             raise ValueError(f"buckets must be positive ints, got {buckets}")
@@ -137,6 +149,16 @@ class ServingEngine:
         self._source = source
         self._hM0 = hM
         self._draw_thin = int(draw_thin)
+        # requested draw-mesh width (None/1 = the committed single-device
+        # path, byte-identical staging); resolved per generation against
+        # the draw count + device count in _build_staged.  One Mesh per
+        # resolved width, cached so a same-shape flip reuses the same
+        # mesh object (NamedSharding equality → zero recompiles).
+        self._draw_shards_req = (None if draw_shards is None
+                                 else int(draw_shards))
+        if self._draw_shards_req is not None and self._draw_shards_req < 1:
+            raise ValueError(f"draw_shards={draw_shards} must be >= 1")
+        self._mesh_cache: dict = {}
         # serialises reload(): two concurrent flips must not both build
         # gen N+1 and race the swap (one fully-staged generation would be
         # silently discarded while _source recorded the other)
@@ -196,6 +218,12 @@ class ServingEngine:
     @property
     def n_draws(self):
         return self._staged.n_draws
+
+    @property
+    def draw_shards(self) -> int:
+        """Resolved draw-mesh width this generation serves at (1 = the
+        single-device path)."""
+        return self._staged.draw_shards
 
     @property
     def ns(self):
@@ -315,13 +343,15 @@ class ServingEngine:
         st.any_normal = bool((st.fam == 1).any())
         st.any_poisson = bool((st.fam == 3).any())
         st.ym_host, st.ys_host = ym, ys
+        st.draw_shards, st.mesh = self._resolve_draw_mesh(st.n_draws)
         # unit label -> Eta row; unknown labels get the appended zero row
         # (index np_r): the mean-field new-unit semantics
         st.unit_lut = [{str(u): i for i, u in enumerate(us)}
                        for us in unit_lists]
         st.new_unit = [len(us) for us in unit_lists]
 
-        with self.telem.span("stage", n_draws=st.n_draws, gen=st.gen):
+        with self.telem.span("stage", n_draws=st.n_draws, gen=st.gen,
+                             draw_shards=st.draw_shards):
             f32 = jnp.float32
 
             def _stage_dtype(a):
@@ -332,35 +362,81 @@ class ServingEngine:
                     return jnp.bfloat16
                 return f32
 
-            st.Beta = jnp.asarray(pooled["Beta"],
-                                  _stage_dtype(pooled["Beta"]))
-            st.sigma = jnp.asarray(pooled["sigma"],
-                                   _stage_dtype(pooled["sigma"]))
+            def _stage(a, name):
+                # single device: jnp.asarray exactly as before (zero-copy
+                # for the f32 memmap).  On a draw mesh: device_put with
+                # the SERVE_DRAW_DIMS NamedSharding so each device holds
+                # only its contiguous draw block — bf16-stored artifacts
+                # stage their STORED dtype per device (half the per-device
+                # HBM, same as the single-device path).
+                if st.mesh is None:
+                    return jnp.asarray(a, _stage_dtype(a))
+                import jax
+                from jax.sharding import NamedSharding
+
+                from ..mcmc.partition import serve_draw_pspec
+                host = np.asarray(a, dtype=np.dtype(_stage_dtype(a)))
+                return jax.device_put(
+                    host, NamedSharding(st.mesh, serve_draw_pspec(name)))
+
+            st.Beta = _stage(pooled["Beta"], "Beta")
+            st.sigma = _stage(pooled["sigma"], "sigma")
             lams, etas = [], []
             for r in range(st.nr):
                 lam = pooled[f"Lambda_{r}"]
                 if lam.ndim == 4:
                     lam = lam[..., 0]
-                lams.append(jnp.asarray(lam, _stage_dtype(lam)))
+                lams.append(_stage(lam, f"Lambda_{r}"))
                 dt = np.dtype(_stage_dtype(pooled[f"Eta_{r}"]))
                 eta = np.asarray(pooled[f"Eta_{r}"], dtype=dt)
                 zero = np.zeros((eta.shape[0], 1, eta.shape[2]), dtype=dt)
-                etas.append(jnp.asarray(np.concatenate([eta, zero],
-                                                       axis=1)))
+                etas.append(_stage(np.concatenate([eta, zero], axis=1),
+                                   f"Eta_{r}"))
             st.lams = tuple(lams)
             st.etas = tuple(etas)
             st.fam_d = jnp.asarray(st.fam)
             st.ym = jnp.asarray(ym)
             st.ys = jnp.asarray(ys)
         # the compile-cache facet of a generation: kernels retrace only
-        # when a staged shape/dtype (or a trace-time static) actually
-        # changed, so a same-shape epoch flip reuses every compiled
-        # kernel — zero recompiles
+        # when a staged shape/dtype, the draw-mesh width, or a trace-time
+        # static actually changed, so a same-shape flip on the same mesh
+        # reuses every compiled kernel — zero recompiles
         st.shape_key = (
-            (st.nr, st.any_probit, st.any_normal, st.any_poisson),
+            (st.nr, st.any_probit, st.any_normal, st.any_poisson,
+             st.draw_shards),
         ) + tuple((tuple(a.shape), str(a.dtype))
                   for a in (st.Beta, st.sigma, *st.lams, *st.etas))
         return st
+
+    def _resolve_draw_mesh(self, n_draws: int):
+        """Resolve the requested draw-mesh width against this
+        generation's draw count and the visible device count: the widest
+        divisor of ``n_draws`` not exceeding either, warning when it
+        differs from the request (``nearest_divisor`` semantics — the
+        engine serves correctly at the fallback width rather than
+        refusing).  Returns ``(draw_shards, mesh-or-None)``; width 1 is
+        the committed single-device path (no mesh, no shard_map)."""
+        k_req = self._draw_shards_req
+        if k_req is None or k_req == 1:
+            return 1, None
+        import jax
+
+        from ..utils.mesh import make_draw_mesh
+        ndev = len(jax.devices())
+        cap = min(k_req, ndev, int(n_draws))
+        k = max(d for d in range(1, cap + 1) if n_draws % d == 0)
+        if k != k_req:
+            import warnings
+            warnings.warn(
+                f"draw_shards={k_req} does not fit n_draws={n_draws} on "
+                f"{ndev} device(s); serving at the nearest width "
+                f"draw_shards={k}", stacklevel=3)
+        if k == 1:
+            return 1, None
+        mesh = self._mesh_cache.get(k)
+        if mesh is None:
+            mesh = self._mesh_cache[k] = make_draw_mesh(k)
+        return k, mesh
 
     # ------------------------------------------------------------------
     # epoch flip
@@ -423,9 +499,10 @@ class ServingEngine:
     # ------------------------------------------------------------------
 
     def submit(self, X, *, units=None, Yc=None, expected: bool = True,
-               mcmc_step: int = 1) -> Future:
+               mcmc_step: int = 1, quantiles=None) -> Future:
         """Enqueue one prediction query; returns a Future resolving to
-        ``{"mean": (q, ns), "sd": (q, ns)}``.
+        ``{"mean": (q, ns), "sd": (q, ns)}`` — plus ``{"quantiles":
+        (nq, q, ns), "q": [...]}`` when ``quantiles`` is given.
 
         ``X`` is the (q, nc) design block (model scale, intercept
         included).  ``units`` optionally maps level name -> q unit labels
@@ -433,7 +510,11 @@ class ServingEngine:
         serve mean-field).  ``Yc`` (q, ns) with NaN for unobserved cells
         switches to conditional prediction refined by ``mcmc_step`` Gibbs
         iterations.  ``expected=False`` samples responses instead of
-        returning the location parameter."""
+        returning the location parameter.  ``quantiles`` (marginal
+        prediction only) is a sequence of probabilities in [0, 1]: the
+        kernel computes full-draw response quantiles on device before the
+        draw-axis reduction — each distinct tuple is its own compiled
+        config, so steady traffic should reuse a small fixed set."""
         if self._closed:
             raise RuntimeError("ServingEngine is closed")
         st = self._staged            # one generation per request, start to
@@ -458,6 +539,18 @@ class ServingEngine:
                         f"labels for {q} query rows")
                 uidx[r] = [lut.get(str(u), new) for u in labels]
         arrays = {"X": X, "uidx": uidx}
+        qs = ()
+        if quantiles is not None:
+            qs = tuple(float(q) for q in np.atleast_1d(
+                np.asarray(quantiles, dtype=np.float64)))
+            if not qs or any(not (0.0 <= q <= 1.0) for q in qs):
+                raise ValueError(
+                    f"quantiles must be probabilities in [0, 1], got "
+                    f"{quantiles!r}")
+            if Yc is not None:
+                raise NotImplementedError(
+                    "serving engine v1: quantiles are marginal-prediction "
+                    "only (conditional queries return mean/sd)")
         if Yc is not None:
             Yc = np.atleast_2d(np.asarray(Yc, dtype=np.float32))
             if Yc.shape != (q, st.ns):
@@ -474,6 +567,8 @@ class ServingEngine:
             arrays["Yc"] = np.nan_to_num(Ycs, nan=0.0).astype(np.float32)
             arrays["mask"] = mask
             config = ("cond", bool(expected), int(mcmc_step))
+        elif qs:
+            config = ("predict", bool(expected), qs)
         else:
             config = ("predict", bool(expected))
         req = _Request(config, q, arrays, Future(), st)
@@ -554,6 +649,10 @@ class ServingEngine:
                 "last_flip_wall": self._last_flip_wall,
                 "buckets": list(self.buckets),
                 "coalesce_ms": self.coalesce_s * 1e3,
+                "draw_shards": int(st.draw_shards),
+                "n_devices": int(st.draw_shards),
+                "mesh": None if st.mesh is None else
+                        {"draws": int(st.draw_shards)},
                 "cache": cache, **counts,
                 "spans": self.telem.totals()}
 
@@ -601,12 +700,24 @@ class ServingEngine:
         # build outside the lock (tracing/compiling can be slow); a racing
         # duplicate build is harmless — last one in wins the cache slot
         if config[0] == "predict":
-            raw = make_predict_kernel(
-                nr=st.nr, expected=config[1],
-                any_probit=st.any_probit, any_poisson=st.any_poisson)
-        else:
+            # ("predict", expected) or ("predict", expected, quantiles)
+            q = config[2] if len(config) > 2 else ()
+            if st.mesh is None:
+                raw = make_predict_kernel(
+                    nr=st.nr, expected=config[1], any_probit=st.any_probit,
+                    any_poisson=st.any_poisson, quantiles=q)
+            else:
+                raw = make_sharded_predict_kernel(
+                    st.mesh, nr=st.nr, expected=config[1],
+                    any_probit=st.any_probit, any_poisson=st.any_poisson,
+                    quantiles=q)
+        elif st.mesh is None:
             raw = make_conditional_kernel(
                 nr=st.nr, mcmc_step=config[2], expected=config[1],
+                any_probit=st.any_probit, any_normal=st.any_normal)
+        else:
+            raw = make_sharded_conditional_kernel(
+                st.mesh, nr=st.nr, mcmc_step=config[2], expected=config[1],
                 any_probit=st.any_probit, any_normal=st.any_normal)
         fn = jax.jit(raw)
         self.telem.emit("metric", "kernel_build",
@@ -727,16 +838,20 @@ class ServingEngine:
                 calls.append((n, b, Xp, up, Ycp, maskp))
             sp.fields["padded"] = padded
 
-        outs = []
+        has_q = config[0] == "predict" and len(config) > 2 and config[2]
+        outs, qouts = [], []
         for n, b, Xp, up, Ycp, maskp in calls:
             fn = self._kernel(st, config, b)
             with self.telem.span("dispatch", bucket=b, rows=n):
-                mean_d, sd_d = fn(*self._device_args(st, config, Xp, up,
-                                                     Ycp, maskp))
+                res = fn(*self._device_args(st, config, Xp, up,
+                                            Ycp, maskp))
             with self.telem.span("fetch", bucket=b):
-                outs.append((np.asarray(mean_d)[:n], np.asarray(sd_d)[:n]))
+                outs.append((np.asarray(res[0])[:n], np.asarray(res[1])[:n]))
+                if has_q:
+                    qouts.append(np.asarray(res[2])[:, :n])
         mean = np.concatenate([m for m, _ in outs], axis=0)
         sd = np.concatenate([s for _, s in outs], axis=0)
+        quants = np.concatenate(qouts, axis=1) if has_q else None
 
         with self._lock:
             self._n_batches += 1
@@ -745,8 +860,16 @@ class ServingEngine:
             self._rows_padded += sum(b - n for n, b, *_ in calls)
         off = 0
         for req in batch:
-            req.future.set_result({"mean": mean[off:off + req.n_rows],
-                                   "sd": sd[off:off + req.n_rows]})
+            # the generation/epoch the answer was COMPUTED on (a flip
+            # landing mid-response must not mislabel it): the fleet's
+            # mixed-generation drill asserts on these
+            res = {"mean": mean[off:off + req.n_rows],
+                   "sd": sd[off:off + req.n_rows],
+                   "generation": st.gen, "epoch": st.epoch}
+            if has_q:
+                res["quantiles"] = quants[:, off:off + req.n_rows]
+                res["q"] = list(config[2])
+            req.future.set_result(res)
             off += req.n_rows
         if self.telem.has_sink:
             self.telem.flush()

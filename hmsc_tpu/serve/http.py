@@ -9,8 +9,11 @@ shared device calls exactly like in-process callers.
 Endpoints::
 
     POST /predict   {"X": [[...]], "units": {level: [...]}?, "Yc": ...?,
-                     "expected": true?, "mcmc_step": 1?}
+                     "expected": true?, "mcmc_step": 1?,
+                     "quantiles": [0.05, 0.5, 0.95]?}
                     -> {"mean": [[...]], "sd": [[...]], "n_draws": N}
+                       (+ "quantiles"/"q" when requested: full-draw
+                       response quantiles computed on device)
     POST /gradient  {"focal": "x1", "ngrid": 20?, "expected": true?}
     POST /flip      {"source": "<path>"?, "warmup": true?}  — admin: hot-
                     reload the served posterior and flip to it atomically
@@ -76,6 +79,7 @@ def make_server(engine, host: str = "127.0.0.1", port: int = 0):
                                  "epoch": engine.epoch,
                                  "generation": engine.generation,
                                  "last_flip_wall": engine.last_flip_wall,
+                                 "draw_shards": engine.draw_shards,
                                  "buckets": list(engine.buckets)})
             elif self.path == "/statz":
                 self._send(200, engine.stats())
@@ -101,7 +105,8 @@ def make_server(engine, host: str = "127.0.0.1", port: int = 0):
                     out = engine.predict(
                         X, units=doc.get("units"), Yc=Yc,
                         expected=bool(doc.get("expected", True)),
-                        mcmc_step=int(doc.get("mcmc_step", 1)))
+                        mcmc_step=int(doc.get("mcmc_step", 1)),
+                        quantiles=doc.get("quantiles"))
                 elif self.path == "/gradient":
                     out = engine.gradient(
                         doc["focal"],
@@ -123,7 +128,13 @@ def make_server(engine, host: str = "127.0.0.1", port: int = 0):
                     "sd": np.asarray(out["sd"]).tolist(),
                     **({"grid": out["grid"].tolist()}
                        if "grid" in out else {}),
+                    **({"quantiles": np.asarray(out["quantiles"]).tolist(),
+                        "q": out["q"]}
+                       if "quantiles" in out else {}),
                     "n_draws": engine.n_draws,
+                    **({"generation": out["generation"],
+                        "epoch": out["epoch"]}
+                       if "generation" in out else {}),
                 })
             except (KeyError, ValueError, NotImplementedError) as e:
                 self._send(400, {"error": f"{type(e).__name__}: {e}"})
@@ -145,10 +156,16 @@ def serve_main(argv=None) -> int:
         prog="python -m hmsc_tpu serve",
         description="serve batched posterior predictions over HTTP from a "
                     "fitted run directory or a compacted serving artifact")
-    ap.add_argument("source",
+    ap.add_argument("source", nargs="?", default=None,
                     help="compacted artifact directory (`hmsc_tpu "
                          "compact`), or a run directory written by "
-                         "`python -m hmsc_tpu run`")
+                         "`python -m hmsc_tpu run` (optional with --fleet: "
+                         "the fleet config names its own source)")
+    ap.add_argument("--fleet", metavar="CONFIG", default=None,
+                    help="run a replicated serving fleet from a JSON "
+                         "config instead of a single engine: N supervised "
+                         "replica processes behind one front end "
+                         "(see fleet.serving.ServeFleetConfig)")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8080)
     ap.add_argument("--buckets",
@@ -158,6 +175,9 @@ def serve_main(argv=None) -> int:
                     help="micro-batch coalescing window (milliseconds)")
     ap.add_argument("--draw-thin", type=int, default=1,
                     help="serve every Nth pooled draw")
+    ap.add_argument("--draw-shards", type=int, default=None,
+                    help="shard the posterior draw axis over this many "
+                         "local devices (1/omitted = single device)")
     ap.add_argument("--telemetry-dir", default=None,
                     help="write the serving event stream "
                          "(events-p0.jsonl) here")
@@ -168,13 +188,29 @@ def serve_main(argv=None) -> int:
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip precompiling one predict kernel per bucket "
                          "at startup")
+    # replica mode (spawned by the serving fleet — not for direct use):
+    # beats a heartbeat file carrying the bound port so the parent
+    # discovers where a port-0 replica landed and watches its liveness
+    ap.add_argument("--replica-rank", type=int, default=None,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--heartbeat-dir", default=None,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--heartbeat-interval-s", type=float, default=0.25,
+                    help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
+
+    if args.fleet is not None:
+        from ..fleet.serving import serve_fleet_main
+        return serve_fleet_main(args.fleet, source_override=args.source)
+    if args.source is None:
+        ap.error("source is required (unless --fleet is given)")
 
     log = get_logger()
     engine = ServingEngine(
         args.source,
         buckets=tuple(int(b) for b in args.buckets.split(",")),
         coalesce_ms=args.coalesce_ms, draw_thin=args.draw_thin,
+        draw_shards=args.draw_shards,
         telemetry=args.telemetry_dir)
     if not args.no_warmup:
         n = engine.warmup()
@@ -185,6 +221,26 @@ def serve_main(argv=None) -> int:
     log.info(f"serve: {engine.n_draws} draws x {engine.ns} species ready "
              f"on http://{host}:{port} (POST /predict, /gradient; "
              f"GET /healthz, /statz, /metrics)")
+    hb = hb_stop = None
+    if args.heartbeat_dir is not None:
+        # serving-replica liveness beacon: same machinery as the fleet
+        # sampler ranks; the payload's `port` is how the parent finds a
+        # port-0 replica, generation/epoch ride along for observability
+        import threading
+
+        from ..utils.coordination import HeartbeatWriter
+        hb = HeartbeatWriter(args.heartbeat_dir, args.replica_rank or 0,
+                             interval_s=args.heartbeat_interval_s)
+        hb.update(port=int(port), host=str(host), role="serve_replica",
+                  generation=engine.generation, epoch=engine.epoch)
+        hb.start()
+        hb_stop = threading.Event()
+
+        def _refresh():
+            while not hb_stop.wait(args.heartbeat_interval_s):
+                hb.update(generation=engine.generation, epoch=engine.epoch)
+        threading.Thread(target=_refresh, daemon=True,
+                         name="hmsc-serve-hb-refresh").start()
     # SIGTERM unwinds like Ctrl-C: the --prom export and the telemetry
     # flush must survive an orchestrator's ordinary stop signal, same as
     # the sampler's preemption-safe shutdown
@@ -199,6 +255,9 @@ def serve_main(argv=None) -> int:
         log.info("serve: interrupted, shutting down")
     finally:
         signal.signal(signal.SIGTERM, old_term)
+        if hb is not None:
+            hb_stop.set()
+            hb.stop()
         server.server_close()
         engine.close()
         if args.prom:

@@ -42,7 +42,8 @@ import functools
 import numpy as np
 
 __all__ = ["linear_predictor", "make_predict_kernel",
-           "make_conditional_kernel", "audit_kernels"]
+           "make_conditional_kernel", "make_sharded_predict_kernel",
+           "make_sharded_conditional_kernel", "audit_kernels"]
 
 
 # ---------------------------------------------------------------------------
@@ -137,7 +138,7 @@ def _apply_link_sampled(L, sigma, fam, key, any_probit, any_poisson):
 
 
 def make_predict_kernel(*, nr: int, expected: bool, any_probit: bool,
-                        any_poisson: bool):
+                        any_poisson: bool, quantiles: tuple = ()):
     """Marginal-prediction kernel for one padded query block.
 
     Returns ``fn(Beta, sigma, lams, etas, fam, ym, ys, X, unit_idx, key)
@@ -147,9 +148,14 @@ def make_predict_kernel(*, nr: int, expected: bool, any_probit: bool,
     ``X (B, nc)``, ``unit_idx (nr, B)`` int32 rows into each level's Eta,
     and ``key`` consumed only when ``expected=False``.  Outputs are the
     (B, ns) posterior mean and sd over draws, back-scaled to the response
-    scale.  The caller jits the returned function (the serving engine owns
-    the compile cache and its hit counters)."""
+    scale.  A non-empty ``quantiles`` tuple (static, sorted by the
+    caller) appends a third ``(nq, B, ns)`` output of full-draw response
+    quantiles; the default ``()`` traces the exact two-output program the
+    jaxpr audit fingerprints.  The caller jits the returned function (the
+    serving engine owns the compile cache and its hit counters)."""
     import jax.numpy as jnp
+
+    quantiles = tuple(float(q) for q in quantiles)
 
     def kernel(Beta, sigma, lams, etas, fam, ym, ys, X, unit_idx, key):
         # bf16-staged artifacts upcast at entry: HBM holds the draws at
@@ -171,9 +177,155 @@ def make_predict_kernel(*, nr: int, expected: bool, any_probit: bool,
             out = _apply_link_sampled(L, sigma, fam, key, any_probit,
                                       any_poisson)
         out = out * ys[None, None, :] + ym[None, None, :]
+        if quantiles:
+            qs = jnp.quantile(out, jnp.asarray(quantiles, f32), axis=0)
+            return out.mean(axis=0), out.std(axis=0), qs
         return out.mean(axis=0), out.std(axis=0)
 
     return kernel
+
+
+def make_sharded_predict_kernel(mesh, *, nr: int, expected: bool,
+                                any_probit: bool, any_poisson: bool,
+                                quantiles: tuple = (), axis: str = "draws"):
+    """Draw-sharded marginal-prediction kernel: same signature and
+    outputs as :func:`make_predict_kernel`, but the posterior params
+    arrive split over the mesh's ``axis`` on their leading draw dim
+    (:data:`~..mcmc.partition.SERVE_DRAW_DIMS`) and every device answers
+    from its local draw block.
+
+    Each shard computes the partial first/second moments of its local
+    draws' responses and ONE stacked psum reduces both at once; the
+    global mean/sd come out within ``SHARD_AGREEMENT_TOL`` of the
+    replicated kernel (psum-vs-fused-sum rounding only — the per-draw
+    responses are bit-identical under ``expected=True``).  Moments
+    reduce on the link scale and back-scale after (``sd = ys * sqrt(
+    E[x^2] - E[x]^2)`` exactly, keeping ``ym`` out of the cancellation).
+    Sampled-path randomness folds the mesh position into the key
+    (distinct valid streams per shard; cross-layout draw streams are not
+    reproducible, matching the sharded sampler's ``local_rng`` contract).
+    Quantiles are order statistics over ALL draws, so they all_gather
+    the (n_local, B, ns) response block — the queried cells only, never
+    the staged params — before reducing.  The caller jits the result."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..mcmc.partition import serve_draw_pspecs
+
+    k_mesh = int(mesh.shape[axis])
+    quantiles = tuple(float(q) for q in quantiles)
+
+    def body(Beta, sigma, lams, etas, fam, ym, ys, X, unit_idx, key):
+        f32 = jnp.float32
+        Beta, sigma = Beta.astype(f32), sigma.astype(f32)
+        lams = tuple(l.astype(f32) for l in lams)
+        etas = tuple(e.astype(f32) for e in etas)
+        n_total = Beta.shape[0] * k_mesh
+        L = jnp.einsum("yc,ncj->nyj", X, Beta)
+        for r in range(nr):
+            rows = etas[r][:, unit_idx[r], :]           # (n_local, B, nf)
+            L = L + jnp.einsum("nyf,nfj->nyj", rows, lams[r])
+        if expected:
+            out = _apply_link_expected(L, sigma, fam, any_probit,
+                                       any_poisson)
+        else:
+            k_loc = jax.random.fold_in(key, jax.lax.axis_index(axis))
+            out = _apply_link_sampled(L, sigma, fam, k_loc, any_probit,
+                                      any_poisson)
+        part = jnp.stack([out.sum(axis=0), (out * out).sum(axis=0)])
+        s1, s2 = jax.lax.psum(part, axis)               # the ONE collective
+        mu = s1 / n_total
+        var = jnp.clip(s2 / n_total - mu * mu, 0.0, None)
+        mean = mu * ys[None, :] + ym[None, :]
+        sd = ys[None, :] * jnp.sqrt(var)
+        if quantiles:
+            full = jax.lax.all_gather(out, axis, axis=0, tiled=True)
+            qs = jnp.quantile(full, jnp.asarray(quantiles, f32), axis=0)
+            qs = qs * ys[None, None, :] + ym[None, None, :]
+            return mean, sd, qs
+        return mean, sd
+
+    out_specs = (P(), P(), P()) if quantiles else (P(), P())
+    return shard_map(body, mesh=mesh, in_specs=serve_draw_pspecs(nr, axis),
+                     out_specs=out_specs, check_rep=False)
+
+
+def _cond_one_draw(*, nr, mcmc_step, expected, any_probit, any_normal,
+                   X, Yc, mask, fam):
+    """Per-draw conditional-refinement program, shared verbatim by the
+    replicated and draw-sharded conditional kernels (so per-draw outputs
+    are bit-identical across layouts — only the final moment reduction
+    differs).  Closes over the per-request operands ``X``/``Yc``/``mask``
+    /``fam`` (tracers of the enclosing kernel) and returns
+    ``one_draw(beta, sig, lams_n, rows_n, k) -> (B, ns)``."""
+    import jax
+    import jax.numpy as jnp
+    from jax.scipy.linalg import cho_solve, solve_triangular
+
+    from ..ops.rand import truncated_normal_onesided
+
+    def z_given_yc(E, isig, k):
+        std = isig[None, :] ** -0.5
+        z = E + std * jax.random.normal(k, E.shape, dtype=E.dtype)
+        if any_normal:
+            z = jnp.where((fam[None, :] == 1) & (mask > 0), Yc, z)
+        if any_probit:
+            kz = jax.random.fold_in(k, 1)
+            ztn = truncated_normal_onesided(kz, 0.0, Yc > 0.5, E, std)
+            z = jnp.where((fam[None, :] == 2) & (mask > 0), ztn, z)
+        return z
+
+    def one_draw(beta, sig, lams_n, rows_n, k):
+        LFix = X @ beta                              # (B, ns)
+        isig = 1.0 / sig
+        # step-invariant per level: each row's nf x nf likelihood gram
+        # and its cholesky factor (prior precision is the identity)
+        chol_n = []
+        for r in range(nr):
+            lam = lams_n[r]
+            U = jnp.einsum("fj,gj,j,yj->yfg", lam, lam, isig, mask)
+            P = U + jnp.eye(lam.shape[0], dtype=lam.dtype)[None]
+            chol_n.append(jnp.linalg.cholesky(P))
+
+        def loading(rows):
+            return sum(rows[r] @ lams_n[r] for r in range(nr))
+
+        def step(carry, kk):
+            z, rows = carry
+            for r in range(nr):
+                others = sum(rows[q] @ lams_n[q] for q in range(nr)
+                             if q != r)
+                S = z - LFix - (others if nr > 1 else 0.0)
+                F = (S * isig[None, :] * mask) @ lams_n[r].T
+                Lc = chol_n[r]
+                mean = cho_solve((Lc, True), F[..., None])[..., 0]
+                kr = jax.random.fold_in(kk, 1 + r)
+                eps = jax.random.normal(kr, mean.shape,
+                                        dtype=mean.dtype)
+                noise = solve_triangular(
+                    jnp.swapaxes(Lc, -1, -2), eps[..., None],
+                    lower=False)[..., 0]
+                rows = rows[:r] + (mean + noise,) + rows[r + 1:]
+            E = LFix + loading(rows)
+            z = z_given_yc(E, isig, jax.random.fold_in(kk, 0))
+            return (z, rows), None
+
+        k0, k_scan, k_out = jax.random.split(k, 3)
+        z0 = z_given_yc(LFix + loading(rows_n), isig, k0)
+        (z, rows), _ = jax.lax.scan(step, (z0, rows_n),
+                                    jax.random.split(k_scan, mcmc_step))
+        E = LFix + loading(rows)
+        if expected:
+            out = _apply_link_expected(E[None], sig[None], fam,
+                                       any_probit, False)[0]
+        else:
+            out = _apply_link_sampled(E[None], sig[None], fam, k_out,
+                                      any_probit, False)[0]
+        return out
+
+    return one_draw
 
 
 def make_conditional_kernel(*, nr: int, mcmc_step: int, expected: bool,
@@ -187,15 +339,12 @@ def make_conditional_kernel(*, nr: int, mcmc_step: int, expected: bool,
     cells.  Each query row is treated as its own unit in every level (the
     serving query model): its Eta rows start from the gathered posterior
     rows (zeros for new units) and are refreshed by ``mcmc_step``
-    iterations of (updateEta, updateZ) under the unstructured N(0,1) prior
-    — exact for non-spatial levels (reference ``predict.R:181-198``).
+    iterations of (updateEta, updateZ) against the unstructured N(0,1)
+    prior — exact for non-spatial levels (reference ``predict.R:181-198``).
     Probit and normal observed cells condition; other families contribute
     no likelihood weight."""
     import jax
     import jax.numpy as jnp
-    from jax.scipy.linalg import cho_solve, solve_triangular
-
-    from ..ops.rand import truncated_normal_onesided
 
     def kernel(Beta, sigma, lams, etas, fam, ym, ys, X, unit_idx, Yc, mask,
                key):
@@ -207,72 +356,67 @@ def make_conditional_kernel(*, nr: int, mcmc_step: int, expected: bool,
         etas = tuple(e.astype(f32) for e in etas)
         n_draws = Beta.shape[0]
         rows0 = tuple(etas[r][:, unit_idx[r], :] for r in range(nr))
-
-        def z_given_yc(E, isig, k):
-            std = isig[None, :] ** -0.5
-            z = E + std * jax.random.normal(k, E.shape, dtype=E.dtype)
-            if any_normal:
-                z = jnp.where((fam[None, :] == 1) & (mask > 0), Yc, z)
-            if any_probit:
-                kz = jax.random.fold_in(k, 1)
-                ztn = truncated_normal_onesided(kz, 0.0, Yc > 0.5, E, std)
-                z = jnp.where((fam[None, :] == 2) & (mask > 0), ztn, z)
-            return z
-
-        def one_draw(beta, sig, lams_n, rows_n, k):
-            LFix = X @ beta                              # (B, ns)
-            isig = 1.0 / sig
-            # step-invariant per level: each row's nf x nf likelihood gram
-            # and its cholesky factor (prior precision is the identity)
-            chol_n = []
-            for r in range(nr):
-                lam = lams_n[r]
-                U = jnp.einsum("fj,gj,j,yj->yfg", lam, lam, isig, mask)
-                P = U + jnp.eye(lam.shape[0], dtype=lam.dtype)[None]
-                chol_n.append(jnp.linalg.cholesky(P))
-
-            def loading(rows):
-                return sum(rows[r] @ lams_n[r] for r in range(nr))
-
-            def step(carry, kk):
-                z, rows = carry
-                for r in range(nr):
-                    others = sum(rows[q] @ lams_n[q] for q in range(nr)
-                                 if q != r)
-                    S = z - LFix - (others if nr > 1 else 0.0)
-                    F = (S * isig[None, :] * mask) @ lams_n[r].T
-                    Lc = chol_n[r]
-                    mean = cho_solve((Lc, True), F[..., None])[..., 0]
-                    kr = jax.random.fold_in(kk, 1 + r)
-                    eps = jax.random.normal(kr, mean.shape,
-                                            dtype=mean.dtype)
-                    noise = solve_triangular(
-                        jnp.swapaxes(Lc, -1, -2), eps[..., None],
-                        lower=False)[..., 0]
-                    rows = rows[:r] + (mean + noise,) + rows[r + 1:]
-                E = LFix + loading(rows)
-                z = z_given_yc(E, isig, jax.random.fold_in(kk, 0))
-                return (z, rows), None
-
-            k0, k_scan, k_out = jax.random.split(k, 3)
-            z0 = z_given_yc(LFix + loading(rows_n), isig, k0)
-            (z, rows), _ = jax.lax.scan(step, (z0, rows_n),
-                                        jax.random.split(k_scan, mcmc_step))
-            E = LFix + loading(rows)
-            if expected:
-                out = _apply_link_expected(E[None], sig[None], fam,
-                                           any_probit, False)[0]
-            else:
-                out = _apply_link_sampled(E[None], sig[None], fam, k_out,
-                                          any_probit, False)[0]
-            return out
-
+        one_draw = _cond_one_draw(nr=nr, mcmc_step=mcmc_step,
+                                  expected=expected, any_probit=any_probit,
+                                  any_normal=any_normal, X=X, Yc=Yc,
+                                  mask=mask, fam=fam)
         keys = jax.random.split(key, n_draws)
         out = jax.vmap(one_draw)(Beta, sigma, lams, rows0, keys)
         out = out * ys[None, None, :] + ym[None, None, :]
         return out.mean(axis=0), out.std(axis=0)
 
     return kernel
+
+
+def make_sharded_conditional_kernel(mesh, *, nr: int, mcmc_step: int,
+                                    expected: bool, any_probit: bool,
+                                    any_normal: bool, axis: str = "draws"):
+    """Draw-sharded conditional kernel: same signature and outputs as
+    :func:`make_conditional_kernel` with the posterior params split over
+    the mesh's ``axis`` on their draw dim.
+
+    The per-draw refinement keys are FULL-WIDTH-AND-SLICED (split the
+    request key to the global draw count, every shard slices its own
+    block by mesh position) so each draw's Gibbs refinement is
+    bit-identical to the replicated kernel's — the sharded sampler's
+    agreement recipe — and the only cross-layout difference is the
+    single stacked psum that reduces the partial moments."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..mcmc.partition import serve_draw_pspecs
+
+    k_mesh = int(mesh.shape[axis])
+
+    def body(Beta, sigma, lams, etas, fam, ym, ys, X, unit_idx, Yc, mask,
+             key):
+        f32 = jnp.float32
+        Beta, sigma = Beta.astype(f32), sigma.astype(f32)
+        lams = tuple(l.astype(f32) for l in lams)
+        etas = tuple(e.astype(f32) for e in etas)
+        n_local = Beta.shape[0]
+        n_total = n_local * k_mesh
+        rows0 = tuple(etas[r][:, unit_idx[r], :] for r in range(nr))
+        one_draw = _cond_one_draw(nr=nr, mcmc_step=mcmc_step,
+                                  expected=expected, any_probit=any_probit,
+                                  any_normal=any_normal, X=X, Yc=Yc,
+                                  mask=mask, fam=fam)
+        keys = jax.random.split(key, n_total)       # full width ...
+        keys = jax.lax.dynamic_slice_in_dim(        # ... slice our block
+            keys, jax.lax.axis_index(axis) * n_local, n_local)
+        out = jax.vmap(one_draw)(Beta, sigma, lams, rows0, keys)
+        part = jnp.stack([out.sum(axis=0), (out * out).sum(axis=0)])
+        s1, s2 = jax.lax.psum(part, axis)           # the ONE collective
+        mu = s1 / n_total
+        var = jnp.clip(s2 / n_total - mu * mu, 0.0, None)
+        return (mu * ys[None, :] + ym[None, :],
+                ys[None, :] * jnp.sqrt(var))
+
+    return shard_map(body, mesh=mesh,
+                     in_specs=serve_draw_pspecs(nr, axis, conditional=True),
+                     out_specs=(P(), P()), check_rep=False)
 
 
 # ---------------------------------------------------------------------------

@@ -21,7 +21,29 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["make_mesh"]
+__all__ = ["make_mesh", "make_draw_mesh"]
+
+
+def make_draw_mesh(draw_shards: int, devices=None, axis: str = "draws"):
+    """1-D ``(draws,)`` Mesh for the serving engine: the posterior draw
+    axis is embarrassingly parallel at query time, so the mesh is a flat
+    row of the first ``draw_shards`` devices — one collective (the
+    partial-moment psum) per query.  Raises if fewer devices exist than
+    requested; divisibility against the artifact's draw count is the
+    engine's job (it falls back via ``nearest_divisor`` with a warning).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(jax.devices()) if devices is None else list(devices)
+    k = int(draw_shards)
+    if k < 1:
+        raise ValueError(f"draw_shards={draw_shards} must be >= 1")
+    if k > len(devices):
+        raise ValueError(
+            f"draw_shards={k} exceeds the {len(devices)} available "
+            "device(s)")
+    return Mesh(np.array(devices[:k]), axis_names=(axis,))
 
 
 def make_mesh(n_chains: int | None = None, species_shards: int = 1,

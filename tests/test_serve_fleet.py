@@ -1,0 +1,274 @@
+"""Replicated serving fleet (ISSUE 17 tentpole B): N ``ServingEngine``
+replica subprocesses behind one front end — least-loaded dispatch,
+heartbeat liveness with backoff restarts, and fleet-wide
+generation-checked epoch flips.
+
+The acceptance drill: a rolling flip across 3 replicas with one replica
+chaos-SIGKILLed mid-flip ends with every replica serving the new epoch,
+ZERO failed in-flight queries and zero mixed-generation answers (each
+response is tagged with the one (generation, epoch) it was computed on),
+and the killed replica restarted within its backoff budget.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from hmsc_tpu import sample_mcmc, update_run
+from hmsc_tpu.fleet import ServeFleetConfig, ServingFleet, fleet_events_path
+from hmsc_tpu.serve import compact_posterior
+
+from util import small_model
+
+pytestmark = [pytest.mark.serve, pytest.mark.fleet]
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    """A compacted artifact — the cheapest replica source (no model
+    rebuild in the subprocess)."""
+    m = small_model(ny=30, ns=4, nc=2, distr="probit", n_units=6, seed=3)
+    post = sample_mcmc(m, samples=8, transient=4, n_chains=2, seed=1,
+                       nf_cap=2, align_post=False)
+    d = os.fspath(tmp_path_factory.mktemp("serve-fleet-art"))
+    compact_posterior(post, d)
+    return d
+
+
+def _cfg(source, work_dir, **kw):
+    kw.setdefault("replicas", 2)
+    kw.setdefault("port", 0)              # the front end picks a free port
+    kw.setdefault("coalesce_ms", 1.0)
+    kw.setdefault("no_warmup", True)
+    kw.setdefault("startup_grace_s", 300.0)
+    kw.setdefault("heartbeat_timeout_s", 60.0)
+    kw.setdefault("stats_interval_s", 2.0)
+    return ServeFleetConfig(source=source, work_dir=work_dir, **kw)
+
+
+def _post(url, path, doc, timeout=120):
+    req = urllib.request.Request(url + path, data=json.dumps(doc).encode(),
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _get(url, path, timeout=30):
+    with urllib.request.urlopen(url + path, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+X3 = [[1.0, -1.0], [1.0, 0.0], [1.0, 1.0]]
+
+
+# ---------------------------------------------------------------------------
+# config validation
+# ---------------------------------------------------------------------------
+
+def test_config_validation(tmp_path):
+    with pytest.raises(ValueError, match="replicas"):
+        ServeFleetConfig(source="s", work_dir="w", replicas=0)
+    with pytest.raises(ValueError, match="backoff_factor"):
+        ServeFleetConfig(source="s", work_dir="w", backoff_factor=0.5)
+    with pytest.raises(ValueError, match="drain_timeout_s"):
+        ServeFleetConfig(source="s", work_dir="w", drain_timeout_s=0)
+    p = os.fspath(tmp_path / "cfg.json")
+    with open(p, "w") as f:
+        json.dump({"source": "s", "work_dir": "w", "replicaz": 3}, f)
+    with pytest.raises(ValueError, match="replicaz"):
+        ServeFleetConfig.from_json(p)
+    with open(p, "w") as f:
+        json.dump({"source": "s", "work_dir": "w", "replicas": 4}, f)
+    cfg = ServeFleetConfig.from_json(p, source="other")
+    assert cfg.replicas == 4 and cfg.source == "other"
+    assert cfg.to_dict()["replicas"] == 4
+
+
+# ---------------------------------------------------------------------------
+# dispatch + liveness + zero-recompile same-shape flip (cache counters)
+# ---------------------------------------------------------------------------
+
+def test_fleet_serves_flips_and_reuses_kernels(artifact, tmp_path):
+    wd = os.fspath(tmp_path / "fleet")
+    cfg = _cfg(artifact, wd, replicas=2, no_warmup=False, buckets="1,4",
+               draw_shards=2)
+    with ServingFleet(cfg) as fleet:
+        fleet.start()
+        url = fleet.url
+        h = _get(url, "/healthz")
+        assert h["ok"] and h["fleet"]
+        states = {r["rank"]: r["state"] for r in h["replicas"]}
+        assert states == {0: "live", 1: "live"}
+
+        out = _post(url, "/predict", {"X": X3,
+                                      "quantiles": [0.05, 0.5, 0.95]})
+        assert np.isfinite(np.asarray(out["mean"])).all()
+        assert len(out["quantiles"]) == 3 and out["generation"] == 0
+
+        # queries spread over both replicas under concurrency
+        def _one():
+            _post(url, "/predict", {"X": X3})
+        threads = [threading.Thread(target=_one) for _ in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        st = fleet.stats()
+        served = {r: s["requests"] for r, s in st["replicas"].items()}
+        assert sum(served.values()) >= 17
+        assert all(v > 0 for v in served.values()), served
+        # every replica warmed its buckets once; record the compile state
+        misses = {r: s["cache"]["misses"] for r, s in st["replicas"].items()}
+        assert all(s["draw_shards"] == 2 for s in st["replicas"].values())
+
+        # same-shape fleet-wide flip: generation-checked on each replica,
+        # acknowledged only when all replicas flipped
+        res = _post(url, "/flip", {})
+        assert res["ok"] and set(res["outcomes"].values()) == {"flipped"}
+        h1 = _get(url, "/healthz")
+        assert all(r["generation"] == 1 for r in h1["replicas"])
+        out1 = _post(url, "/predict", {"X": X3})
+        assert out1["generation"] == 1
+        # zero recompiles across the flip, proven by the engine cache
+        # counters scraped from every replica
+        st1 = fleet.stats()
+        assert {r: s["cache"]["misses"]
+                for r, s in st1["replicas"].items()} == misses
+    ev = [json.loads(l) for l in open(fleet_events_path(wd))]
+    names = [e["name"] for e in ev]
+    assert names[0] == "serve_fleet_start"
+    assert names.count("replica_spawn") == 2
+    assert "flip_start" in names and "flip_done" in names
+    assert names[-1] == "serve_fleet_end"
+    flips = [e for e in ev if e["name"] == "flip_replica"]
+    assert len(flips) == 2 and all(f["ok"] for f in flips)
+
+
+def test_front_end_forwards_replica_errors(artifact, tmp_path):
+    """A malformed query is answered by the replica (400) and forwarded
+    as-is — not retried, not turned into a fleet error."""
+    wd = os.fspath(tmp_path / "fleet")
+    with ServingFleet(_cfg(artifact, wd, replicas=1)) as fleet:
+        fleet.start()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(fleet.url, "/predict", {"X": [[1.0]]})   # wrong nc
+        assert ei.value.code == 400
+        assert fleet.stats()["fleet"]["retried"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the acceptance drill: chaos kill mid-flip across 3 replicas
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_fleet_flip_chaos_drill(tmp_path):
+    """Rolling epoch flip across 3 replicas with one SIGKILLed mid-flip:
+    all replicas end on the new epoch, zero failed and zero
+    mixed-generation in-flight queries, restart within the backoff
+    budget."""
+    from hmsc_tpu.bench_cli import run_main
+
+    d = os.fspath(tmp_path / "run")
+    assert run_main(["--ny", "30", "--ns", "4", "--nf", "2",
+                     "--samples", "8", "--transient", "4", "--chains", "2",
+                     "--checkpoint-dir", d, "--checkpoint-every", "4"]) == 0
+
+    wd = os.fspath(tmp_path / "fleet")
+    cfg = _cfg(d, wd, replicas=3, backoff_base_s=0.1, backoff_max_s=1.0,
+               flip_timeout_s=300.0)
+    with ServingFleet(cfg) as fleet:
+        fleet.start()
+        url = fleet.url
+        assert all(r["epoch"] == 0
+                   for r in _get(url, "/healthz")["replicas"])
+
+        # commit epoch 1 while the fleet serves epoch 0 (model rebuilt
+        # from the run dir's model.json — same as the replicas do)
+        rng = np.random.default_rng(5)
+        n = 6
+        Xn = np.column_stack([np.ones(n), rng.standard_normal(n)])
+        Yn = (rng.standard_normal((n, 4)) > 0).astype(float)
+        units = {"sample": [f"s{i:04d}" for i in range(n)]}
+        res = update_run(d, Yn, Xn, units, samples=8, min_sweeps=4,
+                         max_sweeps=12, probe_every=4, seed=0)
+        assert res.epoch == 1 and res.committed
+
+        # hammer the front end from worker threads across the whole
+        # flip + chaos window; every answer must carry exactly one
+        # (generation, epoch) tag and no query may fail
+        answers, errors, stop = [], [], threading.Event()
+
+        def _hammer():
+            while not stop.is_set():
+                try:
+                    o = _post(url, "/predict", {"X": X3}, timeout=60)
+                    answers.append((o["generation"], o["epoch"]))
+                except Exception as e:  # noqa: BLE001 — the drill records
+                    errors.append(repr(e))
+        threads = [threading.Thread(target=_hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)
+
+        # chaos: SIGKILL one replica just as the rolling flip starts
+        victim = fleet.slots[1]
+
+        def _chaos():
+            time.sleep(0.05)
+            os.kill(victim.pid, signal.SIGKILL)
+        killer = threading.Thread(target=_chaos)
+        killer.start()
+        t_flip = time.monotonic()
+        res = fleet.flip()
+        killer.join()
+        assert res["ok"] and res["epoch"] == 1
+
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join()
+
+        # zero dropped queries through kill + restart + flip
+        assert errors == [], errors[:3]
+        assert len(answers) > 20
+        # zero mixed generations: every recorded tag is a consistent
+        # pre-flip or post-flip pair — never a new generation with the
+        # old epoch or vice versa (the restarted replica restages at
+        # generation 0 ON the new epoch, also a consistent pair)
+        assert set(answers) <= {(0, 0), (1, 1), (0, 1)}, set(answers)
+        assert (1, 1) in set(answers) or (0, 1) in set(answers)
+
+        # all replicas end on the new epoch; the victim was restarted
+        # within its backoff budget
+        h = _get(url, "/healthz")
+        assert all(r["epoch"] == 1 for r in h["replicas"]), h
+        assert all(r["state"] == "live" for r in h["replicas"])
+        assert victim.fails == 1 <= cfg.restart_budget
+        # post-flip queries land on the new epoch only
+        o = _post(url, "/predict", {"X": X3})
+        assert o["epoch"] == 1
+
+    ev = [json.loads(l) for l in open(fleet_events_path(wd))]
+    names = [e["name"] for e in ev]
+    # the chaos kill shows up as a non-zero replica exit + backoff +
+    # respawn, and the flip still acknowledges
+    exits = [e for e in ev if e["name"] == "replica_exit"
+             and e["rank"] == 1]
+    assert exits and exits[0]["rc"] != 0
+    assert "replica_backoff" in names
+    assert names.count("replica_spawn") >= 4        # 3 initial + restart
+    done = [e for e in ev if e["name"] == "flip_done"]
+    assert done and done[-1]["ok"] and done[-1]["epoch"] == 1
+    # the restart completed within the flip window (backoff budget)
+    assert done[-1]["wall_s"] < cfg.flip_timeout_s
+    assert time.monotonic() - t_flip < cfg.flip_timeout_s
+    # per-replica load samples feed the report's qps/queue-wait skew
+    stats_ev = [e for e in ev if e["name"] == "replica_stats"]
+    assert stats_ev and all("inflight" in e for e in stats_ev)

@@ -1,0 +1,321 @@
+"""Draw-axis mesh-sharded serving (ISSUE 17 tentpole A): the engine's
+``draw_shards`` path answers every servable query family within
+``SHARD_AGREEMENT_TOL`` of the single-device engine — f32 AND
+bf16-compacted sources — with the posterior draws physically split
+across local devices and ONE psum per query.
+
+Also under test: on-device full-draw quantiles (satellite — computed
+before the draw-axis reduction), bf16 stored-dtype staging per device,
+zero steady-state recompiles across a bucket sweep on the mesh, and the
+nearest-divisor fallback for widths that don't divide the draw count.
+"""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from hmsc_tpu import sample_mcmc
+from hmsc_tpu.mcmc.partition import (SHARD_AGREEMENT_TOL, serve_draw_pspec,
+                                     serve_draw_pspecs)
+from hmsc_tpu.serve import ServingEngine, compact_posterior, load_artifact
+from hmsc_tpu.utils.mesh import make_draw_mesh
+
+from util import small_model
+
+pytestmark = pytest.mark.serve_mesh
+
+
+@pytest.fixture(scope="module")
+def fitted(tmp_path_factory):
+    m = small_model(ny=30, ns=4, nc=2, distr="probit", n_units=6, seed=3)
+    ck = os.fspath(tmp_path_factory.mktemp("servemesh-run"))
+    post = sample_mcmc(m, samples=8, transient=4, n_chains=2, seed=1,
+                       nf_cap=2, align_post=False, checkpoint_every=4,
+                       checkpoint_path=ck)
+    return m, post, ck
+
+
+@pytest.fixture(scope="module")
+def single(fitted):
+    """The reference single-device engine."""
+    _, post, _ = fitted
+    with ServingEngine(post, coalesce_ms=1.0) as eng:
+        yield eng
+
+
+@pytest.fixture(scope="module")
+def sharded2(fitted):
+    """The fast tier-1 case: 2-way draw mesh (16 pooled draws / 2)."""
+    _, post, _ = fitted
+    with ServingEngine(post, coalesce_ms=1.0, draw_shards=2) as eng:
+        yield eng
+
+
+def _query(q=5):
+    return np.column_stack([np.ones(q),
+                            np.linspace(-1.0, 1.0, q)]).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# staging: the posterior really is draw-sharded on the mesh
+# ---------------------------------------------------------------------------
+
+def test_staged_params_carry_draw_pspecs(sharded2):
+    st = sharded2._staged
+    assert st.draw_shards == 2 and st.mesh is not None
+    assert sharded2.draw_shards == 2
+    # every pooled tensor is placed with its leading draw axis split
+    for a, name in [(st.Beta, "Beta"), (st.sigma, "sigma"),
+                    *[(l, "Lambda") for l in st.lams],
+                    *[(e, "Eta") for e in st.etas]]:
+        assert a.sharding.spec == serve_draw_pspec(name), name
+        # 2 shards -> each device holds half the draw rows
+        shard_shapes = {s.data.shape for s in a.addressable_shards}
+        assert len(shard_shapes) == 1
+        assert next(iter(shard_shapes))[0] * 2 == a.shape[0], name
+
+
+def test_stats_record_mesh(sharded2, single):
+    st = sharded2.stats()
+    assert st["draw_shards"] == 2 and st["n_devices"] == 2
+    assert st["mesh"] == {"draws": 2}
+    s1 = single.stats()
+    assert s1["draw_shards"] == 1 and s1["mesh"] is None
+
+
+def test_make_draw_mesh_validation():
+    import jax
+    with pytest.raises(ValueError, match=">= 1"):
+        make_draw_mesh(0)
+    with pytest.raises(ValueError, match="exceeds"):
+        make_draw_mesh(len(jax.devices()) + 1)
+    m = make_draw_mesh(2)
+    assert m.axis_names == ("draws",) and m.devices.shape == (2,)
+
+
+def test_serve_draw_pspecs_table():
+    from jax.sharding import PartitionSpec as P
+    specs = serve_draw_pspecs(2)
+    # Beta, sigma, 2 lams, 2 etas sharded; operands + key replicated
+    assert specs[0] == P("draws") and specs[1] == P("draws")
+    assert all(s == P("draws") for s in specs[2]) \
+        and all(s == P("draws") for s in specs[3])
+    assert all(s == P() for s in specs[4:])
+    cond = serve_draw_pspecs(1, conditional=True)
+    assert len(cond) == len(serve_draw_pspecs(1)) + 2
+
+
+# ---------------------------------------------------------------------------
+# agreement: sharded == single-device within SHARD_AGREEMENT_TOL
+# ---------------------------------------------------------------------------
+
+def test_sharded_predict_agreement(single, sharded2):
+    X = _query()
+    a = single.predict(X)
+    b = sharded2.predict(X)
+    assert np.abs(a["mean"] - b["mean"]).max() < SHARD_AGREEMENT_TOL
+    assert np.abs(a["sd"] - b["sd"]).max() < SHARD_AGREEMENT_TOL
+
+
+def test_sharded_predict_at_units_agreement(single, sharded2, fitted):
+    m, _, _ = fitted
+    X = _query(4)
+    units = {"lvl": [m.pi_names[0][i] for i in (0, 2, 4, 1)]}
+    a = single.predict(X, units=units)
+    b = sharded2.predict(X, units=units)
+    assert np.abs(a["mean"] - b["mean"]).max() < SHARD_AGREEMENT_TOL
+    assert np.abs(a["sd"] - b["sd"]).max() < SHARD_AGREEMENT_TOL
+
+
+def test_sharded_sampled_path_valid(single, sharded2):
+    """The sampled (expected=False) path folds the shard index into the
+    per-draw keys — a DIFFERENT but equally valid stream, so only
+    statistical agreement holds; assert validity, not bit equality."""
+    X = _query()
+    b = sharded2.predict(X, expected=False)
+    assert np.isfinite(b["mean"]).all() and np.isfinite(b["sd"]).all()
+    # probit sampled means are Bernoulli frequencies
+    assert (b["mean"] >= 0).all() and (b["mean"] <= 1).all()
+
+
+def test_sharded_conditional_agreement(single, sharded2, fitted):
+    """The conditional kernel derives per-draw keys by slicing ONE
+    full-width split — bit-identical refinement draws per posterior draw,
+    so sharded == single within float tolerance."""
+    m, _, _ = fitted
+    X = _query(3)
+    Yc = np.full((3, m.ns), np.nan, np.float32)
+    Yc[:, 0] = 1.0
+    # pin both engines' dispatch-key streams: the kernels are then
+    # deterministic functions of an identical key
+    single._rng = np.random.default_rng(123)
+    sharded2._rng = np.random.default_rng(123)
+    a = single.predict(X, Yc=Yc, mcmc_step=2)
+    b = sharded2.predict(X, Yc=Yc, mcmc_step=2)
+    assert np.abs(a["mean"] - b["mean"]).max() < SHARD_AGREEMENT_TOL
+    assert np.abs(a["sd"] - b["sd"]).max() < SHARD_AGREEMENT_TOL
+
+
+def test_sharded_gradient_agreement():
+    """Gradient queries need an XData/XFormula model; build one and run
+    the same gradient on both engines."""
+    import pandas as pd
+
+    from hmsc_tpu import Hmsc
+    from hmsc_tpu.random_level import (HmscRandomLevel,
+                                       set_priors_random_level)
+    rng = np.random.default_rng(7)
+    ny, ns = 24, 3
+    xdf = pd.DataFrame({"x1": rng.standard_normal(ny)})
+    Y = (rng.standard_normal((ny, ns)) > 0).astype(float)
+    study = pd.DataFrame({"lvl": [f"u{i % 5}" for i in range(ny)]})
+    rl = HmscRandomLevel(units=study["lvl"])
+    set_priors_random_level(rl, nf_max=2, nf_min=2)
+    m = Hmsc(Y=Y, x_data=xdf, x_formula="~x1", distr="probit",
+             study_design=study, ran_levels={"lvl": rl})
+    post = sample_mcmc(m, samples=4, transient=2, n_chains=2, seed=2,
+                       nf_cap=2, align_post=False)
+    with ServingEngine(post, coalesce_ms=0.5) as ref, \
+            ServingEngine(post, coalesce_ms=0.5, draw_shards=2) as eng:
+        a = ref.gradient("x1", ngrid=7)
+        b = eng.gradient("x1", ngrid=7)
+    np.testing.assert_array_equal(a["grid"], b["grid"])
+    assert np.abs(np.asarray(a["mean"])
+                  - np.asarray(b["mean"])).max() < SHARD_AGREEMENT_TOL
+
+
+@pytest.mark.slow
+def test_sharded_predict_agreement_8way(fitted, single):
+    """The full 8-way mesh (every emulated device) stays within tol."""
+    _, post, _ = fitted
+    X = _query()
+    a = single.predict(X)
+    with ServingEngine(post, coalesce_ms=1.0, draw_shards=8) as eng:
+        assert eng.draw_shards == 8
+        b = eng.predict(X)
+    assert np.abs(a["mean"] - b["mean"]).max() < SHARD_AGREEMENT_TOL
+    assert np.abs(a["sd"] - b["sd"]).max() < SHARD_AGREEMENT_TOL
+
+
+# ---------------------------------------------------------------------------
+# satellite: on-device full-draw quantiles (computed BEFORE the reduction)
+# ---------------------------------------------------------------------------
+
+def test_quantiles_on_device(single, sharded2):
+    X = _query()
+    qs = (0.05, 0.5, 0.95)
+    a = single.predict(X, quantiles=qs)
+    b = sharded2.predict(X, quantiles=qs)
+    assert a["q"] == list(qs) and b["q"] == list(qs)
+    assert a["quantiles"].shape == (3,) + a["mean"].shape
+    # sharded quantiles all_gather the queried cells and agree with the
+    # single-device computation over the identical draw set
+    assert np.abs(np.asarray(a["quantiles"])
+                  - np.asarray(b["quantiles"])).max() < SHARD_AGREEMENT_TOL
+    # quantile curves are monotone in q and bracket the median
+    q05, q50, q95 = np.asarray(a["quantiles"])
+    assert (q05 <= q50 + 1e-6).all() and (q50 <= q95 + 1e-6).all()
+
+
+def test_quantiles_validation(sharded2):
+    X = _query(2)
+    with pytest.raises(ValueError):
+        sharded2.predict(X, quantiles=[1.5])
+    with pytest.raises(ValueError):
+        sharded2.predict(X, quantiles=[])
+    with pytest.raises(NotImplementedError):
+        sharded2.predict(X, Yc=np.full((2, 4), np.nan, np.float32),
+                         quantiles=[0.5])
+
+
+# ---------------------------------------------------------------------------
+# satellite: bf16 compacted artifacts under the draw-sharded engine
+# ---------------------------------------------------------------------------
+
+def test_bf16_artifact_sharded_staging_and_agreement(fitted, tmp_path):
+    """bf16 artifacts stay bf16 ON-DEVICE per shard (each device holds
+    1/k of the half-width posterior) and agree with the single-device
+    bf16 engine within the tolerance the manifest recorded."""
+    import jax.numpy as jnp
+    _, post, _ = fitted
+    man = compact_posterior(post, os.fspath(tmp_path), dtype="bfloat16")
+    art = load_artifact(os.fspath(tmp_path))
+    X = _query()
+    with ServingEngine(art, coalesce_ms=1.0) as ref:
+        a = ref.predict(X)
+    with ServingEngine(art, coalesce_ms=1.0, draw_shards=2) as eng:
+        st = eng._staged
+        # stored dtype survives mesh staging: bf16 shards on every device
+        assert st.Beta.dtype == jnp.bfloat16
+        assert st.Beta.sharding.spec == serve_draw_pspec("Beta")
+        assert all(l.dtype == jnp.bfloat16 for l in st.lams)
+        b = eng.predict(X)
+    tols = [e.get("cast", {}).get("max_abs_err", 0.0)
+            for e in man["params"].values()]
+    tol = max(10 * max(tols) + 1e-6, SHARD_AGREEMENT_TOL)
+    assert np.abs(a["mean"] - b["mean"]).max() <= tol
+    assert np.abs(a["sd"] - b["sd"]).max() <= tol
+    # and bf16-sharded vs f32-unsharded stays within the same budget
+    assert art.cast_tolerance("Beta") is not None
+
+
+# ---------------------------------------------------------------------------
+# zero steady-state recompiles across a 1..64-row bucket sweep on the mesh
+# ---------------------------------------------------------------------------
+
+def test_zero_recompiles_bucket_sweep_on_mesh(fitted):
+    _, post, _ = fitted
+    with ServingEngine(post, coalesce_ms=1.0, draw_shards=2,
+                       buckets=(1, 4, 16, 64)) as eng:
+        assert eng.warmup() == 4
+        misses = eng.stats()["cache"]["misses"]
+        for q in (1, 2, 3, 4, 5, 16, 17, 33, 64):
+            out = eng.predict(_query(q))
+            assert out["mean"].shape[0] == q
+        st = eng.stats()
+        # every sweep query padded into a warmed bucket: zero recompiles
+        assert st["cache"]["misses"] == misses
+        assert st["cache"]["hits"] >= 9
+
+
+# ---------------------------------------------------------------------------
+# width resolution: nearest divisor, device cap, flip stability
+# ---------------------------------------------------------------------------
+
+def test_nearest_divisor_fallback_warns(fitted):
+    _, post, _ = fitted
+    with pytest.warns(UserWarning, match="nearest"):
+        with ServingEngine(post, coalesce_ms=1.0, draw_shards=5) as eng:
+            # 16 draws: 5 does not divide -> nearest valid width <= 5 is 4
+            assert eng.draw_shards == 4
+            out = eng.predict(_query(2))
+            assert np.isfinite(out["mean"]).all()
+
+
+def test_draw_shards_one_is_single_device(fitted):
+    _, post, _ = fitted
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        with ServingEngine(post, coalesce_ms=1.0, draw_shards=1) as eng:
+            assert eng.draw_shards == 1 and eng._staged.mesh is None
+
+
+def test_sharded_same_shape_flip_zero_recompiles(fitted, tmp_path):
+    """A same-shape reload on the mesh reuses the cached Mesh object, so
+    every staged NamedSharding compares equal and the compiled kernels
+    all hit (the fleet's rolling flip relies on this per replica)."""
+    _, post, _ = fitted
+    with ServingEngine(post, coalesce_ms=1.0, draw_shards=2,
+                       buckets=(1, 4)) as eng:
+        eng.warmup()
+        eng.predict(_query(3))
+        misses = eng.stats()["cache"]["misses"]
+        mesh_before = eng._staged.mesh
+        out = eng.reload()
+        assert out["generation"] == 1 and out["shapes_changed"] is False
+        assert eng._staged.mesh is mesh_before
+        r = eng.predict(_query(3))
+        assert r["generation"] == 1
+        assert eng.stats()["cache"]["misses"] == misses

@@ -556,6 +556,13 @@ def test_comm_ledger_has_2d_entries():
         assert entry["comm_bytes"] > 0
         assert "psum" in entry["collectives"]
         assert "all_gather" in entry["collectives"]
+        # every sweep block also carries its own 2D entry, so a comm
+        # regression is attributable to the block that introduced it
+        blocks = [k for k in led["programs"]
+                  if k.startswith(f"{m}/shard4x2:block:")]
+        assert blocks, f"no per-block shard4x2 entries for {m}"
+        assert all("comm_bytes" in led["programs"][b] for b in blocks)
+        assert sum(led["programs"][b]["comm_bytes"] for b in blocks) > 0
 
 
 def test_nearest_divisor():
