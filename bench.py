@@ -380,6 +380,17 @@ def _autopilot_summary():
          "1", "--light"], timeout=1800)
 
 
+def _scenarios_summary():
+    """The scenario-engine digest (`benchmarks/bench_scenarios.py
+    --digest`): reduced-scale k-fold CV of NNGP candidates batched over
+    the job queue vs the serial per-fold workflow — bucket occupancy,
+    steady-state aggregate speedup, the pad-tolerance agreement gate and
+    the zero-pad CV bit-identity gate — CPU-only subprocess, so the
+    batch-analysis path rides the trajectory on every round."""
+    return _digest_subprocess(
+        ["benchmarks/bench_scenarios.py", "--digest"], timeout=1800)
+
+
 def _skip(reason: str):
     """Emit a parseable skip record instead of a bare nonzero exit: the
     bench trajectory must distinguish "chip unreachable this round" from "a
@@ -409,6 +420,7 @@ def _skip(reason: str):
         "multitenant": _multitenant_summary(),
         "refit": _refit_summary(),
         "autopilot": _autopilot_summary(),
+        "scenarios": _scenarios_summary(),
     }))
     raise SystemExit(0)
 
@@ -596,6 +608,12 @@ def main():
         # (benchmarks/bench_autopilot.py) — autonomous operation rides
         # the trajectory alongside throughput
         "autopilot": _autopilot_summary(),
+        # scenario-engine digest (CPU subprocess): batched CV sweep over
+        # the job queue vs the serial per-fold workflow, steady-state
+        # bucket-cache speedup + pad-agreement + zero-pad CV bit-identity
+        # gates (benchmarks/bench_scenarios.py) — the batch-analysis path
+        # rides the trajectory alongside fitting throughput
+        "scenarios": _scenarios_summary(),
     }))
 
 

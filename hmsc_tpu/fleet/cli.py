@@ -80,7 +80,10 @@ def fleet_main(argv=None) -> int:
             ap.error("--chaos-seed is not supported in job-queue mode")
         from .jobs import JobQueue
         summary = JobQueue(cfg).run()
-        print(json.dumps(summary))
+        # the bulk CV prediction matrices stay in the returned summary for
+        # API callers; the CLI's one-line JSON keeps the verdicts only
+        print(json.dumps({k: v for k, v in summary.items()
+                          if k != "scenario_preds"}))
         if summary["ok"]:
             return 0
         # same failure-class taxonomy as the rank fleet below: a queue

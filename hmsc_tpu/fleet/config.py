@@ -67,6 +67,11 @@ class FleetConfig:
     # feeds sample_mcmc_batched and nprocs/ladder are ignored
     jobs_dir: str | None = None
     bucket_rounding: dict | None = None
+    # group every bucket of a queue run into ONE worker process (results
+    # still land per bucket, and a restart re-dispatches only the buckets
+    # without a result) — amortizes interpreter/JAX start-up across a
+    # scenario sweep's buckets instead of paying it once per bucket
+    group_buckets: bool = False
 
     def __post_init__(self):
         self.run_kw = dict(self.run_kw or {})

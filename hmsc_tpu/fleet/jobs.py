@@ -13,12 +13,40 @@ Job file schema (one JSON object per file)::
 
     {"name": "regionA",                  # unique tenant name (default: stem)
      "model": {"ny": 40, "ns": 5, ...},  # build_worker_model kwargs
-     "seed": 11}                         # per-tenant seed (default: stable
+     "seed": 11,                         # per-tenant seed (default: stable
                                          #  hash of the name)
+     "type": "fit"}                      # "fit" | "cv" | "waic" | "gradient"
 
 The run cadence (samples / transient / thin / n_chains /
 checkpoint_every) is queue-wide, from the fleet config's ``run_kw`` —
 bucketing requires a uniform cadence anyway.
+
+**Scenario jobs** (the scenario engine): beyond the plain ``fit``, three
+embarrassingly parallel scenario types ride the same bucketed batched
+dispatch:
+
+- ``{"type": "cv", "nfolds": 5}`` — k-fold cross-validation.  The job
+  expands into one tenant per fold (``<name>@cv<k>``), each a training
+  refit binned by the SAME bucket fingerprinting as any other tenant
+  (equal-shape folds share one vmapped program), with the fold partition
+  and all per-fold seeds drawn from the job seed in EXACTLY
+  :func:`~hmsc_tpu.predict.cv.compute_predicted_values`'s consumption
+  order — a zero-pad scenario CV reproduces the serial path bit for bit.
+  Each fold's held-out predictions are reduced to their posterior mean in
+  the worker and re-assembled into the serial function's (ny, ns)
+  predicted-value matrix at aggregation time.
+- ``{"type": "waic"}`` — fit plus a
+  :func:`~hmsc_tpu.post.metrics.compute_waic` evaluation; a sweep of waic
+  jobs over model variants is a model-selection comparison.
+- ``{"type": "gradient", "focal": 1, "ngrid": 8}`` — fit plus a
+  counterfactual grid: the focal design column sweeps its observed range
+  over ``ngrid`` points with every other column at its training mean
+  (the raw-matrix analogue of ``construct_gradient``, which requires a
+  formula-built model), predicted at the level's first training unit.
+
+Scenario results aggregate into the summary's ``scenarios`` section, one
+``scenario_done`` fleet event per scenario job, rendered by
+``python -m hmsc_tpu report <dir> --scenarios``.
 
 Supervision mirrors the rank fleet: each bucket attempt is watched by exit
 code, failures restart with exponential backoff under a per-bucket budget,
@@ -42,7 +70,10 @@ import time
 from ..exit_codes import EXIT_DIVERGED, EXIT_OK, describe
 
 __all__ = ["JobQueue", "scan_jobs", "plan_buckets", "batch_worker_main",
-           "bucket_ckpt_dir", "queue_status"]
+           "bucket_ckpt_dir", "queue_status", "build_tenant_model",
+           "expand_scenarios"]
+
+SCENARIO_TYPES = ("fit", "cv", "waic", "gradient")
 
 
 def queue_status(outcomes: list[dict]) -> str:
@@ -74,7 +105,8 @@ def _job_seed(name: str) -> int:
 def scan_jobs(jobs_dir: str) -> list[dict]:
     """Load every ``*.json`` job file under ``jobs_dir`` (sorted, so the
     queue order is deterministic).  Each job gets a unique ``name`` (file
-    stem default) and a stable per-tenant ``seed``."""
+    stem default), a stable per-tenant ``seed``, a scenario ``type``
+    (default ``"fit"``) and the type's parameters."""
     jobs, seen = [], set()
     for fn in sorted(os.listdir(jobs_dir)):
         if not fn.endswith(".json"):
@@ -88,24 +120,180 @@ def scan_jobs(jobs_dir: str) -> list[dict]:
         if name in seen:
             raise ValueError(f"{path}: duplicate job name {name!r}")
         seen.add(name)
+        typ = str(doc.get("type", "fit"))
+        if typ not in SCENARIO_TYPES:
+            raise ValueError(f"{path}: unknown job type {typ!r} "
+                             f"(one of {SCENARIO_TYPES})")
+        params = {k: doc[k] for k in ("nfolds", "focal", "ngrid")
+                  if k in doc}
         jobs.append({"name": name, "model": dict(doc.get("model", {})),
                      "seed": int(doc.get("seed", _job_seed(name))),
+                     "type": typ, "params": params,
                      "path": path})
     return jobs
 
 
+def build_tenant_model(job: dict):
+    """The tenant's Hmsc model: the base worker model from the job's
+    ``model`` kwargs, restricted to the fold's TRAINING rows when the
+    tenant is a CV-fold expansion (same rebuild as the serial CV path's
+    :func:`~hmsc_tpu.predict.cv._fold_model`, scaling copied verbatim)."""
+    from ..testing.multiproc import build_worker_model
+
+    hM = build_worker_model(**job.get("model", {}))
+    sc = job.get("scenario") or {}
+    if sc.get("kind") == "cv_fold":
+        import numpy as np
+
+        from ..predict.cv import _fold_model
+        part = np.asarray(sc["partition"])
+        return _fold_model(hM, part != int(sc["fold"]))
+    return hM
+
+
+def expand_scenarios(jobs: list[dict]) -> list[dict]:
+    """Expand scenario jobs into the flat per-tenant job list the planner
+    buckets.  ``fit`` jobs pass through; ``waic`` / ``gradient`` jobs stay
+    one tenant carrying an evaluation spec; ``cv`` jobs expand into one
+    tenant per fold (``<name>@cv<k>``).
+
+    The CV expansion draws from ``default_rng(job seed)`` in EXACTLY
+    :func:`~hmsc_tpu.predict.cv.compute_predicted_values`'s consumption
+    order — partition first, then per sorted fold a fit seed followed by a
+    predict seed — so a zero-pad bucket reproduces the serial CV bit for
+    bit from the same job seed."""
+    import numpy as np
+
+    from ..predict.cv import create_partition
+    from ..testing.multiproc import build_worker_model
+
+    out = []
+    for job in jobs:
+        typ = job.get("type", "fit")
+        base = {k: v for k, v in job.items() if k not in ("type", "params")}
+        params = job.get("params", {})
+        if typ == "cv":
+            nfolds = int(params.get("nfolds", 5))
+            rng = np.random.default_rng(int(job["seed"]))
+            hM = build_worker_model(**job.get("model", {}))
+            part = create_partition(hM, nfolds, rng=rng)
+            for k in np.unique(part):
+                fit_seed = int(rng.integers(2**31))
+                predict_seed = int(rng.integers(2**31))
+                out.append(dict(
+                    base, name=f"{job['name']}@cv{int(k)}", seed=fit_seed,
+                    scenario={"kind": "cv_fold", "parent": job["name"],
+                              "fold": int(k), "nfolds": nfolds,
+                              "partition": [int(x) for x in part],
+                              "predict_seed": predict_seed}))
+        elif typ == "waic":
+            out.append(dict(base,
+                            scenario={"kind": "waic",
+                                      "parent": job["name"]}))
+        elif typ == "gradient":
+            out.append(dict(
+                base,
+                scenario={"kind": "gradient", "parent": job["name"],
+                          "focal": int(params.get("focal", 1)),
+                          "ngrid": int(params.get("ngrid", 8)),
+                          "predict_seed":
+                              _job_seed(f"{job['name']}:gradient")}))
+        else:
+            out.append(dict(base))
+    return out
+
+
+# heavy per-tenant scenario payload fields that stay in the worker result
+# JSON (and the queue summary) but are stripped from streamed fleet events
+_SCENARIO_HEAVY = ("partition", "val_rows", "pred_mean", "grid", "grid_pred")
+
+
+def _evaluate_scenario(job: dict, hM, post) -> dict | None:
+    """Evaluate one tenant's scenario payload against its fitted posterior
+    (runs inside the batch worker).  ``hM`` is the tenant's model as built
+    by :func:`build_tenant_model` (the FOLD model for cv_fold tenants)."""
+    sc = job.get("scenario") or {}
+    kind = sc.get("kind")
+    if not kind:
+        return None
+    import numpy as np
+
+    if kind == "waic":
+        from ..post.metrics import compute_waic
+        return {"kind": "waic", "parent": sc["parent"],
+                "waic": float(compute_waic(post))}
+
+    import pandas as pd
+
+    from ..predict.predict import predict
+    from ..testing.multiproc import build_worker_model
+
+    parent = build_worker_model(**job.get("model", {}))
+    if kind == "cv_fold":
+        part = np.asarray(sc["partition"])
+        val = part == int(sc["fold"])
+        sd_val = (pd.DataFrame({name: np.asarray(parent.df_pi[r])[val]
+                                for r, name in enumerate(parent.rl_names)})
+                  if parent.nr > 0 else None)
+        X_val = (list(parent.X[:, val, :]) if parent.x_is_list
+                 else parent.X[val])
+        XRRR_val = None if parent.nc_rrr == 0 else parent.XRRR[val]
+        pred = np.asarray(predict(
+            post, X=X_val, XRRR=XRRR_val, study_design=sd_val,
+            mcmc_step=1, expected=True, seed=int(sc["predict_seed"])))
+        pm = pred.mean(axis=0)
+        resid = pm - parent.Y[val]
+        sse = float(np.nansum(resid ** 2))
+        n = int(np.isfinite(parent.Y[val]).sum())
+        return {"kind": "cv_fold", "parent": sc["parent"],
+                "fold": int(sc["fold"]), "nfolds": int(sc["nfolds"]),
+                "val_rows": [int(i) for i in np.flatnonzero(val)],
+                "pred_mean": pm.tolist(), "sse": sse, "n": n}
+
+    if kind == "gradient":
+        # raw-matrix counterfactual grid: construct_gradient needs a
+        # formula-built model, so sweep the focal column over its observed
+        # range with every other column held at its training mean, pinned
+        # to each level's first training unit (study_design=None would
+        # reuse the TRAINING labels, whose length mismatches the grid)
+        focal = int(sc["focal"])
+        ngrid = int(sc["ngrid"])
+        Xb = parent.X[0] if parent.x_is_list else parent.X
+        grid = np.linspace(float(Xb[:, focal].min()),
+                           float(Xb[:, focal].max()), ngrid)
+        Xg = np.tile(np.asarray(Xb).mean(axis=0), (ngrid, 1))
+        Xg[:, focal] = grid
+        sd = (pd.DataFrame({name: [np.asarray(parent.df_pi[r])[0]] * ngrid
+                            for r, name in enumerate(parent.rl_names)})
+              if parent.nr > 0 else None)
+        XRRRg = (np.tile(np.asarray(parent.XRRR).mean(axis=0), (ngrid, 1))
+                 if parent.nc_rrr > 0 else None)
+        pred = np.asarray(predict(
+            post, X=list(np.broadcast_to(Xg, (len(parent.X), *Xg.shape)))
+            if parent.x_is_list else Xg,
+            XRRR=XRRRg, study_design=sd, mcmc_step=1, expected=True,
+            seed=int(sc["predict_seed"])))
+        return {"kind": "gradient", "parent": sc["parent"], "focal": focal,
+                "ngrid": ngrid, "grid": grid.tolist(),
+                "grid_pred": pred.mean(axis=0).tolist()}
+
+    raise ValueError(f"unknown scenario kind {kind!r}")
+
+
 def plan_buckets(jobs: list[dict], rounding: dict | None = None) -> dict:
-    """Bin jobs by padded-shape-bucket fingerprint.  Builds each job's
-    spec host-side (cheap — no sampling, no compile) and groups by
-    :func:`~hmsc_tpu.mcmc.multitenant.bucket_key`."""
+    """Bin (already scenario-expanded) jobs by padded-shape-bucket
+    fingerprint.  Builds each tenant's spec host-side (cheap — no
+    sampling, no compile) and groups by
+    :func:`~hmsc_tpu.mcmc.multitenant.bucket_key`.  CV-fold tenants get
+    their FOLD model's fingerprint, so equal-shape folds land in one
+    bucket and batch into a single vmapped program."""
     from ..mcmc.multitenant import (batch_unsupported_reason, bucket_key)
     from ..mcmc.structs import build_model_data, build_spec
     from ..precompute import compute_data_parameters
-    from ..testing.multiproc import build_worker_model
 
     buckets: dict[str, list[dict]] = {}
     for job in jobs:
-        hM = build_worker_model(**job["model"])
+        hM = build_tenant_model(job)
         spec = build_spec(hM)
         reason = batch_unsupported_reason(spec)
         if reason is not None:
@@ -129,11 +317,17 @@ def batch_worker_main(argv=None) -> int:
     import argparse
 
     ap = argparse.ArgumentParser(description="batched-bucket fitting worker")
-    ap.add_argument("--jobs", required=True,
+    ap.add_argument("--jobs", default=None,
                     help="JSON list of job objects (name/model/seed)")
-    ap.add_argument("--ckpt-dir", required=True,
+    ap.add_argument("--buckets", default=None,
+                    help="JSON list of bucket specs ({bkey, jobs, "
+                         "ckpt_dir, action, out}) to run back to back in "
+                         "THIS process — the grouped dispatch that "
+                         "amortizes start-up across a sweep's buckets")
+    ap.add_argument("--ckpt-dir", default=None,
                     help="this bucket's checkpoint root (per-tenant "
-                         "manifests land in tenant-<name>/ under it)")
+                         "manifests land in tenant-<name>/ under it); "
+                         "grouped dispatch carries it per bucket spec")
     ap.add_argument("--run", default="{}",
                     help="JSON kwargs for sample_mcmc_batched")
     ap.add_argument("--action", choices=("run", "resume"), default="run")
@@ -145,22 +339,27 @@ def batch_worker_main(argv=None) -> int:
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
 
-    import numpy as np
-
-    from ..mcmc.multitenant import sample_mcmc_batched
-    from ..obs import get_logger
-    from ..testing.multiproc import build_worker_model
-
-    jobs = json.loads(args.jobs)
     run_kw = dict(json.loads(args.run))
     run_kw.setdefault("samples", 8)
     run_kw.setdefault("checkpoint_every",
                       max(1, int(run_kw["samples"]) // 4))
     rounding = json.loads(args.rounding) if args.rounding else None
 
-    models = [build_worker_model(**j.get("model", {})) for j in jobs]
-    names = [j["name"] for j in jobs]
-    seeds = [int(j.get("seed", _job_seed(j["name"]))) for j in jobs]
+    if args.jobs is None and args.buckets is None:
+        ap.error("one of --jobs / --buckets is required")
+
+    if args.buckets is not None:
+        any_diverged = False
+        for spec in json.loads(args.buckets):
+            rec = _run_worker_bucket(spec["jobs"], spec["ckpt_dir"],
+                                     run_kw, spec.get("action", "run"),
+                                     rounding, None)
+            any_diverged |= not all(t["ok"] for t in rec["tenants"])
+            with open(spec["out"], "w") as f:
+                json.dump(rec, f)
+        return EXIT_DIVERGED if any_diverged else EXIT_OK
+
+    jobs = json.loads(args.jobs)
 
     if args.kill_at is not None:
         kill_at = int(args.kill_at)
@@ -185,11 +384,35 @@ def batch_worker_main(argv=None) -> int:
     else:
         progress_callback = None
 
+    rec = _run_worker_bucket(jobs, args.ckpt_dir, run_kw, args.action,
+                             rounding, progress_callback)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(rec, f)
+    return (EXIT_OK if all(t["ok"] for t in rec["tenants"])
+            else EXIT_DIVERGED)
+
+
+def _run_worker_bucket(jobs: list[dict], ckpt_dir: str, run_kw: dict,
+                       action: str, rounding, progress_callback) -> dict:
+    """Fit one bucket's tenants (batched) and evaluate their scenarios;
+    returns the result record the supervisor reads (``tenants`` +
+    occupancy ``report``).  Shared by the one-bucket-per-process dispatch
+    and the grouped (many buckets, one process) dispatch."""
+    import numpy as np
+
+    from ..mcmc.multitenant import sample_mcmc_batched
+    from ..obs import get_logger
+
+    models = [build_tenant_model(j) for j in jobs]
+    names = [j["name"] for j in jobs]
+    seeds = [int(j.get("seed", _job_seed(j["name"]))) for j in jobs]
+
     try:
         posts, report = sample_mcmc_batched(
             models, names=names, seeds=seeds,
-            checkpoint_path=args.ckpt_dir,
-            resume=(args.action == "resume"),
+            checkpoint_path=ckpt_dir,
+            resume=(action == "resume"),
             bucket_rounding=rounding,
             progress_callback=progress_callback,
             return_report=True, **run_kw)
@@ -198,22 +421,25 @@ def batch_worker_main(argv=None) -> int:
         raise
 
     tenants = []
-    any_diverged = False
-    for name, post in zip(names, posts):
+    for job, hM, name, post in zip(jobs, models, names, posts):
         good = bool(np.asarray(post.chain_health["good_chains"]).all())
-        any_diverged |= not good
-        tenants.append({
+        trec = {
             "tenant": name, "ok": good,
             "samples": int(post.samples), "n_chains": int(post.n_chains),
             "first_bad_it": [int(x) for x in
                              np.asarray(post.chain_health["first_bad_it"])],
             "digest": {k: float(np.asarray(v, dtype=np.float64).sum())
                        for k, v in post.arrays.items()},
-        })
-    if args.out:
-        with open(args.out, "w") as f:
-            json.dump({"tenants": tenants, "report": report}, f)
-    return EXIT_DIVERGED if any_diverged else EXIT_OK
+        }
+        if job.get("scenario"):
+            if good:
+                trec["scenario"] = _evaluate_scenario(job, hM, post)
+            else:
+                # no finite draws worth evaluating — mirror the serial CV
+                # path's all-diverged refusal, but per tenant
+                trec["scenario"] = dict(job["scenario"], failed=True)
+        tenants.append(trec)
+    return {"tenants": tenants, "report": report}
 
 
 # ---------------------------------------------------------------------------
@@ -325,12 +551,124 @@ class JobQueue:
             self._emit("backoff", bucket=bkey, seconds=round(backoff, 3))
             time.sleep(backoff)
         if result is not None:
-            for trec in result.get("tenants", []):
-                self._emit("tenant_done", bucket=bkey, **trec)
+            self._emit_bucket_done(bkey, result)
         return {"bucket": bkey, "attempts": attempt,
                 "ok": result is not None
                 and all(t["ok"] for t in result.get("tenants", [])),
                 "diverged": diverged, "result": result}
+
+    def _emit_bucket_done(self, bkey: str, result: dict) -> None:
+        for trec in result.get("tenants", []):
+            ev = dict(trec)
+            if ev.get("scenario"):
+                # the streamed event keeps the scenario verdict but not
+                # the bulk payload (fold partitions, prediction grids)
+                ev["scenario"] = {k: v for k, v in ev["scenario"].items()
+                                  if k not in _SCENARIO_HEAVY}
+            self._emit("tenant_done", bucket=bkey, **ev)
+
+    def _run_buckets_grouped(self, buckets: dict,
+                             chaos_kill_at=None) -> list[dict]:
+        """Dispatch EVERY bucket to one worker process per attempt (the
+        ``group_buckets`` mode): interpreter/JAX start-up is paid once per
+        sweep instead of once per bucket.  The worker writes one result
+        record per completed bucket, so a retry re-dispatches only the
+        buckets without a result — per-bucket fault isolation survives
+        grouping."""
+        from ..utils.checkpoint import checkpoint_files
+        cfg = self.cfg
+        pending = dict(sorted(buckets.items()))
+        done: dict = {}
+        budget = int(cfg.restart_budget)
+        attempt = 0
+        while pending:
+            attempt += 1
+            specs = []
+            for bkey, bjobs in pending.items():
+                ck_root = bucket_ckpt_dir(cfg.ckpt_dir, bkey)
+                has_ck = any(
+                    checkpoint_files(os.path.join(ck_root, d))
+                    for d in (os.listdir(ck_root)
+                              if os.path.isdir(ck_root) else [])
+                    if d.startswith("tenant-"))
+                specs.append({
+                    "bkey": bkey,
+                    "jobs": [{k: v for k, v in j.items() if k != "path"}
+                             for j in bjobs],
+                    "ckpt_dir": ck_root,
+                    "action": "resume" if has_ck else "run",
+                    "out": os.path.join(
+                        cfg.work_dir, f"job-{bkey}-{attempt:03d}.json")})
+            from ..testing.multiproc import _pkg_root, worker_env
+            cmd = [sys.executable, "-c",
+                   "from hmsc_tpu.fleet.jobs import batch_worker_main; "
+                   "raise SystemExit(batch_worker_main())",
+                   "--buckets", json.dumps(specs),
+                   "--run", json.dumps(cfg.run_kw)]
+            if getattr(cfg, "bucket_rounding", None):
+                cmd += ["--rounding", json.dumps(cfg.bucket_rounding)]
+            log_path = os.path.join(cfg.work_dir,
+                                    f"job-grouped-{attempt:03d}.log")
+            with open(log_path, "w") as logf:
+                p = subprocess.Popen(cmd, cwd=_pkg_root(), env=worker_env(),
+                                     stdout=logf,
+                                     stderr=subprocess.STDOUT)
+            for spec in specs:
+                self._emit("job_dispatch", bucket=spec["bkey"],
+                           attempt=attempt, pid=p.pid,
+                           action=spec["action"], grouped=True,
+                           n_tenants=len(spec["jobs"]),
+                           tenants=[j["name"] for j in spec["jobs"]])
+            try:
+                rc = p.wait(timeout=cfg.wall_timeout_s * len(specs))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                rc = p.wait()
+            for spec in specs:
+                bkey = spec["bkey"]
+                rec = None
+                if os.path.exists(spec["out"]):
+                    try:
+                        with open(spec["out"]) as f:
+                            rec = json.load(f)
+                    except (OSError, ValueError):
+                        rec = None
+                if rec is None:
+                    self._emit("job_exit", bucket=bkey, attempt=attempt,
+                               rc=int(rc), outcome=describe(int(rc)))
+                    self.attempt_log.append(
+                        {"bucket": bkey, "attempt": attempt,
+                         "action": spec["action"], "rc": int(rc)})
+                    continue
+                ok = all(t["ok"] for t in rec.get("tenants", []))
+                b_rc = EXIT_OK if ok else EXIT_DIVERGED
+                self._emit("job_exit", bucket=bkey, attempt=attempt,
+                           rc=b_rc, outcome=describe(b_rc))
+                self.attempt_log.append(
+                    {"bucket": bkey, "attempt": attempt,
+                     "action": spec["action"], "rc": b_rc})
+                self._emit_bucket_done(bkey, rec)
+                done[bkey] = {"bucket": bkey, "attempts": attempt,
+                              "ok": ok, "diverged": not ok, "result": rec}
+                del pending[bkey]
+            if not pending:
+                break
+            budget -= 1
+            if budget <= 0:
+                for bkey in pending:
+                    self._emit("job_abort", bucket=bkey,
+                               reason="budget-exhausted", attempts=attempt)
+                    done[bkey] = {"bucket": bkey, "attempts": attempt,
+                                  "ok": False, "diverged": False,
+                                  "result": None}
+                break
+            backoff = min(cfg.backoff_base_s
+                          * cfg.backoff_factor ** (attempt - 1),
+                          cfg.backoff_max_s)
+            self._emit("backoff", bucket="grouped",
+                       seconds=round(backoff, 3))
+            time.sleep(backoff)
+        return [done[b] for b in sorted(done)]
 
     def run(self, chaos_kill_at=None) -> dict:
         """Run the whole queue: scan, plan, dispatch every bucket.
@@ -343,14 +681,20 @@ class JobQueue:
         self.telem.attach_sink(fleet_events_path(cfg.ckpt_dir),
                                truncate=True)
         jobs = scan_jobs(self.jobs_dir)
-        buckets = plan_buckets(jobs, getattr(cfg, "bucket_rounding", None))
-        self._emit("queue_start", n_jobs=len(jobs), n_buckets=len(buckets),
+        tenants = expand_scenarios(jobs)
+        buckets = plan_buckets(tenants,
+                               getattr(cfg, "bucket_rounding", None))
+        self._emit("queue_start", n_jobs=len(jobs),
+                   n_tenants=len(tenants), n_buckets=len(buckets),
                    buckets={k: [j["name"] for j in v]
                             for k, v in sorted(buckets.items())})
-        outcomes = []
-        for bkey, bjobs in sorted(buckets.items()):
-            outcomes.append(self._run_bucket_supervised(
-                bkey, bjobs, chaos_kill_at=chaos_kill_at))
+        if getattr(cfg, "group_buckets", False) and chaos_kill_at is None:
+            outcomes = self._run_buckets_grouped(buckets)
+        else:
+            outcomes = []
+            for bkey, bjobs in sorted(buckets.items()):
+                outcomes.append(self._run_bucket_supervised(
+                    bkey, bjobs, chaos_kill_at=chaos_kill_at))
         report = {"buckets": [], "occupancy": None, "padding_waste": None}
         cr = cp = 0
         for o in outcomes:
@@ -362,21 +706,90 @@ class JobQueue:
         if cp:
             report["occupancy"] = round(cr / cp, 4)
             report["padding_waste"] = round(1.0 - cr / cp, 4)
+        scenarios, scenario_preds = self._aggregate_scenarios(jobs, outcomes)
         status = queue_status(outcomes)
         summary = {
             "ok": status == "ok",
             "status": status,
-            "n_jobs": len(jobs), "n_buckets": len(buckets),
+            "n_jobs": len(jobs), "n_tenants": len(tenants),
+            "n_buckets": len(buckets),
             "bucket_outcomes": [{k: v for k, v in o.items()
                                  if k != "result"} for o in outcomes],
             "tenants_done": sum(
                 len((o["result"] or {}).get("tenants", []))
                 for o in outcomes),
             "report": report,
+            "scenarios": scenarios,
             "wall_s": round(time.monotonic() - self._t0, 3),
         }
+        # queue_end stays light: the (ny, ns) CV prediction matrices ride
+        # only the returned summary, not the event stream
         self._emit("queue_end", **summary)
+        summary["scenario_preds"] = scenario_preds
         return summary
+
+    def _aggregate_scenarios(self, jobs, outcomes):
+        """Reduce per-tenant scenario payloads to one comparison record per
+        scenario job: CV folds regroup by parent into an aggregate RMSE and
+        the serial ``compute_predicted_values``-shaped (ny, ns) posterior-
+        mean matrix; waic / gradient pass their verdicts through.  Emits one
+        ``scenario_done`` fleet event per scenario."""
+        import math
+        by_parent: dict[str, dict] = {}
+        for o in outcomes:
+            for trec in (o["result"] or {}).get("tenants", []):
+                sc = trec.get("scenario")
+                if not sc:
+                    continue
+                e = by_parent.setdefault(
+                    sc["parent"], {"scenario": sc["parent"], "ok": True,
+                                   "_folds": []})
+                e["ok"] &= bool(trec["ok"]) and not sc.get("failed")
+                if sc.get("failed"):
+                    continue
+                if sc["kind"] == "cv_fold":
+                    e["type"] = "cv"
+                    e["nfolds"] = int(sc["nfolds"])
+                    e["_folds"].append(sc)
+                elif sc["kind"] == "waic":
+                    e["type"] = "waic"
+                    e["waic"] = sc["waic"]
+                elif sc["kind"] == "gradient":
+                    e["type"] = "gradient"
+                    e["focal"] = sc["focal"]
+                    e["ngrid"] = sc["ngrid"]
+                    e["grid"] = sc["grid"]
+                    e["grid_pred"] = sc["grid_pred"]
+        scenarios, preds = [], {}
+        for job in jobs:           # job-file order, deterministic
+            e = by_parent.get(job["name"])
+            if e is None:
+                continue
+            folds = sorted(e.pop("_folds"), key=lambda s: s["fold"])
+            if e.get("type") == "cv":
+                sse = sum(s["sse"] for s in folds)
+                n = sum(s["n"] for s in folds)
+                e["folds_done"] = len(folds)
+                e["ok"] &= len(folds) == e["nfolds"]
+                e["rmse"] = round(math.sqrt(sse / n), 6) if n else None
+                pm = {}
+                for s in folds:
+                    for i, row in zip(s["val_rows"], s["pred_mean"]):
+                        pm[int(i)] = row
+                preds[job["name"]] = pm
+            elif e.get("type") == "gradient" and "grid_pred" in e:
+                preds[job["name"]] = {"grid": e["grid"],
+                                      "grid_pred": e["grid_pred"]}
+                # one scalar for the comparison report: the mean (over
+                # species) response shift across the focal sweep
+                lo, hi = e["grid_pred"][0], e["grid_pred"][-1]
+                e["pred_span"] = round(
+                    sum(h - l for l, h in zip(lo, hi)) / len(lo), 6)
+            light = {k: v for k, v in e.items()
+                     if k not in _SCENARIO_HEAVY}
+            self._emit("scenario_done", **light)
+            scenarios.append(light)
+        return scenarios, preds
 
 
 if __name__ == "__main__":

@@ -119,21 +119,16 @@ def tenant_dir(base: str, name: str) -> str:
 def batch_unsupported_reason(spec: ModelSpec,
                              updater: dict | None = None) -> str | None:
     """Why this model cannot join a padded batch, or ``None`` when it can.
-    The supported family is PAPER.md's core: normal/probit/Poisson
-    observation models, traits, phylogeny, unstructured random levels."""
-    for ls in spec.levels:
-        if ls.spatial is not None:
-            return (f"spatial random level '{ls.name}' ({ls.spatial}): the "
-                    "spatial precision grids have no padded formulation yet")
-        if ls.x_dim > 0:
-            return (f"covariate-dependent random level '{ls.name}' "
-                    "(xDim > 0)")
+    The supported family is PAPER.md's full core: normal/probit/Poisson
+    observation models, traits, phylogeny, unstructured AND spatial
+    (Full/NNGP/GPP) random levels, covariate-dependent levels (xDim > 0),
+    spike-and-slab selection (XSelect) and reduced-rank regression
+    (XRRRData).  Spatial precision grids pad block-diagonally (identity /
+    inert-Vecchia / zero-knot-correction pad units); sel/RRR models keep
+    their covariate axis static (``bucket_dims`` never rounds ``nc`` for
+    them) so the selection groups and RRR component rows stay exact."""
     if spec.x_is_list:
         return "per-species design matrices (x_is_list)"
-    if spec.ncsel > 0:
-        return "spike-and-slab variable selection (XSelect)"
-    if spec.nc_rrr > 0:
-        return "reduced-rank regression (XRRRData)"
     up = updater or {}
     if up.get("Gamma2") is True or up.get("GammaEta") is True:
         return "opt-in collapsed updaters (Gamma2/GammaEta)"
@@ -143,13 +138,19 @@ def batch_unsupported_reason(spec: ModelSpec,
 
 
 def bucket_dims(spec: ModelSpec, rounding: dict | None = None) -> dict:
-    """This model's padded target dims under the rounding granularity."""
+    """This model's padded target dims under the rounding granularity.
+
+    sel/RRR models keep ``nc`` EXACT (never rounded): selection groups
+    are per-covariate static structure and the RRR component rows sit at
+    ``nc_nrrr:``, so a padded covariate axis would shift traced group
+    unrolls — such models only share a bucket at equal ``nc``."""
     g = dict(DEFAULT_BUCKET_ROUNDING)
     g.update(rounding or {})
+    nc_static = spec.ncsel > 0 or spec.nc_rrr > 0
     return {
         "ny": _round_up(spec.ny, g["ny"]),
         "ns": _round_up(spec.ns, g["ns"]),
-        "nc": _round_up(spec.nc, g["nc"]),
+        "nc": spec.nc if nc_static else _round_up(spec.nc, g["nc"]),
         "nt": _round_up(spec.nt, g["nt"]),
         "np": tuple(_round_up(ls.n_units, g["np"]) for ls in spec.levels),
         "nf": tuple(_round_up(ls.nf_max, g["nf"]) for ls in spec.levels),
@@ -159,13 +160,21 @@ def bucket_dims(spec: ModelSpec, rounding: dict | None = None) -> dict:
 def _struct_sig(spec: ModelSpec, data: ModelData) -> tuple:
     """The trace-path part of the bucket key: every static flag that picks
     compiled code, EXCLUDING the raw dims (those enter via padded dims)."""
+    # sel/RRR: the covariate split (nc_nrrr | nc_rrr | nc_orrr) and the
+    # per-selection group counts pick statically-unrolled traced code, so
+    # they join the key (alongside the exact nc that bucket_dims keeps)
+    sel_rrr = ()
+    if spec.ncsel > 0 or spec.nc_rrr > 0:
+        sel_rrr = (spec.nc, spec.nc_orrr, spec.nc_nrrr,
+                   tuple(int(np.asarray(q).shape[0]) for q in data.sel_q))
     return (
         spec.nr,
-        tuple((ls.x_dim, ls.spatial, ls.ncr) for ls in spec.levels),
+        tuple((ls.x_dim, ls.spatial, ls.ncr, ls.n_alpha,
+               ls.n_neighbours, ls.n_knots) for ls in spec.levels),
         spec.has_phylo, spec.n_rho,
         spec.any_normal, spec.any_probit, spec.any_poisson,
         spec.any_estimated_sigma, spec.homoskedastic_fixed,
-        spec.x_is_list, spec.ncsel, spec.nc_rrr,
+        spec.x_is_list, spec.ncsel, spec.nc_rrr, sel_rrr,
         data.x_ones_ind is not None,
         data.x_intercept_ind is not None,
         data.tr_intercept_ind is not None,
@@ -222,6 +231,20 @@ def _pad_diag_one(a, n: int):
     return out
 
 
+def _pad_grid_diag_one(a, n: int):
+    """Pad a (G, np, np) per-grid-point precision stack to (G, n, n) with
+    zeros, ones on each grid point's pad diagonal — every alpha's padded
+    precision gains an identity pad block (exact real/pad decoupling
+    through the joint Cholesky, zero log-det contribution)."""
+    a = np.asarray(a)
+    k = a.shape[1]
+    out = _padded(a, {1: n, 2: n})
+    if n > k:
+        idx = np.arange(k, n)
+        out[:, idx, idx] = 1.0
+    return out
+
+
 def _pad_scale_par(sp, n: int):
     """(2, d) back-transform params: pad means with 0, scales with 1."""
     sp = np.asarray(sp)
@@ -258,11 +281,12 @@ def pad_spec(spec: ModelSpec, dims: dict, has_na: bool) -> ModelSpec:
     return dataclasses.replace(
         spec, ny=int(dims["ny"]), ns=int(dims["ns"]), nc=int(dims["nc"]),
         nt=int(dims["nt"]), has_na=bool(has_na), levels=levels,
-        # batch-eligible models carry no RRR columns (nc == nc_nrrr), so
-        # the padded spec keeps that identity — record_sample's RRR concat
+        # non-RRR models carry no RRR columns (nc == nc_nrrr), so the
+        # padded spec keeps that identity — record_sample's RRR concat
         # branch (spec.nc > nc_nrrr) must not fire against the padded
-        # x_scale_par
-        nc_nrrr=int(dims["nc"]))
+        # x_scale_par.  RRR models keep nc static (bucket_dims), so their
+        # own nc_nrrr stays exact
+        nc_nrrr=spec.nc_nrrr if spec.nc_rrr > 0 else int(dims["nc"]))
 
 
 def _tenant_masks(spec: ModelSpec, dims: dict, dtype=np.float32):
@@ -314,17 +338,49 @@ def pad_tenant(spec: ModelSpec, data: ModelData, dims: dict) -> ModelData:
         # either way, this just keeps the segment sums tidy
         pad_unit = np_r if np_p > np_r else 0
         pi_p = _padded(pi, {0: ny}, fill=pad_unit).astype(np.int32)
-        levels.append(lvd.replace(
+        lkw = dict(
             pi_row=jnp.asarray(pi_p),
             unit_count=f32(_padded(lvd.unit_count, {0: np_p})),
             x_row=f32(_padded(lvd.x_row, {0: ny}, fill=1.0)),
             x_unit=f32(_padded(lvd.x_unit, {0: np_p}, fill=1.0)),
-        ))
+        )
+        # spatial precision grids pad block-diagonally per alpha grid
+        # point — padded units decouple from real ones EXACTLY, for every
+        # alpha, so the Eta Cholesky/CG factors and the Alpha grid
+        # log-densities are bitwise independent of pad content:
+        # - Full: identity pad block in each iWg (zero log-det, detWg
+        #   unchanged)
+        # - NNGP: inert Vecchia pad rows — no neighbours (nn_idx 0 with
+        #   nn_coef 0 scatters nothing), unit conditional variance
+        #   (nn_D 1) => pad rows of the Cholesky factor are e_i; real
+        #   rows never reference pad units (pads append past np_r)
+        # - GPP: unit diagonal (idDg 1, the alpha=0 convention) with zero
+        #   knot corrections (idDW12g 0) => MtAM / rhs pad contributions
+        #   are exact zeros; Fg/iFg/detDg are knot-indexed and pass
+        #   through untouched
+        # The lone traced consequence of these fills — 1'iW1 counting one
+        # per pad unit in eta_ones_forms_at — is corrected per tenant in
+        # interweave_location.
+        if lvd.iWg is not None:
+            lkw["iWg"] = f32(_pad_grid_diag_one(lvd.iWg, np_p))
+        if lvd.nn_idx is not None:
+            lkw["nn_idx"] = jnp.asarray(
+                _padded(np.asarray(lvd.nn_idx), {0: np_p}).astype(np.int32))
+            lkw["nn_coef"] = f32(_padded(lvd.nn_coef, {1: np_p}))
+            lkw["nn_D"] = f32(_padded(lvd.nn_D, {1: np_p}, fill=1.0))
+        if lvd.idDg is not None:
+            lkw["idDg"] = f32(_padded(lvd.idDg, {1: np_p}, fill=1.0))
+            lkw["idDW12g"] = f32(_padded(lvd.idDW12g, {1: np_p}))
+        levels.append(lvd.replace(**lkw))
 
+    # the stored design carries the non-RRR columns only (effective_design
+    # appends the RRR components per sweep), so its covariate pad target is
+    # nc - nc_rrr — equal to nc for every non-RRR model
+    ncn_p = nc - spec.nc_rrr
     kw = dict(
         Y=f32(_padded(data.Y, {0: ny, 1: ns})),
         Ymask=f32(_padded(data.Ymask, {0: ny, 1: ns})),
-        X=f32(_padded(data.X, {0: ny, 1: nc})),
+        X=f32(_padded(data.X, {0: ny, 1: ncn_p})),
         Tr=f32(_padded(data.Tr, {0: ns, 1: nt})),
         distr_family=jnp.asarray(
             _padded(np.asarray(data.distr_family), {0: ns},
@@ -339,7 +395,7 @@ def pad_tenant(spec: ModelSpec, data: ModelData, dims: dict) -> ModelData:
         aSigma=f32(_padded(data.aSigma, {0: ns}, fill=1.0)),
         bSigma=f32(_padded(data.bSigma, {0: ns}, fill=1.0)),
         levels=tuple(levels),
-        x_scale_par=f32(_pad_scale_par(data.x_scale_par, nc)),
+        x_scale_par=f32(_pad_scale_par(data.x_scale_par, ncn_p)),
         tr_scale_par=f32(_pad_scale_par(data.tr_scale_par, nt)),
         y_scale_par=f32(_pad_scale_par(data.y_scale_par, ns)),
         x_intercept_ind=data.x_intercept_ind,
@@ -347,6 +403,31 @@ def pad_tenant(spec: ModelSpec, data: ModelData, dims: dict) -> ModelData:
         x_ones_ind=data.x_ones_ind,
         tenant=_tenant_masks(spec, dims),
     )
+    if spec.nc_rrr > 0:
+        kw.update(
+            # XRRRs pad rows MUST be exact zeros: A2 = XRRRs' XRRRs has no
+            # Ymask gating, and zero rows also kill the padded-row terms of
+            # the wRRR data gram (S's pad rows are zero because Z and the
+            # loadings are masked, but junk-in-padding inertness must not
+            # depend on that)
+            XRRRs=f32(_padded(data.XRRRs, {0: ny})),
+            nuRRR=f32(data.nuRRR), a1RRR=f32(data.a1RRR),
+            b1RRR=f32(data.b1RRR), a2RRR=f32(data.a2RRR),
+            b2RRR=f32(data.b2RRR),
+            xrrr_scale_par=f32(data.xrrr_scale_par),
+        )
+    if spec.ncsel > 0:
+        kw.update(
+            # sel_cov stays exact (nc is static for sel models); padded
+            # species join group 0 — their lldif terms are exact zeros
+            # (Beta pad columns are masked to zero and logdens carries the
+            # Ymask factor), so the MH flips are pad-independent
+            sel_cov=tuple(f32(c) for c in data.sel_cov),
+            sel_spg=tuple(
+                jnp.asarray(_padded(np.asarray(g), {0: ns}).astype(np.int32))
+                for g in data.sel_spg),
+            sel_q=tuple(f32(q) for q in data.sel_q),
+        )
     if spec.has_phylo:
         kw.update(
             rhopw=f32(data.rhopw),

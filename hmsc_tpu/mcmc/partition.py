@@ -435,8 +435,11 @@ def engaged_site_extent(spec, mesh, species_axis: str = "species",
     """The site-shard extent the sampler WOULD engage for this model on
     this mesh — 1 whenever any of its fallbacks fire (no/extent-1 site
     axis, missing species axis, a species-axis divisibility fallback
-    dragging the sites down with it, non-divisible ny/unit counts, a
-    site-ineligible model class, or an active precision policy).  The
+    dragging the sites down with it, non-divisible ny/unit counts, or a
+    site-ineligible model class).  ``has_policy`` is accepted for API
+    compatibility and ignored: the staged shadow table shards its site
+    dims like the f32 originals (``staged_pspecs``), so a precision
+    policy no longer forces the species-only fallback.  The
     decision mirror of ``sample_mcmc``'s site gating, used by
     ``resume_run``'s local_rng mesh-tuple pinning so a continuation on a
     mesh that falls back identically is not falsely rejected."""
@@ -452,8 +455,6 @@ def engaged_site_extent(spec, mesh, species_axis: str = "species",
     if spec.ny % m or any(ls.n_units % m for ls in spec.levels):
         return 1
     if site_shard_unsupported_reason(spec, updater) is not None:
-        return 1
-    if has_policy:
         return 1
     return m
 

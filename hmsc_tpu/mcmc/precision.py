@@ -267,21 +267,28 @@ def stage_data(data, policy: PrecisionPolicy) -> dict:
 
 
 def staged_pspecs(staged: dict, spec, species_axis: str,
-                  x_is_list: bool = False):
-    """PartitionSpecs for the staged shadow table on a species-sharded
-    mesh: each entry shards exactly like its f32 counterpart (the
-    committed :data:`~hmsc_tpu.mcmc.partition.DATA_SPECIES_DIMS` table,
-    resolved through the per-level name suffix, with ``tree_pspecs``'s
+                  x_is_list: bool = False, site_axis: str | None = None):
+    """PartitionSpecs for the staged shadow table on the sharded mesh:
+    each entry shards exactly like its f32 counterpart (the committed
+    :data:`~hmsc_tpu.mcmc.partition.DATA_SPECIES_DIMS` /
+    :data:`~hmsc_tpu.mcmc.partition.DATA_SITE_DIMS` tables, resolved
+    through the per-level name suffix, with ``tree_pspecs``'s
     per-species-design special case for ``X``), everything else
-    replicated."""
+    replicated.  With ``site_axis`` the row/unit dims shard too — guarded
+    on the dim actually being ``spec.ny``-sized (row arrays) or the
+    owning level's ``n_units`` (the NNGP/GPP per-unit structure grids),
+    the same guards ``tree_pspecs`` applies to the f32 originals — so a
+    precision policy composes with ``site_shards > 1``."""
     from jax.sharding import PartitionSpec as P
 
-    from .partition import DATA_SPECIES_DIMS
+    from .partition import (DATA_SITE_DIMS, DATA_SPECIES_DIMS,
+                            _SITE_UNIT_NAMES)
 
     out = {}
     for name, arr in staged.items():
         head, _, tail = name.rpartition("_")
-        base = head if (tail.isdigit() and head) else name
+        lvl = int(tail) if (tail.isdigit() and head) else None
+        base = head if lvl is not None else name
         ax = [None] * arr.ndim
         d = DATA_SPECIES_DIMS.get(base)
         if base == "X":
@@ -290,6 +297,19 @@ def staged_pspecs(staged: dict, spec, species_axis: str,
             d = 0 if x_is_list else None
         if d is not None and d < arr.ndim and arr.shape[d] == spec.ns:
             ax[d] = species_axis
+        if site_axis is not None:
+            ds = DATA_SITE_DIMS.get(base)
+            if base == "X" and x_is_list:
+                ds = None          # (ns, ny, nc) lists are site-gated off
+            if ds is not None and ds < arr.ndim and ax[ds] is None:
+                if base in _SITE_UNIT_NAMES:
+                    want = (spec.levels[lvl].n_units
+                            if lvl is not None and lvl < len(spec.levels)
+                            else -1)
+                else:
+                    want = spec.ny
+                if arr.shape[ds] == want:
+                    ax[ds] = site_axis
         out[name] = P(*ax)
     return out
 

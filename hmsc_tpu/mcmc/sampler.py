@@ -609,7 +609,7 @@ def _compiled_runner(spec, updater_items, adapt_nf, samples, transient, thin,
             from .precision import staged_pspecs
             in_specs = in_specs + (
                 staged_pspecs(staged_args[0] or {}, spec, species_axis,
-                              x_is_list=spec.x_is_list),)
+                              x_is_list=spec.x_is_list, site_axis=st),)
         state_out = in_specs[1]
 
         # the recorded-sample tree's structure is known statically from
@@ -1378,10 +1378,6 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
         if st is not None:
             from .partition import site_shard_unsupported_reason
             reason = site_shard_unsupported_reason(spec, updater)
-            if reason is None and policy is not None:
-                reason = ("the mixed-precision staged operands have no "
-                          "site-sharded layout yet — drop "
-                          "precision_policy or the site axis")
             if reason is not None:
                 if shard_sweep is True and _sp_ext < 2:
                     raise ValueError(

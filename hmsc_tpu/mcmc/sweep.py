@@ -471,7 +471,7 @@ def make_sharded_sweep(spec: ModelSpec, mesh, updater: dict | None = None,
                         site_axis=st, site_dims=site_dims_s),
             P(),
             staged_pspecs(staged or {}, spec, species_axis,
-                          x_is_list=spec.x_is_list))
+                          x_is_list=spec.x_is_list, site_axis=st))
         return shard_map(body, mesh=mesh, in_specs=in_specs,
                          out_specs=in_specs[1], check_rep=False)(
                              data, state, key, staged or {})

@@ -816,6 +816,15 @@ def interweave_location(spec: ModelSpec, data: ModelData, state: GibbsState,
             from .spatial import eta_ones_forms_at
             q1, s = eta_ones_forms_at(lvd, ls, lv.Eta, lv.alpha_idx, r=r,
                                       shard=shard)
+            if data.tenant is not None:
+                # padded spatial units contribute exactly 1.0 each to
+                # 1'iW1 under the block-diagonal pad convention (identity
+                # iWg blocks / unit Vecchia rows / unit GPP diagonal, see
+                # multitenant.pad_tenant) while 1'iW eta gets exact zeros
+                # (Eta pads are re-masked between blocks) — subtract the
+                # pad count so the orbit prior precision counts REAL units
+                q1 = q1 - (float(ls.n_units)
+                           - data.tenant.levels[r].n_units.astype(lam.dtype))
         Us = mx.staged("U", data.U) if spec.has_phylo else None
         if spec.has_phylo and shard is None:
             e = data.Qeig[state.rho_idx]                  # (ns,)

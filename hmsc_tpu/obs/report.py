@@ -54,8 +54,8 @@ import os
 
 __all__ = ["load_run_events", "load_fleet_events", "build_report",
            "render_report", "epoch_drift_report", "render_drift",
-           "prometheus_textfile", "serving_prometheus_textfile",
-           "report_main", "PROM_GAUGES"]
+           "render_scenarios", "prometheus_textfile",
+           "serving_prometheus_textfile", "report_main", "PROM_GAUGES"]
 
 # the frozen gauge-name registry (see the module docstring): every
 # *_prometheus_textfile exporter routes through _gauge(), which refuses
@@ -203,6 +203,7 @@ def build_report(run_dir: str) -> dict:
               "fleet": _fleet_section(ops),
               "serve_fleet": _serve_fleet_section(ops),
               "pipeline": _pipeline_section(ops),
+              "scenarios": _scenarios_section(ops),
               "status": "no-events" if not streams else "unknown"}
     for proc, events in streams.items():
         # per-epoch clock re-basing: ``t`` restarts at ~0 in each appended
@@ -423,6 +424,58 @@ def _serve_fleet_section(events: list) -> dict | None:
             "summary": summary}
 
 
+def _scenarios_section(events: list) -> dict | None:
+    """Structured scenario comparison from a job-queue run's fleet stream
+    (``python -m hmsc_tpu fleet --jobs`` with cv / waic / gradient jobs):
+    one ``scenario_done`` verdict per scenario job — CV aggregate RMSE,
+    WAIC, counterfactual-gradient response span — plus the queue-level
+    context from ``queue_start`` / ``queue_end``."""
+    events = [e for e in events if e.get("kind") == "fleet"]
+    scen = [{k: v for k, v in e.items()
+             if k not in ("seq", "t", "wall", "proc", "kind", "name")}
+            for e in events if e.get("name") == "scenario_done"]
+    if not scen:
+        return None
+    queue = None
+    for ev in events:
+        if ev.get("name") == "queue_end":
+            queue = {k: ev.get(k) for k in ("status", "n_jobs", "n_tenants",
+                                            "n_buckets", "wall_s")}
+    return {"scenarios": scen, "queue": queue}
+
+
+def render_scenarios(sec: dict) -> str:
+    """Text rendering of the scenario-comparison section — one line per
+    scenario job, so a cv / waic sweep over model variants reads as a
+    single side-by-side table."""
+    lines = ["== scenario comparison (job queue) =="]
+    q = sec.get("queue")
+    if q:
+        lines.append(
+            f"  queue: {q.get('status')}; {q.get('n_jobs')} job(s) -> "
+            f"{q.get('n_tenants')} tenant(s) in {q.get('n_buckets')} "
+            f"bucket(s), wall {q.get('wall_s')}s")
+    w = max((len(s.get("scenario", "?")) for s in sec["scenarios"]),
+            default=1)
+    for s in sec["scenarios"]:
+        flag = "" if s.get("ok") else "  [FAILED]"
+        typ = s.get("type")
+        if typ == "cv":
+            verdict = (f"cv      rmse={s.get('rmse')}  "
+                       f"({s.get('folds_done')}/{s.get('nfolds')} folds)")
+        elif typ == "waic":
+            verdict = f"waic    waic={s.get('waic')}"
+        elif typ == "gradient":
+            verdict = (f"gradient focal={s.get('focal')} "
+                       f"ngrid={s.get('ngrid')} "
+                       f"pred_span={s.get('pred_span')}")
+        else:
+            verdict = str({k: v for k, v in s.items()
+                           if k not in ("scenario", "ok")})
+        lines.append(f"  {s.get('scenario', '?'):<{w}}  {verdict}{flag}")
+    return "\n".join(lines)
+
+
 def _pipeline_section(events: list) -> dict | None:
     """Structured autopilot timeline from the daemon's ``kind="pipeline"``
     stream: per-drop lifecycle (seen -> accepted/rejected -> committed ->
@@ -641,6 +694,10 @@ def render_report(report: dict) -> str:
             lines.append(f"  front end: {s.get('proxied')} proxied, "
                          f"{s.get('retried')} retried, "
                          f"{s.get('rejected')} rejected")
+    scen = report.get("scenarios")
+    if scen:
+        lines.append("")
+        lines.append(render_scenarios(scen))
     pipe = report.get("pipeline")
     if pipe:
         lines.append("")
@@ -900,12 +957,26 @@ def report_main(argv=None) -> int:
                          "streaming-refit run directory (epoch 0 vs each "
                          "committed refit epoch; Welch-style z per "
                          "monitored entry)")
+    ap.add_argument("--scenarios", action="store_true",
+                    help="scenario comparison for a job-queue run with "
+                         "cv / waic / gradient jobs: one verdict line per "
+                         "scenario (CV RMSE, WAIC, gradient response span)")
     args = ap.parse_args(argv)
 
     if args.drift:
         drift = epoch_drift_report(args.run_dir)
         print(json.dumps(drift, indent=1) if args.json
               else render_drift(drift))
+        return 0
+
+    if args.scenarios:
+        sec = _scenarios_section(load_fleet_events(args.run_dir))
+        if sec is None:
+            print(f"{args.run_dir}: no scenario_done events "
+                  "(not a scenario job-queue run?)")
+            return 1
+        print(json.dumps(sec, indent=1) if args.json
+              else render_scenarios(sec))
         return 0
 
     report = build_report(args.run_dir)

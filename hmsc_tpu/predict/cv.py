@@ -92,13 +92,18 @@ def compute_predicted_values(post, partition=None, partition_sp=None,
                              updater: dict | None = None,
                              nf_cap: int | None = None,
                              seed: int | None = None,
+                             nfolds: int | None = None,
                              verbose: bool = True) -> np.ndarray:
     """Posterior-predictive values; (n_draws, ny, ns).
 
     Without ``partition``: predictions on the training data.  With a
     partition vector (from :func:`create_partition`): k-fold CV with a full
     refit per fold; ``partition_sp`` additionally predicts each species fold
-    conditional on the remaining species (``Yc`` machinery).
+    conditional on the remaining species (``Yc`` machinery).  Passing
+    ``nfolds`` (with ``partition=None``) draws the partition HERE from the
+    same seeded Generator that seeds the fold refits — one ``seed``
+    reproduces the whole CV end-to-end (fold vector, refits, predictions);
+    the fleet scenario engine mirrors exactly this consumption order.
     """
     from ..mcmc.sampler import sample_mcmc
     from ..mcmc.structs import DEFAULT_NF_CAP
@@ -107,6 +112,10 @@ def compute_predicted_values(post, partition=None, partition_sp=None,
     hM = post.hM
     rng = np.random.default_rng(seed)
     post = post.subset(start, thin)
+    if partition is None and nfolds is not None:
+        # the partition draw comes FIRST off the seeded stream, before any
+        # fold's fit/predict seeds — the scenario workers replay this order
+        partition = create_partition(hM, int(nfolds), rng=rng)
     if partition is None:
         return predict(post, Yc=Yc, mcmc_step=mcmc_step, expected=expected,
                        seed=None if seed is None else int(rng.integers(2**31)))

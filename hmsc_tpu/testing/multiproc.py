@@ -47,10 +47,15 @@ def _log():
 
 def build_worker_model(ny: int = 24, ns: int = 3, nc: int = 2,
                        distr: str = "normal", n_units: int = 5,
-                       seed: int = 3, nf: int = 2):
+                       seed: int = 3, nf: int = 2, spatial: str | None = None,
+                       n_neighbours: int = 5, n_knots: int | None = None):
     """A compact one-random-level model every worker (and the in-test
     reference run) builds identically from the same kwargs — the
-    multi-process bit-identity assertions compare runs of THIS model."""
+    multi-process bit-identity assertions compare runs of THIS model.
+    ``spatial`` upgrades the level to a spatial one (``'Full'`` /
+    ``'NNGP'`` / ``'GPP'``) for the scenario-engine jobs; the default
+    (non-spatial) rng consumption order is untouched, so every committed
+    worker-model stream stays byte-identical."""
     import numpy as np
     import pandas as pd
 
@@ -66,7 +71,20 @@ def build_worker_model(ny: int = 24, ns: int = 3, nc: int = 2,
     for i in range(n_units):
         units[i % ny] = f"u{i:02d}"
     study = pd.DataFrame({"lvl": units})
-    rl = HmscRandomLevel(units=study["lvl"])
+    if spatial is not None:
+        # spatial draws come AFTER every default-path draw, so non-spatial
+        # jobs see the exact historical stream
+        xy = rng.uniform(size=(n_units, 2))
+        s_df = pd.DataFrame(xy, index=sorted(set(units)),
+                            columns=["x", "y"])
+        skw = dict(s_data=s_df, s_method=spatial)
+        if spatial == "GPP":
+            skw["s_knot"] = rng.uniform(size=(n_knots or 4, 2))
+        if spatial == "NNGP":
+            skw["n_neighbours"] = n_neighbours
+        rl = HmscRandomLevel(**skw)
+    else:
+        rl = HmscRandomLevel(units=study["lvl"])
     set_priors_random_level(rl, nf_max=nf, nf_min=nf)
     return Hmsc(Y=Y, X=X, distr=distr, study_design=study,
                 ran_levels={"lvl": rl})

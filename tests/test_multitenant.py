@@ -67,11 +67,14 @@ def test_bucket_dims_round_up():
 
 
 def test_unsupported_models_rejected():
-    spatial = small_model(ny=16, ns=3, spatial="Full", n_units=5, seed=3)
-    spec, data, _ = _build_md(spatial)
-    assert "spatial" in batch_unsupported_reason(spec)
-    with pytest.raises(NotImplementedError, match="spatial"):
-        sample_mcmc_batched([spatial], samples=2)
+    """The extended pad-and-mask family: spatial / xDim / sel / RRR models
+    now JOIN padded batches (the scenario-engine prerequisite) — only the
+    structural incompatibilities stay rejected."""
+    for kw in ({"spatial": "Full"}, {"spatial": "NNGP", "n_neighbours": 3},
+               {"spatial": "GPP", "n_knots": 4}, {"x_dim": 2}):
+        m = small_model(ny=16, ns=3, n_units=5, seed=3, **kw)
+        spec, data, _ = _build_md(m)
+        assert batch_unsupported_reason(spec) is None, kw
     base = small_model(ny=16, ns=3, n_units=5)
     spec_b, _, _ = _build_md(base)
     assert batch_unsupported_reason(spec_b) is None
@@ -164,21 +167,26 @@ def _applicable_entries():
     return out
 
 
-@pytest.mark.parametrize("name", _applicable_entries())
-def test_updater_pad_junk_invariance(name):
-    """Junk written into every masked cell (padded/NA Y and Z cells,
-    padded design rows) must leave the updater's REAL output slice
-    bit-identical — a gram or likelihood term missing its Ymask, or a row
-    reduction missing its row mask, breaks bitwise equality here.  This is
-    the block-level mask-leak catcher for every registered updater the
-    batched path can run."""
+def _check_updater_junk_invariance(name, spec, spec_b, data_b, clean):
     from hmsc_tpu.mcmc.registry import UPDATER_REGISTRY
+    from hmsc_tpu.mcmc.sweep import effective_spec_data
     entry = {e.name: e for e in UPDATER_REGISTRY}[name]
-    spec, spec_b, data_b, clean = _padded_base()
     data_j, state_j = _junk_masked_cells(data_b, clean)
     key = jax.random.key(9, impl="threefry2x32")
 
-    fn = jax.jit(lambda d, st: entry.fn(spec_b, d, st, key))
+    # design consumers see the state-dependent effective design exactly
+    # like the sweep (RRR columns appended, selection zeroing applied —
+    # a no-op on non-sel/RRR models); the sel machinery itself takes the
+    # raw design
+    needs_raw = name in ("BetaSel", "wRRR", "wRRRPriors")
+
+    def call(d, st):
+        if needs_raw:
+            return entry.fn(spec_b, d, st, key)
+        s2, d2 = effective_spec_data(spec_b, d, st)
+        return entry.fn(s2, d2, st, key)
+
+    fn = jax.jit(call)
     out_c, out_d = fn(data_b, clean), fn(data_j, state_j)
     # normalise both outputs to full GibbsState-shaped trees when the
     # updater returns a LevelState (Eta/Nf return just the level)
@@ -196,6 +204,18 @@ def test_updater_pad_junk_invariance(name):
     for a, b in zip(jax.tree.leaves(sc), jax.tree.leaves(sd)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
                                       err_msg=f"{name}: mask leak")
+
+
+@pytest.mark.parametrize("name", _applicable_entries())
+def test_updater_pad_junk_invariance(name):
+    """Junk written into every masked cell (padded/NA Y and Z cells,
+    padded design rows) must leave the updater's REAL output slice
+    bit-identical — a gram or likelihood term missing its Ymask, or a row
+    reduction missing its row mask, breaks bitwise equality here.  This is
+    the block-level mask-leak catcher for every registered updater the
+    batched path can run."""
+    spec, spec_b, data_b, clean = _padded_base()
+    _check_updater_junk_invariance(name, spec, spec_b, data_b, clean)
 
 
 def test_masked_sweep_junk_invariance_end_to_end():
@@ -219,6 +239,193 @@ def test_masked_sweep_junk_invariance_end_to_end():
     remasked = mask_tenant_state(spec_b, data_b.tenant, out_c)
     for a, b in zip(jax.tree.leaves(out_c), jax.tree.leaves(remasked)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# extended pad-and-mask family: spatial / xDim / sel / RRR (PR 18)
+# ---------------------------------------------------------------------------
+
+def _ext_sel_model(seed=6):
+    import pandas as pd
+
+    from hmsc_tpu import Hmsc, HmscRandomLevel
+    from hmsc_tpu.model import XSelect
+    from hmsc_tpu.random_level import set_priors_random_level
+    rng = np.random.default_rng(seed)
+    ny, ns = 21, 4
+    X = np.column_stack([np.ones(ny), rng.standard_normal(ny)])
+    grp = np.array([0, 0, 1, 1])
+    Y = ((X @ np.vstack([np.full(ns, 0.3), (grp == 1) * 1.5])
+          + rng.standard_normal((ny, ns))) > 0).astype(float)
+    sel = XSelect(cov_group=[1], sp_group=grp, q=[0.5, 0.5])
+    units = [f"u{i % 5}" for i in range(ny)]
+    rl = HmscRandomLevel(units=units)
+    set_priors_random_level(rl, nf_max=2, nf_min=2)
+    return Hmsc(Y=Y, X=X, x_select=[sel], distr="probit",
+                study_design=pd.DataFrame({"lvl": units}),
+                ran_levels={"lvl": rl})
+
+
+def _ext_rrr_model(seed=6):
+    import pandas as pd
+
+    from hmsc_tpu import Hmsc, HmscRandomLevel
+    from hmsc_tpu.random_level import set_priors_random_level
+    rng = np.random.default_rng(seed)
+    ny, ns = 21, 4
+    X = np.column_stack([np.ones(ny), rng.standard_normal(ny)])
+    XRRR = rng.standard_normal((ny, 3))
+    Y = X @ rng.standard_normal((2, ns)) \
+        + (XRRR @ rng.standard_normal((3, 1))) @ rng.standard_normal((1, ns)) \
+        + rng.standard_normal((ny, ns)) * 0.5
+    units = [f"u{i % 5}" for i in range(ny)]
+    rl = HmscRandomLevel(units=units)
+    set_priors_random_level(rl, nf_max=2, nf_min=2)
+    return Hmsc(Y=Y, X=X, XRRR=XRRR, nc_rrr=1, distr="normal",
+                study_design=pd.DataFrame({"lvl": units}),
+                ran_levels={"lvl": rl})
+
+
+_EXT_FAMILIES = {
+    "full": lambda: small_model(ny=21, ns=5, nc=2, distr="normal",
+                                n_units=5, spatial="Full", seed=6),
+    "nngp": lambda: small_model(ny=21, ns=5, nc=2, distr="normal",
+                                n_units=5, spatial="NNGP", n_neighbours=3,
+                                seed=6),
+    "gpp": lambda: small_model(ny=21, ns=5, nc=2, distr="normal",
+                               n_units=5, spatial="GPP", n_knots=4, seed=6),
+    "xdim": lambda: small_model(ny=21, ns=5, nc=2, distr="normal",
+                                n_units=5, x_dim=2, seed=6),
+    "sel": _ext_sel_model,
+    "rrr": _ext_rrr_model,
+}
+
+# the newly batchable updaters, each checked on every family that runs it:
+# the spatial Eta/Alpha pair on all three precision structures (plus the
+# pad-count-corrected interweave), BetaSel, the wRRR pair, and the
+# xDim-form Eta
+_EXT_CASES = [
+    ("full", "EtaSpatial"), ("full", "Alpha"),
+    ("full", "InterweaveLocation"),
+    ("nngp", "EtaSpatial"), ("nngp", "Alpha"),
+    ("gpp", "EtaSpatial"), ("gpp", "Alpha"),
+    ("xdim", "Eta"), ("xdim", "BetaLambda"),
+    ("sel", "BetaSel"), ("sel", "Z"),
+    ("rrr", "wRRR"), ("rrr", "wRRRPriors"), ("rrr", "BetaLambda"),
+]
+
+
+@functools.lru_cache(maxsize=None)
+def _padded_ext_base(fam):
+    spec, data, state = _build_md(_EXT_FAMILIES[fam]())
+    dims = bucket_dims(spec)
+    spec_b = pad_spec(spec, dims, has_na=True)
+    data_b = pad_tenant(spec, data, dims)
+    state_b = mask_tenant_state(spec_b, data_b.tenant,
+                                pad_state(spec, state, dims))
+    return spec, spec_b, data_b, state_b
+
+
+@pytest.mark.parametrize("fam,name",
+                         [pytest.param(f, n, id=f"{f}-{n}")
+                          for f, n in _EXT_CASES])
+def test_extended_updater_pad_junk_invariance(fam, name):
+    """The mask-leak catcher extended to the newly batchable families:
+    per-unit spatial precision pads (identity grid blocks / inert Vecchia
+    rows / unit-idD knot rows) and static-nc sel/RRR structure must make
+    pad junk bitwise inert for each family's own updaters."""
+    spec, spec_b, data_b, clean = _padded_ext_base(fam)
+    _check_updater_junk_invariance(name, spec, spec_b, data_b, clean)
+
+
+@pytest.mark.parametrize("fam", sorted(_EXT_FAMILIES))
+def test_extended_masked_sweep_junk_invariance(fam):
+    """The COMPOSED masked sweep under don't-care junk, per extended
+    family: real draws bit-identical, output pads neutral."""
+    spec, spec_b, data_b, clean = _padded_ext_base(fam)
+    data_j, state_j = _junk_masked_cells(data_b, clean)
+    sweep = make_batched_sweep(spec_b, None, (1,))
+    key = jax.random.key(3, impl="threefry2x32")
+    out_c = jax.jit(sweep)(data_b, clean, key)
+    out_d = jax.jit(sweep)(data_j, state_j, key)
+    Ym = np.asarray(data_b.Ymask) > 0
+    np.testing.assert_array_equal(np.where(Ym, np.asarray(out_c.Z), 0.0),
+                                  np.where(Ym, np.asarray(out_d.Z), 0.0))
+    sc = slice_tenant_state(spec, out_c.replace(Z=jnp.zeros_like(out_c.Z)))
+    sd = slice_tenant_state(spec, out_d.replace(Z=jnp.zeros_like(out_d.Z)))
+    for a, b in zip(jax.tree.leaves(sc), jax.tree.leaves(sd)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_spatial_zero_padding_bit_identity_vs_unbatched():
+    """A zero-pad spatial bucket (all-1 rounding, equal shapes) is
+    bit-identical to each tenant's own sample_mcmc run — the spatial
+    batched program is the single-model program under vmap."""
+    ms = [small_model(ny=24, ns=4, nc=2, distr="normal", n_units=6,
+                      spatial="NNGP", n_neighbours=3, seed=s)
+          for s in (0, 5)]
+    seeds = [11, 22]
+    posts, rep = sample_mcmc_batched(
+        ms, samples=4, transient=3, n_chains=2, seeds=seeds,
+        bucket_rounding=R1, return_report=True)
+    assert len(rep["buckets"]) == 1 and rep["buckets"][0]["zero_padding"]
+    for m, s, pb in zip(ms, seeds, posts):
+        ps = sample_mcmc(m, samples=4, transient=3, n_chains=2, seed=s)
+        assert set(pb.arrays) == set(ps.arrays)
+        for k in ps.arrays:
+            np.testing.assert_array_equal(pb.arrays[k], ps.arrays[k],
+                                          err_msg=k)
+
+
+@pytest.mark.parametrize("spatial,kw", [
+    ("Full", {}), ("NNGP", {"n_neighbours": 3}), ("GPP", {"n_knots": 4})])
+def test_spatial_padded_bucket_stays_finite(spatial, kw):
+    """Mixed-shape spatial tenants padded into one bucket (rows, species
+    AND spatial units all pad) run finite and undiverged under each
+    per-unit precision structure."""
+    ms = [small_model(ny=13, ns=3, nc=2, distr="normal", n_units=4,
+                      spatial=spatial, seed=1, **kw),
+          small_model(ny=21, ns=5, nc=2, distr="normal", n_units=6,
+                      spatial=spatial, seed=2, **kw)]
+    posts = sample_mcmc_batched(ms, samples=3, transient=2, n_chains=1,
+                                seeds=[7, 8])
+    for p in posts:
+        assert (np.asarray(p.chain_health["first_bad_it"]) < 0).all()
+        for v in p.arrays.values():
+            assert np.isfinite(np.asarray(v)).all()
+
+
+def test_sel_rrr_batched_record_shapes():
+    """sel / RRR tenants in padded buckets: static nc keeps the traced
+    group unrolls aligned; the recorded wRRR / Beta slices keep their real
+    shapes and stay finite."""
+    m_rrr = [_ext_rrr_model(seed=s) for s in (6, 7)]
+    posts = sample_mcmc_batched(m_rrr, samples=3, transient=2, n_chains=1,
+                                seeds=[1, 2])
+    for m, p in zip(m_rrr, posts):
+        assert p["wRRR"].shape[2:] == (1, 3)
+        assert p["Beta"].shape[2:] == (3, m.ns)   # nc_nrrr + nc_rrr rows
+        assert np.isfinite(np.asarray(p["Beta"])).all()
+    m_sel = [_ext_sel_model(seed=s) for s in (6, 7)]
+    posts = sample_mcmc_batched(m_sel, samples=3, transient=2, n_chains=1,
+                                seeds=[3, 4])
+    for p in posts:
+        for v in p.arrays.values():
+            assert np.isfinite(np.asarray(v)).all()
+
+
+def test_sel_rrr_bucket_requires_equal_nc_structure():
+    """sel/RRR models never round nc: a sel model and a plain model of
+    otherwise-identical shapes must land in DIFFERENT buckets (the traced
+    selection unroll is structure, not padding)."""
+    m_sel = _ext_sel_model(seed=6)
+    spec_s, data_s, _ = _build_md(m_sel)
+    d = bucket_dims(spec_s)
+    assert d["nc"] == spec_s.nc           # exact, never rounded
+    m_base = small_model(ny=21, ns=4, nc=2, distr="probit", n_units=5,
+                         seed=6)
+    spec_b, data_b, _ = _build_md(m_base)
+    assert bucket_key(spec_s, data_s) != bucket_key(spec_b, data_b)
 
 
 # ---------------------------------------------------------------------------

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from hmsc_tpu.analysis.jaxpr_rules import _build, _canonical_models, \
-    _shard_models
+    _shard_models, _site_shard_models
 from hmsc_tpu.mcmc.precision import (PRECISION_AGREEMENT_TOL,
                                      PrecisionPolicy, default_policy,
                                      load_tolerance,
@@ -292,6 +292,51 @@ def test_sharded_policy_per_species_design_agreement():
                                     precision=pol))(
         data, state, _key(), staged)
     assert _state_dev(ref, sh) <= PRECISION_AGREEMENT_TOL
+
+
+def test_site_sharded_policy_agreement():
+    """policy'd sweep on the 2D (species x sites) mesh vs the replicated
+    f32 sweep: the staged bf16 shadows carry site dims in staged_pspecs,
+    so the shard_map body sees ny_local/np_local slices of X/Y/Pi — an
+    unsharded shadow would shape-mismatch at trace time."""
+    from jax.sharding import Mesh
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 emulated devices")
+    spec, data, state = _build(_site_shard_models()["nngp"]())
+    pol = default_policy(spec, ledger={})
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(1, 2, 2),
+                axis_names=("chains", "species", "sites"))
+    zeros = tuple(0 for _ in range(spec.nr))
+    ref = jax.jit(make_sweep(spec, None, zeros))(data, state, _key())
+    sh = jax.jit(make_sharded_sweep(spec, mesh, None, zeros,
+                                    precision=pol))(
+        data, state, _key(), stage_data(data, pol))
+    assert _state_dev(ref, sh) <= PRECISION_AGREEMENT_TOL
+
+
+def test_policy_site_shard_meta_engages(tmp_path):
+    """sample_mcmc composes precision_policy="auto" with a (1, 2, 2)
+    species x sites mesh without falling back: the checkpoint meta pins
+    both the policy and site_shards=2."""
+    from hmsc_tpu.utils.checkpoint import latest_valid_checkpoint
+    from hmsc_tpu.utils.mesh import make_mesh
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 emulated devices")
+    hM = _site_shard_models()["base"]()
+    ck = os.fspath(tmp_path / "run")
+    post = sample_mcmc(hM, samples=3, transient=2, n_chains=1, seed=2,
+                       align_post=False, precision_policy="auto",
+                       mesh=make_mesh(n_chains=1, species_shards=2,
+                                      site_shards=2),
+                       checkpoint_every=2, checkpoint_path=ck)
+    for k in post.arrays:
+        assert np.isfinite(np.asarray(post[k], float)).all(), k
+    meta = latest_valid_checkpoint(ck, hM).run_meta
+    assert meta["species_shards"] == 2
+    assert meta["site_shards"] == 2
+    assert meta["precision_policy"] is not None
 
 
 def test_policy_checkpoint_resume_roundtrip(tmp_path):

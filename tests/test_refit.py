@@ -21,6 +21,7 @@ import json
 import os
 import shutil
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -372,6 +373,7 @@ def test_serving_flip_continuity_and_zero_recompiles(parent, tmp_path):
         out = eng.reload()
         stop.set()
         t.join()
+        assert out.pop("last_flip_wall") <= time.time()
         assert out == {"old_epoch": 0, "epoch": 1, "generation": 1,
                        "n_draws": eng.n_draws, "shapes_changed": False}
         r1 = eng.predict(X)

@@ -1,0 +1,225 @@
+"""Scenario-engine suite (fleet/jobs.py scenario job types).
+
+Covers: job-file ``type`` parsing and validation, deterministic cv/waic/
+gradient expansion into bucketable tenants (with the CV fold seeds drawn
+in EXACTLY ``compute_predicted_values``'s consumption order), the seeded
+``nfolds=`` path of the serial CV itself, the queue drill — one supervised
+run batching CV folds + a waic job + a gradient grid, zero-pad CV
+bit-identical to the serial function — and the ``report --scenarios``
+comparison rendering.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from util import small_model
+
+pytestmark = pytest.mark.scenario
+
+MK = {"ny": 24, "ns": 3, "nc": 2, "n_units": 6, "nf": 2}
+R1 = {"ny": 1, "ns": 1, "nc": 1, "nt": 1, "np": 1, "nf": 1}
+RUN = {"samples": 4, "transient": 4, "thin": 1, "n_chains": 2}
+
+
+def _write(jobs_dir, docs):
+    os.makedirs(jobs_dir, exist_ok=True)
+    for i, doc in enumerate(docs):
+        with open(os.path.join(jobs_dir, f"{i}.json"), "w") as f:
+            json.dump(doc, f)
+
+
+# ---------------------------------------------------------------------------
+# scan + expand
+# ---------------------------------------------------------------------------
+
+def test_scan_parses_types_and_rejects_unknown(tmp_path):
+    from hmsc_tpu.fleet.jobs import scan_jobs
+    _write(str(tmp_path), [
+        {"name": "f", "model": MK, "seed": 1},
+        {"name": "c", "type": "cv", "nfolds": 3, "seed": 2, "model": MK},
+        {"name": "w", "type": "waic", "seed": 3, "model": MK},
+        {"name": "g", "type": "gradient", "focal": 1, "ngrid": 4,
+         "seed": 4, "model": MK}])
+    jobs = scan_jobs(str(tmp_path))
+    assert [j["type"] for j in jobs] == ["fit", "cv", "waic", "gradient"]
+    assert jobs[1]["params"] == {"nfolds": 3}
+    assert jobs[3]["params"] == {"focal": 1, "ngrid": 4}
+    _write(str(tmp_path / "bad"), [{"name": "x", "type": "bogus"}])
+    with pytest.raises(ValueError, match="unknown job type"):
+        scan_jobs(str(tmp_path / "bad"))
+
+
+def test_expand_scenarios_mirrors_cv_seed_order(tmp_path):
+    """The CV expansion consumes default_rng(job seed) in EXACTLY the
+    serial compute_predicted_values order: partition first, then per
+    sorted fold a fit seed followed by a predict seed — so the fold
+    tenants' seeds equal the serial path's draws verbatim."""
+    from hmsc_tpu.fleet.jobs import expand_scenarios
+    from hmsc_tpu.predict.cv import create_partition
+    from hmsc_tpu.testing.multiproc import build_worker_model
+
+    job = {"name": "c", "type": "cv", "seed": 13, "model": dict(MK),
+           "params": {"nfolds": 3}}
+    tenants = expand_scenarios([job])
+    assert [t["name"] for t in tenants] == ["c@cv1", "c@cv2", "c@cv3"]
+
+    rng = np.random.default_rng(13)
+    part = create_partition(build_worker_model(**MK), 3, rng=rng)
+    for t in tenants:
+        sc = t["scenario"]
+        assert sc["partition"] == [int(x) for x in part]
+        assert t["seed"] == int(rng.integers(2**31))          # fit seed
+        assert sc["predict_seed"] == int(rng.integers(2**31))
+    # deterministic: a second expansion is identical
+    assert expand_scenarios([job]) == tenants
+    # fit jobs pass through untouched (minus the type/params keys)
+    (fit,) = expand_scenarios([{"name": "f", "type": "fit", "seed": 5,
+                                "model": dict(MK), "params": {}}])
+    assert fit["name"] == "f" and "scenario" not in fit
+
+
+def test_build_tenant_model_restricts_cv_fold_rows():
+    from hmsc_tpu.fleet.jobs import build_tenant_model, expand_scenarios
+    job = {"name": "c", "type": "cv", "seed": 13, "model": dict(MK),
+           "params": {"nfolds": 2}}
+    t = expand_scenarios([job])[0]
+    hM = build_tenant_model(t)
+    part = np.asarray(t["scenario"]["partition"])
+    assert hM.ny == int((part != t["scenario"]["fold"]).sum())
+    # a plain job builds the full worker model
+    full = build_tenant_model({"name": "f", "model": dict(MK)})
+    assert full.ny == MK["ny"]
+
+
+# ---------------------------------------------------------------------------
+# the serial CV's seeded nfolds= path (the seed-plumbing satellite)
+# ---------------------------------------------------------------------------
+
+def test_cv_nfolds_seeded_end_to_end_reproducible():
+    """One seed reproduces the whole serial CV — fold vector, refits,
+    predictions — via the nfolds= path; a different seed moves it."""
+    from hmsc_tpu.mcmc.sampler import sample_mcmc
+    from hmsc_tpu.predict.cv import compute_predicted_values
+    m = small_model(ny=20, ns=3, nc=2, n_units=5, seed=1)
+    post = sample_mcmc(m, samples=3, transient=2, n_chains=1, seed=5)
+    a = compute_predicted_values(post, nfolds=2, seed=11, verbose=False)
+    b = compute_predicted_values(post, nfolds=2, seed=11, verbose=False)
+    np.testing.assert_array_equal(a, b)
+    c = compute_predicted_values(post, nfolds=2, seed=12, verbose=False)
+    assert not np.array_equal(a, c)
+
+
+# ---------------------------------------------------------------------------
+# the queue drill: cv + waic + gradient through one supervised queue
+# ---------------------------------------------------------------------------
+
+@pytest.mark.multiproc
+def test_scenario_queue_drill_and_report(tmp_path, capsys):
+    """One supervised queue run over a cv job (folds batched by the shared
+    bucket fingerprinting), a waic job and a gradient job: the zero-pad CV
+    reproduces the serial compute_predicted_values matrix bit for bit, all
+    three scenarios aggregate into summary['scenarios'] + scenario_done
+    events, and ``report --scenarios`` renders the comparison."""
+    from hmsc_tpu.fleet.config import FleetConfig
+    from hmsc_tpu.fleet.jobs import JobQueue
+    from hmsc_tpu.mcmc.sampler import sample_mcmc
+    from hmsc_tpu.obs.report import report_main
+    from hmsc_tpu.predict.cv import compute_predicted_values
+    from hmsc_tpu.testing.multiproc import build_worker_model
+
+    jobs_dir = str(tmp_path / "jobs")
+    _write(jobs_dir, [
+        {"name": "cvA", "type": "cv", "nfolds": 2, "seed": 7, "model": MK},
+        {"name": "wB", "type": "waic", "seed": 9, "model": MK},
+        {"name": "gC", "type": "gradient", "focal": 1, "ngrid": 5,
+         "seed": 11, "model": MK}])
+    ck = str(tmp_path / "ck")
+    summary = JobQueue(FleetConfig(
+        ckpt_dir=ck, work_dir=str(tmp_path / "wk"), nprocs=1,
+        jobs_dir=jobs_dir, bucket_rounding=dict(R1),
+        run_kw=dict(RUN))).run()
+    assert summary["ok"], summary
+    assert summary["n_jobs"] == 3 and summary["n_tenants"] == 4
+    by_name = {s["scenario"]: s for s in summary["scenarios"]}
+    assert by_name["cvA"]["type"] == "cv" and by_name["cvA"]["ok"]
+    assert by_name["cvA"]["folds_done"] == 2
+    assert by_name["wB"]["type"] == "waic"
+    assert np.isfinite(by_name["wB"]["waic"])
+    assert by_name["gC"]["type"] == "gradient"
+    assert np.isfinite(by_name["gC"]["pred_span"])
+
+    # zero-pad CV == the serial path, bit for bit (same job seed drives
+    # the same partition / fit-seed / predict-seed stream)
+    hM = build_worker_model(**MK)
+    post = sample_mcmc(hM, seed=123, **RUN)
+    serial = np.nanmean(
+        compute_predicted_values(post, nfolds=2, seed=7, verbose=False),
+        axis=0)
+    queue_pm = np.full_like(serial, np.nan)
+    for i, row in summary["scenario_preds"]["cvA"].items():
+        queue_pm[int(i)] = row
+    np.testing.assert_array_equal(queue_pm, serial)
+
+    # one scenario_done event per scenario job, stripped of bulk payloads
+    evs = [json.loads(l) for l in
+           open(os.path.join(ck, "fleet-events.jsonl"))]
+    done = [e for e in evs if e.get("name") == "scenario_done"]
+    assert {e["scenario"] for e in done} == {"cvA", "wB", "gC"}
+    assert all("partition" not in e and "pred_mean" not in e for e in done)
+
+    capsys.readouterr()
+    assert report_main([ck, "--scenarios"]) == 0
+    out = capsys.readouterr().out
+    assert "scenario comparison" in out
+    assert "rmse=" in out and "waic=" in out and "pred_span=" in out
+
+
+@pytest.mark.multiproc
+def test_grouped_dispatch_matches_per_bucket(tmp_path):
+    """``group_buckets=True`` (one worker process runs every bucket,
+    amortizing interpreter/JAX start-up across a sweep) produces
+    byte-identical per-tenant draws and scenario results to the default
+    one-worker-per-bucket dispatch, and stamps its dispatch events."""
+    from hmsc_tpu.fleet.config import FleetConfig
+    from hmsc_tpu.fleet.jobs import JobQueue
+
+    jobs_dir = str(tmp_path / "jobs")
+    _write(jobs_dir, [  # two shapes -> two buckets under rounding 1
+        {"name": "cvA", "type": "cv", "nfolds": 2, "seed": 7, "model": MK},
+        {"name": "wB", "type": "waic", "seed": 9,
+         "model": dict(MK, ny=28)}])
+    run = dict(samples=3, transient=2, thin=1, n_chains=1)
+
+    def _go(tag, grouped):
+        summary = JobQueue(FleetConfig(
+            ckpt_dir=str(tmp_path / tag / "ck"),
+            work_dir=str(tmp_path / tag / "wk"), nprocs=1,
+            jobs_dir=jobs_dir, bucket_rounding=dict(R1),
+            group_buckets=grouped, run_kw=dict(run))).run()
+        assert summary["ok"] and summary["n_buckets"] == 2
+        return summary
+
+    grouped, plain = _go("g", True), _go("p", False)
+
+    def _events(tag):
+        with open(os.path.join(str(tmp_path / tag / "ck"),
+                               "fleet-events.jsonl")) as f:
+            return [json.loads(l) for l in f]
+
+    def _digests(evs):
+        return {e["tenant"]: e["digest"] for e in evs
+                if e.get("name") == "tenant_done"}
+
+    gev, pev = _events("g"), _events("p")
+    assert _digests(gev) == _digests(pev)  # same draws, byte for byte
+    assert {s["scenario"]: s["rmse"] for s in grouped["scenarios"]
+            if s["type"] == "cv"} == \
+           {s["scenario"]: s["rmse"] for s in plain["scenarios"]
+            if s["type"] == "cv"}
+    dispatches = [e for e in gev if e.get("name") == "job_dispatch"]
+    assert dispatches and all(e.get("grouped") for e in dispatches)
+    assert not any(e.get("grouped")
+                   for e in pev if e.get("name") == "job_dispatch")
