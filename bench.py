@@ -391,6 +391,20 @@ def _scenarios_summary():
         ["benchmarks/bench_scenarios.py", "--digest"], timeout=1800)
 
 
+def _watch_summary():
+    """The mission-control digest (`benchmarks/bench_watch.py`): live-hub
+    tailing overhead vs an untailed 2-rank FileCoordinator run,
+    exactly-once event observation under concurrent append/rotation and
+    a job-queue drill (with tenant-stream trace linkage), and the
+    seeded-fault alert drill firing every SLO rule — CPU-only
+    subprocess.  The overhead gate is the poll thread's CPU share of
+    the tailed run's wall (deterministic, unlike the wall A/B which is
+    import-dominated on shared boxes and reported informationally), so
+    it stays on here."""
+    return _digest_subprocess(
+        ["benchmarks/bench_watch.py", "--reps", "2"], timeout=1800)
+
+
 def _skip(reason: str):
     """Emit a parseable skip record instead of a bare nonzero exit: the
     bench trajectory must distinguish "chip unreachable this round" from "a
@@ -421,6 +435,7 @@ def _skip(reason: str):
         "refit": _refit_summary(),
         "autopilot": _autopilot_summary(),
         "scenarios": _scenarios_summary(),
+        "watch": _watch_summary(),
     }))
     raise SystemExit(0)
 
@@ -614,6 +629,12 @@ def main():
         # gates (benchmarks/bench_scenarios.py) — the batch-analysis path
         # rides the trajectory alongside fitting throughput
         "scenarios": _scenarios_summary(),
+        # mission-control digest (CPU subprocess): live-hub tailing
+        # overhead, exactly-once event observation under rotation + a
+        # job-queue drill, and the seeded-fault SLO alert drill
+        # (benchmarks/bench_watch.py) — observability health rides the
+        # trajectory alongside the paths it watches
+        "watch": _watch_summary(),
     }))
 
 

@@ -1,0 +1,372 @@
+"""Mission-control hub bench (ISSUE 20): the live-tailing overhead,
+exactly-once, and alert-drill gates for ``python -m hmsc_tpu watch``.
+
+Gates (all CPU-only, no accelerator needed):
+
+1. **Driver overhead < 2%** — a live 2-rank FileCoordinator run is
+   tailed mid-flight by a :class:`~hmsc_tpu.obs.hub.MetricsHub` polling
+   from another process (the bench's tail thread).  The gated quantity
+   is the hub's measured CPU share of the live run's wall
+   (``thread_time`` of the poll loop / driver wall): the hub touches
+   the run ONLY through filesystem reads, so its CPU+IO appetite is
+   exactly the contention it can impose on the driver — and unlike a
+   wall-clock A/B it resolves well under 2% on a shared box.  The
+   untailed-vs-tailed wall A/B (best-of-``--reps``, arms interleaved,
+   one untimed warm-up priming the shared XLA compile cache) is
+   recorded alongside as ``ab_overhead_pct`` — informational, since
+   ±5% run-to-run wall noise on a ~15 s import-dominated drill cannot
+   resolve a 2% budget (same shared-box reasoning as the chaos bench's
+   standalone-only throughput gate).
+
+2. **Exactly-once observation** — every committed event is observed
+   exactly once: (a) id-level, a concurrent writer appending with torn
+   mid-line flushes AND a mid-stream rotation (``os.replace`` + fresh
+   file at the same path) while a :class:`JsonlTailer` polls; (b)
+   count-level across the live 2-rank run and a job-queue drill (two
+   tenants through ``fleet.jobs.JobQueue`` with per-tenant event
+   fan-out): the hub's ``events_seen`` equals the ground-truth committed
+   line count under the watch root, with zero malformed.  The job-queue
+   drill also gates trace linkage: the tenant streams' ``trace`` id must
+   equal the queue's own root trace (the CV-fold/job join).
+
+3. **Alert drill** — a seeded fault plan (stale heartbeat, stalled live
+   stream, tenant divergence, cross-rank skew, serving queue-wait p99,
+   epoch lag across replicas, bucket padding waste) is laid out as
+   synthetic streams under a watch root; one ``check_alerts`` pass must
+   fire every one of the seven ``KNOWN_RULES`` as ``kind="alert"``
+   events into ``alerts.jsonl``, each exactly once (latching).
+
+Prints one JSON digest line on stdout (bench.py embeds it in headline
+and skip records); exit status is the gate verdict.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+MODEL_KW = dict(ny=24, ns=3, nc=2, distr="probit", n_units=6, seed=3)
+RUN_KW = dict(samples=8, transient=4, thin=1, n_chains=4, seed=11,
+              verbose=0, checkpoint_every=4)
+
+
+def _log(msg):
+    print(f"bench_watch: {msg}", file=sys.stderr, flush=True)
+
+
+def _count_committed(root):
+    """Ground truth: complete (newline-terminated) lines in every stream
+    the hub tails under ``root``."""
+    from hmsc_tpu.obs import ALERTS_FILE
+    from hmsc_tpu.obs.events import EVENTS_FILE_RE
+    n = 0
+    for dirpath, _dirs, files in os.walk(root):
+        for fn in files:
+            if not (fn == "fleet-events.jsonl" or fn == ALERTS_FILE
+                    or EVENTS_FILE_RE.fullmatch(fn)):
+                continue
+            with open(os.path.join(dirpath, fn), "rb") as f:
+                data = f.read()
+            n += sum(1 for ln in data.split(b"\n")[:-1] if ln.strip())
+    return n
+
+
+def _tail_while(root, fn, interval_s=0.2):
+    """Run ``fn()`` while a hub polls ``root`` from a daemon thread;
+    returns (fn wall seconds, hub CPU seconds, hub) with the hub fully
+    drained.  ``hub CPU`` is the poll thread's ``time.thread_time()`` —
+    the compute+IO the tail actually consumed while the run was live."""
+    from hmsc_tpu.obs import MetricsHub
+    hub = MetricsHub(root, evaluate_alerts=False)
+    stop = threading.Event()
+    cpu = {"s": 0.0}
+
+    def pump():
+        while not stop.is_set():
+            hub.poll()
+            cpu["s"] = time.thread_time()
+            stop.wait(interval_s)
+
+    th = threading.Thread(target=pump, daemon=True)
+    th.start()
+    t0 = time.monotonic()
+    fn()
+    wall = time.monotonic() - t0
+    stop.set()
+    th.join(timeout=30)
+    hub.poll()                        # drain the committed tail
+    return wall, cpu["s"], hub
+
+
+def _two_rank_run(td, tag):
+    from hmsc_tpu.testing.multiproc import spawn_workers
+    ck = os.path.join(td, f"ck-{tag}")
+
+    def run():
+        recs = spawn_workers(2, ckpt_dir=ck,
+                             coord_dir=os.path.join(td, f"coord-{tag}"),
+                             model_kw=MODEL_KW, run_kw=dict(RUN_KW),
+                             timeout_s=300, wall_timeout_s=560)
+        bad = [r for r in recs if r["returncode"] != 0]
+        if bad:
+            raise RuntimeError(
+                f"2-rank run failed: rc={bad[0]['returncode']}\n"
+                + bad[0]["stderr"][-2000:])
+    return ck, run
+
+
+def overhead_drill(td, reps):
+    """Gate 1 + count-level gate 2a: best-of-reps walls, tailed vs not."""
+    _log("warm-up 2-rank run (primes the shared compile cache, untimed)")
+    _, warm = _two_rank_run(td, "warm")
+    warm()
+    base = hub_wall = float("inf")
+    hub_cpu_pct = 0.0
+    observed = committed = malformed = 0
+    # arms interleaved (base, tailed, base, tailed, ...): load drifting
+    # over the minutes-long drill hits both best-of windows equally
+    for r in range(reps):
+        _log(f"baseline rep {r + 1}/{reps}")
+        _, run = _two_rank_run(td, f"base{r}")
+        t0 = time.monotonic()
+        run()
+        base = min(base, time.monotonic() - t0)
+        _log(f"tailed rep {r + 1}/{reps}")
+        ck, run = _two_rank_run(td, f"hub{r}")
+        wall, cpu_s, hub = _tail_while(ck, run)
+        hub_wall = min(hub_wall, wall)
+        hub_cpu_pct = max(hub_cpu_pct, 100.0 * cpu_s / wall)
+        observed, malformed = hub.events_seen, hub.malformed
+        committed = _count_committed(ck)
+        hub.close()
+    ab_pct = 100.0 * (hub_wall - base) / base
+    return {"base_wall_s": round(base, 3),
+            "hub_wall_s": round(hub_wall, 3),
+            # the gated metric: the tail's CPU share of the live wall
+            "hub_cpu_pct": round(hub_cpu_pct, 3),
+            # informational: wall A/B, noise-dominated on shared boxes
+            "ab_overhead_pct": round(ab_pct, 2),
+            "events_committed": committed,
+            "events_observed": observed,
+            "malformed": malformed}
+
+
+def rotation_drill(td, n=300):
+    """Gate 2b (id-level): concurrent writer with torn mid-line flushes
+    and one mid-stream rotation; every event observed exactly once."""
+    from hmsc_tpu.obs import JsonlTailer
+    p = os.path.join(td, "rotating.jsonl")
+    open(p, "w").close()
+    done = threading.Event()
+
+    def writer():
+        f = open(p, "a")
+        for i in range(n):
+            if i == n // 2:           # GC-style rotation at half-stream
+                f.close()
+                os.replace(p, p + ".old")
+                f = open(p, "a")
+            line = json.dumps({"i": i}) + "\n"
+            cut = (i % 9) + 1
+            f.write(line[:cut])
+            f.flush()
+            f.write(line[cut:])
+            f.flush()
+            if i % 16 == 0:
+                time.sleep(0.001)
+        f.close()
+        done.set()
+
+    th = threading.Thread(target=writer)
+    th.start()
+    tl = JsonlTailer(p)
+    seen = []
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        seen += [e["i"] for e in tl.poll()]
+        if done.is_set() and len(seen) >= n:
+            break
+        time.sleep(0.001)
+    th.join()
+    seen += [e["i"] for e in tl.poll()]
+    tl.close()
+    ok = seen == list(range(n)) and tl.n_malformed == 0
+    return {"n": n, "observed": len(seen),
+            "duplicates": len(seen) - len(set(seen)),
+            "exactly_once": ok}
+
+
+def jobqueue_drill(td):
+    """Gate 2c: a two-tenant job-queue run tailed live — count-level
+    exactly-once plus the tenant-stream trace linkage."""
+    from hmsc_tpu.fleet.config import FleetConfig
+    from hmsc_tpu.fleet.jobs import JobQueue
+    jobs_dir = os.path.join(td, "jobs")
+    os.makedirs(jobs_dir)
+    for i, (ny, ns) in enumerate([(20, 3), (24, 4)]):
+        with open(os.path.join(jobs_dir, f"job-{i}.json"), "w") as f:
+            json.dump({"name": f"r{i}",
+                       "model": {"ny": ny, "ns": ns, "nc": 2,
+                                 "n_units": 5, "seed": i},
+                       "seed": 100 + i}, f)
+    ck = os.path.join(td, "jq-ck")
+    q = JobQueue(FleetConfig(
+        ckpt_dir=ck, work_dir=os.path.join(td, "jq-work"), nprocs=1,
+        jobs_dir=jobs_dir,
+        run_kw={"samples": 8, "n_chains": 2, "checkpoint_every": 4,
+                "transient": 4}))
+    summary = {}
+
+    def run():
+        summary.update(q.run())
+
+    wall, cpu_s, hub = _tail_while(ck, run)
+    committed = _count_committed(ck)
+    # tenant fan-out streams must link back to the queue's root trace
+    chain = hub.traces().get(q.trace.trace_id, [])
+    tenant_streams = {e["stream"] for e in chain
+                      if any(part.startswith("tenant-")
+                             for part in e["stream"].split(os.sep))}
+    rec = {"ok": bool(summary.get("ok")),
+           "tenants_done": summary.get("tenants_done"),
+           "hub_cpu_pct": round(100.0 * cpu_s / max(wall, 1e-9), 3),
+           "events_committed": committed,
+           "events_observed": hub.events_seen,
+           "malformed": hub.malformed,
+           "tenant_streams_in_trace": sorted(tenant_streams),
+           "tenant_trace_linked": len(tenant_streams) >= 2}
+    hub.close()
+    return rec
+
+
+def alert_drill(td):
+    """Gate 3: seed all seven rule faults under one watch root; every
+    rule fires as a kind="alert" event, each exactly once."""
+    from hmsc_tpu.obs import ALERTS_FILE, MetricsHub, RunTelemetry
+    from hmsc_tpu.obs.alerts import KNOWN_RULES
+    root = os.path.join(td, "alert-root")
+    os.makedirs(os.path.join(root, "tenant-acme"))
+    os.makedirs(os.path.join(root, "hb"))
+    now = time.time()
+
+    def w(path, *events):
+        with open(os.path.join(root, path), "a") as f:
+            for ev in events:
+                f.write(json.dumps(ev) + "\n")
+
+    # throughput_stall: a live stream silent for minutes; rank_skew +
+    # queue_wait_p99 ride the same rank stream
+    w("events-p0.jsonl",
+      {"kind": "run", "name": "start", "proc": 0, "wall": now - 600.0,
+       "n_chains": 4},
+      {"kind": "metric", "name": "segment_health", "wall": now - 600.0,
+       "samples_done": 4, "draws_per_s": 50.0, "diverged_chains": 0},
+      {"kind": "metric", "name": "rank_skew", "skew_s": 9.0},
+      {"kind": "span", "name": "queue_wait", "dur_s": 8.0})
+    # divergence_rate: a tenant with every chain diverged
+    w(os.path.join("tenant-acme", "events-p0.jsonl"),
+      {"kind": "run", "name": "start", "tenant": "acme", "n_chains": 2},
+      {"kind": "metric", "name": "tenant_health", "tenant": "acme",
+       "diverged": 2, "n_chains": 2})
+    # epoch_lag: serving replicas disagree; padding_waste: queue aggregate
+    w("fleet-events.jsonl",
+      {"kind": "fleet", "name": "replica_stats", "rank": 0,
+       "generation": 3, "epoch": 2},
+      {"kind": "fleet", "name": "replica_stats", "rank": 1,
+       "generation": 1, "epoch": 1},
+      {"kind": "fleet", "name": "queue_start", "n_jobs": 2,
+       "n_tenants": 2, "n_buckets": 1},
+      {"kind": "fleet", "name": "queue_end", "occupancy": 0.5,
+       "padding_waste": 0.9})
+    # heartbeat_gap: a beat file whose mtime is a minute stale
+    hb = os.path.join(root, "hb", "heartbeat-p0.json")
+    with open(hb, "w") as f:
+        f.write('{"beat": 1}')
+    os.utime(hb, (now - 60.0, now - 60.0))
+
+    telem = RunTelemetry(proc=0)
+    telem.attach_sink(os.path.join(root, ALERTS_FILE))
+    hub = MetricsHub(root, alert_telemetry=telem)
+    hub.poll()
+    fired = hub.check_alerts()
+    refire = hub.check_alerts()       # latched: nothing re-fires
+    with open(os.path.join(root, ALERTS_FILE)) as f:
+        events = [json.loads(ln) for ln in f if ln.strip()]
+    hub.close()
+    fired_rules = sorted({a["rule"] for a in fired})
+    return {"seeded": sorted(KNOWN_RULES),
+            "fired": fired_rules,
+            "alert_events": len(events),
+            "all_kind_alert": all(e.get("kind") == "alert"
+                                  for e in events),
+            "latched": not refire,
+            "ok": (fired_rules == sorted(KNOWN_RULES)
+                   and len(events) == len(fired) and not refire)}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--reps", type=int, default=3,
+                    help="timed reps per overhead arm (best-of, "
+                         "interleaved)")
+    ap.add_argument("--overhead-budget-pct", type=float, default=2.0)
+    ap.add_argument("--no-overhead-gate", action="store_true",
+                    help="record overhead informationally (shared CI "
+                         "boxes: wall noise can exceed the budget)")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the scratch directory")
+    args = ap.parse_args(argv)
+
+    td = tempfile.mkdtemp(prefix="bench_watch_")
+    try:
+        alerts = alert_drill(td)
+        _log(f"alert drill: fired {len(alerts['fired'])}/7")
+        rotation = rotation_drill(td)
+        _log(f"rotation drill: {rotation['observed']} observed, "
+             f"exactly_once={rotation['exactly_once']}")
+        jq = jobqueue_drill(td)
+        _log(f"job-queue drill: {jq['events_observed']} observed / "
+             f"{jq['events_committed']} committed")
+        ov = overhead_drill(td, max(1, args.reps))
+        _log(f"overhead: hub cpu {ov['hub_cpu_pct']}% of live wall "
+             f"(wall A/B {ov['ab_overhead_pct']}%, base "
+             f"{ov['base_wall_s']}s, tailed {ov['hub_wall_s']}s)")
+
+        worst_cpu_pct = max(ov["hub_cpu_pct"], jq["hub_cpu_pct"])
+        gates = {
+            "overhead": (args.no_overhead_gate
+                         or worst_cpu_pct < args.overhead_budget_pct),
+            "exactly_once_live": (ov["events_observed"]
+                                  == ov["events_committed"]
+                                  and ov["malformed"] == 0),
+            "exactly_once_rotation": rotation["exactly_once"],
+            "exactly_once_jobqueue": (jq["ok"]
+                                      and jq["events_observed"]
+                                      == jq["events_committed"]
+                                      and jq["malformed"] == 0),
+            "tenant_trace_linked": jq["tenant_trace_linked"],
+            "alert_drill": alerts["ok"],
+        }
+        rec = {"overhead": ov, "rotation": rotation, "jobqueue": jq,
+               "alerts": alerts, "gates": gates,
+               "gates_ok": all(gates.values())}
+        print(json.dumps(rec))
+        return 0 if rec["gates_ok"] else 1
+    finally:
+        if not args.keep:
+            shutil.rmtree(td, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

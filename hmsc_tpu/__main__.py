@@ -5,7 +5,10 @@ Subcommands: ``bench`` (default; the throughput probe, same entry as the
 long-run driver with ``--resume``), ``report`` (render a run's
 telemetry — phase timeline, throughput, cross-rank skew, checkpoint I/O
 and MCMC health — from its ``events-p<rank>.jsonl`` streams; ``--prom``
-exports Prometheus textfile gauges), ``lint`` (the static correctness
+exports Prometheus textfile gauges), ``watch`` (the LIVE counterpart of
+``report``: tail every event stream under a watch root into one
+fleet-wide view with SLO alert rules — see README "Observability"),
+``lint`` (the static correctness
 suite: AST lint + jaxpr audits, see ``ANALYSIS.md``; exit 1 on any active
 severity=error finding), ``profile`` (sweep-level cost attribution: the
 static per-updater flops/HBM ledger with its committed diffable digest,
@@ -38,6 +41,9 @@ def main(argv=None):
     if argv[:1] == ["report"]:
         from .obs.report import report_main
         return report_main(argv[1:])
+    if argv[:1] == ["watch"]:
+        from .obs.hub import watch_main
+        return watch_main(argv[1:])
     if argv[:1] == ["lint"]:
         from .analysis.cli import lint_main
         return lint_main(argv[1:])

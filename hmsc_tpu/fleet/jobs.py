@@ -453,9 +453,15 @@ class JobQueue:
 
     def __init__(self, cfg, *, jobs_dir: str | None = None):
         from ..obs import RunTelemetry
+        from ..obs.trace import inherit_or_mint
         self.cfg = cfg
         self.jobs_dir = os.fspath(jobs_dir or cfg.jobs_dir)
         self.telem = RunTelemetry(proc=0)
+        # a queue run is a top-level entry point: every dispatch and every
+        # bucket worker's event stream (incl. per-tenant scenario folds)
+        # links back to this trace
+        self.trace = inherit_or_mint()
+        self.telem.set_trace(self.trace)
         self.attempt_log: list = []
         self._t0 = time.monotonic()
 
@@ -483,12 +489,16 @@ class JobQueue:
         log_path = os.path.join(cfg.work_dir,
                                 f"job-{bkey}-{attempt:03d}.log")
         logf = open(log_path, "w")
-        p = subprocess.Popen(cmd, cwd=_pkg_root(), env=worker_env(),
+        # per-dispatch child span: the worker inherits it via the env, so
+        # the bucket's sampler stream parents under THIS dispatch event
+        ctx = self.trace.child()
+        p = subprocess.Popen(cmd, cwd=_pkg_root(), env=worker_env(trace=ctx),
                              stdout=logf, stderr=subprocess.STDOUT)
         logf.close()
         self._emit("job_dispatch", bucket=bkey, attempt=attempt, pid=p.pid,
                    action=action, n_tenants=len(jobs),
-                   tenants=[j["name"] for j in jobs])
+                   tenants=[j["name"] for j in jobs],
+                   span=ctx.span_id, parent=self.trace.span_id)
         return p, out, log_path
 
     def _run_bucket_supervised(self, bkey: str, jobs: list,
@@ -609,8 +619,12 @@ class JobQueue:
                 cmd += ["--rounding", json.dumps(cfg.bucket_rounding)]
             log_path = os.path.join(cfg.work_dir,
                                     f"job-grouped-{attempt:03d}.log")
+            # one child span for the whole grouped attempt: every bucket in
+            # the sweep shares the worker process, so they share its span
+            ctx = self.trace.child()
             with open(log_path, "w") as logf:
-                p = subprocess.Popen(cmd, cwd=_pkg_root(), env=worker_env(),
+                p = subprocess.Popen(cmd, cwd=_pkg_root(),
+                                     env=worker_env(trace=ctx),
                                      stdout=logf,
                                      stderr=subprocess.STDOUT)
             for spec in specs:
@@ -618,7 +632,8 @@ class JobQueue:
                            attempt=attempt, pid=p.pid,
                            action=spec["action"], grouped=True,
                            n_tenants=len(spec["jobs"]),
-                           tenants=[j["name"] for j in spec["jobs"]])
+                           tenants=[j["name"] for j in spec["jobs"]],
+                           span=ctx.span_id, parent=self.trace.span_id)
             try:
                 rc = p.wait(timeout=cfg.wall_timeout_s * len(specs))
             except subprocess.TimeoutExpired:

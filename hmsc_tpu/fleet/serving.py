@@ -165,8 +165,13 @@ class ServingFleet:
 
     def __init__(self, config: ServeFleetConfig):
         from ..obs import RunTelemetry
+        from ..obs.trace import inherit_or_mint
         self.cfg = config
         self.telem = RunTelemetry(proc=0)
+        # a serving fleet is a top-level entry point: replica lifecycles
+        # and fleet-wide flips all link back to this trace
+        self.trace = inherit_or_mint()
+        self.telem.set_trace(self.trace)
         self.slots = [_Replica(r) for r in range(int(config.replicas))]
         self._lock = threading.Lock()
         self._flip_lock = threading.Lock()
@@ -224,6 +229,10 @@ class ServingFleet:
         env = dict(os.environ)
         env["PYTHONPATH"] = (pkg_parent + os.pathsep + env["PYTHONPATH"]
                              if env.get("PYTHONPATH") else pkg_parent)
+        # the replica joins the fleet trace: its per-request spans (and
+        # the first query on a freshly flipped epoch) link back here
+        from ..obs.trace import trace_env
+        env.update(trace_env(self.trace))
         slot.proc = subprocess.Popen(cmd, stdout=logf,
                                      stderr=subprocess.STDOUT, env=env)
         logf.close()                  # the child holds its own descriptor
@@ -457,10 +466,14 @@ class ServingFleet:
                     except ValueError:
                         self._send(400, {"error": "invalid JSON"})
                         return
+                    from ..obs.trace import from_header
+                    tctx = from_header(
+                        self.headers.get("X-Hmsc-Trace") or "")
                     try:
                         self._send(200, fleet.flip(
                             source=doc.get("source"),
-                            warmup=bool(doc.get("warmup", True))))
+                            warmup=bool(doc.get("warmup", True)),
+                            trace=tctx))
                     except Exception as e:  # noqa: BLE001 — a failed flip
                         # answers 500; the fleet keeps serving the old epoch
                         self._send(500,
@@ -526,12 +539,16 @@ class ServingFleet:
                 "replicas": reps, "fleet": True}
 
     def stats(self) -> dict:
-        """Front-end counters + each live replica's engine stats."""
+        """Front-end counters + each live replica's engine stats (plus
+        its heartbeat age, so /statz shows staleness per replica)."""
         with self._lock:
             counts = {"proxied": self._n_proxied,
                       "retried": self._n_retried,
                       "rejected": self._n_rejected}
         import urllib.request
+
+        from ..utils.coordination import read_heartbeats
+        beats = read_heartbeats(self._hb_dir)
         reps = {}
         for slot in self.slots:
             if slot.state != "live":
@@ -539,14 +556,18 @@ class ServingFleet:
             try:
                 with urllib.request.urlopen(self._url(slot) + "/statz",
                                             timeout=2.0) as r:
-                    reps[str(slot.rank)] = json.loads(r.read().decode())
+                    st = json.loads(r.read().decode())
             except Exception:         # noqa: BLE001 — stats best-effort
-                pass
+                continue
+            hb = beats.get(slot.rank)
+            st["last_beat_age_s"] = (None if hb is None
+                                     else round(hb["age_s"], 3))
+            reps[str(slot.rank)] = st
         return {"fleet": counts, "replicas": reps}
 
     # -- fleet-wide flip ---------------------------------------------------
 
-    def flip(self, source=None, warmup: bool = True) -> dict:
+    def flip(self, source=None, warmup: bool = True, trace=None) -> dict:
         """Rolling, generation-checked epoch flip across the fleet.
 
         Calls ``reload()`` on every rotation member in turn; each
@@ -554,12 +575,18 @@ class ServingFleet:
         one (anything else is a coordination error).  The flip is
         acknowledged only when EVERY replica — including any that died
         and restarted mid-flip — reports the target epoch from
-        ``/healthz``.  Returns the per-replica outcome summary."""
+        ``/healthz``.  Returns the per-replica outcome summary.
+
+        ``trace`` (a :class:`~hmsc_tpu.obs.trace.TraceContext`, e.g.
+        parsed from the front end's ``X-Hmsc-Trace`` header) joins the
+        flip events to the caller's trace — an autopilot rollout's flip
+        lands in the SAME trace as the refit that produced the epoch."""
         import urllib.request
         cfg = self.cfg
+        tf = trace.fields() if trace is not None else {}
         with self._flip_lock:         # one fleet-wide flip at a time
             t0 = time.monotonic()
-            self._emit("flip_start", source=source)
+            self._emit("flip_start", source=source, **tf)
             target_epoch = None
             outcomes = {}
             for slot in list(self.slots):
@@ -572,10 +599,13 @@ class ServingFleet:
                     {"source": source, "warmup": warmup}
                     if source is not None else
                     {"warmup": warmup}).encode()
+                hdrs = {"Content-Type": "application/json"}
+                if trace is not None:
+                    hdrs["X-Hmsc-Trace"] = trace.header()
                 try:
                     req = urllib.request.Request(
                         self._url(slot) + "/flip", data=payload,
-                        headers={"Content-Type": "application/json"})
+                        headers=hdrs)
                     with urllib.request.urlopen(
                             req, timeout=cfg.flip_timeout_s) as r:
                         res = json.loads(r.read().decode())
@@ -584,7 +614,7 @@ class ServingFleet:
                     # on the NEW epoch; the ack phase below waits for it
                     outcomes[slot.rank] = f"died ({type(e).__name__})"
                     self._emit("flip_replica", rank=slot.rank, ok=False,
-                               error=type(e).__name__)
+                               error=type(e).__name__, **tf)
                     continue
                 gen = res.get("generation")
                 if pre_gen is not None and gen != pre_gen + 1:
@@ -603,7 +633,7 @@ class ServingFleet:
                 outcomes[slot.rank] = "flipped"
                 self._emit("flip_replica", rank=slot.rank, ok=True,
                            generation=gen, epoch=res.get("epoch"),
-                           shapes_changed=res.get("shapes_changed"))
+                           shapes_changed=res.get("shapes_changed"), **tf)
             # ack phase: every slot that is (or comes back) live must
             # serve the target epoch before the flip is acknowledged
             deadline = time.monotonic() + cfg.flip_timeout_s
@@ -626,7 +656,7 @@ class ServingFleet:
             self._emit("flip_done", ok=ok, epoch=target_epoch,
                        outcomes={str(k): v for k, v in outcomes.items()},
                        pending=sorted(pending),
-                       wall_s=round(time.monotonic() - t0, 3))
+                       wall_s=round(time.monotonic() - t0, 3), **tf)
             if not ok:
                 raise TimeoutError(
                     f"fleet flip not acknowledged: replicas {sorted(pending)} "

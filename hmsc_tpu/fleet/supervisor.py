@@ -82,9 +82,15 @@ class FleetSupervisor:
 
     def __init__(self, config, *, chaos=None):
         from ..obs import RunTelemetry
+        from ..obs.trace import inherit_or_mint
         self.cfg = config
         self.chaos = chaos
         self.telem = RunTelemetry(proc=0)
+        # a fleet run is a top-level entry point: every fleet event and
+        # every spawned rank's stream joins this trace (obs.trace)
+        self.trace = inherit_or_mint()
+        self.telem.set_trace(self.trace)
+        self.hub = None               # in-process MetricsHub (run() attaches)
         self.attempt_log: list = []
         self._t0 = time.monotonic()
 
@@ -121,7 +127,10 @@ class FleetSupervisor:
         # worker output goes to a file, not a pipe: a full pipe would wedge
         # a healthy worker mid-run while its heartbeat keeps beating
         logf = open(log_path, "w")
-        p = subprocess.Popen(cmd, cwd=_pkg_root(), env=worker_env(),
+        # each rank inherits the fleet trace as its parent span — the
+        # rank's events-p<r>.jsonl stream joins the fleet timeline
+        p = subprocess.Popen(cmd, cwd=_pkg_root(),
+                             env=worker_env(trace=self.trace),
                              stdout=logf, stderr=subprocess.STDOUT)
         logf.close()                  # the child holds its own descriptor
         self._emit("spawn", attempt=attempt, rank=rank, pid=p.pid,
@@ -202,6 +211,10 @@ class FleetSupervisor:
                            elapsed_s=round(elapsed, 1))
                 for p in procs.values():
                     p.kill()
+            if self.hub is not None:
+                # live SLO check each liveness tick: alerts land in this
+                # stream (kind="alert") next to the decisions they motivate
+                self.hub.pump()
             time.sleep(cfg.poll_s)
         rec = {"attempt": attempt, "nprocs": nprocs, "action": action,
                "exits": exits, "hb_killed": hb_killed,
@@ -217,6 +230,10 @@ class FleetSupervisor:
         os.makedirs(cfg.ckpt_dir, exist_ok=True)
         self.telem.attach_sink(fleet_events_path(cfg.ckpt_dir),
                                truncate=True)
+        # in-process metrics hub over the run directory: the supervisor
+        # evaluates the SLO rules against its own fleet while it runs
+        from ..obs.hub import MetricsHub
+        self.hub = MetricsHub(cfg.ckpt_dir, alert_telemetry=self.telem)
         ladder = cfg.ladder()
         nprocs = ladder[0]
         budgets = {r: int(cfg.restart_budget) for r in range(ladder[0])}

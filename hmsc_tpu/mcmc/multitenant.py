@@ -986,8 +986,13 @@ def _run_bucket(bkey, tenants, *, samples, transient, thin, n_chains,
         datas = [pad_tenant(t.spec, t.data, dims0) for t in tenants]
         waste = _occupancy(tenants, dims0)["padding_waste"]
         if waste > 0.5:
+            # the dedup key carries the run + tenant identity, not just the
+            # bucket fingerprint: two runs (or two tenant groups) sharing a
+            # bucket shape in one process must EACH get their warning
+            run_id = os.fspath(checkpoint_path) if checkpoint_path else "-"
+            members = ",".join(sorted(t.name for t in tenants))
             log.warn_once(
-                f"pad-waste:{bkey}",
+                f"pad-waste:{run_id}:{bkey}:{members}",
                 f"shape bucket {bkey}: padding waste {waste:.0%} of batched "
                 f"cells ({K} tenants padded to ny={dims0['ny']}, "
                 f"ns={dims0['ns']}) — tighten bucket_rounding or regroup "
@@ -1078,6 +1083,27 @@ def _run_bucket(bkey, tenants, *, samples, transient, thin, n_chains,
                 base_post=t.base_post, base_samples=t.base_samples,
                 shards=t.shards, keep=int(checkpoint_keep),
                 keys_impl=rng_impl)
+
+    # per-tenant event streams (tenant-<name>/events-p0.jsonl, next to the
+    # manifests): one run/start + end-of-bucket health record per tenant,
+    # joined to the dispatching queue's trace via the env so the metrics
+    # hub links a scenario fold back to the job that spawned it
+    tenant_telems: dict = {}
+    if ck_every:
+        from ..obs import RunTelemetry, events_path
+        from ..obs.trace import inherit_or_mint
+        tctx = inherit_or_mint()
+        for t in tenants:
+            tt = RunTelemetry(proc=0)
+            tt.set_trace(tctx)
+            tt.attach_sink(
+                events_path(tenant_dir(checkpoint_path, t.name), 0),
+                truncate=(t.base_samples == 0))
+            tt.emit("run", "start", tenant=t.name, bucket=bkey,
+                    n_chains=int(n_chains), samples=int(samples),
+                    zero_padding=bool(zero_pad))
+            tt.flush()
+            tenant_telems[t.name] = tt
 
     writer = _SegmentWriter(2) if pipeline else _InlineWriter()
     host_segs: list = []              # fetched (K, C, S, ...) record trees
@@ -1202,6 +1228,17 @@ def _run_bucket(bkey, tenants, *, samples, transient, thin, n_chains,
                      f"{int(fb[c])}); its draws are excluded from pooled "
                      "summaries")
         t.post = post
+        tt = tenant_telems.get(t.name)
+        if tt is not None:
+            ndiv = int((fb >= 0).sum())
+            tt.emit("metric", "tenant_health", tenant=t.name, bucket=bkey,
+                    diverged=ndiv, n_chains=int(n_chains),
+                    samples_done=int(t.base_samples) + int(samples),
+                    draws_per_s=round(int(samples) * int(n_chains)
+                                      / max(wall, 1e-9), 3),
+                    done=True)
+            tt.emit("run", "end", tenant=t.name, ok=ndiv == 0)
+            tt.flush()
         if retry_diverged > 0 and (fb >= 0).any():
             st_k = jax.tree.map(
                 lambda x: x[k] if isinstance(x, jax.Array) else x, state_b)

@@ -1573,6 +1573,14 @@ def sample_mcmc(hM: Hmsc, samples: int, transient: int = 0, thin: int = 1,
             # runs have no barrier protecting a non-checkpoint dir)
             _sweep_stale_events(tel_dir)
         telem.attach_sink(events_path(tel_dir, proc), truncate=fresh)
+    # cross-process trace correlation (obs.trace, event-schema v2): a
+    # sample_mcmc invocation is a top-level entry point — join the
+    # spawning parent's trace when HMSC_TPU_TRACE_CTX carries one (fleet
+    # worker, refit worker, job-queue bucket), otherwise mint a root.
+    # Host-side entropy only; the draw stream never sees it.
+    if telemetry is not False:
+        from ..obs.trace import inherit_or_mint
+        telem.set_trace(inherit_or_mint())
     telem.emit("run", "start", schema=SCHEMA_VERSION,
                samples=int(samples), transient=int(transient),
                thin=int(thin), n_chains=int(n_chains),

@@ -28,9 +28,17 @@ from .events import (RunTelemetry, SCHEMA_VERSION, compact_summary,
                      events_path)
 from .log import RunLogger, get_logger
 from .health import RunningDiagnostics, rhat_ess
+from .trace import (TraceContext, TRACE_ENV, current_context,
+                    inherit_or_mint, trace_env)
+from .alerts import AlertEngine, AlertRule, default_rules, load_rules
+from .hub import ALERTS_FILE, JsonlTailer, MetricsHub
 
 __all__ = [
     "RunTelemetry", "SCHEMA_VERSION", "compact_summary", "events_path",
     "RunLogger", "get_logger",
     "RunningDiagnostics", "rhat_ess",
+    "TraceContext", "TRACE_ENV", "current_context", "inherit_or_mint",
+    "trace_env",
+    "AlertEngine", "AlertRule", "default_rules", "load_rules",
+    "ALERTS_FILE", "JsonlTailer", "MetricsHub",
 ]

@@ -1,0 +1,232 @@
+"""Declarative SLO/alert rules evaluated by the metrics hub.
+
+A rule is a JSON-loadable threshold on one fleet-level aggregate the
+:class:`~hmsc_tpu.obs.hub.MetricsHub` maintains — the quantities that,
+historically, each required a human reading ``report`` *after* the run
+died: a rank that stopped heartbeating, a stream whose throughput stalled,
+a tenant whose chains are diverging, cross-rank skew accumulating into
+gather stalls, serving queue waits, a replica serving a stale epoch after
+a flip, a bucket burning half its cells on padding.
+
+The engine is edge-triggered with per-``(rule, subject)`` latching: an
+alert fires ONCE when its condition first becomes true for a subject and
+re-arms only after the condition clears — a stalled rank does not emit one
+alert per hub poll.  Fired alerts become ``kind="alert"`` events in the
+hub's alert stream (and, when a supervisor/autopilot attaches the hub
+in-process, in that daemon's own decision log), so the ``report`` CLI
+renders them on the same timeline as the decisions they motivated.
+
+Rule config is a JSON list of objects: ``{"rule": <name>, "threshold":
+<number>, "severity": "info"|"warn"|"page", "enabled": true}``.  Unknown
+rule names are rejected up front (a typo'd config must not silently
+monitor nothing).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+__all__ = ["AlertRule", "AlertEngine", "KNOWN_RULES", "default_rules",
+           "load_rules"]
+
+# rule name -> (default threshold, unit, severity, one-line meaning)
+KNOWN_RULES = {
+    "heartbeat_gap": (10.0, "s", "page",
+                      "a rank/replica heartbeat is older than threshold"),
+    "throughput_stall": (60.0, "s", "page",
+                         "an active run stream reported no segment "
+                         "progress for threshold seconds"),
+    "divergence_rate": (0.5, "frac", "warn",
+                        "diverged chains / total chains on one stream "
+                        "exceeds threshold"),
+    "rank_skew": (5.0, "s", "warn",
+                  "latest cross-rank commit skew exceeds threshold"),
+    "queue_wait_p99": (5.0, "s", "warn",
+                       "serving queue-wait p99 over the rolling window "
+                       "exceeds threshold"),
+    "epoch_lag": (0.0, "epochs", "warn",
+                  "serving replicas disagree on epoch/generation by more "
+                  "than threshold"),
+    "padding_waste": (0.5, "frac", "info",
+                      "a batched bucket (or the queue aggregate) pads "
+                      "more than threshold of its cells"),
+}
+
+
+@dataclass(frozen=True)
+class AlertRule:
+    """One threshold rule; immutable so a rule set is shareable."""
+
+    rule: str
+    threshold: float
+    severity: str = "warn"
+    enabled: bool = True
+
+    def __post_init__(self):
+        if self.rule not in KNOWN_RULES:
+            raise ValueError(
+                f"unknown alert rule {self.rule!r} — known rules: "
+                f"{sorted(KNOWN_RULES)}")
+
+
+def default_rules() -> list[AlertRule]:
+    """One enabled rule per known name at its default threshold."""
+    return [AlertRule(name, thr, sev)
+            for name, (thr, _unit, sev, _doc) in KNOWN_RULES.items()]
+
+
+def load_rules(path: str) -> list[AlertRule]:
+    """Load a JSON rule list; entries override the defaults field-wise."""
+    with open(path) as f:
+        raw = json.load(f)
+    if not isinstance(raw, list):
+        raise ValueError(f"{path}: alert config must be a JSON list")
+    rules = []
+    for i, entry in enumerate(raw):
+        if not isinstance(entry, dict) or "rule" not in entry:
+            raise ValueError(f"{path}[{i}]: each entry needs a 'rule' key")
+        name = entry["rule"]
+        extra = set(entry) - {"rule", "threshold", "severity", "enabled"}
+        if extra:
+            raise ValueError(f"{path}[{i}]: unknown keys {sorted(extra)}")
+        dflt = KNOWN_RULES.get(name, (0.0, "", "warn", ""))
+        rules.append(AlertRule(
+            name,
+            float(entry.get("threshold", dflt[0])),
+            str(entry.get("severity", dflt[2])),
+            bool(entry.get("enabled", True))))
+    return rules
+
+
+# -- per-rule snapshot probes ------------------------------------------------
+# each probe maps a hub snapshot to [(subject, observed value)] — the
+# engine compares value > threshold; probes never raise on partial
+# snapshots (the hub may not have seen every stream kind yet)
+
+def _probe_heartbeat_gap(snap):
+    out = []
+    for d, ranks in (snap.get("heartbeats") or {}).items():
+        for rank, age in ranks.items():
+            if age is not None:
+                out.append((f"{d}:p{rank}", float(age)))
+    return out
+
+
+def _probe_throughput_stall(snap):
+    out = []
+    now = snap.get("wall", 0.0)
+    for rel, st in (snap.get("streams") or {}).items():
+        if st.get("kind") != "run" or st.get("ended") \
+                or not st.get("started"):
+            continue
+        last = st.get("last_progress_wall")
+        if last is not None:
+            out.append((rel, float(now - last)))
+    return out
+
+
+def _probe_divergence_rate(snap):
+    out = []
+    for rel, st in (snap.get("streams") or {}).items():
+        h = st.get("health") or {}
+        div, nc = h.get("diverged_chains"), st.get("n_chains")
+        if div is not None and nc:
+            out.append((rel, float(div) / float(nc)))
+    for name, t in (snap.get("tenants") or {}).items():
+        div, nc = t.get("diverged"), t.get("n_chains")
+        if div is not None and nc:
+            out.append((f"tenant:{name}", float(div) / float(nc)))
+    return out
+
+
+def _probe_rank_skew(snap):
+    last = (snap.get("skew") or {}).get("last_s")
+    return [("fleet", float(last))] if last is not None else []
+
+
+def _probe_queue_wait_p99(snap):
+    out = []
+    serving = snap.get("serving") or {}
+    for rank, rep in (serving.get("replicas") or {}).items():
+        p99 = rep.get("queue_wait_p99_s")
+        if p99 is not None:
+            out.append((f"replica:{rank}", float(p99)))
+    for rel, st in (snap.get("streams") or {}).items():
+        p99 = st.get("queue_wait_p99_s")
+        if p99 is not None:
+            out.append((rel, float(p99)))
+    return out
+
+
+def _probe_epoch_lag(snap):
+    serving = snap.get("serving") or {}
+    out = []
+    for key in ("epoch_lag", "generation_lag"):
+        v = serving.get(key)
+        if v is not None:
+            out.append((key, float(v)))
+    return out
+
+
+def _probe_padding_waste(snap):
+    out = []
+    q = snap.get("queue") or {}
+    if q.get("padding_waste") is not None:
+        out.append(("queue", float(q["padding_waste"])))
+    for bkey, w in (q.get("bucket_waste") or {}).items():
+        out.append((f"bucket:{bkey}", float(w)))
+    return out
+
+
+_PROBES = {
+    "heartbeat_gap": _probe_heartbeat_gap,
+    "throughput_stall": _probe_throughput_stall,
+    "divergence_rate": _probe_divergence_rate,
+    "rank_skew": _probe_rank_skew,
+    "queue_wait_p99": _probe_queue_wait_p99,
+    "epoch_lag": _probe_epoch_lag,
+    "padding_waste": _probe_padding_waste,
+}
+
+
+class AlertEngine:
+    """Evaluate a rule set against successive hub snapshots.
+
+    Single-threaded by design: the hub calls :meth:`evaluate` from its own
+    poll loop (the hub holds any cross-thread locking)."""
+
+    def __init__(self, rules=None):
+        self.rules = list(default_rules() if rules is None else rules)
+        self._active: set[tuple[str, str]] = set()   # latched (rule, subj)
+        self.n_fired = 0
+
+    def active(self) -> list[str]:
+        return sorted(f"{r}:{s}" for r, s in self._active)
+
+    def evaluate(self, snap: dict) -> list[dict]:
+        """Newly-firing alerts for this snapshot (edge-triggered); each is
+        a JSON-safe dict ready to emit as a ``kind="alert"`` event."""
+        fired = []
+        seen_true: set[tuple[str, str]] = set()
+        for rule in self.rules:
+            if not rule.enabled:
+                continue
+            probe = _PROBES[rule.rule]
+            for subject, value in probe(snap):
+                key = (rule.rule, subject)
+                if value > rule.threshold:
+                    seen_true.add(key)
+                    if key not in self._active:
+                        self._active.add(key)
+                        self.n_fired += 1
+                        fired.append({
+                            "rule": rule.rule, "subject": subject,
+                            "value": round(float(value), 6),
+                            "threshold": rule.threshold,
+                            "severity": rule.severity,
+                        })
+        # re-arm every latched pair whose condition cleared (or whose
+        # subject vanished from the snapshot — a finished stream clears)
+        self._active &= seen_true
+        return fired

@@ -26,6 +26,11 @@ cross-host alignment only):
   mark), ``profile_capture``.
 - ``kind="log"`` — messages routed through :mod:`hmsc_tpu.obs.log`.
 
+Schema v2 adds three ADDITIVE optional fields — ``trace``/``span``/
+``parent`` (:mod:`hmsc_tpu.obs.trace`) — present only while a
+:class:`TraceContext` is bound via :meth:`RunTelemetry.set_trace`.  v1
+readers ignore them; with no context bound, event bytes are unchanged.
+
 Threading contract: :class:`RunTelemetry` is shared between the sampler's
 driver thread and its background writer thread; one lock guards the buffer
 and the aggregates.  Disk writes happen only in :meth:`flush`, which the
@@ -48,7 +53,7 @@ import time
 __all__ = ["RunTelemetry", "SCHEMA_VERSION", "EVENTS_FILE_RE", "events_path",
            "compact_summary", "GATHER_SPAN_SCHEMA", "record_rank_skew"]
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
 # events-p<rank>.jsonl — one stream per writing process, next to the
 # checkpoint layout (but not part of it: GC/rotation never touch it)
@@ -167,7 +172,7 @@ class RunTelemetry:
 
     # shared between the driver thread and the background segment writer;
     # `hmsc_tpu lint` (lock-discipline) enforces the declaration below
-    # hmsc: guarded-by[_lock]: _buffer, _spans, _counters, _last, _mark, _health, _seq, _sid, n_events, dropped_events
+    # hmsc: guarded-by[_lock]: _buffer, _spans, _counters, _last, _mark, _health, _seq, _sid, _trace, n_events, dropped_events
 
     def __init__(self, proc: int = 0, enabled: bool = True):
         self.proc = int(proc)
@@ -185,6 +190,7 @@ class RunTelemetry:
         self._last: dict[str, dict] = {}         # latest metric per name
         self._mark: dict[str, float] = {}        # span totals at last mark
         self._health: list[dict] = []            # segment_health series
+        self._trace = None                       # bound TraceContext | None
         self.n_events = 0
         self.dropped_events = 0
 
@@ -218,9 +224,20 @@ class RunTelemetry:
         ev = {"seq": self._seq, "t": round(self._now(), 6),
               "wall": round(time.time(), 3), "proc": self.proc,
               "kind": kind, "name": name}
+        if self._trace is not None:
+            # additive v2 fields; explicit per-event fields (a child span's
+            # own ids) override via the update below
+            ev.update(self._trace.fields())
         ev.update(fields)
         self._seq += 1
         self._buffer.append(ev)
+
+    def set_trace(self, ctx) -> None:
+        """Bind a :class:`~hmsc_tpu.obs.trace.TraceContext` (or ``None`` to
+        unbind): every subsequent event carries its ``trace``/``span``/
+        ``parent`` fields.  Already-buffered events are untouched."""
+        with self._lock:
+            self._trace = ctx
 
     def count(self, name: str, value: float) -> None:
         """Accumulate a named counter (surfaced in :meth:`summary`)."""

@@ -1,0 +1,720 @@
+"""Live fleet-wide metrics hub: ``python -m hmsc_tpu watch <root>``.
+
+``report`` is a postmortem — it parses completed (or at least quiescent)
+event streams.  The hub is the live view: it incrementally tails EVERY
+JSONL stream under a watch root (run dirs, ``fleet-events.jsonl`` from
+supervisors / job queues / serving fleets / autopilots, tenant fan-out
+dirs, serving replica telemetry) with per-file byte offsets and
+torn-last-line tolerance, folds the events into rolling fleet-level
+aggregates, evaluates the :mod:`~hmsc_tpu.obs.alerts` SLO rules against
+each snapshot, and exposes the result three ways: a live terminal view, a
+``--once --json`` snapshot, and a stdlib HTTP ``/metrics`` endpoint
+speaking the same frozen ``PROM_GAUGES`` registry as the offline
+exporters.
+
+Tailing contract (``JsonlTailer``, exercised against a concurrent writer
+by ``tests/test_watch.py`` and gated by ``benchmarks/bench_watch.py``):
+every COMMITTED event — complete line, newline written — is observed
+exactly once; a torn final line is left unconsumed until its newline
+lands; a rotation (rename + fresh file at the same path) first drains the
+renamed file through the still-open handle, then follows the new inode
+from byte 0.  The hub only ever reads — it opens no sampler state, holds
+no locks any writer contends on, and adds <2% driver overhead to a live
+2-rank run (the bench gate).
+
+Cross-process trace assembly rides the same poll: every event carrying a
+schema-v2 ``trace`` field is indexed by trace id, so ``traces()`` joins
+one autopilot drop's chain — validate → refit worker → epoch commit →
+serving flip — across the processes that wrote it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from .events import EVENTS_FILE_RE, RunTelemetry
+
+__all__ = ["JsonlTailer", "MetricsHub", "ALERTS_FILE", "render_watch",
+           "watch_main", "serve_hub"]
+
+# the hub's own alert stream under the watch root (kind="alert" events);
+# per-rank sampler streams never carry alerts — their kind set is pinned
+ALERTS_FILE = "alerts.jsonl"
+
+# fleet-events.jsonl (supervisor/queue/serving/autopilot decision logs);
+# name mirrored from fleet.supervisor.FLEET_EVENTS_FILE — imported lazily
+# in discover() to keep obs free of an import cycle with fleet
+_FLEET_EVENTS_FILE = "fleet-events.jsonl"
+
+_READ_CHUNK = 1 << 16
+_MAX_TRACES = 256            # LRU-dropped beyond this
+_MAX_TRACE_EVENTS = 2000     # per-trace index cap
+_MAX_RECENT_ALERTS = 50
+_QUEUE_WAIT_WINDOW = 512     # rolling per-stream queue_wait observations
+
+
+class JsonlTailer:
+    """Incremental exactly-once reader of one append-mode JSONL file."""
+
+    __slots__ = ("path", "_f", "_ino", "_buf", "n_events", "n_malformed")
+
+    def __init__(self, path: str):
+        self.path = os.fspath(path)
+        self._f = None
+        self._ino = None
+        self._buf = b""
+        self.n_events = 0
+        self.n_malformed = 0
+
+    def _open(self) -> bool:
+        try:
+            f = open(self.path, "rb")
+            self._ino = os.fstat(f.fileno()).st_ino
+        except OSError:
+            return False
+        self._f = f
+        return True
+
+    def _drain(self) -> list[dict]:
+        """Read the open handle to EOF; return the complete events."""
+        out = []
+        while True:
+            try:
+                chunk = self._f.read(_READ_CHUNK)
+            except OSError:
+                break
+            if not chunk:
+                break
+            self._buf += chunk
+            while True:
+                nl = self._buf.find(b"\n")
+                if nl < 0:
+                    break           # torn tail: wait for its newline
+                line, self._buf = self._buf[:nl], self._buf[nl + 1:]
+                if not line.strip():
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    self.n_malformed += 1
+                    continue
+                if isinstance(ev, dict):
+                    self.n_events += 1
+                    out.append(ev)
+                else:
+                    self.n_malformed += 1
+        return out
+
+    def poll(self) -> list[dict]:
+        """All events committed since the last poll."""
+        if self._f is None and not self._open():
+            return []
+        out = self._drain()
+        # rotation / truncation: the path no longer names the inode we
+        # hold (rename/GC), or it shrank in place — the old handle was
+        # fully drained above, so follow the fresh file from byte 0
+        rotated = False
+        try:
+            st = os.stat(self.path)
+            if st.st_ino != self._ino:
+                rotated = True
+            elif st.st_size < self._f.tell():
+                rotated = True
+        except OSError:
+            rotated = True          # vanished; reopen when it returns
+        if rotated:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f, self._buf = None, b""
+            if self._open():
+                out += self._drain()
+        return out
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = None
+
+
+def _p99(values: list[float]) -> float | None:
+    if not values:
+        return None
+    vs = sorted(values)
+    return vs[min(len(vs) - 1, int(0.99 * (len(vs) - 1) + 0.999))]
+
+
+class MetricsHub:
+    """Tail every stream under ``root``; fold into rolling aggregates.
+
+    Single-threaded: callers drive :meth:`poll` / :meth:`pump` from one
+    loop (the watch CLI, a supervisor's liveness loop, a test).  All reads
+    are lock-free file appends from other processes' perspective."""
+
+    def __init__(self, root: str, *, rules=None, alert_telemetry=None,
+                 evaluate_alerts: bool = True):
+        from .alerts import AlertEngine
+        self.root = os.fspath(root)
+        self._tailers: dict[str, JsonlTailer] = {}
+        self._hb_dirs: set[str] = set()
+        self._engine = AlertEngine(rules)
+        self._alert_telem = alert_telemetry
+        self._evaluate = bool(evaluate_alerts)
+        self._last_pump = 0.0
+        self.events_seen = 0
+        self.malformed = 0
+        # rolling state folded from events
+        self._streams: dict[str, dict] = {}
+        self._tenants: dict[str, dict] = {}
+        self._queue: dict = {}
+        self._fleet: dict = {"counts": {}}
+        self._serving: dict = {"replicas": {}, "flips": 0,
+                               "flip_latency_s": {}}
+        self._pipeline: dict = {"counts": {}}
+        self._skew: dict = {}
+        self._qwait: dict[str, list[float]] = {}
+        self._pending_flip_t: dict[str, float] = {}
+        self._recent_alerts: list[dict] = []
+        self._traces: dict[str, list[dict]] = {}
+
+    # -- discovery ---------------------------------------------------------
+
+    def discover(self) -> int:
+        """Walk the root for new streams/heartbeat dirs; idempotent."""
+        from ..utils.coordination import HEARTBEAT_FILE_RE
+        try:
+            from ..fleet.supervisor import FLEET_EVENTS_FILE
+        except ImportError:          # pragma: no cover - fleet optional
+            FLEET_EVENTS_FILE = _FLEET_EVENTS_FILE
+        new = 0
+        if os.path.isfile(self.root):
+            if self.root not in self._tailers:
+                self._tailers[self.root] = JsonlTailer(self.root)
+                new += 1
+            return new
+        for dirpath, _dirs, files in os.walk(self.root):
+            for fn in files:
+                path = os.path.join(dirpath, fn)
+                if fn == FLEET_EVENTS_FILE or fn == ALERTS_FILE \
+                        or EVENTS_FILE_RE.fullmatch(fn):
+                    if path not in self._tailers:
+                        self._tailers[path] = JsonlTailer(path)
+                        new += 1
+                elif HEARTBEAT_FILE_RE.fullmatch(fn):
+                    self._hb_dirs.add(dirpath)
+        return new
+
+    def _rel(self, path: str) -> str:
+        try:
+            rel = os.path.relpath(path, self.root)
+        except ValueError:           # pragma: no cover - cross-drive
+            return path
+        return path if rel.startswith("..") else rel
+
+    @staticmethod
+    def _stream_kind(path: str) -> str:
+        fn = os.path.basename(path)
+        if fn == ALERTS_FILE:
+            return "alerts"
+        if fn == _FLEET_EVENTS_FILE:
+            return "fleet"
+        return "run"
+
+    # -- folding -----------------------------------------------------------
+
+    def poll(self) -> int:
+        """Discover + drain every stream once; fold; return events read."""
+        self.discover()
+        n = 0
+        for path, tailer in sorted(self._tailers.items()):
+            events = tailer.poll()
+            if not events:
+                continue
+            rel = self._rel(path)
+            kind = self._stream_kind(path)
+            for ev in events:
+                self._fold(rel, kind, ev)
+            n += len(events)
+        self.events_seen += n
+        self.malformed = sum(t.n_malformed for t in self._tailers.values())
+        return n
+
+    def _stream_state(self, rel: str, kind: str) -> dict:
+        st = self._streams.get(rel)
+        if st is None:
+            tenant = None
+            for part in rel.split(os.sep):
+                if part.startswith("tenant-"):
+                    tenant = part[len("tenant-"):]
+            st = self._streams[rel] = {
+                "kind": kind, "events": 0, "proc": None, "tenant": tenant,
+                "started": False, "ended": False, "n_chains": None,
+                "health": None, "last_wall": None,
+                "last_progress_wall": None,
+            }
+        return st
+
+    def _fold(self, rel: str, stream_kind: str, ev: dict) -> None:
+        st = self._stream_state(rel, stream_kind)
+        st["events"] += 1
+        st["last_wall"] = ev.get("wall")
+        if st["proc"] is None:
+            st["proc"] = ev.get("proc")
+        kind, name = ev.get("kind"), ev.get("name")
+        tid = ev.get("trace")
+        if tid:
+            self._index_trace(rel, tid, ev)
+        if kind == "run":
+            if name == "start":
+                st["started"] = True
+                st["ended"] = False
+                st["n_chains"] = ev.get("n_chains", st["n_chains"])
+                st["last_progress_wall"] = ev.get("wall")
+                tenant = ev.get("tenant") or st["tenant"]
+                if tenant:
+                    self._tenant(tenant).update(
+                        n_chains=ev.get("n_chains"), done=False)
+            elif name in ("end", "preempted"):
+                st["ended"] = True
+        elif kind == "metric":
+            self._fold_metric(st, name, ev)
+        elif kind == "span" and name == "queue_wait":
+            dq = self._qwait.setdefault(rel, [])
+            dq.append(float(ev.get("dur_s", 0.0)))
+            del dq[:-_QUEUE_WAIT_WINDOW]
+        elif kind == "alert":
+            self._remember_alert(ev)
+        elif kind == "fleet":
+            self._fold_fleet(rel, name, ev)
+        elif kind == "pipeline":
+            self._fold_pipeline(name, ev)
+
+    def _tenant(self, name: str) -> dict:
+        return self._tenants.setdefault(str(name), {})
+
+    def _fold_metric(self, st: dict, name: str, ev: dict) -> None:
+        if name == "segment_health":
+            st["health"] = {k: ev.get(k) for k in
+                            ("seg", "samples_done", "draws_per_s",
+                             "diverged_chains", "rhat_max", "ess_min")}
+            st["last_progress_wall"] = ev.get("wall")
+            if st["tenant"]:
+                self._tenant(st["tenant"]).update(
+                    draws_per_s=ev.get("draws_per_s"),
+                    diverged=ev.get("diverged_chains"),
+                    n_chains=st["n_chains"]
+                    or self._tenant(st["tenant"]).get("n_chains"))
+        elif name == "tenant_health":
+            t = self._tenant(ev.get("tenant", "?"))
+            for k in ("diverged", "n_chains", "draws_per_s", "nf",
+                      "samples_done", "done"):
+                if ev.get(k) is not None:
+                    t[k] = ev.get(k)
+        elif name == "rank_skew":
+            s = float(ev.get("skew_s", 0.0))
+            self._skew["last_s"] = s
+            self._skew["max_s"] = max(s, self._skew.get("max_s", 0.0))
+
+    def _fold_fleet(self, rel: str, name: str, ev: dict) -> None:
+        c = self._fleet["counts"]
+        c[name] = c.get(name, 0) + 1
+        if name == "queue_start":
+            self._queue.update(
+                jobs=ev.get("n_jobs"), tenants=ev.get("n_tenants"),
+                buckets=ev.get("n_buckets"), dispatched=0, done=0,
+                scenarios=0)
+        elif name == "job_dispatch":
+            self._queue["dispatched"] = self._queue.get("dispatched", 0) + 1
+        elif name == "tenant_done":
+            self._queue["done"] = self._queue.get("done", 0) + 1
+            t = self._tenant(ev.get("tenant", "?"))
+            t["done"] = True
+        elif name == "scenario_done":
+            self._queue["scenarios"] = self._queue.get("scenarios", 0) + 1
+        elif name == "queue_end":
+            for k in ("occupancy", "padding_waste"):
+                if ev.get(k) is not None:
+                    self._queue[k] = ev.get(k)
+            self._queue["ended"] = True
+        elif name == "bucket_report":
+            if ev.get("padding_waste") is not None:
+                self._queue.setdefault("bucket_waste", {})[
+                    str(ev.get("bucket"))] = ev.get("padding_waste")
+        elif name == "replica_stats":
+            rep = self._serving["replicas"].setdefault(
+                str(ev.get("rank")), {})
+            for k in ("generation", "epoch", "requests", "rows_served",
+                      "inflight"):
+                if ev.get(k) is not None:
+                    rep[k] = ev.get(k)
+            qn = ev.get("queue_wait_n") or 0
+            if qn:
+                rep["queue_wait_mean_s"] = round(
+                    float(ev.get("queue_wait_s", 0.0)) / qn, 6)
+        elif name == "flip_start":
+            self._pending_flip_t[rel] = float(ev.get("t", 0.0))
+        elif name == "flip_done":
+            t0 = self._pending_flip_t.pop(rel, None)
+            if t0 is not None:
+                lat = max(0.0, float(ev.get("t", t0)) - t0)
+                fl = self._serving["flip_latency_s"]
+                fl["last"] = round(lat, 6)
+                fl["max"] = round(max(lat, fl.get("max", 0.0)), 6)
+            self._serving["flips"] += 1
+
+    def _fold_pipeline(self, name: str, ev: dict) -> None:
+        c = self._pipeline["counts"]
+        c[name] = c.get(name, 0) + 1
+        if name == "epoch_committed" and ev.get("epoch") is not None:
+            self._pipeline["epoch"] = ev.get("epoch")
+        if name in ("drop_seen", "drop_accepted", "drop_rejected",
+                    "drop_done") and ev.get("drop") is not None:
+            self._pipeline["last_drop"] = ev.get("drop")
+
+    def _remember_alert(self, ev: dict) -> None:
+        self._recent_alerts.append(
+            {k: ev.get(k) for k in ("wall", "name", "rule", "subject",
+                                    "value", "threshold", "severity")})
+        del self._recent_alerts[:-_MAX_RECENT_ALERTS]
+
+    def _index_trace(self, rel: str, tid: str, ev: dict) -> None:
+        chain = self._traces.get(tid)
+        if chain is None:
+            if len(self._traces) >= _MAX_TRACES:
+                self._traces.pop(next(iter(self._traces)))
+            chain = self._traces[tid] = []
+        if len(chain) < _MAX_TRACE_EVENTS:
+            chain.append({"stream": rel, "proc": ev.get("proc"),
+                          "kind": ev.get("kind"), "name": ev.get("name"),
+                          "span": ev.get("span"),
+                          "parent": ev.get("parent"),
+                          "wall": ev.get("wall")})
+
+    # -- views -------------------------------------------------------------
+
+    def traces(self) -> dict[str, list[dict]]:
+        """``{trace_id: [indexed events, arrival order]}`` — the
+        cross-process join (each entry names its stream and span ids)."""
+        return {k: list(v) for k, v in self._traces.items()}
+
+    def heartbeats(self) -> dict:
+        from ..utils.coordination import read_heartbeats
+        out = {}
+        for d in sorted(self._hb_dirs):
+            hbs = read_heartbeats(d)
+            if hbs:
+                out[self._rel(d)] = {
+                    str(r): (None if hb.get("age_s") is None
+                             else round(float(hb["age_s"]), 3))
+                    for r, hb in hbs.items()}
+        return out
+
+    def snapshot(self) -> dict:
+        """JSON-safe rolling aggregate view (the ``--once --json`` body,
+        the alert-probe input, and the Prometheus exporter's source)."""
+        active = [rel for rel, st in self._streams.items()
+                  if st["kind"] == "run" and st["started"]
+                  and not st["ended"]]
+        draws = sum((st.get("health") or {}).get("draws_per_s") or 0.0
+                    for rel, st in self._streams.items() if rel in active)
+        streams = {}
+        for rel, st in sorted(self._streams.items()):
+            view = dict(st)
+            p99 = _p99(self._qwait.get(rel, []))
+            if p99 is not None:
+                view["queue_wait_p99_s"] = round(p99, 6)
+            streams[rel] = view
+        reps = self._serving["replicas"]
+        serving = {"replicas": {k: dict(v) for k, v in reps.items()},
+                   "flips": self._serving["flips"],
+                   "flip_latency_s": dict(self._serving["flip_latency_s"])}
+        for key, field in (("generation_lag", "generation"),
+                           ("epoch_lag", "epoch")):
+            vals = [v.get(field) for v in reps.values()
+                    if v.get(field) is not None]
+            if vals:
+                serving[key] = max(vals) - min(vals)
+        queue = dict(self._queue)
+        if queue.get("tenants") is not None:
+            queue["depth"] = max(
+                0, int(queue["tenants"]) - int(queue.get("done") or 0))
+        return {
+            "schema": 1,
+            "root": self.root,
+            "wall": round(time.time(), 3),
+            "streams": streams,
+            "n_streams": len(self._tailers),
+            "events": self.events_seen,
+            "malformed": self.malformed,
+            "active_runs": len(active),
+            "draws_per_s_total": round(draws, 4),
+            "skew": dict(self._skew),
+            "tenants": {k: dict(v)
+                        for k, v in sorted(self._tenants.items())},
+            "queue": queue,
+            "fleet": {"counts": dict(self._fleet["counts"])},
+            "serving": serving,
+            "pipeline": dict(self._pipeline,
+                             counts=dict(self._pipeline["counts"])),
+            "heartbeats": self.heartbeats(),
+            "alerts": {"fired": self._engine.n_fired,
+                       "active": self._engine.active(),
+                       "recent": list(self._recent_alerts)},
+            "traces": {"n": len(self._traces)},
+        }
+
+    # -- alert evaluation --------------------------------------------------
+
+    def check_alerts(self, snap: dict | None = None) -> list[dict]:
+        """Evaluate the rule set against a snapshot; emit newly-firing
+        alerts as ``kind="alert"`` events on the attached telemetry."""
+        if not self._evaluate:
+            return []
+        fired = self._engine.evaluate(snap or self.snapshot())
+        if fired and self._alert_telem is not None:
+            for a in fired:
+                fields = {k: v for k, v in a.items() if k != "rule"}
+                self._alert_telem.emit("alert", a["rule"], rule=a["rule"],
+                                       **fields)
+            self._alert_telem.flush()
+        for a in fired:
+            self._remember_alert(dict(a, name=a["rule"]))
+        return fired
+
+    def pump(self, min_interval_s: float = 1.0) -> list[dict]:
+        """Rate-limited poll + alert check, for daemons that attach a hub
+        inside their own watch loop (supervisor, autopilot): cheap enough
+        to call every liveness tick."""
+        now = time.monotonic()
+        if now - self._last_pump < min_interval_s:
+            return []
+        self._last_pump = now
+        self.poll()
+        return self.check_alerts()
+
+    def prometheus(self) -> str:
+        from .report import hub_prometheus_textfile
+        return hub_prometheus_textfile(self.snapshot())
+
+    def close(self) -> None:
+        for t in self._tailers.values():
+            t.close()
+
+
+# -- rendering ---------------------------------------------------------------
+
+def _fmt(v, nd=2):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def render_watch(snap: dict) -> str:
+    """Plain-text live view of one hub snapshot."""
+    L = [f"watch {snap['root']} — {snap['n_streams']} streams, "
+         f"{snap['events']} events, {snap['active_runs']} active runs, "
+         f"{snap['draws_per_s_total']:.1f} draws/s"
+         + (f", {snap['malformed']} malformed" if snap.get("malformed")
+            else "")]
+    skew = snap.get("skew") or {}
+    if skew:
+        L.append(f"  skew: last {_fmt(skew.get('last_s'), 3)}s "
+                 f"max {_fmt(skew.get('max_s'), 3)}s")
+    runs = [(rel, st) for rel, st in snap["streams"].items()
+            if st["kind"] == "run"]
+    if runs:
+        L.append("ranks:")
+        for rel, st in runs:
+            h = st.get("health") or {}
+            status = ("done" if st["ended"]
+                      else "live" if st["started"] else "idle")
+            L.append(f"  {rel:40s} {status:5s} "
+                     f"draws/s {_fmt(h.get('draws_per_s'), 1):>8s} "
+                     f"samples {_fmt(h.get('samples_done')):>6s} "
+                     f"rhat {_fmt(h.get('rhat_max')):>6s} "
+                     f"div {_fmt(h.get('diverged_chains')):>3s}")
+    if snap.get("tenants"):
+        L.append("tenants:")
+        for name, t in snap["tenants"].items():
+            L.append(f"  {name:24s} done={t.get('done', False)} "
+                     f"diverged={_fmt(t.get('diverged'))} "
+                     f"draws/s={_fmt(t.get('draws_per_s'), 1)}")
+    q = snap.get("queue") or {}
+    if q:
+        L.append(f"queue: {_fmt(q.get('done'))}/{_fmt(q.get('tenants'))} "
+                 f"tenants done, depth {_fmt(q.get('depth'))}, "
+                 f"occupancy {_fmt(q.get('occupancy'))}, "
+                 f"padding waste {_fmt(q.get('padding_waste'))}")
+    sv = snap.get("serving") or {}
+    if sv.get("replicas"):
+        lat = sv.get("flip_latency_s") or {}
+        L.append(f"serving: {len(sv['replicas'])} replicas, "
+                 f"gen lag {_fmt(sv.get('generation_lag'))}, "
+                 f"epoch lag {_fmt(sv.get('epoch_lag'))}, "
+                 f"flips {sv.get('flips', 0)} "
+                 f"(last {_fmt(lat.get('last'), 3)}s)")
+        for rank, rep in sorted(sv["replicas"].items()):
+            L.append(f"  replica {rank}: gen {_fmt(rep.get('generation'))} "
+                     f"epoch {_fmt(rep.get('epoch'))} "
+                     f"req {_fmt(rep.get('requests'))} "
+                     f"qwait {_fmt(rep.get('queue_wait_mean_s'), 4)}s")
+    pc = (snap.get("pipeline") or {}).get("counts") or {}
+    if pc:
+        L.append("pipeline: " + " ".join(
+            f"{k}={v}" for k, v in sorted(pc.items())))
+    hbs = snap.get("heartbeats") or {}
+    for d, ranks in hbs.items():
+        ages = " ".join(f"p{r}={_fmt(a, 1)}s"
+                        for r, a in sorted(ranks.items()))
+        L.append(f"heartbeats {d}: {ages}")
+    al = snap.get("alerts") or {}
+    if al.get("fired") or al.get("recent"):
+        L.append(f"alerts: {al.get('fired', 0)} fired, "
+                 f"{len(al.get('active') or [])} active")
+        for a in (al.get("recent") or [])[-8:]:
+            L.append(f"  [{a.get('severity')}] {a.get('rule') or a.get('name')}"
+                     f" {a.get('subject')}: {_fmt(a.get('value'), 4)} > "
+                     f"{_fmt(a.get('threshold'), 4)}")
+    return "\n".join(L)
+
+
+# -- HTTP endpoint -----------------------------------------------------------
+
+def serve_hub(hub: MetricsHub, host: str = "127.0.0.1", port: int = 0):
+    """A stdlib HTTP server exposing the hub: ``/metrics`` (Prometheus
+    textfile over the frozen registry), ``/snapshot`` (JSON), ``/healthz``.
+    The handler polls the hub before answering, so the endpoint is always
+    current without a background thread mutating shared state."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    import threading
+
+    lock = threading.Lock()     # serialise polls across handler threads
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):   # quiet access log
+            pass
+
+        def _send(self, code, body: bytes, ctype: str):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            path = self.path.split("?")[0]
+            with lock:
+                hub.poll()
+                hub.check_alerts()
+                if path == "/metrics":
+                    body, ctype = hub.prometheus().encode(), \
+                        "text/plain; version=0.0.4"
+                elif path == "/snapshot":
+                    body, ctype = json.dumps(hub.snapshot()).encode(), \
+                        "application/json"
+                elif path == "/healthz":
+                    body = json.dumps(
+                        {"ok": True, "streams": len(hub._tailers),
+                         "events": hub.events_seen}).encode()
+                    ctype = "application/json"
+                else:
+                    self._send(404, b'{"error": "not found"}',
+                               "application/json")
+                    return
+            self._send(200, body, ctype)
+
+    srv = ThreadingHTTPServer((host, port), Handler)
+    srv.daemon_threads = True
+    return srv
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def watch_main(argv=None) -> int:
+    """``python -m hmsc_tpu watch <root>`` — live terminal view (default),
+    one-shot snapshot (``--once [--json]``), or HTTP endpoint
+    (``--serve PORT``)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m hmsc_tpu watch",
+        description="live fleet-wide metrics hub over a watch root")
+    ap.add_argument("root", help="directory tree (or one JSONL file) to "
+                                 "tail: run dirs, fleet work dirs, a "
+                                 "whole tenant fan-out root")
+    ap.add_argument("--once", action="store_true",
+                    help="poll once, print, exit")
+    ap.add_argument("--json", action="store_true",
+                    help="print the JSON snapshot instead of the text view")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="poll/render period in seconds (default 2)")
+    ap.add_argument("--max-seconds", type=float, default=None,
+                    help="exit after this long (bounded watch for "
+                         "tests/benches)")
+    ap.add_argument("--rules", default=None,
+                    help="JSON alert-rule config (default: built-in rules)")
+    ap.add_argument("--no-alerts", action="store_true",
+                    help="disable SLO rule evaluation")
+    ap.add_argument("--alerts-sink", default=None,
+                    help=f"alert event stream path (default: "
+                         f"<root>/{ALERTS_FILE}; 'none' disables writing)")
+    ap.add_argument("--serve", type=int, default=None, metavar="PORT",
+                    help="also expose /metrics, /snapshot, /healthz on "
+                         "this port (0 = ephemeral, printed)")
+    ap.add_argument("--host", default="127.0.0.1")
+    args = ap.parse_args(argv)
+
+    from .alerts import load_rules
+    rules = load_rules(args.rules) if args.rules else None
+    telem = None
+    if not args.no_alerts:
+        sink = args.alerts_sink
+        if sink is None and os.path.isdir(args.root):
+            sink = os.path.join(args.root, ALERTS_FILE)
+        if sink and sink != "none":
+            telem = RunTelemetry(proc=0)
+            telem.attach_sink(sink)
+    hub = MetricsHub(args.root, rules=rules, alert_telemetry=telem,
+                     evaluate_alerts=not args.no_alerts)
+
+    srv = None
+    if args.serve is not None:
+        import threading
+        srv = serve_hub(hub, args.host, args.serve)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        print(f"hub: http://{srv.server_address[0]}:"
+              f"{srv.server_address[1]}/metrics")
+
+    t_end = (None if args.max_seconds is None
+             else time.monotonic() + args.max_seconds)
+    try:
+        while True:
+            hub.poll()
+            snap = hub.snapshot()
+            hub.check_alerts(snap)
+            if args.json:
+                print(json.dumps(snap))
+            else:
+                print(render_watch(snap))
+            if args.once:
+                break
+            if t_end is not None and time.monotonic() >= t_end:
+                break
+            time.sleep(max(0.05, args.interval))
+            if not args.json:
+                print()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if srv is not None:
+            srv.shutdown()
+        hub.close()
+    return 0

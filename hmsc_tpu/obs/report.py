@@ -55,7 +55,8 @@ import os
 __all__ = ["load_run_events", "load_fleet_events", "build_report",
            "render_report", "epoch_drift_report", "render_drift",
            "render_scenarios", "prometheus_textfile",
-           "serving_prometheus_textfile", "report_main", "PROM_GAUGES"]
+           "serving_prometheus_textfile", "hub_prometheus_textfile",
+           "report_main", "PROM_GAUGES"]
 
 # the frozen gauge-name registry (see the module docstring): every
 # *_prometheus_textfile exporter routes through _gauge(), which refuses
@@ -88,6 +89,22 @@ PROM_GAUGES = (
     "hmsc_tpu_serve_kernel_cache_misses_total",
     "hmsc_tpu_serve_kernel_cache_size",
     "hmsc_tpu_serve_posterior_draws",
+    # live metrics hub (hub_prometheus_textfile / `watch --serve`)
+    "hmsc_tpu_watch_streams",
+    "hmsc_tpu_watch_events_total",
+    "hmsc_tpu_watch_active_runs",
+    "hmsc_tpu_watch_draws_per_second",
+    "hmsc_tpu_watch_rank_skew_seconds",
+    "hmsc_tpu_watch_heartbeat_age_seconds",
+    "hmsc_tpu_watch_queue_depth",
+    "hmsc_tpu_watch_occupancy_ratio",
+    "hmsc_tpu_watch_padding_waste_ratio",
+    "hmsc_tpu_watch_epoch_lag",
+    "hmsc_tpu_watch_generation_lag",
+    "hmsc_tpu_watch_flip_latency_seconds",
+    "hmsc_tpu_watch_queue_wait_p99_seconds",
+    "hmsc_tpu_watch_diverged_chains",
+    "hmsc_tpu_watch_alerts_fired_total",
 )
 _PROM_SET = frozenset(PROM_GAUGES)
 
@@ -204,6 +221,7 @@ def build_report(run_dir: str) -> dict:
               "serve_fleet": _serve_fleet_section(ops),
               "pipeline": _pipeline_section(ops),
               "scenarios": _scenarios_section(ops),
+              "alerts": _alerts_section(run_dir, ops),
               "status": "no-events" if not streams else "unknown"}
     for proc, events in streams.items():
         # per-epoch clock re-basing: ``t`` restarts at ~0 in each appended
@@ -533,6 +551,32 @@ def _pipeline_section(events: list) -> dict | None:
             "retention": retention, "summary": summary}
 
 
+def _alerts_section(run_dir: str, ops: list) -> dict | None:
+    """SLO alerts fired against this run: ``kind="alert"`` events from the
+    shared fleet/pipeline stream (written by a supervisor's or autopilot's
+    in-process hub) plus the standalone hub's ``alerts.jsonl`` under the
+    run directory (``python -m hmsc_tpu watch``)."""
+    from .hub import ALERTS_FILE
+    alerts = [e for e in ops if e.get("kind") == "alert"]
+    run_dir = os.fspath(run_dir)
+    if os.path.isdir(run_dir):
+        extra = _read_jsonl(os.path.join(run_dir, ALERTS_FILE)) or []
+        alerts += [e for e in extra if e.get("kind") == "alert"]
+    if not alerts:
+        return None
+    alerts.sort(key=lambda e: e.get("wall") or 0.0)
+    stripped = [{k: e.get(k) for k in ("t", "wall", "name", "rule",
+                                       "subject", "value", "threshold",
+                                       "severity")}
+                for e in alerts]
+    by_rule: dict = {}
+    for a in stripped:
+        r = a.get("rule") or a.get("name") or "?"
+        by_rule[r] = by_rule.get(r, 0) + 1
+    return {"count": len(stripped), "by_rule": by_rule,
+            "alerts": stripped}
+
+
 def _bar(frac: float, width: int = 24) -> str:
     n = max(0, min(width, int(round(frac * width))))
     return "#" * n + "." * (width - n)
@@ -740,6 +784,17 @@ def render_report(report: dict) -> str:
                 f"worker restarts {s.get('worker_restarts')}, compactions "
                 f"{s.get('compactions')}, epochs reclaimed "
                 f"{s.get('epochs_reclaimed')}, wall {s.get('wall_s')}s")
+    al = report.get("alerts")
+    if al:
+        lines.append("")
+        lines.append("== SLO alerts ==")
+        lines.append("  " + ", ".join(f"{r}: {n}" for r, n in
+                                      sorted(al["by_rule"].items())))
+        for a in al["alerts"]:
+            rule = a.get("rule") or a.get("name")
+            lines.append(
+                f"  [{a.get('severity')}] {rule} {a.get('subject')}: "
+                f"{a.get('value')} > {a.get('threshold')}")
     return "\n".join(lines)
 
 
@@ -849,6 +904,76 @@ def serving_prometheus_textfile(stats: dict) -> str:
     for name, v in gauges:
         out.append(f"# TYPE {name} gauge")
         _gauge(out, name, "", v)
+    return "\n".join(out) + "\n"
+
+
+def hub_prometheus_textfile(snap: dict) -> str:
+    """Prometheus textfile-collector export of a live
+    :meth:`~hmsc_tpu.obs.hub.MetricsHub.snapshot` — the fleet-wide
+    counterpart of :func:`prometheus_textfile`, served on the hub's
+    ``GET /metrics`` (``python -m hmsc_tpu watch --serve``).  Routes
+    through the same frozen :data:`PROM_GAUGES` registry."""
+    out = ["# HELP hmsc_tpu_watch_streams JSONL streams tailed by the hub",
+           "# TYPE hmsc_tpu_watch_streams gauge",
+           "# TYPE hmsc_tpu_watch_events_total gauge",
+           "# TYPE hmsc_tpu_watch_active_runs gauge",
+           "# TYPE hmsc_tpu_watch_draws_per_second gauge",
+           "# TYPE hmsc_tpu_watch_alerts_fired_total gauge"]
+    _gauge(out, "hmsc_tpu_watch_streams", "", snap.get("n_streams", 0))
+    _gauge(out, "hmsc_tpu_watch_events_total", "", snap.get("events", 0))
+    _gauge(out, "hmsc_tpu_watch_active_runs", "",
+           snap.get("active_runs", 0))
+    _gauge(out, "hmsc_tpu_watch_draws_per_second", "",
+           snap.get("draws_per_s_total", 0.0))
+    _gauge(out, "hmsc_tpu_watch_alerts_fired_total", "",
+           (snap.get("alerts") or {}).get("fired", 0))
+    skew = (snap.get("skew") or {}).get("last_s")
+    if skew is not None:
+        out.append("# TYPE hmsc_tpu_watch_rank_skew_seconds gauge")
+        _gauge(out, "hmsc_tpu_watch_rank_skew_seconds", "", skew)
+    diverged = sum((st.get("health") or {}).get("diverged_chains") or 0
+                   for st in (snap.get("streams") or {}).values())
+    out.append("# TYPE hmsc_tpu_watch_diverged_chains gauge")
+    _gauge(out, "hmsc_tpu_watch_diverged_chains", "", diverged)
+    q = snap.get("queue") or {}
+    if q:
+        for key, name in (("depth", "hmsc_tpu_watch_queue_depth"),
+                          ("occupancy", "hmsc_tpu_watch_occupancy_ratio"),
+                          ("padding_waste",
+                           "hmsc_tpu_watch_padding_waste_ratio")):
+            if q.get(key) is not None:
+                out.append(f"# TYPE {name} gauge")
+                _gauge(out, name, "", q[key])
+    sv = snap.get("serving") or {}
+    for key, name in (("epoch_lag", "hmsc_tpu_watch_epoch_lag"),
+                      ("generation_lag",
+                       "hmsc_tpu_watch_generation_lag")):
+        if sv.get(key) is not None:
+            out.append(f"# TYPE {name} gauge")
+            _gauge(out, name, "", sv[key])
+    lat = (sv.get("flip_latency_s") or {}).get("last")
+    if lat is not None:
+        out.append("# TYPE hmsc_tpu_watch_flip_latency_seconds gauge")
+        _gauge(out, "hmsc_tpu_watch_flip_latency_seconds", "", lat)
+    p99s = [(f'replica="{r}"', rep["queue_wait_p99_s"])
+            for r, rep in sorted((sv.get("replicas") or {}).items())
+            if rep.get("queue_wait_p99_s") is not None]
+    p99s += [(f'stream="{rel}"', st["queue_wait_p99_s"])
+             for rel, st in sorted((snap.get("streams") or {}).items())
+             if st.get("queue_wait_p99_s") is not None]
+    if p99s:
+        out.append("# TYPE hmsc_tpu_watch_queue_wait_p99_seconds gauge")
+        for lbl, v in p99s:
+            _gauge(out, "hmsc_tpu_watch_queue_wait_p99_seconds",
+                   "{" + lbl + "}", v)
+    hbs = snap.get("heartbeats") or {}
+    if hbs:
+        out.append("# TYPE hmsc_tpu_watch_heartbeat_age_seconds gauge")
+        for d, ranks in sorted(hbs.items()):
+            for rank, age in sorted(ranks.items()):
+                if age is not None:
+                    _gauge(out, "hmsc_tpu_watch_heartbeat_age_seconds",
+                           f'{{dir="{d}",rank="{rank}"}}', age)
     return "\n".join(out) + "\n"
 
 

@@ -90,6 +90,7 @@ class Autopilot:
 
     def __init__(self, config, *, engine=None, chaos=None, hM0=None):
         from ..obs import RunTelemetry
+        from ..obs.trace import inherit_or_mint
         self.cfg = config
         self.engine = engine
         self.chaos = chaos
@@ -98,6 +99,14 @@ class Autopilot:
             hM0 = build_worker_model(**config.model_kw)
         self._hM0 = hM0
         self.telem = RunTelemetry(proc=0)
+        # the daemon is a top-level entry point; each drop's full cycle
+        # (validate → refit worker → epoch commit → flip) runs under one
+        # per-drop child span of this trace, so the hub can assemble the
+        # whole rollout across processes
+        self.trace = inherit_or_mint()
+        self.telem.set_trace(self.trace)
+        self._drop_trace = None        # per-drop child span (see _emit)
+        self.hub = None                # in-process MetricsHub (run() attaches)
         self.counters = {"drops_seen": 0, "drops_committed": 0,
                          "drops_rejected": 0, "epochs_committed": 0,
                          "worker_restarts": 0, "flips": 0,
@@ -107,6 +116,11 @@ class Autopilot:
     # -- event plumbing ----------------------------------------------------
 
     def _emit(self, name: str, **fields) -> None:
+        if self._drop_trace is not None:
+            # events inside a drop cycle carry the drop's child span; its
+            # parent is the daemon's root span, so every cycle nests
+            fields.setdefault("span", self._drop_trace.span_id)
+            fields.setdefault("parent", self._drop_trace.parent_id)
         self.telem.emit("pipeline", name, **fields)
         self.telem.flush()            # the stream must be tailable live
 
@@ -192,6 +206,10 @@ class Autopilot:
         # APPEND to the shared operational stream: restarts must not
         # erase the history that explains them
         self.telem.attach_sink(fleet_events_path(cfg.run_dir))
+        # in-process metrics hub over the run directory: the daemon
+        # evaluates the SLO rules against its own loop while it runs
+        from ..obs.hub import MetricsHub
+        self.hub = MetricsHub(cfg.run_dir, alert_telemetry=self.telem)
         self._emit("pipeline_start", config=cfg.to_dict(),
                    chaos=(self.chaos.summary() if self.chaos else None))
         prev_term = None
@@ -220,6 +238,8 @@ class Autopilot:
                     if cfg.idle_exit_s is not None and \
                             time.monotonic() - idle_t0 > cfg.idle_exit_s:
                         break
+                    if self.hub is not None:
+                        self.hub.pump()   # live SLO check while idle
                     time.sleep(cfg.poll_s)
                     continue
                 idle_t0 = time.monotonic()
@@ -245,6 +265,16 @@ class Autopilot:
     def _process_drop(self, name: str, idx: int) -> None:
         cfg = self.cfg
         path = os.path.join(os.fspath(cfg.drop_dir), name)
+        # one child span per drop cycle — _emit folds it into every event
+        # until drop_done, and the refit worker + flip target inherit it
+        self._drop_trace = self.trace.child()
+        try:
+            self._process_drop_traced(name, idx, path)
+        finally:
+            self._drop_trace = None
+
+    def _process_drop_traced(self, name: str, idx: int, path: str) -> None:
+        cfg = self.cfg
         self.counters["drops_seen"] += 1
         try:
             nbytes = os.path.getsize(path)
@@ -355,7 +385,10 @@ class Autopilot:
                 chaos_action=(arm["action"] if arm else None),
                 out=out)
             logf = open(logp, "w")
-            p = subprocess.Popen(cmd, cwd=_pkg_root(), env=worker_env(),
+            # the refit worker joins the drop's span: its sampler stream
+            # (events-p0.jsonl under the run dir) parents under this cycle
+            p = subprocess.Popen(cmd, cwd=_pkg_root(),
+                                 env=worker_env(trace=self._drop_trace),
                                  stdout=logf, stderr=subprocess.STDOUT)
             logf.close()
             self._emit("refit_dispatch", drop=idx, attempt=attempt,
@@ -384,6 +417,8 @@ class Autopilot:
                                elapsed_s=round(elapsed, 1))
                     hb_killed = True
                     p.kill()
+                if self.hub is not None:
+                    self.hub.pump()    # live SLO check during the refit
                 time.sleep(cfg.poll_s)
             rc = int(rc)
             self._emit("refit_exit", drop=idx, attempt=attempt, rc=rc,
@@ -433,9 +468,12 @@ class Autopilot:
         import urllib.request
         url = self.cfg.serve_url.rstrip("/") + path
         data = None if body is None else json.dumps(body).encode()
-        req = urllib.request.Request(
-            url, data=data,
-            headers=({"Content-Type": "application/json"} if data else {}))
+        headers = {"Content-Type": "application/json"} if data else {}
+        # propagate the drop's span to the serving engine: its flip events
+        # (and the first queries on the new epoch) join this trace
+        ctx = self._drop_trace or self.trace
+        headers["X-Hmsc-Trace"] = ctx.header()
+        req = urllib.request.Request(url, data=data, headers=headers)
         with urllib.request.urlopen(req, timeout=30.0) as r:
             return json.loads(r.read().decode())
 
@@ -467,7 +505,8 @@ class Autopilot:
                                    generation=self.engine.generation,
                                    reconcile=True)
                         return
-                    res = self.engine.reload()
+                    res = self.engine.reload(
+                        trace=self._drop_trace or self.trace)
                     ok = (res["epoch"] == target
                           and self.engine.generation == res["generation"])
                 else:

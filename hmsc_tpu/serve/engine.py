@@ -141,6 +141,12 @@ class ServingEngine:
         # only (no event retention), True = in-memory events, a directory
         # = events + JSONL sink
         self.telem = RunTelemetry(proc=0, enabled=bool(telemetry))
+        if telemetry:
+            # a serving process is a top-level entry point: join the
+            # spawning fleet's trace from the env, else mint a root —
+            # every serve event (flips included) links back to it
+            from ..obs.trace import inherit_or_mint
+            self.telem.set_trace(inherit_or_mint())
         if telemetry and not isinstance(telemetry, bool):
             self.telem.attach_sink(events_path(telemetry, 0), truncate=True)
             self.telem.emit("run", "serve_start", buckets=list(self.buckets),
@@ -442,7 +448,8 @@ class ServingEngine:
     # epoch flip
     # ------------------------------------------------------------------
 
-    def reload(self, source=None, *, warmup: bool = True) -> dict:
+    def reload(self, source=None, *, warmup: bool = True,
+               trace=None) -> dict:
         """Hot-reload the served posterior and flip to it atomically.
 
         ``source=None`` re-resolves the engine's ORIGINAL source — for an
@@ -485,10 +492,15 @@ class ServingEngine:
             if source is not None:
                 self._source = source
                 self._hM0 = None
+        # `trace` (a TraceContext parsed from the caller's X-Hmsc-Trace
+        # header) joins this flip to the rollout that requested it
         self.telem.emit("run", "epoch_flip", gen=new.gen,
                         old_epoch=old.epoch, epoch=new.epoch,
                         n_draws=new.n_draws,
-                        shapes_changed=bool(shapes_changed))
+                        shapes_changed=bool(shapes_changed),
+                        **(trace.fields() if trace is not None else {}))
+        if self.telem.has_sink:
+            self.telem.flush()        # flips must be tailable live
         return {"old_epoch": old.epoch, "epoch": new.epoch,
                 "generation": new.gen, "n_draws": new.n_draws,
                 "shapes_changed": bool(shapes_changed),

@@ -57,6 +57,7 @@ def make_server(engine, host: str = "127.0.0.1", port: int = 0):
     import numpy as np
 
     from ..obs.report import serving_prometheus_textfile
+    from ..obs.trace import from_header
 
     class Handler(http.server.BaseHTTPRequestHandler):
         # route access logging through the library logger, not stderr
@@ -92,6 +93,12 @@ def make_server(engine, host: str = "127.0.0.1", port: int = 0):
                 self._send(404, {"error": f"unknown path {self.path!r}"})
 
         def do_POST(self):  # noqa: N802 — BaseHTTP API
+            # cross-process trace correlation: a request carrying an
+            # X-Hmsc-Trace header (e.g. an autopilot-driven flip, or the
+            # first query against a freshly flipped epoch) joins the
+            # caller's trace — its serve events and its response tag the
+            # same trace_id the rollout started with
+            tctx = from_header(self.headers.get("X-Hmsc-Trace") or "")
             try:
                 doc = _json_body(self)
                 if self.path == "/predict":
@@ -117,12 +124,23 @@ def make_server(engine, host: str = "127.0.0.1", port: int = 0):
                 elif self.path == "/flip":
                     self._send(200, engine.reload(
                         doc.get("source"),
-                        warmup=bool(doc.get("warmup", True))))
+                        warmup=bool(doc.get("warmup", True)),
+                        trace=tctx))
                     return
                 else:
                     self._send(404,
                                {"error": f"unknown path {self.path!r}"})
                     return
+                if tctx is not None:
+                    # a traced query leaves an event: the hub links the
+                    # first post-flip query to the rollout's trace
+                    engine.telem.emit(
+                        "metric", "query", path=self.path,
+                        epoch=out.get("epoch"),
+                        generation=out.get("generation"),
+                        **tctx.fields())
+                    if engine.telem.has_sink:
+                        engine.telem.flush()
                 self._send(200, {
                     "mean": np.asarray(out["mean"]).tolist(),
                     "sd": np.asarray(out["sd"]).tolist(),
@@ -135,6 +153,7 @@ def make_server(engine, host: str = "127.0.0.1", port: int = 0):
                     **({"generation": out["generation"],
                         "epoch": out["epoch"]}
                        if "generation" in out else {}),
+                    **({"trace": tctx.trace_id} if tctx is not None else {}),
                 })
             except (KeyError, ValueError, NotImplementedError) as e:
                 self._send(400, {"error": f"{type(e).__name__}: {e}"})

@@ -293,12 +293,21 @@ def _pkg_root() -> str:
         os.path.abspath(__file__))))
 
 
-def worker_env(env: dict | None = None) -> dict:
+def worker_env(env: dict | None = None, *, trace=None) -> dict:
     """The spawn environment every worker runs under: CPU backend,
     single-threaded XLA-CPU eigen, the shared persistent compilation cache
     (each spawned interpreter would otherwise recompile the identical
-    sampling program from scratch), and the package root on PYTHONPATH."""
+    sampling program from scratch), and the package root on PYTHONPATH.
+
+    ``trace`` (a :class:`~hmsc_tpu.obs.trace.TraceContext`) propagates the
+    caller's trace to the child via ``HMSC_TPU_TRACE_CTX`` — the child's
+    sampler inherits it at its run-start mark, so the cross-process event
+    chain joins on one trace id.  With no ``trace``, any context already
+    in ``os.environ`` passes through unchanged (a grandparent's)."""
     base_env = dict(os.environ)
+    if trace is not None:
+        from ..obs.trace import trace_env
+        base_env.update(trace_env(trace))
     base_env["JAX_PLATFORMS"] = "cpu"
     flags = base_env.get("XLA_FLAGS", "")
     if "xla_cpu_multi_thread_eigen" not in flags:

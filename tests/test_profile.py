@@ -298,6 +298,21 @@ def test_prom_gauge_names_pinned():
         "hmsc_tpu_serve_kernel_cache_misses_total",
         "hmsc_tpu_serve_kernel_cache_size",
         "hmsc_tpu_serve_posterior_draws",
+        "hmsc_tpu_watch_streams",
+        "hmsc_tpu_watch_events_total",
+        "hmsc_tpu_watch_active_runs",
+        "hmsc_tpu_watch_draws_per_second",
+        "hmsc_tpu_watch_rank_skew_seconds",
+        "hmsc_tpu_watch_heartbeat_age_seconds",
+        "hmsc_tpu_watch_queue_depth",
+        "hmsc_tpu_watch_occupancy_ratio",
+        "hmsc_tpu_watch_padding_waste_ratio",
+        "hmsc_tpu_watch_epoch_lag",
+        "hmsc_tpu_watch_generation_lag",
+        "hmsc_tpu_watch_flip_latency_seconds",
+        "hmsc_tpu_watch_queue_wait_p99_seconds",
+        "hmsc_tpu_watch_diverged_chains",
+        "hmsc_tpu_watch_alerts_fired_total",
     }
     assert all(n.startswith("hmsc_tpu_") for n in PROM_GAUGES)
     with pytest.raises(ValueError, match="unregistered"):
@@ -306,7 +321,8 @@ def test_prom_gauge_names_pinned():
 
 def test_exporters_emit_only_registered_gauges():
     import re
-    from hmsc_tpu.obs.report import (PROM_GAUGES, prometheus_textfile,
+    from hmsc_tpu.obs.report import (PROM_GAUGES, hub_prometheus_textfile,
+                                     prometheus_textfile,
                                      serving_prometheus_textfile)
     report = {
         "ranks": [0],
@@ -330,9 +346,23 @@ def test_exporters_emit_only_registered_gauges():
     stats = {"spans": {"dispatch": {"count": 1, "total_s": 0.1,
                                     "max_s": 0.1}},
              "requests": 1, "cache": {"hits": 1, "misses": 1, "size": 1}}
+    snap = {
+        "n_streams": 2, "events": 10, "active_runs": 1,
+        "draws_per_s_total": 3.5,
+        "skew": {"last_s": 0.01},
+        "streams": {"a/events-p0.jsonl": {"queue_wait_p99_s": 0.2}},
+        "queue": {"depth": 1, "occupancy": 0.8, "padding_waste": 0.2},
+        "serving": {"epoch_lag": 0, "generation_lag": 0,
+                    "flip_latency_s": {"last": 0.5},
+                    "replicas": {"0": {"queue_wait_p99_s": 0.1}}},
+        "heartbeats": {"hb": {"0": 0.2}},
+        "tenants": {"t1": {"diverged": 0, "n_chains": 2}},
+        "alerts": {"fired": 1, "active": [], "recent": []},
+    }
     names = set()
     for text in (prometheus_textfile(report),
-                 serving_prometheus_textfile(stats)):
+                 serving_prometheus_textfile(stats),
+                 hub_prometheus_textfile(snap)):
         for line in text.splitlines():
             if line.startswith("#") or not line.strip():
                 continue
@@ -341,3 +371,7 @@ def test_exporters_emit_only_registered_gauges():
     # the new cost gauges actually fired in this fixture
     assert {"hmsc_tpu_updater_wall_seconds", "hmsc_tpu_ledger_flops_total",
             "hmsc_tpu_profile_attributed_fraction"} <= names
+    # the hub exporter fired its core + labeled gauges from the snapshot
+    assert {"hmsc_tpu_watch_streams", "hmsc_tpu_watch_queue_depth",
+            "hmsc_tpu_watch_heartbeat_age_seconds",
+            "hmsc_tpu_watch_alerts_fired_total"} <= names

@@ -91,7 +91,7 @@ def test_event_stream_schema_and_ordering(recorded_run):
     # lifecycle: starts with run/start (carrying schema + config), ends
     # with a terminal mark
     assert events[0]["kind"] == "run" and events[0]["name"] == "start"
-    assert events[0]["schema"] == 1
+    assert events[0]["schema"] == 2
     assert events[0]["samples"] == RUN_KW["samples"]
     assert events[0]["n_chains"] == RUN_KW["n_chains"]
     runs = [e["name"] for e in events if e["kind"] == "run"]

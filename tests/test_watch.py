@@ -1,0 +1,630 @@
+"""Mission-control observability suite (hmsc_tpu/obs v2, ISSUE 20):
+trace-context propagation (mint/child/header round trips, env carrier,
+telemetry field injection with byte-unchanged v1 streams when unset), the
+JSONL tailer's exactly-once contract under torn final lines / rotation /
+a live concurrent writer, metrics-hub aggregate folding + snapshot
+schema, the SLO alert engine (rule validation, edge-triggered latching,
+config loading, hub emission as ``kind="alert"`` events), the watch CLI
+and /metrics endpoint, the ``report --json`` schema pin, draw-stream
+bit-identity with tracing active, and the end-to-end acceptance drill:
+one supervised autopilot drop whose whole cycle (validate -> refit
+worker -> epoch commit -> serving flip) assembles into a single-trace
+chain across two processes via the hub."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from hmsc_tpu.obs import (ALERTS_FILE, AlertEngine, AlertRule, JsonlTailer,
+                          MetricsHub, RunTelemetry, TRACE_ENV, TraceContext,
+                          default_rules, events_path, load_rules, trace_env)
+from hmsc_tpu.obs.alerts import KNOWN_RULES
+from hmsc_tpu.obs.hub import render_watch, serve_hub, watch_main
+from hmsc_tpu.obs.trace import current_context, from_header, inherit_or_mint, mint
+
+pytestmark = pytest.mark.watch
+
+
+def _jl(path, *events, mode="a"):
+    with open(path, mode) as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+
+
+# ---------------------------------------------------------------------------
+# trace context: mint / child / header carrier / env propagation
+# ---------------------------------------------------------------------------
+
+def test_trace_mint_child_header():
+    root = mint()
+    assert len(root.trace_id) == 32 and len(root.span_id) == 16
+    assert root.parent_id is None
+    assert mint().trace_id != root.trace_id          # fresh ids every mint
+    child = root.child()
+    assert child.trace_id == root.trace_id           # same trace
+    assert child.span_id != root.span_id
+    assert child.parent_id == root.span_id           # nests under the root
+    # header carries (trace, span); the receiver mints its OWN span whose
+    # parent is the carried span — each process gets a distinct span id
+    ctx = from_header(root.header())
+    assert ctx.trace_id == root.trace_id
+    assert ctx.parent_id == root.span_id
+    assert ctx.span_id not in (root.span_id, child.span_id)
+    # fields() is what telemetry injects
+    assert root.fields() == {"trace": root.trace_id, "span": root.span_id}
+    assert ctx.fields()["parent"] == root.span_id
+
+
+def test_from_header_malformed():
+    for bad in ("", "justone", "a:b:c", ":b", "a:", ":"):
+        assert from_header(bad) is None
+
+
+def test_trace_env_roundtrip():
+    root = mint()
+    env = trace_env(root, {"OTHER": "1"})
+    assert env["OTHER"] == "1" and TRACE_ENV in env
+    got = current_context(env)
+    assert got.trace_id == root.trace_id
+    assert got.parent_id == root.span_id             # child of the sender
+    assert current_context({}) is None
+    # inherit_or_mint: carried env joins the trace, empty env starts one
+    joined = inherit_or_mint(env)
+    assert joined.trace_id == root.trace_id
+    fresh = inherit_or_mint({})
+    assert fresh.trace_id != root.trace_id and fresh.parent_id is None
+
+
+def test_telemetry_trace_injection(tmp_path):
+    p = os.fspath(tmp_path / "events-p0.jsonl")
+    # no context set: schema v2 events carry NO trace fields (v1 readers
+    # see byte-identical payload keys)
+    t = RunTelemetry(proc=0)
+    t.attach_sink(p, truncate=True)
+    t.emit("run", "start", n_chains=2)
+    t.flush()
+    ev = json.loads(open(p).read().splitlines()[0])
+    assert not {"trace", "span", "parent"} & set(ev)
+    # with a context: every event carries trace/span; explicit span=/
+    # parent= kwargs (per-drop child spans) override the injected ones
+    ctx = mint()
+    t.set_trace(ctx)
+    t.emit("metric", "x", v=1)
+    t.emit("pipeline", "drop_seen", span="SPAN", parent="PARENT")
+    t.flush()
+    lines = [json.loads(s) for s in open(p).read().splitlines()]
+    assert lines[1]["trace"] == ctx.trace_id
+    assert lines[1]["span"] == ctx.span_id
+    assert lines[2]["trace"] == ctx.trace_id
+    assert lines[2]["span"] == "SPAN" and lines[2]["parent"] == "PARENT"
+
+
+# ---------------------------------------------------------------------------
+# JSONL tailer: exactly-once under torn tails, rotation, live writers
+# ---------------------------------------------------------------------------
+
+def test_tailer_torn_line_held_back(tmp_path):
+    p = os.fspath(tmp_path / "ev.jsonl")
+    _jl(p, {"i": 0}, {"i": 1})
+    tl = JsonlTailer(p)
+    assert [e["i"] for e in tl.poll()] == [0, 1]
+    assert tl.poll() == []                           # nothing new
+    # a torn final line (no newline yet) must NOT be delivered...
+    with open(p, "a") as f:
+        f.write('{"i": 2')
+        f.flush()
+        assert tl.poll() == []
+        # ...until its newline commits it — then exactly once
+        f.write('}\n')
+        f.flush()
+    assert [e["i"] for e in tl.poll()] == [2]
+    assert tl.n_events == 3 and tl.n_malformed == 0
+    # malformed complete lines are counted, never delivered, never retried
+    with open(p, "a") as f:
+        f.write("not json\n")
+    assert tl.poll() == [] and tl.n_malformed == 1
+    tl.close()
+
+
+def test_tailer_rotation_exactly_once(tmp_path):
+    p = os.fspath(tmp_path / "ev.jsonl")
+    _jl(p, {"i": 0}, {"i": 1})
+    tl = JsonlTailer(p)
+    assert len(tl.poll()) == 2
+    # GC-style rotation: the old inode is renamed away and a fresh file
+    # takes the path; events appended to the old inode BEFORE the swap
+    # must still be seen (drain-then-check), plus the fresh file's
+    _jl(p, {"i": 2})
+    os.replace(p, os.fspath(tmp_path / "ev.jsonl.old"))
+    _jl(p, {"i": 10}, {"i": 11}, mode="w")
+    got = [e["i"] for e in tl.poll()]
+    assert got == [2, 10, 11]
+    # in-place truncation (same inode, shrunk) also re-follows from 0
+    _jl(p, {"i": 20}, mode="w")
+    got = [e["i"] for e in tl.poll()]
+    assert got == [20]
+    assert tl.n_events == 6
+    tl.close()
+
+
+def test_tailer_concurrent_writer_exactly_once(tmp_path):
+    """Satellite: a live writer appending (with deliberately split
+    writes) while the tailer polls — every committed event observed
+    exactly once, no duplicates, no losses, no malformed counts."""
+    p = os.fspath(tmp_path / "ev.jsonl")
+    open(p, "w").close()
+    N = 400
+    done = threading.Event()
+
+    def writer():
+        with open(p, "a") as f:
+            for i in range(N):
+                line = json.dumps({"i": i}) + "\n"
+                cut = (i % 7) + 1                    # torn mid-line flushes
+                f.write(line[:cut])
+                f.flush()
+                f.write(line[cut:])
+                f.flush()
+        done.set()
+
+    th = threading.Thread(target=writer)
+    th.start()
+    tl = JsonlTailer(p)
+    seen = []
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        seen += [e["i"] for e in tl.poll()]
+        if done.is_set() and len(seen) >= N:
+            break
+        time.sleep(0.002)
+    th.join()
+    seen += [e["i"] for e in tl.poll()]
+    assert seen == list(range(N))                    # once each, in order
+    assert tl.n_malformed == 0
+    tl.close()
+
+
+# ---------------------------------------------------------------------------
+# alert rules: validation, config loading, edge-triggered latching
+# ---------------------------------------------------------------------------
+
+def test_alert_rule_validation():
+    with pytest.raises(ValueError, match="unknown alert rule"):
+        AlertRule("not_a_rule", 1.0)
+    assert {r.rule for r in default_rules()} == set(KNOWN_RULES)
+
+
+def test_load_rules(tmp_path):
+    p = tmp_path / "rules.json"
+    p.write_text(json.dumps([
+        {"rule": "heartbeat_gap", "threshold": 2.5, "severity": "warn"},
+        {"rule": "padding_waste", "enabled": False},
+    ]))
+    rules = load_rules(os.fspath(p))
+    assert rules[0].threshold == 2.5 and rules[0].severity == "warn"
+    assert rules[1].enabled is False
+    assert rules[1].threshold == KNOWN_RULES["padding_waste"][0]
+    p.write_text(json.dumps([{"rule": "typo_rule"}]))
+    with pytest.raises(ValueError, match="unknown alert rule"):
+        load_rules(os.fspath(p))
+    p.write_text(json.dumps([{"rule": "rank_skew", "bogus_key": 1}]))
+    with pytest.raises(ValueError, match="unknown keys"):
+        load_rules(os.fspath(p))
+    p.write_text(json.dumps({"rule": "rank_skew"}))
+    with pytest.raises(ValueError, match="JSON list"):
+        load_rules(os.fspath(p))
+
+
+def test_alert_engine_latch_and_rearm():
+    eng = AlertEngine([AlertRule("rank_skew", 1.0, "warn")])
+    hot = {"skew": {"last_s": 3.0}}
+    cold = {"skew": {"last_s": 0.1}}
+    fired = eng.evaluate(hot)
+    assert [a["rule"] for a in fired] == ["rank_skew"]
+    assert fired[0]["value"] == 3.0 and fired[0]["threshold"] == 1.0
+    assert eng.active() == ["rank_skew:fleet"]
+    # latched: the still-true condition does not re-fire every poll
+    assert eng.evaluate(hot) == []
+    # condition clears -> re-arms -> next breach fires again
+    assert eng.evaluate(cold) == [] and eng.active() == []
+    assert [a["rule"] for a in eng.evaluate(hot)] == ["rank_skew"]
+    assert eng.n_fired == 2
+
+
+def test_alert_engine_every_rule_fires():
+    """One snapshot seeded with all seven faults: every known rule must
+    fire at its default threshold (the bench_watch drill's unit twin)."""
+    now = time.time()
+    snap = {
+        "wall": now,
+        "heartbeats": {"hb": {"0": 99.0}},
+        "streams": {
+            "events-p0.jsonl": {
+                "kind": "run", "started": True, "ended": False,
+                "last_progress_wall": now - 300.0, "n_chains": 4,
+                "health": {"diverged_chains": 3},
+                "queue_wait_p99_s": 9.0,
+            },
+        },
+        "tenants": {},
+        "skew": {"last_s": 7.5},
+        "serving": {"replicas": {"0": {"queue_wait_p99_s": 6.0}},
+                    "epoch_lag": 1, "generation_lag": 2},
+        "queue": {"padding_waste": 0.9,
+                  "bucket_waste": {"(6, 2, 4)": 0.8}},
+    }
+    eng = AlertEngine()
+    fired = eng.evaluate(snap)
+    assert {a["rule"] for a in fired} == set(KNOWN_RULES)
+    sevs = {a["rule"]: a["severity"] for a in fired}
+    assert sevs["heartbeat_gap"] == "page"
+    assert sevs["padding_waste"] == "info"
+
+
+# ---------------------------------------------------------------------------
+# metrics hub: discovery + folding + snapshot schema + /metrics endpoint
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def synth_root(tmp_path):
+    """A synthetic watch root exercising every stream kind the hub folds:
+    a rank stream, a tenant fan-out stream, the shared fleet/pipeline
+    stream, and a live heartbeat dir."""
+    root = tmp_path / "watch"
+    (root / "tenant-acme").mkdir(parents=True)
+    (root / "hb").mkdir()
+    _jl(os.fspath(root / "events-p0.jsonl"),
+        {"kind": "run", "name": "start", "proc": 0, "wall": 1000.0,
+         "n_chains": 4, "trace": "t" * 32, "span": "s" * 16},
+        {"kind": "metric", "name": "segment_health", "proc": 0,
+         "wall": 1001.0, "seg": 1, "samples_done": 8, "draws_per_s": 123.5,
+         "diverged_chains": 1, "rhat_max": 1.01, "ess_min": 55.0},
+        {"kind": "metric", "name": "rank_skew", "skew_s": 0.75},
+        {"kind": "span", "name": "queue_wait", "dur_s": 0.25})
+    _jl(os.fspath(root / "tenant-acme" / "events-p0.jsonl"),
+        {"kind": "run", "name": "start", "tenant": "acme", "n_chains": 2,
+         "trace": "t" * 32, "span": "u" * 16, "parent": "s" * 16},
+        {"kind": "metric", "name": "tenant_health", "tenant": "acme",
+         "diverged": 1, "n_chains": 2, "draws_per_s": 10.0,
+         "samples_done": 6, "done": True},
+        {"kind": "run", "name": "end", "ok": True})
+    _jl(os.fspath(root / "fleet-events.jsonl"),
+        {"kind": "fleet", "name": "queue_start", "n_jobs": 3,
+         "n_tenants": 2, "n_buckets": 1},
+        {"kind": "fleet", "name": "job_dispatch"},
+        {"kind": "fleet", "name": "tenant_done", "tenant": "acme"},
+        {"kind": "fleet", "name": "bucket_report", "bucket": "(6, 2)",
+         "padding_waste": 0.4},
+        {"kind": "fleet", "name": "queue_end", "occupancy": 0.8,
+         "padding_waste": 0.6},
+        {"kind": "fleet", "name": "replica_stats", "rank": 0,
+         "generation": 3, "epoch": 2, "requests": 10, "rows_served": 40,
+         "queue_wait_s": 0.5, "queue_wait_n": 10},
+        {"kind": "fleet", "name": "replica_stats", "rank": 1,
+         "generation": 2, "epoch": 1, "requests": 4},
+        {"kind": "fleet", "name": "flip_start", "t": 1.0},
+        {"kind": "fleet", "name": "flip_done", "t": 1.5},
+        {"kind": "pipeline", "name": "epoch_committed", "epoch": 2,
+         "drop": 0},
+        {"kind": "pipeline", "name": "drop_done", "drop": 0})
+    (root / "hb" / "heartbeat-p0.json").write_text('{"beat": 3}')
+    return os.fspath(root)
+
+
+def test_hub_folds_streams(synth_root):
+    hub = MetricsHub(synth_root, evaluate_alerts=False)
+    n = hub.poll()
+    assert n == 18
+    assert hub.poll() == 0                           # incremental: no re-read
+    snap = hub.snapshot()
+    assert snap["n_streams"] == 3 and snap["events"] == 18
+    assert snap["malformed"] == 0
+    # per-rank: the root stream is live, the tenant stream ended
+    st = snap["streams"]["events-p0.jsonl"]
+    assert st["started"] and not st["ended"] and st["n_chains"] == 4
+    assert st["health"]["draws_per_s"] == 123.5
+    assert st["queue_wait_p99_s"] == 0.25
+    assert snap["streams"][os.path.join("tenant-acme",
+                                        "events-p0.jsonl")]["ended"]
+    assert snap["active_runs"] == 1
+    assert snap["draws_per_s_total"] == 123.5
+    assert snap["skew"] == {"last_s": 0.75, "max_s": 0.75}
+    # tenants fold from both tenant_health and the fleet tenant_done
+    t = snap["tenants"]["acme"]
+    assert t["diverged"] == 1 and t["done"] is True
+    # queue: 2 tenants, 1 done -> depth 1; occupancy/waste from queue_end
+    q = snap["queue"]
+    assert (q["jobs"], q["tenants"], q["done"], q["depth"]) == (3, 2, 1, 1)
+    assert q["occupancy"] == 0.8 and q["padding_waste"] == 0.6
+    assert q["bucket_waste"] == {"(6, 2)": 0.4}
+    # serving: replica lag + flip latency from the t-delta
+    sv = snap["serving"]
+    assert sv["epoch_lag"] == 1 and sv["generation_lag"] == 1
+    assert sv["flips"] == 1 and sv["flip_latency_s"]["last"] == 0.5
+    assert sv["replicas"]["0"]["queue_wait_mean_s"] == 0.05
+    # pipeline + heartbeats + trace index
+    assert snap["pipeline"]["epoch"] == 2
+    assert snap["pipeline"]["counts"]["drop_done"] == 1
+    assert list(snap["heartbeats"]) == ["hb"]
+    assert snap["heartbeats"]["hb"]["0"] < 60.0
+    assert snap["traces"]["n"] == 1
+    chain = hub.traces()["t" * 32]
+    assert {e["stream"] for e in chain} == {
+        "events-p0.jsonl", os.path.join("tenant-acme", "events-p0.jsonl")}
+    assert chain[-1]["parent"] == "s" * 16           # tenant nests in root
+    # the text view renders without raising and names the key aggregates
+    text = render_watch(snap)
+    assert "draws/s" in text and "tenants:" in text and "serving:" in text
+    hub.close()
+
+
+def test_hub_incremental_append_and_new_stream(synth_root):
+    hub = MetricsHub(synth_root, evaluate_alerts=False)
+    hub.poll()
+    # appended events fold incrementally; new streams are discovered live
+    _jl(os.path.join(synth_root, "events-p0.jsonl"),
+        {"kind": "metric", "name": "segment_health", "seg": 2,
+         "samples_done": 16, "draws_per_s": 200.0, "diverged_chains": 0})
+    _jl(os.path.join(synth_root, "events-p1.jsonl"),
+        {"kind": "run", "name": "start", "proc": 1, "n_chains": 4})
+    assert hub.poll() == 2
+    snap = hub.snapshot()
+    assert snap["n_streams"] == 4
+    assert snap["streams"]["events-p0.jsonl"]["health"]["seg"] == 2
+    assert snap["active_runs"] == 2
+    hub.close()
+
+
+def test_hub_alert_emission_and_report(tmp_path):
+    """A stalled live stream fires throughput_stall through check_alerts;
+    the alert lands as a ``kind="alert"`` event in alerts.jsonl and the
+    report CLI renders it in its SLO section."""
+    from hmsc_tpu.obs.report import build_report
+    root = tmp_path / "run"
+    root.mkdir()
+    now = time.time()
+    _jl(os.fspath(root / "events-p0.jsonl"),
+        {"kind": "run", "name": "start", "proc": 0, "wall": now - 300.0,
+         "n_chains": 2},
+        {"kind": "metric", "name": "segment_health", "wall": now - 300.0,
+         "samples_done": 4, "draws_per_s": 50.0, "diverged_chains": 0})
+    telem = RunTelemetry(proc=0)
+    telem.attach_sink(os.fspath(root / ALERTS_FILE))
+    hub = MetricsHub(os.fspath(root), alert_telemetry=telem)
+    hub.poll()
+    fired = hub.check_alerts()
+    assert {a["rule"] for a in fired} == {"throughput_stall"}
+    assert fired[0]["subject"] == "events-p0.jsonl"
+    # latched: a second pass does not re-fire
+    assert hub.check_alerts() == []
+    snap = hub.snapshot()
+    assert snap["alerts"]["fired"] == 1
+    assert snap["alerts"]["active"] == ["throughput_stall:events-p0.jsonl"]
+    # the emitted event stream is schema'd like every other
+    evs = [json.loads(s) for s in
+           open(root / ALERTS_FILE).read().splitlines()]
+    assert [e["kind"] for e in evs] == ["alert"]
+    assert evs[0]["rule"] == "throughput_stall"
+    assert evs[0]["value"] > evs[0]["threshold"]
+    # report picks the alerts up from alerts.jsonl under the run dir
+    rep = build_report(os.fspath(root))
+    assert rep["alerts"]["count"] == 1
+    assert "throughput_stall" in rep["alerts"]["by_rule"]
+    hub.close()
+
+
+def test_hub_http_endpoint(synth_root):
+    hub = MetricsHub(synth_root, evaluate_alerts=False)
+    srv = serve_hub(hub, "127.0.0.1", 0)
+    th = threading.Thread(target=srv.serve_forever, daemon=True)
+    th.start()
+    base = f"http://{srv.server_address[0]}:{srv.server_address[1]}"
+    try:
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            prom = r.read().decode()
+        assert "hmsc_tpu_watch_streams 3" in prom
+        assert "hmsc_tpu_watch_queue_depth 1" in prom
+        with urllib.request.urlopen(base + "/snapshot", timeout=10) as r:
+            snap = json.loads(r.read().decode())
+        assert snap["events"] == 18
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            h = json.loads(r.read().decode())
+        assert h["ok"] and h["streams"] == 3
+    finally:
+        srv.shutdown()
+        th.join(timeout=10)
+        hub.close()
+
+
+def test_watch_cli_once_json(synth_root, capsys):
+    assert watch_main([synth_root, "--once", "--json",
+                       "--no-alerts"]) == 0
+    snap = json.loads(capsys.readouterr().out)
+    assert snap["events"] == 18 and snap["n_streams"] == 3
+    assert snap["queue"]["depth"] == 1
+    # single-file root: tail exactly that stream
+    assert watch_main([os.path.join(synth_root, "events-p0.jsonl"),
+                       "--once", "--json", "--no-alerts"]) == 0
+    snap = json.loads(capsys.readouterr().out)
+    assert snap["n_streams"] == 1 and snap["events"] == 4
+
+
+# ---------------------------------------------------------------------------
+# satellite: report --json schema pin (scenarios + fleet/autopilot sections)
+# ---------------------------------------------------------------------------
+
+def test_report_json_schema_pin(tmp_path, capsys):
+    """The structured report's top-level schema is pinned: every section a
+    dashboard keys on (fleet, serve_fleet, pipeline, scenarios, alerts)
+    is present in ``--json`` output, and ``--scenarios --json`` emits the
+    scenario section alone."""
+    from hmsc_tpu.obs.report import build_report, report_main
+    run = tmp_path / "run"
+    run.mkdir()
+    _jl(os.fspath(run / "events-p0.jsonl"),
+        {"kind": "run", "name": "start", "proc": 0, "t": 0.0, "wall": 1.0,
+         "n_chains": 2, "schema": 2},
+        {"kind": "run", "name": "end", "proc": 0, "t": 1.0, "wall": 2.0,
+         "ok": True, "schema": 2})
+    _jl(os.fspath(run / "fleet-events.jsonl"),
+        {"kind": "fleet", "name": "queue_start", "n_jobs": 1,
+         "n_tenants": 1, "n_buckets": 1},
+        {"kind": "fleet", "name": "scenario_done", "scenario": "cv@4",
+         "job": "cv", "rmse": 0.5},
+        {"kind": "fleet", "name": "queue_end", "status": "ok", "n_jobs": 1,
+         "n_tenants": 1, "n_buckets": 1, "wall_s": 2.0},
+        {"kind": "pipeline", "name": "drop_seen", "drop": 0, "file": "d"},
+        {"kind": "alert", "name": "rank_skew", "rule": "rank_skew",
+         "subject": "fleet", "value": 9.0, "threshold": 5.0,
+         "severity": "warn", "wall": 3.0})
+    rep = build_report(os.fspath(run))
+    assert set(rep) == {"run_dir", "ranks", "per_rank", "skew", "fleet",
+                        "serve_fleet", "pipeline", "scenarios", "alerts",
+                        "status"}
+    assert rep["ranks"] == [0]
+    assert rep["scenarios"]["scenarios"][0]["scenario"] == "cv@4"
+    assert rep["scenarios"]["queue"]["status"] == "ok"
+    assert rep["pipeline"]["drops"]
+    assert rep["alerts"]["count"] == 1
+    # --json round trips through the CLI byte-for-byte as JSON
+    assert report_main([os.fspath(run), "--json"]) == 0
+    cli = json.loads(capsys.readouterr().out)
+    assert set(cli) == set(rep) and cli["scenarios"] == rep["scenarios"]
+    # --scenarios --json emits the section alone (parity with the text
+    # verdict view)
+    assert report_main([os.fspath(run), "--scenarios", "--json"]) == 0
+    sec = json.loads(capsys.readouterr().out)
+    assert sec == rep["scenarios"]
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: tracing + a live hub never touch the draw stream
+# ---------------------------------------------------------------------------
+
+def test_draws_bit_identical_with_tracing_and_hub(tmp_path, monkeypatch):
+    from hmsc_tpu.mcmc.sampler import sample_mcmc
+    from hmsc_tpu.testing.multiproc import build_worker_model
+    kw = dict(samples=4, transient=2, n_chains=2, seed=7, nf_cap=2,
+              align_post=False, checkpoint_every=2)
+    m = build_worker_model(ny=16, ns=3, nc=2, distr="probit", n_units=4,
+                          seed=9)
+    # run A: carried trace context + a hub tailing the run dir mid-flight
+    da = os.fspath(tmp_path / "a")
+    monkeypatch.setenv(TRACE_ENV, f"{'a' * 32}:{'b' * 16}")
+    hub = MetricsHub(da, evaluate_alerts=False)
+    post_a = sample_mcmc(m, checkpoint_path=da, **kw)
+    hub.poll()
+    # run B: no trace context, no hub
+    monkeypatch.delenv(TRACE_ENV)
+    db = os.fspath(tmp_path / "b")
+    post_b = sample_mcmc(m, checkpoint_path=db, **kw)
+    assert set(post_a.arrays) == set(post_b.arrays)
+    for k in post_a.arrays:
+        np.testing.assert_array_equal(post_a.arrays[k], post_b.arrays[k],
+                                      err_msg=k)
+    # the carried context reached the sampler's stream: trace id joined,
+    # span parented under the carrier's span
+    evs = [json.loads(s)
+           for s in open(events_path(da, 0)).read().splitlines()]
+    start = next(e for e in evs if e.get("kind") == "run"
+                 and e.get("name") == "start")
+    assert start["trace"] == "a" * 32
+    assert start["parent"] == "b" * 16
+    # run B minted its own fresh trace
+    evs_b = [json.loads(s)
+             for s in open(events_path(db, 0)).read().splitlines()]
+    start_b = next(e for e in evs_b if e.get("kind") == "run"
+                   and e.get("name") == "start")
+    assert start_b["trace"] != "a" * 32 and "parent" not in start_b
+    hub.close()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: one supervised autopilot drop = one cross-process trace
+# ---------------------------------------------------------------------------
+
+def test_autopilot_drop_single_trace_chain(tmp_path):
+    """The ISSUE 20 acceptance drill: an autopilot drop dispatched to a
+    supervised refit WORKER (a second process) leaves one trace_id whose
+    chain — assembled by the hub from two different streams — covers
+    validate -> refit dispatch -> the worker's own sampler events ->
+    epoch commit -> serving flip, with the worker's span parented under
+    the drop's child span."""
+    from hmsc_tpu.mcmc.sampler import sample_mcmc
+    from hmsc_tpu.pipeline import Autopilot, PipelineConfig
+    from hmsc_tpu.serve.engine import ServingEngine
+    from hmsc_tpu.testing.multiproc import build_worker_model
+    from hmsc_tpu.utils.checkpoint import committed_epochs
+
+    model_kw = dict(ny=24, ns=4, nc=2, distr="probit", n_units=6, seed=3)
+    m = build_worker_model(**model_kw)
+    run = os.fspath(tmp_path / "run")
+    sample_mcmc(m, samples=8, transient=4, n_chains=2, seed=1, nf_cap=2,
+                align_post=False, checkpoint_every=4, checkpoint_path=run)
+    drops = os.fspath(tmp_path / "drops")
+    os.makedirs(drops)
+    rng = np.random.default_rng(11)
+    X = np.column_stack([np.ones(4), rng.standard_normal(4)])
+    Y = (rng.standard_normal((4, 4)) > 0).astype(float)
+    units = np.array([f"u{j % 6:02d}" for j in range(4)])
+    np.savez(os.path.join(drops, "drop-000.npz"), Y=Y, X=X,
+             **{"units:lvl": units})
+
+    cfg = PipelineConfig(run_dir=run, drop_dir=drops,
+                         work_dir=os.fspath(tmp_path / "work"),
+                         refit_kw=dict(samples=6, min_sweeps=4,
+                                       max_sweeps=4, probe_every=4, seed=0),
+                         model_kw=model_kw, dispatch="worker", max_drops=1,
+                         poll_s=0.05, heartbeat_timeout_s=30.0)
+    engine = ServingEngine(run, hM=m)
+    ap = Autopilot(cfg, engine=engine, hM0=m)
+    summary = ap.run()
+    engine.close()
+    assert summary["status"] == "ok" and summary["drops_committed"] == 1
+    assert committed_epochs(run) == [0, 1]
+
+    # the daemon attached a hub in-process; assemble independently too
+    hub = MetricsHub(run, evaluate_alerts=False)
+    hub.poll()
+    chains = hub.traces()
+    tid = ap.trace.trace_id
+    assert tid in chains
+    chain = chains[tid]
+    names = {(e["kind"], e["name"]) for e in chain}
+    for want in (("pipeline", "drop_accepted"),
+                 ("pipeline", "refit_dispatch"),
+                 ("pipeline", "epoch_committed"),
+                 ("pipeline", "flip"),
+                 ("pipeline", "drop_done"),
+                 ("run", "start")):
+        assert want in names, f"missing {want} in trace chain"
+    # the chain spans BOTH processes' streams: the daemon's decision log
+    # and the refit worker's own sampler stream(s) under the new epoch
+    streams = {e["stream"] for e in chain}
+    sampler_streams = {s for s in streams
+                       if os.path.basename(s) == "events-p0.jsonl"}
+    assert "fleet-events.jsonl" in streams and sampler_streams
+    # span nesting: drop-cycle events share one child span of the daemon
+    # root; the worker's sampler span is parented under that drop span
+    drop_spans = {e["span"] for e in chain
+                  if e["kind"] == "pipeline"
+                  and e["name"] in ("drop_accepted", "refit_dispatch",
+                                    "epoch_committed", "flip", "drop_done")}
+    assert len(drop_spans) == 1
+    (drop_span,) = drop_spans
+    assert drop_span != ap.trace.span_id
+    for e in chain:
+        if e["kind"] == "pipeline" and e["span"] == drop_span:
+            assert e["parent"] == ap.trace.span_id
+    worker = [e for e in chain if e["stream"] in sampler_streams
+              and e["kind"] == "run" and e["name"] == "start"]
+    assert worker and all(e["parent"] == drop_span for e in worker)
+    # the daemon's own in-process hub saw the same chain live
+    assert ap.hub is not None
+    assert tid in ap.hub.traces()
+    hub.close()
